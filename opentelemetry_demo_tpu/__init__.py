@@ -1,0 +1,29 @@
+"""opentelemetry_demo_tpu — TPU-native streaming-sketch analytics framework.
+
+A ground-up, TPU-first rebuild of the capability surface of the
+OpenTelemetry Astronomy Shop demo (`antimetal/opentelemetry-demo`, mounted
+read-only at /root/reference), centred on the system's north star: a
+streaming anomaly-detection sidecar that consumes the shop's Kafka
+`orders` topic (reference: src/fraud-detection/src/main/kotlin/frauddetection/main.kt:54-69)
+and OTLP span/metric streams (reference: src/otel-collector/otelcol-config.yml:4-143)
+and runs HyperLogLog / Count-Min / EWMA z-score sketch kernels in
+JAX/Pallas on batched span tensors.
+
+Package layout
+--------------
+- ``ops``       pure, stateless sketch kernels (HLL, CMS, EWMA, hashing,
+                fused Pallas) on packed tensor state — the MXU/VPU path.
+- ``models``    the AnomalyDetector "model": multi-window sketch-bank state
+                pytree + a single jitted, donated update step.
+- ``parallel``  device meshes, shard_map sketch-merge collectives (ICI),
+                ring/DCN replay — the distributed backend.
+- ``runtime``   host streaming runtime: tensorization, double-buffered
+                device feed, Kafka/OTLP ingest, checkpoint/resume.
+- ``services``  the Astronomy Shop capability layer (checkout orchestration,
+                cart, currency, payment, …) as in-process services driving
+                realistic span streams for tests and load generation.
+- ``telemetry`` OTel-style span/metric emission and Prometheus export.
+- ``utils``     config (env contract), flagd-style feature flags, helpers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,51 @@
+"""Pure sketch kernels on packed tensor state.
+
+Every kernel here is a stateless function ``state -> state`` or
+``state -> measurement`` with static shapes, safe under ``jax.jit``,
+``jax.vmap`` and ``shard_map``. Sketch states are associative monoids
+(HLL registers merge by elementwise max, CMS tables by elementwise add),
+which is what makes the multi-chip story trivial: shard the span batch,
+sketch locally, merge with one collective.
+"""
+
+from .hashing import fmix32, hash_spans_synthetic, splitmix64_np
+from .hll import (
+    HLL_P,
+    hll_estimate,
+    hll_indices,
+    hll_init,
+    hll_merge,
+    hll_update,
+)
+from .cms import (
+    CMS_DEPTH,
+    CMS_WIDTH,
+    cms_indices,
+    cms_init,
+    cms_merge,
+    cms_query,
+    cms_update,
+)
+from .ewma import ewma_init, ewma_update, segment_stats
+
+__all__ = [
+    "fmix32",
+    "hash_spans_synthetic",
+    "splitmix64_np",
+    "HLL_P",
+    "hll_init",
+    "hll_indices",
+    "hll_update",
+    "hll_estimate",
+    "hll_merge",
+    "CMS_DEPTH",
+    "CMS_WIDTH",
+    "cms_init",
+    "cms_indices",
+    "cms_update",
+    "cms_query",
+    "cms_merge",
+    "ewma_init",
+    "ewma_update",
+    "segment_stats",
+]

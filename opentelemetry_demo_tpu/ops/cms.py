@@ -1,0 +1,103 @@
+"""Count-Min sketch on packed count tensors, TPU-first.
+
+State layout: ``int32[..., D, W]`` — leading window axes, then ``D`` hash
+rows × ``W`` counters. The sketch is *global* with the service id folded
+into the key hash (keys are (service, attribute) pairs hashed together on
+the host / in ``models.detector``): point queries always name a service,
+so folding loses nothing and keeps the scatter one flat 1-D op instead of
+a per-service loop — the shape XLA lowers best on TPU.
+
+Row hashes use the Kirsch–Mitzenmacher construction ``g_i = lo + i·hi``
+(two independent 32-bit hashes generate d pairwise-usable row hashes),
+so the device never needs more than the one 64-bit key hash produced by
+``ops.hashing``.
+
+Monoid algebra: update = scatter-add, merge = elementwise add (``lax.psum``
+over the batch mesh axis on multi-chip), query = min over rows.
+
+Answers BASELINE config #2 ("Count-Min heavy-hitter attrs across all
+services") — the reference system surfaces the same question as Grafana
+top-k panels over spanmetrics
+(/root/reference/src/grafana/provisioning/dashboards/demo/spanmetrics-dashboard.json).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CMS_DEPTH = 4
+# Width 8192: for counts over a 1-60s window of ~thousands of spans the
+# over-estimate bound e·N/W is single-digit counts — negligible against the
+# heavy-hitter thresholds we flag on. int32[4, 8192] = 128 KiB per window.
+CMS_WIDTH = 8192
+
+
+def cms_init(
+    depth: int = CMS_DEPTH, width: int = CMS_WIDTH, leading: tuple[int, ...] = ()
+) -> jnp.ndarray:
+    """Zeroed count table ``int32[*leading, depth, width]``."""
+    return jnp.zeros((*leading, depth, width), dtype=jnp.int32)
+
+
+def cms_indices(
+    hash_hi: jnp.ndarray,
+    hash_lo: jnp.ndarray,
+    depth: int = CMS_DEPTH,
+    width: int = CMS_WIDTH,
+) -> jnp.ndarray:
+    """Row indices ``int32[depth, B]`` via Kirsch–Mitzenmacher.
+
+    ``width`` must be a power of two so the modulo is a mask (VPU and-op,
+    no integer division anywhere on device).
+    """
+    assert width & (width - 1) == 0, "CMS width must be a power of two"
+    hi = hash_hi.astype(jnp.uint32)
+    lo = hash_lo.astype(jnp.uint32)
+    rows = []
+    for i in range(depth):
+        g = lo + jnp.uint32(i) * hi  # wrapping uint32 arithmetic
+        rows.append((g & jnp.uint32(width - 1)).astype(jnp.int32))
+    return jnp.stack(rows, axis=0)
+
+
+def cms_update(
+    table: jnp.ndarray,
+    idx: jnp.ndarray,
+    weight: jnp.ndarray | None = None,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Scatter-add a batch into ``table[D, W]``.
+
+    ``idx`` is ``[D, B]`` from :func:`cms_indices`. Invalid lanes add 0
+    (the monoid identity) so batches stay fixed-width. One flat scatter of
+    D·B elements.
+    """
+    d, w = table.shape[-2], table.shape[-1]
+    b = idx.shape[-1]
+    if weight is None:
+        weight = jnp.ones((b,), dtype=table.dtype)
+    weight = jnp.broadcast_to(weight.astype(table.dtype), (d, b))
+    if valid is not None:
+        weight = jnp.where(valid[None, :], weight, 0)
+    row_offset = jnp.arange(d, dtype=jnp.int32)[:, None] * w
+    flat_idx = (idx + row_offset).reshape(-1)
+    flat = table.reshape(*table.shape[:-2], d * w)
+    flat = flat.at[..., flat_idx].add(weight.reshape(-1), mode="drop")
+    return flat.reshape(table.shape)
+
+
+def cms_query(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Point-query counts for a batch: ``min`` over the D rows.
+
+    Returns ``int32[..., B]`` for ``table[..., D, W]`` and ``idx[D, B]``.
+    Gathers vectorise over leading window axes.
+    """
+    gathered = jnp.take_along_axis(
+        table, jnp.broadcast_to(idx, (*table.shape[:-2], *idx.shape)), axis=-1
+    )
+    return jnp.min(gathered, axis=-2)
+
+
+def cms_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """CMS union: tables merge by elementwise addition (exact)."""
+    return a + b

@@ -1,0 +1,96 @@
+"""EWMA mean/variance tracking and z-scores, vectorised over keyed axes.
+
+This is the detection head (BASELINE config #1: "EWMA z-score on
+checkoutservice span latency"). State is a pair of ``float32[..., S, T]``
+tensors (mean, var) for S services × T timescales; each update folds one
+batch observation per service into all timescales at once.
+
+Timescales replace tumbling windows for the latency/error-rate signals:
+an EWMA with time constant τ *is* a continuously-sliding window of width
+≈τ, with none of the reset discontinuities — ideal for <100 ms detection
+lag because every batch moves the estimate. (Distinct-count signals can't
+be EWMA'd that way — cardinality is not an average — so HLL banks keep
+real tumbling windows; see ``models.windows``.)
+
+The per-service batch reduction is a one-hot matmul (``segment_stats``):
+(B,S) one-hot against the value vector rides the MXU, turning the only
+"segmented" operation in the hot path into dense linear algebra — the
+TPU-first answer to what a CUDA build would do with atomics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ewma_init(num_keys: int, num_scales: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed (mean, var) state ``float32[num_keys, num_scales]``."""
+    shape = (num_keys, num_scales)
+    return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+
+def segment_stats(
+    values: jnp.ndarray,
+    seg: jnp.ndarray,
+    num_segments: int,
+    valid: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-segment (count, sum, sum-of-squares) via one-hot matmul.
+
+    ``values: float32[B]``, ``seg: int32[B]`` → three ``float32[S]``.
+    The (B,S) one-hot is built with a broadcasted-iota compare (no 1-D
+    iota — TPU constraint) and contracted on the MXU with
+    ``preferred_element_type=float32``.
+    """
+    b = values.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (b, num_segments), 1)
+    onehot = (col == seg.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    if valid is not None:
+        onehot = onehot * valid.astype(jnp.float32)[:, None]
+    values = values.astype(jnp.float32)
+    stacked = jnp.stack(
+        [jnp.ones_like(values), values, values * values], axis=0
+    )  # [3, B]
+    out = jnp.dot(stacked, onehot, preferred_element_type=jnp.float32)  # [3, S]
+    return out[0], out[1], out[2]
+
+
+def ewma_update(
+    mean: jnp.ndarray,
+    var: jnp.ndarray,
+    x: jnp.ndarray,
+    alpha: jnp.ndarray,
+    observed: jnp.ndarray | None = None,
+    warmup: jnp.ndarray | None = None,
+    eps: float = 1e-6,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One EWMA step; returns (mean', var', z).
+
+    ``x`` broadcasts against ``mean``/``var`` (typically ``[S, 1]`` vs
+    ``[S, T]``). ``alpha`` is the per-timescale smoothing weight,
+    ``1 - exp(-dt/τ)`` for batch gap ``dt`` — passed in by the caller so
+    the kernel stays shape-static while the cadence varies.
+
+    The z-score is computed against the *prior* state (the anomaly question
+    is "is this batch surprising given history so far"), then the state
+    absorbs the observation: West's incremental update
+    ``var' = (1-α)(var + α·δ²)``.
+
+    ``observed`` masks keys with no data this batch (state frozen, z=0).
+    ``warmup`` (same shape semantics) suppresses z until a key has seen
+    enough history to make "surprise" meaningful.
+    """
+    x = x.astype(jnp.float32)
+    delta = x - mean
+    z = delta / jnp.sqrt(var + eps)
+    new_mean = mean + alpha * delta
+    new_var = (1.0 - alpha) * (var + alpha * delta * delta)
+    if observed is not None:
+        obs = observed.astype(jnp.bool_)
+        new_mean = jnp.where(obs, new_mean, mean)
+        new_var = jnp.where(obs, new_var, var)
+        z = jnp.where(obs, z, 0.0)
+    if warmup is not None:
+        z = jnp.where(warmup.astype(jnp.bool_), 0.0, z)
+    return new_mean, new_var, z

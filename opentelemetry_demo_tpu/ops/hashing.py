@@ -1,0 +1,100 @@
+"""Hashing primitives for sketch keys, host-side (NumPy) and device-side (JAX).
+
+Design: all sketch kernels consume a single 64-bit hash per key, carried as
+two ``uint32`` lanes ``(hi, lo)``. TPUs have no native 64-bit integer path
+(and we deliberately avoid ``jax_enable_x64``), so the 64-bit hash is either
+
+- computed on the **host** with vectorised NumPy ``uint64`` splitmix64
+  (the real ingest path — trace-ids arrive as 16 raw bytes, attribute
+  strings are interned/CRC'd; see ``runtime.tensorize``), or
+- synthesised on **device** from counters with two independent murmur3
+  fmix32 finalisers (the benchmark path, so throughput benchmarks measure
+  sketch updates, not host→device transfer).
+
+HLL needs (index bits ⊥ rank bits) and CMS derives its ``d`` row hashes via
+the Kirsch–Mitzenmacher construction ``g_i = lo + i*hi``, so one 64-bit
+hash per key serves every sketch.
+
+Reference parity note: the reference system keys everything by OTel
+trace/span ids (16/8 random bytes, e.g. produced by the Go SDK used in
+/root/reference/src/checkout/main.go:92-106) — random ids are already
+uniform, but we re-hash through splitmix64 so that adversarial or
+low-entropy keys (attribute strings) are safe too.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_SPLIT_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SPLIT_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SPLIT_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorised splitmix64 over a ``uint64`` NumPy array (host path).
+
+    Wrapping arithmetic is numpy's native behaviour for unsigned dtypes, so
+    this runs at memory bandwidth on the host — it is the scalariser-free
+    hash for the 200k spans/sec ingest target (BASELINE north_star).
+    """
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x += _SPLIT_GAMMA
+        z = x.copy()
+        z ^= z >> np.uint64(30)
+        z *= _SPLIT_M1
+        z ^= z >> np.uint64(27)
+        z *= _SPLIT_M2
+        z ^= z >> np.uint64(31)
+    return z
+
+
+def split_hi_lo_np(h64: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split host uint64 hashes into device-friendly ``(hi, lo)`` uint32."""
+    hi = (h64 >> np.uint64(32)).astype(np.uint32)
+    lo = (h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3 32-bit finaliser (device path, uint32 lanes, VPU-only)."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_u32_pair(x: jnp.ndarray, seed: int = 0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Expand uint32 keys into a pseudo-64-bit hash as two uint32 lanes.
+
+    Two fmix32 passes with distinct seeds give two independent 32-bit
+    hashes — exactly what HLL (index ⊥ rank) and Kirsch–Mitzenmacher CMS
+    rows require.
+    """
+    x = x.astype(jnp.uint32)
+    hi = fmix32(x ^ jnp.uint32(0x9E3779B9 + seed))
+    lo = fmix32(x ^ jnp.uint32(0x85EBCA77 + 2 * seed))
+    return hi, lo
+
+
+def hash_spans_synthetic(
+    start: jnp.ndarray, batch: int, seed: int = 0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side synthetic span-key generator for benchmarks.
+
+    Produces ``batch`` hash pairs for the counter range
+    ``[start, start+batch)`` entirely on device, so benchmark loops never
+    touch the host. ``start`` may be a traced scalar.
+    """
+    # TPU requires >=1D iota; broadcasted_iota over a (batch, 1) frame.
+    import jax
+
+    ctr = jax.lax.broadcasted_iota(jnp.uint32, (batch, 1), 0).squeeze(-1)
+    x = ctr + jnp.uint32(start)
+    return hash_u32_pair(x, seed=seed)

@@ -1,0 +1,120 @@
+"""HyperLogLog on packed register tensors, TPU-first.
+
+State layout: ``int32[..., S, R]`` — any number of leading window/bank
+axes, then a *keyed* axis ``S`` (one sub-sketch per service, mirroring the
+per-service cardinality question the reference system answers with Jaeger
+queries over trace ids; see SURVEY.md §2.3 and BASELINE config #3
+"HyperLogLog distinct trace_id per service") and ``R = 2**p`` registers.
+
+Registers hold the HLL rank (leading-zero count + 1) of the best hash seen
+per bucket; ``int32`` rather than ``uint8`` because TPU vector lanes are
+32-bit anyway and int32 scatter-max lowers cleanly; HBM cost is trivial
+(S=64, p=12 → 1 MiB per bank).
+
+Everything here is monoid algebra:
+- update  = elementwise max of scattered ranks,
+- merge   = elementwise max across shards (``lax.pmax`` over the batch
+  mesh axis — the ICI collective; see ``parallel.merge``),
+- query   = the classic bias-corrected harmonic estimator.
+
+No data-dependent shapes: invalid lanes are masked to rank 0, which is the
+monoid identity, so fixed-width batches need no compaction.
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+
+# p=12 → 4096 registers, standard error 1.04/sqrt(4096) ≈ 1.6% — plenty for
+# anomaly *detection* (we look for multi-sigma cardinality swings, not
+# billing-grade counts), and small enough that a full multi-window bank of
+# per-service sketches stays VMEM-resident for the fused Pallas kernel.
+HLL_P = 12
+
+
+def hll_init(num_keys: int, p: int = HLL_P, leading: tuple[int, ...] = ()) -> jnp.ndarray:
+    """Zeroed register bank ``int32[*leading, num_keys, 2**p]``."""
+    return jnp.zeros((*leading, num_keys, 1 << p), dtype=jnp.int32)
+
+
+def hll_indices(
+    hash_hi: jnp.ndarray, hash_lo: jnp.ndarray, p: int = HLL_P
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Split 64-bit hashes (as uint32 hi/lo lanes) into (bucket, rank).
+
+    Bucket = low ``p`` bits of ``lo``. Rank = leading-zero count of the
+    remaining 64-p bits + 1 (range [1, 65-p]); computed with ``lax.clz``
+    on the two 32-bit lanes of ``w = h64 >> p`` — no 64-bit integers
+    anywhere, so this maps directly onto TPU VPU ops.
+    """
+    hash_hi = hash_hi.astype(jnp.uint32)
+    hash_lo = hash_lo.astype(jnp.uint32)
+    r_mask = jnp.uint32((1 << p) - 1)
+    bucket = (hash_lo & r_mask).astype(jnp.int32)
+
+    # w = h64 >> p, in two lanes. w_hi has (32-p) significant bits.
+    w_lo = (hash_lo >> p) | (hash_hi << (32 - p))
+    w_hi = hash_hi >> p
+
+    clz_hi = jax.lax.clz(w_hi).astype(jnp.int32)  # 32 when w_hi == 0
+    clz_lo = jax.lax.clz(w_lo).astype(jnp.int32)
+    # Leading zeros of w within its (64-p)-bit frame.
+    lz = jnp.where(w_hi != 0, clz_hi - p, (32 - p) + clz_lo)
+    rank = lz + 1
+    return bucket, rank
+
+
+def hll_update(
+    regs: jnp.ndarray,
+    key: jnp.ndarray,
+    bucket: jnp.ndarray,
+    rank: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Scatter-max a batch of (key, bucket, rank) into ``regs[S, R]``.
+
+    ``key`` is the sub-sketch selector (service id). Invalid lanes are
+    masked to rank 0 — the max-monoid identity — so the scatter is always
+    full-width and shape-static. Flattening (key, bucket) into one index
+    lets XLA emit a single 1-D scatter-max, the cheapest scatter form on
+    TPU.
+    """
+    s, r = regs.shape[-2], regs.shape[-1]
+    rank = rank.astype(jnp.int32)
+    if valid is not None:
+        rank = jnp.where(valid, rank, 0)
+    flat_idx = key.astype(jnp.int32) * r + bucket.astype(jnp.int32)
+    flat = regs.reshape(*regs.shape[:-2], s * r)
+    flat = flat.at[..., flat_idx].max(rank, mode="drop")
+    return flat.reshape(regs.shape)
+
+
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """HLL union: registers merge by elementwise max (exact, order-free)."""
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def hll_estimate(regs: jnp.ndarray) -> jnp.ndarray:
+    """Bias-corrected cardinality estimate over the last axis.
+
+    Standard Flajolet et al. HLL estimator with the small-range
+    linear-counting correction; the large-range correction is unnecessary
+    with 64-bit hashes. Vectorises over all leading axes (windows ×
+    services) in one fused VPU pass — querying the full sketch bank every
+    step is cheap enough to feed the cardinality EWMA each batch.
+    ``m`` comes from the register axis itself, so banks of any precision
+    query correctly without plumbing ``p``.
+    """
+    m = jnp.float32(regs.shape[-1])
+    regs_f = regs.astype(jnp.float32)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv_sum = jnp.sum(jnp.exp2(-regs_f), axis=-1)
+    raw = alpha * m * m / inv_sum
+    zeros = jnp.sum((regs == 0).astype(jnp.float32), axis=-1)
+    # Linear counting when raw <= 2.5m and empty registers exist.
+    lc = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_lc = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_lc, lc, raw)

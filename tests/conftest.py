@@ -1,0 +1,35 @@
+"""Test harness: JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; per the build contract the
+sharding layer is validated on ``--xla_force_host_platform_device_count=8``
+CPU devices (the driver separately dry-run-compiles the multi-chip path via
+``__graft_entry__.dryrun_multichip``). Tests must never touch the real
+tunneled TPU: the session interpreter registers the remote-TPU PJRT plugin
+from sitecustomize at startup (before conftest), imports jax then, and
+snapshots ``jax_platforms`` from the environment — so neither setting
+``JAX_PLATFORMS`` here nor popping the plugin factory helps. The reliable
+override is ``jax.config.update("jax_platforms", "cpu")`` before any
+backend is initialized; ``XLA_FLAGS`` is still read lazily at first CPU
+client creation, so the virtual device count can be set here too.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,79 @@
+"""Plain-Python/NumPy reference implementations of the sketch algorithms.
+
+These are the ground truth the JAX/Pallas kernels are property-tested
+against (BASELINE config #1 calls for a "CPU NumPy ref"). They use
+arbitrary-precision Python ints and dicts — slow, obvious, and independent
+of the device code's bit tricks.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class HLLRef:
+    """Reference HyperLogLog over 64-bit integer hashes."""
+
+    def __init__(self, p: int):
+        self.p = p
+        self.m = 1 << p
+        self.regs = [0] * self.m
+
+    def add_hash(self, h64: int) -> None:
+        bucket = h64 & (self.m - 1)
+        w = h64 >> self.p
+        width = 64 - self.p
+        if w == 0:
+            rank = width + 1
+        else:
+            rank = width - w.bit_length() + 1
+        self.regs[bucket] = max(self.regs[bucket], rank)
+
+    def estimate(self) -> float:
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        inv_sum = sum(2.0 ** (-r) for r in self.regs)
+        raw = alpha * m * m / inv_sum
+        zeros = self.regs.count(0)
+        if raw <= 2.5 * m and zeros > 0:
+            return m * math.log(m / zeros)
+        return raw
+
+
+class CMSRef:
+    """Reference Count-Min sketch using the same Kirsch–Mitzenmacher rows."""
+
+    def __init__(self, depth: int, width: int):
+        self.depth = depth
+        self.width = width
+        self.table = np.zeros((depth, width), dtype=np.int64)
+        self.true_counts: dict[int, int] = {}
+
+    def _rows(self, h64: int) -> list[int]:
+        hi = (h64 >> 32) & 0xFFFFFFFF
+        lo = h64 & 0xFFFFFFFF
+        return [((lo + i * hi) & 0xFFFFFFFF) & (self.width - 1) for i in range(self.depth)]
+
+    def add_hash(self, h64: int, w: int = 1) -> None:
+        for i, idx in enumerate(self._rows(h64)):
+            self.table[i, idx] += w
+        self.true_counts[h64] = self.true_counts.get(h64, 0) + w
+
+    def query_hash(self, h64: int) -> int:
+        return int(min(self.table[i, idx] for i, idx in enumerate(self._rows(h64))))
+
+
+def ewma_ref(xs: list[float], alpha: float) -> tuple[list[float], list[float], list[float]]:
+    """Scalar EWMA mean/var/z trace for a sequence of observations."""
+    mean, var = 0.0, 0.0
+    means, vars_, zs = [], [], []
+    for x in xs:
+        delta = x - mean
+        zs.append(delta / math.sqrt(var + 1e-6))
+        mean = mean + alpha * delta
+        var = (1.0 - alpha) * (var + alpha * delta * delta)
+        means.append(mean)
+        vars_.append(var)
+    return means, vars_, zs

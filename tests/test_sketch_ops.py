@@ -1,0 +1,223 @@
+"""Property tests: JAX sketch kernels vs NumPy/Python references.
+
+BASELINE configs #1–#3: the sketch math is pure-functional and must match
+independent reference implementations bit-for-bit (registers/counts) and
+statistically (estimates vs true cardinalities).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opentelemetry_demo_tpu.ops import (
+    cms_indices,
+    cms_init,
+    cms_merge,
+    cms_query,
+    cms_update,
+    ewma_init,
+    ewma_update,
+    hll_estimate,
+    hll_indices,
+    hll_init,
+    hll_merge,
+    hll_update,
+    segment_stats,
+    splitmix64_np,
+)
+from opentelemetry_demo_tpu.ops.hashing import split_hi_lo_np
+
+from .references import CMSRef, HLLRef, ewma_ref
+
+P = 12
+DEPTH, WIDTH = 4, 1 << 13
+
+
+def _hashes(rng, n):
+    h64 = splitmix64_np(rng.integers(0, 2**63, size=n, dtype=np.uint64))
+    hi, lo = split_hi_lo_np(h64)
+    return h64, jnp.asarray(hi), jnp.asarray(lo)
+
+
+class TestHLL:
+    def test_registers_match_reference(self, rng):
+        h64, hi, lo = _hashes(rng, 5000)
+        ref = HLLRef(P)
+        for h in h64.tolist():
+            ref.add_hash(h)
+
+        bucket, rank = hll_indices(hi, lo, p=P)
+        regs = hll_init(1, p=P)
+        regs = hll_update(regs, jnp.zeros(5000, jnp.int32), bucket, rank)
+        np.testing.assert_array_equal(np.asarray(regs[0]), np.asarray(ref.regs))
+
+    def test_estimate_matches_reference_formula(self, rng):
+        h64, hi, lo = _hashes(rng, 20000)
+        ref = HLLRef(P)
+        for h in h64.tolist():
+            ref.add_hash(h)
+        bucket, rank = hll_indices(hi, lo, p=P)
+        regs = hll_update(hll_init(1, p=P), jnp.zeros(20000, jnp.int32), bucket, rank)
+        est = float(hll_estimate(regs)[0])
+        assert est == pytest.approx(ref.estimate(), rel=1e-5)
+
+    @pytest.mark.parametrize("true_n", [100, 5000, 200_000])
+    def test_estimate_accuracy(self, rng, true_n):
+        # Distinct keys, possibly repeated: cardinality must track true_n.
+        keys = rng.integers(0, true_n, size=max(true_n * 2, 1000), dtype=np.uint64)
+        h64 = splitmix64_np(keys)
+        hi, lo = split_hi_lo_np(h64)
+        bucket, rank = hll_indices(jnp.asarray(hi), jnp.asarray(lo), p=P)
+        regs = hll_update(
+            hll_init(1, p=P), jnp.zeros(len(keys), jnp.int32), bucket, rank
+        )
+        est = float(hll_estimate(regs)[0])
+        true_card = len(np.unique(keys))
+        # 1.04/sqrt(4096) ≈ 1.6% std error; allow 5 sigma.
+        assert abs(est - true_card) / true_card < 0.08
+
+    def test_keyed_update_isolates_services(self, rng):
+        h64, hi, lo = _hashes(rng, 4000)
+        svc = jnp.asarray(rng.integers(0, 4, size=4000), jnp.int32)
+        bucket, rank = hll_indices(hi, lo, p=P)
+        regs = hll_update(hll_init(8, p=P), svc, bucket, rank)
+        # Services 4..7 saw nothing.
+        assert int(jnp.sum(regs[4:])) == 0
+        ests = hll_estimate(regs)
+        for s in range(4):
+            true_card = int(np.sum(np.asarray(svc) == s))
+            assert abs(float(ests[s]) - true_card) / true_card < 0.1
+
+    def test_merge_equals_union(self, rng):
+        h64a, hia, loa = _hashes(rng, 3000)
+        h64b, hib, lob = _hashes(rng, 3000)
+        za = jnp.zeros(3000, jnp.int32)
+        ba, ra = hll_indices(hia, loa, p=P)
+        bb, rb = hll_indices(hib, lob, p=P)
+        regs_a = hll_update(hll_init(1, p=P), za, ba, ra)
+        regs_b = hll_update(hll_init(1, p=P), za, bb, rb)
+        merged = hll_merge(regs_a, regs_b)
+        both = hll_update(regs_a, za, bb, rb)
+        np.testing.assert_array_equal(np.asarray(merged), np.asarray(both))
+
+    def test_valid_mask_is_identity(self, rng):
+        h64, hi, lo = _hashes(rng, 1000)
+        bucket, rank = hll_indices(hi, lo, p=P)
+        svc = jnp.zeros(1000, jnp.int32)
+        valid = jnp.asarray(rng.integers(0, 2, size=1000).astype(bool))
+        regs = hll_update(hll_init(1, p=P), svc, bucket, rank, valid=valid)
+        ref = HLLRef(P)
+        for h, v in zip(h64.tolist(), np.asarray(valid).tolist()):
+            if v:
+                ref.add_hash(h)
+        np.testing.assert_array_equal(np.asarray(regs[0]), np.asarray(ref.regs))
+
+
+class TestCMS:
+    def test_counts_match_reference(self, rng):
+        # Zipf-ish key distribution: heavy hitters + long tail.
+        keys = rng.zipf(1.3, size=8000).astype(np.uint64) % 500
+        h64 = splitmix64_np(keys)
+        hi, lo = split_hi_lo_np(h64)
+        ref = CMSRef(DEPTH, WIDTH)
+        for h in h64.tolist():
+            ref.add_hash(h)
+
+        idx = cms_indices(jnp.asarray(hi), jnp.asarray(lo), DEPTH, WIDTH)
+        table = cms_update(cms_init(DEPTH, WIDTH), idx)
+        np.testing.assert_array_equal(
+            np.asarray(table), ref.table.astype(np.int32)
+        )
+        got = np.asarray(cms_query(table, idx))
+        want = np.array([ref.query_hash(h) for h in h64.tolist()])
+        np.testing.assert_array_equal(got, want)
+
+    def test_query_overestimates_only(self, rng):
+        keys = rng.integers(0, 2000, size=10000, dtype=np.uint64)
+        h64 = splitmix64_np(keys)
+        hi, lo = split_hi_lo_np(h64)
+        idx = cms_indices(jnp.asarray(hi), jnp.asarray(lo), DEPTH, WIDTH)
+        table = cms_update(cms_init(DEPTH, WIDTH), idx)
+        uniq, counts = np.unique(h64, return_counts=True)
+        uhi, ulo = split_hi_lo_np(uniq)
+        uidx = cms_indices(jnp.asarray(uhi), jnp.asarray(ulo), DEPTH, WIDTH)
+        est = np.asarray(cms_query(table, uidx))
+        assert np.all(est >= counts)
+        # e/W error bound: overshoot ≤ e·N/W with prob 1-exp(-D); generous 10x slack.
+        assert np.all(est - counts <= 10 * np.e * 10000 / WIDTH + 5)
+
+    def test_merge_equals_combined_stream(self, rng):
+        h64, hi, lo = _hashes(rng, 4000)
+        idx = cms_indices(hi, lo, DEPTH, WIDTH)
+        t_a = cms_update(cms_init(DEPTH, WIDTH), idx[:, :2000])
+        t_b = cms_update(cms_init(DEPTH, WIDTH), idx[:, 2000:])
+        t_all = cms_update(cms_init(DEPTH, WIDTH), idx)
+        np.testing.assert_array_equal(
+            np.asarray(cms_merge(t_a, t_b)), np.asarray(t_all)
+        )
+
+    def test_weights_and_mask(self, rng):
+        h64, hi, lo = _hashes(rng, 100)
+        idx = cms_indices(hi, lo, DEPTH, WIDTH)
+        w = jnp.asarray(rng.integers(1, 5, size=100), jnp.int32)
+        valid = jnp.asarray(rng.integers(0, 2, size=100).astype(bool))
+        table = cms_update(cms_init(DEPTH, WIDTH), idx, weight=w, valid=valid)
+        ref = CMSRef(DEPTH, WIDTH)
+        for h, wi, v in zip(h64.tolist(), np.asarray(w).tolist(), np.asarray(valid).tolist()):
+            if v:
+                ref.add_hash(h, wi)
+        np.testing.assert_array_equal(np.asarray(table), ref.table.astype(np.int32))
+
+
+class TestEWMA:
+    def test_scalar_trace_matches_reference(self, rng):
+        xs = rng.normal(100.0, 10.0, size=200).tolist()
+        alpha = 0.2
+        means, vars_, zs = ewma_ref(xs, alpha)
+        mean, var = ewma_init(1, 1)
+        got_z = []
+        for x in xs:
+            mean, var, z = ewma_update(
+                mean, var, jnp.full((1, 1), x), jnp.float32(alpha)
+            )
+            got_z.append(float(z[0, 0]))
+        assert float(mean[0, 0]) == pytest.approx(means[-1], rel=1e-4)
+        assert float(var[0, 0]) == pytest.approx(vars_[-1], rel=1e-3)
+        np.testing.assert_allclose(got_z, zs, rtol=1e-3, atol=1e-4)
+
+    def test_shift_detection(self, rng):
+        """A 5x latency shift must push |z| well past threshold."""
+        mean, var = ewma_init(1, 1)
+        alpha = jnp.float32(0.1)
+        for _ in range(100):
+            x = jnp.full((1, 1), float(rng.normal(100.0, 5.0)))
+            mean, var, z = ewma_update(mean, var, x, alpha)
+        assert abs(float(z[0, 0])) < 4.0
+        mean, var, z = ewma_update(mean, var, jnp.full((1, 1), 500.0), alpha)
+        assert float(z[0, 0]) > 10.0
+
+    def test_observed_mask_freezes_state(self):
+        mean, var = ewma_init(2, 1)
+        mean = mean + 7.0
+        obs = jnp.asarray([[True], [False]])
+        m2, v2, z = ewma_update(
+            mean, var, jnp.asarray([[10.0], [99.0]]), jnp.float32(0.5), observed=obs
+        )
+        assert float(m2[0, 0]) == pytest.approx(8.5)
+        assert float(m2[1, 0]) == pytest.approx(7.0)
+        assert float(z[1, 0]) == 0.0
+
+    def test_segment_stats_matches_numpy(self, rng):
+        vals = rng.normal(50, 10, size=512).astype(np.float32)
+        seg = rng.integers(0, 8, size=512)
+        valid = rng.integers(0, 2, size=512).astype(bool)
+        cnt, s, ss = segment_stats(
+            jnp.asarray(vals), jnp.asarray(seg, dtype=jnp.int32), 8,
+            valid=jnp.asarray(valid),
+        )
+        for k in range(8):
+            m = (seg == k) & valid
+            assert float(cnt[k]) == pytest.approx(m.sum())
+            assert float(s[k]) == pytest.approx(vals[m].sum(), rel=1e-5)
+            assert float(ss[k]) == pytest.approx((vals[m] ** 2).sum(), rel=1e-5)
