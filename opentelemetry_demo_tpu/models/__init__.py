@@ -1,0 +1,28 @@
+"""Model layer: the streaming anomaly detector.
+
+The "model" of this framework is not a neural net — it is a bank of
+mergeable sketch states plus EWMA detection heads, advanced by a single
+jitted, donated update step (``detector.step``). Like a training step,
+it is: pure function, static shapes, state pytree in → state pytree out,
+one compile, collective-friendly.
+"""
+
+from .detector import (
+    AnomalyDetector,
+    DetectorConfig,
+    DetectorReport,
+    DetectorState,
+    detector_init,
+    detector_step,
+)
+from .windows import WindowClock
+
+__all__ = [
+    "AnomalyDetector",
+    "DetectorConfig",
+    "DetectorReport",
+    "DetectorState",
+    "detector_init",
+    "detector_step",
+    "WindowClock",
+]

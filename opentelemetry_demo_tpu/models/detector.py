@@ -1,0 +1,352 @@
+"""The streaming anomaly detector: multi-window sketch bank + z-score heads.
+
+This is the framework's flagship model — the TPU answer to the question
+the reference system leaves to humans staring at Grafana
+(/root/reference/src/grafana/provisioning/dashboards/demo/demo-dashboard.json):
+"which service just went weird, on which signal?". It consumes the span
+stream the shop emits (Kafka ``orders`` + OTLP; SURVEY.md §3.2) and flags,
+per service:
+
+- **latency** anomalies — EWMA z-score on span duration at 3 timescales
+  (catches paymentFailure / imageSlowLoad-style degradations),
+- **error-rate** anomalies — EWMA z-score on status-error fraction
+  (catches adFailure / productCatalogFailure-style fault flags),
+- **throughput** anomalies — EWMA z-score on spans/sec
+  (catches kafkaQueueProblems / loadGeneratorFloodHomepage floods),
+- **cardinality** anomalies — EWMA z-score on HLL distinct-trace counts
+  per tumbling window (catches session/id explosions),
+- **heavy-hitter** attributes — CMS count ratio per window (catches one
+  product id / user dominating traffic).
+
+Everything lives in one ``DetectorState`` pytree and advances by one
+jitted, state-donating ``step`` — compiled once, static shapes, no
+data-dependent control flow (window rotation is a masked select, not a
+branch). On a mesh the same step runs SPMD with the batch axis sharded;
+sketch deltas merge with ``psum``/``pmax`` (see ``parallel``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import cms, ewma, hll
+from ..ops.collectives import NO_COMM, Comm
+from ..runtime.tensorize import TensorBatch
+from .windows import WindowClock
+
+
+class DetectorConfig(NamedTuple):
+    """Static shape/threshold configuration (closed over at jit time).
+
+    Defaults size the state for the shop: ~20 services (padded to 32 with
+    an overflow bucket), 1s/10s/60s windows matching BASELINE config #5.
+    """
+
+    num_services: int = 32
+    hll_p: int = 12
+    cms_depth: int = 4
+    cms_width: int = 8192
+    windows_s: tuple[float, ...] = (1.0, 10.0, 60.0)  # tumbling (HLL/CMS)
+    taus_s: tuple[float, ...] = (1.0, 10.0, 60.0)  # EWMA timescales
+    z_threshold: float = 6.0
+    card_alpha: float = 0.3  # EWMA weight per completed window
+    warmup_batches: float = 20.0  # z suppressed until this many obs
+    warmup_windows: float = 5.0
+    eps: float = 1e-6
+
+    @property
+    def num_windows(self) -> int:
+        return len(self.windows_s)
+
+    @property
+    def num_taus(self) -> int:
+        return len(self.taus_s)
+
+
+class DetectorState(NamedTuple):
+    """All detector memory; a donated pytree of static-shaped arrays.
+
+    Axis glossary: W#=tumbling windows, S=services, R=HLL registers,
+    D×C=CMS rows×counters, T=EWMA timescales. The ``[W#, 2, ...]`` banks
+    hold {0: current, 1: previous} per window — a sliding window as two
+    tumbling halves, rotated by masked select inside the step.
+    """
+
+    hll_bank: jnp.ndarray  # int32[W#, 2, S, R]
+    cms_bank: jnp.ndarray  # int32[W#, 2, D, C]
+    span_total: jnp.ndarray  # float32[W#, 2] — spans per window bank
+    lat_mean: jnp.ndarray  # float32[S, T]
+    lat_var: jnp.ndarray  # float32[S, T]
+    err_mean: jnp.ndarray  # float32[S, T]
+    err_var: jnp.ndarray  # float32[S, T]
+    rate_mean: jnp.ndarray  # float32[S, T]
+    rate_var: jnp.ndarray  # float32[S, T]
+    card_mean: jnp.ndarray  # float32[S, W#]
+    card_var: jnp.ndarray  # float32[S, W#]
+    obs_batches: jnp.ndarray  # float32[S] — batches seen per service
+    obs_windows: jnp.ndarray  # float32[S, W#] — completed windows seen
+    step_idx: jnp.ndarray  # int32[] — steps taken
+
+
+class DetectorReport(NamedTuple):
+    """Per-step detection output (small; cheap to fetch to host)."""
+
+    lat_z: jnp.ndarray  # float32[S, T]
+    err_z: jnp.ndarray  # float32[S, T]
+    rate_z: jnp.ndarray  # float32[S, T]
+    card_z: jnp.ndarray  # float32[S, W#]
+    card_est: jnp.ndarray  # float32[S, W#] — completed-window distinct count
+    hh_ratio: jnp.ndarray  # float32[S, W#] — max attr share of window traffic
+    svc_count: jnp.ndarray  # float32[S] — valid spans this batch
+    flags: jnp.ndarray  # bool[S] — any signal over threshold
+
+
+def detector_init(config: DetectorConfig) -> DetectorState:
+    nw, s, t = config.num_windows, config.num_services, config.num_taus
+    return DetectorState(
+        hll_bank=hll.hll_init(s, p=config.hll_p, leading=(nw, 2)),
+        cms_bank=cms.cms_init(config.cms_depth, config.cms_width, leading=(nw, 2)),
+        span_total=jnp.zeros((nw, 2), jnp.float32),
+        lat_mean=jnp.zeros((s, t), jnp.float32),
+        lat_var=jnp.zeros((s, t), jnp.float32),
+        err_mean=jnp.zeros((s, t), jnp.float32),
+        err_var=jnp.zeros((s, t), jnp.float32),
+        rate_mean=jnp.zeros((s, t), jnp.float32),
+        rate_var=jnp.zeros((s, t), jnp.float32),
+        card_mean=jnp.zeros((s, nw), jnp.float32),
+        card_var=jnp.zeros((s, nw), jnp.float32),
+        obs_batches=jnp.zeros((s,), jnp.float32),
+        obs_windows=jnp.zeros((s, nw), jnp.float32),
+        step_idx=jnp.zeros((), jnp.int32),
+    )
+
+
+def detector_step(
+    config: DetectorConfig,
+    state: DetectorState,
+    svc: jnp.ndarray,  # int32[B]
+    lat_us: jnp.ndarray,  # float32[B]
+    is_error: jnp.ndarray,  # float32[B]
+    trace_hi: jnp.ndarray,  # uint32[B]
+    trace_lo: jnp.ndarray,  # uint32[B]
+    attr_hi: jnp.ndarray,  # uint32[B]
+    attr_lo: jnp.ndarray,  # uint32[B]
+    valid: jnp.ndarray,  # bool[B]
+    dt: jnp.ndarray,  # float32[] — seconds since previous batch
+    rotate: jnp.ndarray,  # bool[W#] — window boundary crossed
+    comm: Comm = NO_COMM,
+) -> tuple[DetectorState, DetectorReport]:
+    """One fully-fused detector update; jit with ``donate_argnums=1``.
+
+    Order of operations matters and is fixed:
+    1. *Harvest* completed windows: estimate cardinality of each current
+       bank, then feed the card EWMA only where ``rotate`` is set (each
+       completed window is exactly one observation).
+    2. *Rotate* banks by masked select (prev ← cur, cur ← 0). A
+       ``lax.cond`` per window would recompile-friendly too, but a select
+       keeps the whole step a single straight-line fused program.
+    3. *Absorb* the batch into every current bank and the EWMA heads.
+
+    SPMD: the same function runs per-shard inside ``shard_map`` with a
+    real ``comm``. State arrays then hold this shard's slice (service
+    axis of HLL/EWMA, depth axis of CMS); batch arrays hold the local
+    batch shard; four collectives (psum/pmax over the batch axis, pmin
+    over the sketch axis) reconcile the shards. Service/row ids are
+    global on the wire and localised here via ``comm.sketch_index`` —
+    out-of-slice ids fall off through scatter-drop and one-hot miss, so
+    no gather/compaction is ever needed.
+    """
+    # Local shard geometry, derived from the state arrays themselves.
+    s_axis = state.lat_mean.shape[0]  # local service count
+    d_local = state.cms_bank.shape[-2]  # local CMS depth rows
+    shard = comm.sketch_index()
+    svc = svc.astype(jnp.int32) - shard * s_axis  # global → local ids
+    # Out-of-slice ids must become *positive* out-of-bounds (scatter's
+    # drop mode drops those; negative ids would wrap numpy-style and
+    # alias another service's registers).
+    svc = jnp.where((svc >= 0) & (svc < s_axis), svc, s_axis)
+    valid_f = valid.astype(jnp.float32)
+
+    # ---- 1. harvest cardinality of windows that just completed -------
+    cur_est = hll.hll_estimate(state.hll_bank[:, 0])  # [W#, S]
+    card_x = cur_est.T  # [S, W#]
+    rot_row = rotate[None, :]  # [1, W#]
+    card_obs = rot_row & (card_x > 0.5)
+    card_warm = state.obs_windows < config.warmup_windows
+    card_mean, card_var, card_z = ewma.ewma_update(
+        state.card_mean,
+        state.card_var,
+        card_x,
+        jnp.float32(config.card_alpha),
+        observed=card_obs,
+        warmup=card_warm,
+        eps=config.eps,
+    )
+    obs_windows = state.obs_windows + card_obs.astype(jnp.float32)
+
+    # ---- 2. rotate tumbling banks ------------------------------------
+    def rot_bank(bank: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        # new cur = 0, new prev = old cur, where mask; else unchanged.
+        rolled = jnp.stack([jnp.zeros_like(bank[:, 0]), bank[:, 0]], axis=1)
+        mask_b = mask.reshape((-1,) + (1,) * (bank.ndim - 1))
+        return jnp.where(mask_b, rolled, bank)
+
+    hll_bank = rot_bank(state.hll_bank, rotate)
+    cms_bank = rot_bank(state.cms_bank, rotate)
+    span_total = rot_bank(state.span_total, rotate)
+
+    # ---- 3a. absorb batch into sketch banks --------------------------
+    # HLL: local scatter-max, then max-union across batch shards. The
+    # bank enters replicated (over the batch axis), so pmax of the
+    # updated banks IS the union — the one-collective merge that makes
+    # sketches the right abstraction for SPMD ingest.
+    bucket, rank = hll.hll_indices(trace_hi, trace_lo, p=config.hll_p)
+    upd_hll = jax.vmap(hll.hll_update, in_axes=(0, None, None, None, None))
+    hll_bank = hll_bank.at[:, 0].set(
+        comm.pmax_batch(upd_hll(hll_bank[:, 0], svc, bucket, rank, valid))
+    )
+
+    # CMS: rows are hash-independent, so the sketch axis shards the
+    # depth dimension; this shard updates its own row slice with the
+    # matching global row hashes, and batch shards sum-merge deltas.
+    cidx_full = cms.cms_indices(
+        attr_hi, attr_lo, config.cms_depth, config.cms_width
+    )
+    cidx = jax.lax.dynamic_slice_in_dim(cidx_full, shard * d_local, d_local, 0)
+    upd_cms = jax.vmap(cms.cms_update, in_axes=(0, None, None, None))
+    cms_cur = upd_cms(cms_bank[:, 0], cidx, None, valid)
+    cms_bank = cms_bank.at[:, 0].set(
+        cms_bank[:, 0] + comm.psum_batch(cms_cur - cms_bank[:, 0])
+    )
+    n_valid = comm.psum_batch(jnp.sum(valid_f))
+    span_total = span_total.at[:, 0].add(n_valid)
+
+    # ---- 3b. EWMA heads ----------------------------------------------
+    taus = jnp.asarray(config.taus_s, jnp.float32)  # [T]
+    alphas = 1.0 - jnp.exp(-dt / taus)  # [T]
+    cnt, lat_sum, _ = ewma.segment_stats(lat_us, svc, s_axis, valid=valid)
+    _, err_sum, _ = ewma.segment_stats(is_error, svc, s_axis, valid=valid)
+    cnt = comm.psum_batch(cnt)
+    lat_sum = comm.psum_batch(lat_sum)
+    err_sum = comm.psum_batch(err_sum)
+    seen = cnt > 0  # [S]
+    warm = (state.obs_batches < config.warmup_batches)[:, None]  # [S,1]
+
+    lat_x = (lat_sum / jnp.maximum(cnt, 1.0))[:, None]  # [S,1]
+    lat_mean, lat_var, lat_z = ewma.ewma_update(
+        state.lat_mean, state.lat_var, lat_x, alphas,
+        observed=seen[:, None], warmup=warm, eps=config.eps,
+    )
+
+    err_x = (err_sum / jnp.maximum(cnt, 1.0))[:, None]
+    err_mean, err_var, err_z = ewma.ewma_update(
+        state.err_mean, state.err_var, err_x, alphas,
+        observed=seen[:, None], warmup=warm, eps=config.eps,
+    )
+
+    # Throughput: zero is an observation too, once a service exists.
+    rate_x = (cnt / jnp.maximum(dt, 1e-3))[:, None]
+    rate_obs = (seen | (state.obs_batches > 0))[:, None]
+    rate_mean, rate_var, rate_z = ewma.ewma_update(
+        state.rate_mean, state.rate_var, rate_x, alphas,
+        observed=rate_obs, warmup=warm, eps=config.eps,
+    )
+
+    obs_batches = state.obs_batches + seen.astype(jnp.float32)
+
+    # ---- 3c. heavy hitters: attr share of each current window --------
+    # Row-sharded CMS query: min over local rows, then min across the
+    # sketch axis; batch shards each score their own spans, max-merged.
+    counts = comm.pmin_sketch(
+        jax.vmap(cms.cms_query, in_axes=(0, None))(cms_bank[:, 0], cidx)
+    ).astype(jnp.float32)  # [W#, B]
+    col = jax.lax.broadcasted_iota(jnp.int32, (svc.shape[0], s_axis), 1)
+    onehot = (col == svc[:, None]).astype(jnp.float32) * valid_f[:, None]  # [B,S]
+    per_svc_max = comm.pmax_batch(
+        jnp.max(counts[:, :, None] * onehot[None, :, :], axis=1)
+    )  # [W#, S]
+    hh_ratio = (per_svc_max / jnp.maximum(span_total[:, 0], 1.0)[:, None]).T
+
+    # ---- flags -------------------------------------------------------
+    thr = config.z_threshold
+    flags = (
+        jnp.any(jnp.abs(lat_z) > thr, axis=1)
+        | jnp.any(jnp.abs(err_z) > thr, axis=1)
+        | jnp.any(jnp.abs(rate_z) > thr, axis=1)
+        | jnp.any(jnp.abs(card_z) > thr, axis=1)
+    )
+
+    new_state = DetectorState(
+        hll_bank=hll_bank,
+        cms_bank=cms_bank,
+        span_total=span_total,
+        lat_mean=lat_mean,
+        lat_var=lat_var,
+        err_mean=err_mean,
+        err_var=err_var,
+        rate_mean=rate_mean,
+        rate_var=rate_var,
+        card_mean=card_mean,
+        card_var=card_var,
+        obs_batches=obs_batches,
+        obs_windows=obs_windows,
+        step_idx=state.step_idx + 1,
+    )
+    report = DetectorReport(
+        lat_z=lat_z,
+        err_z=err_z,
+        rate_z=rate_z,
+        card_z=card_z,
+        card_est=card_x,
+        hh_ratio=hh_ratio,
+        svc_count=cnt,
+        flags=flags,
+    )
+    return new_state, report
+
+
+class AnomalyDetector:
+    """Host-side driver: owns state, the compiled step, and the clock.
+
+    Usage::
+
+        det = AnomalyDetector(DetectorConfig())
+        report = det.observe(tensor_batch, t_now)   # t in seconds
+
+    The jitted step donates the previous state buffer, so steady-state
+    ingest allocates nothing on device beyond the incoming batch.
+    """
+
+    def __init__(self, config: DetectorConfig | None = None):
+        self.config = config or DetectorConfig()
+        self.state = detector_init(self.config)
+        self.clock = WindowClock(self.config.windows_s)
+        self._step = jax.jit(
+            partial(detector_step, self.config), donate_argnums=0
+        )
+
+    def observe(self, batch: TensorBatch, t_now: float) -> DetectorReport:
+        dt, rotate = self.clock.tick(t_now)
+        self.state, report = self._step(
+            self.state,
+            jnp.asarray(batch.svc),
+            jnp.asarray(batch.lat_us),
+            jnp.asarray(batch.is_error),
+            jnp.asarray(batch.trace_hi),
+            jnp.asarray(batch.trace_lo),
+            jnp.asarray(batch.attr_hi),
+            jnp.asarray(batch.attr_lo),
+            jnp.asarray(batch.valid),
+            jnp.float32(dt),
+            jnp.asarray(rotate),
+        )
+        return report
+
+    def flagged_services(self, report: DetectorReport, names: list[str]) -> list[str]:
+        mask = np.asarray(report.flags)
+        return [n for i, n in enumerate(names) if i < mask.shape[0] and mask[i]]

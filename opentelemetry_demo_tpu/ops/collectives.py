@@ -1,0 +1,39 @@
+"""Named-axis communicator: the detector's entire comm surface.
+
+``detector_step`` is written against this four-method interface; with
+``NO_COMM`` every method is the identity and the step is the single-chip
+program. Inside ``shard_map`` the same code runs per-shard and these
+methods become XLA collectives — the whole distributed design is "insert
+four reductions", which is what mergeable sketch monoids buy.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class Comm(NamedTuple):
+    """Axis names; ``None`` means that axis is not sharded."""
+
+    batch_axis: str | None = None
+    sketch_axis: str | None = None
+
+    def psum_batch(self, x: jnp.ndarray) -> jnp.ndarray:
+        return lax.psum(x, self.batch_axis) if self.batch_axis else x
+
+    def pmax_batch(self, x: jnp.ndarray) -> jnp.ndarray:
+        return lax.pmax(x, self.batch_axis) if self.batch_axis else x
+
+    def pmin_sketch(self, x: jnp.ndarray) -> jnp.ndarray:
+        return lax.pmin(x, self.sketch_axis) if self.sketch_axis else x
+
+    def sketch_index(self) -> jnp.ndarray:
+        if self.sketch_axis is None:
+            return jnp.int32(0)
+        return lax.axis_index(self.sketch_axis).astype(jnp.int32)
+
+
+NO_COMM = Comm(None, None)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 _SPLIT_GAMMA = np.uint64(0x9E3779B97F4A7C15)
@@ -78,8 +79,8 @@ def hash_u32_pair(x: jnp.ndarray, seed: int = 0) -> tuple[jnp.ndarray, jnp.ndarr
     rows require.
     """
     x = x.astype(jnp.uint32)
-    hi = fmix32(x ^ jnp.uint32(0x9E3779B9 + seed))
-    lo = fmix32(x ^ jnp.uint32(0x85EBCA77 + 2 * seed))
+    hi = fmix32(x ^ jnp.uint32((0x9E3779B9 + seed) & 0xFFFFFFFF))
+    lo = fmix32(x ^ jnp.uint32((0x85EBCA77 + 2 * seed) & 0xFFFFFFFF))
     return hi, lo
 
 
@@ -92,9 +93,7 @@ def hash_spans_synthetic(
     ``[start, start+batch)`` entirely on device, so benchmark loops never
     touch the host. ``start`` may be a traced scalar.
     """
-    # TPU requires >=1D iota; broadcasted_iota over a (batch, 1) frame.
-    import jax
-
+    # TPU requires >=2D iota; broadcasted_iota over a (batch, 1) frame.
     ctr = jax.lax.broadcasted_iota(jnp.uint32, (batch, 1), 0).squeeze(-1)
     x = ctr + jnp.uint32(start)
     return hash_u32_pair(x, seed=seed)

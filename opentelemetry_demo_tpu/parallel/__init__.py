@@ -1,0 +1,33 @@
+"""Distributed backend: device meshes + XLA collectives over ICI/DCN.
+
+The reference system distributes by service replication and Kafka
+consumer-group fan-out over TCP (SURVEY.md §2.3); the TPU-native
+equivalent is SPMD over a ``jax.sharding.Mesh`` with the span batch
+sharded over a ``batch`` axis (data parallelism) and sketch state sharded
+over a ``sketch`` axis (service/row parallelism — the expert-parallel
+analogue, since a service's sub-sketch is an independent "expert").
+Sketch merges are exactly the XLA collectives:
+
+- HLL registers  → ``lax.pmax``  (max-monoid union)
+- CMS counters   → ``lax.psum``  (sum-monoid union)
+- segment stats  → ``lax.psum``
+- CMS row-shard queries → ``pmin`` across the sketch axis
+
+All collectives ride ICI inside a pod; the ``ring`` module provides the
+``ppermute``-based chunked variant for DCN-scale replay/merge.
+"""
+
+from ..ops.collectives import Comm, NO_COMM
+from .spmd import make_sharded_step, sharded_state_specs
+from .mesh import make_mesh
+from .ring import ring_merge_max, ring_merge_sum
+
+__all__ = [
+    "Comm",
+    "NO_COMM",
+    "make_mesh",
+    "make_sharded_step",
+    "sharded_state_specs",
+    "ring_merge_max",
+    "ring_merge_sum",
+]

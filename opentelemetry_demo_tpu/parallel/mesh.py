@@ -1,0 +1,33 @@
+"""Mesh construction for the detector's 2-D (batch × sketch) layout."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_batch: int | None = None,
+    n_sketch: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` with axes ``("batch", "sketch")``.
+
+    Defaults to all available devices on the batch axis. On a v5e-8 the
+    natural layouts are (8,1) for pure DP ingest (BASELINE config #5) and
+    (4,2)/(2,4) when the service axis outgrows one chip's VMEM budget for
+    the fused kernel.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_batch is None:
+        n_batch = max(len(devs) // n_sketch, 1)
+    use = n_batch * n_sketch
+    if use > len(devs):
+        raise ValueError(
+            f"mesh ({n_batch} batch × {n_sketch} sketch) needs {use} devices, "
+            f"only {len(devs)} available"
+        )
+    arr = np.asarray(devs[:use]).reshape(n_batch, n_sketch)
+    return Mesh(arr, axis_names=("batch", "sketch"))

@@ -1,0 +1,95 @@
+"""Ring merges via ``lax.ppermute`` — the DCN/long-haul sketch path.
+
+Inside a pod, ``pmax``/``psum`` are the right merge (XLA lowers them onto
+ICI optimally; see ``spmd``). Across pods/hosts — the reference's
+analogue is replaying the Kafka ``orders`` topic into a second consumer
+group over the datacenter network (SURVEY.md §2.3) — bandwidth is scarcer
+and latency lumpier, so the merge wants to be *chunked and overlapped*:
+each step sends one sketch chunk to the ring neighbour while reducing the
+chunk that just arrived. That is the ring all-reduce, expressed here with
+``ppermute`` over a named mesh axis so it works under ``shard_map`` on
+any axis (ICI or DCN) without new code.
+
+This is the sequence-parallel analogue for this workload: the "sequence"
+is the span stream, sharded arbitrarily across devices because sketch
+states are associative monoids — ring *rotation* (à la ring attention)
+is unnecessary, ring *reduction* is all that's left. One hop per step,
+n-1 steps, each hop moving 1/n of the state: bandwidth-optimal.
+"""
+
+from __future__ import annotations
+
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_allreduce(x: jnp.ndarray, axis_name: str, op) -> jnp.ndarray:
+    """Bandwidth-optimal ring all-reduce of ``x`` over ``axis_name``.
+
+    reduce-scatter phase (n-1 hops) + all-gather phase (n-1 hops), each
+    hop a single neighbour ``ppermute`` — the classic two-phase ring.
+    Chunking is along the leading axis; ``x`` is padded to ``n`` chunks.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def rs_body(step, chunks):
+        # In the reduce-scatter phase, device ``me`` accumulates chunk
+        # ``(me - step - 1) mod n``: it receives the partial from its
+        # left neighbour and folds in its own copy.
+        src_chunk = (me - step - 1) % n
+        send_chunk = (me - step) % n
+        payload = jnp.take(chunks, send_chunk, axis=0)
+        recvd = lax.ppermute(payload, axis_name, fwd)
+        return chunks.at[src_chunk].set(op(jnp.take(chunks, src_chunk, axis=0), recvd))
+
+    chunks = lax.fori_loop(0, n - 1, rs_body, chunks)
+
+    def ag_body(step, chunks):
+        # Each device now owns the fully-reduced chunk ``(me + 1) mod n``
+        # after reduce-scatter; circulate owned chunks around the ring.
+        send_chunk = (me - step + 1) % n
+        payload = jnp.take(chunks, send_chunk, axis=0)
+        recvd = lax.ppermute(payload, axis_name, fwd)
+        return chunks.at[(me - step) % n].set(recvd)
+
+    chunks = lax.fori_loop(0, n - 1, ag_body, chunks)
+    out = chunks.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+def ring_merge_max(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Ring all-reduce with max — HLL register union across hosts."""
+    return _ring_allreduce(x, axis_name, jnp.maximum)
+
+
+def ring_merge_sum(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Ring all-reduce with add — CMS/count union across hosts."""
+    return _ring_allreduce(x, axis_name, jnp.add)
+
+
+def merge_states_across(axis_name: str, hll_bank, cms_bank, use_ring=True):
+    """Merge sketch banks across a mesh axis (DCN replay/recovery path).
+
+    With ``use_ring`` the merge is the chunked neighbour-hop version;
+    otherwise it falls back to one-shot ``pmax``/``psum`` (better on
+    ICI, where XLA already emits near-optimal collectives).
+    """
+    if use_ring:
+        return (
+            ring_merge_max(hll_bank, axis_name),
+            ring_merge_sum(cms_bank, axis_name),
+        )
+    return lax.pmax(hll_bank, axis_name), lax.psum(cms_bank, axis_name)

@@ -1,0 +1,119 @@
+"""SPMD detector step: shard_map over the (batch × sketch) mesh.
+
+Layout (the scaling-book recipe — pick a mesh, annotate shardings, let
+XLA place collectives):
+
+- **batch axis (DP)**: every span-batch array sharded; state replicated.
+  Merges: ``psum`` (CMS deltas, segment stats, counts), ``pmax`` (HLL
+  banks, heavy-hitter maxima). These ride ICI every step.
+- **sketch axis (EP/TP analogue)**: per-service state (HLL service axis,
+  EWMA heads) and the CMS depth axis sharded. No gather is needed on the
+  forward path: global service ids localise by subtraction and
+  out-of-slice ids vanish through scatter-drop/one-hot-miss; only the
+  CMS point-query needs a ``pmin`` across the axis.
+
+The local function is ``models.detector_step`` itself — the single-chip
+and multi-chip programs are one implementation, parameterised by
+``parallel.collectives.Comm``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.detector import (
+    DetectorConfig,
+    DetectorReport,
+    DetectorState,
+    detector_init,
+    detector_step,
+)
+from ..ops.collectives import Comm
+
+
+def sharded_state_specs(config: DetectorConfig) -> DetectorState:
+    """PartitionSpecs for DetectorState on a ("batch","sketch") mesh.
+
+    Replicated over ``batch`` (the batch axis merges through collectives,
+    so every batch shard holds the same state); service/depth axes live
+    on ``sketch``.
+    """
+    del config  # specs are shape-independent
+    per_service = P("sketch", None)
+    return DetectorState(
+        hll_bank=P(None, None, "sketch", None),
+        cms_bank=P(None, None, "sketch", None),  # depth axis sharded
+        span_total=P(None, None),
+        lat_mean=per_service,
+        lat_var=per_service,
+        err_mean=per_service,
+        err_var=per_service,
+        rate_mean=per_service,
+        rate_var=per_service,
+        card_mean=per_service,
+        card_var=per_service,
+        obs_batches=P("sketch"),
+        obs_windows=per_service,
+        step_idx=P(),
+    )
+
+
+def report_specs() -> DetectorReport:
+    """PartitionSpecs for DetectorReport (per-service → sketch axis)."""
+    return DetectorReport(
+        lat_z=P("sketch", None),
+        err_z=P("sketch", None),
+        rate_z=P("sketch", None),
+        card_z=P("sketch", None),
+        card_est=P("sketch", None),
+        hh_ratio=P("sketch", None),
+        svc_count=P("sketch"),
+        flags=P("sketch"),
+    )
+
+
+def make_sharded_step(
+    config: DetectorConfig, mesh: Mesh
+) -> tuple[Callable, DetectorState]:
+    """Build the jitted SPMD step and a correctly-placed initial state.
+
+    Returns ``(step_fn, state)``; ``step_fn(state, *batch_arrays, dt,
+    rotate)`` matches the single-chip step's signature and semantics.
+    Constraints: ``num_services`` and ``cms_depth`` must divide by the
+    sketch-axis size, the batch size by the batch-axis size.
+    """
+    n_sketch = mesh.shape["sketch"]
+    if config.num_services % n_sketch:
+        raise ValueError("num_services must divide by the sketch axis")
+    if config.cms_depth % n_sketch:
+        raise ValueError("cms_depth must divide by the sketch axis")
+
+    comm = Comm(batch_axis="batch", sketch_axis="sketch")
+    local = partial(detector_step, config, comm=comm)
+
+    state_specs = sharded_state_specs(config)
+    b = P("batch")
+    in_specs = (
+        state_specs,
+        b, b, b, b, b, b, b, b,  # svc, lat, err, t_hi, t_lo, a_hi, a_lo, valid
+        P(),  # dt
+        P(),  # rotate mask
+    )
+    out_specs = (state_specs, report_specs())
+
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    step = jax.jit(fn, donate_argnums=0)
+
+    state = detector_init(config)
+    # PartitionSpec is a tuple subclass, so a naive tree_map would recurse
+    # into it; DetectorState is a NamedTuple, so map its fields directly.
+    shardings = DetectorState(
+        *(NamedSharding(mesh, spec) for spec in state_specs)
+    )
+    state = jax.device_put(state, shardings)
+    return step, state

@@ -1,0 +1,16 @@
+"""Host streaming runtime: span → tensor ingestion and device feeding.
+
+The reference system's ingest seams are (a) the Kafka ``orders`` topic
+consumed the way src/fraud-detection does
+(/root/reference/src/fraud-detection/src/main/kotlin/frauddetection/main.kt:54-69)
+and (b) the OTel collector's OTLP export pipeline
+(/root/reference/src/otel-collector/otelcol-config.yml:120-131). Both
+ultimately deliver *span-shaped records*; this package turns them into
+fixed-width tensor batches (``tensorize``), feeds the device without
+host syncs (``pipeline``), and snapshots sketch state keyed to stream
+offsets for resume (``checkpoint``).
+"""
+
+from .tensorize import SpanRecord, SpanTensorizer, TensorBatch
+
+__all__ = ["SpanRecord", "SpanTensorizer", "TensorBatch"]

@@ -49,7 +49,6 @@ class CMSRef:
         self.depth = depth
         self.width = width
         self.table = np.zeros((depth, width), dtype=np.int64)
-        self.true_counts: dict[int, int] = {}
 
     def _rows(self, h64: int) -> list[int]:
         hi = (h64 >> 32) & 0xFFFFFFFF
@@ -59,7 +58,6 @@ class CMSRef:
     def add_hash(self, h64: int, w: int = 1) -> None:
         for i, idx in enumerate(self._rows(h64)):
             self.table[i, idx] += w
-        self.true_counts[h64] = self.true_counts.get(h64, 0) + w
 
     def query_hash(self, h64: int) -> int:
         return int(min(self.table[i, idx] for i, idx in enumerate(self._rows(h64))))

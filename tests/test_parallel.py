@@ -1,0 +1,153 @@
+"""Distributed-layer tests on the virtual 8-device CPU mesh.
+
+The central invariant: the SPMD step on any (batch × sketch) mesh layout
+produces bit-identical sketch banks and (up to float-reduction order)
+identical detection state to the single-chip step on the same data.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from opentelemetry_demo_tpu.models import (
+    DetectorConfig,
+    detector_init,
+    detector_step,
+)
+from opentelemetry_demo_tpu.parallel import (
+    make_mesh,
+    make_sharded_step,
+    ring_merge_max,
+    ring_merge_sum,
+)
+from opentelemetry_demo_tpu.runtime import SpanTensorizer
+
+B = 512
+
+
+def _batch_args(rng, num_services):
+    tz = SpanTensorizer(num_services=num_services, batch_size=B)
+    n = B - 37  # leave some invalid lanes
+    batch = tz.pack_arrays(
+        svc=rng.integers(0, 5, size=n),
+        lat_us=rng.normal(300.0, 30.0, size=n).astype(np.float32),
+        trace_id=rng.integers(0, 2**63, size=n, dtype=np.uint64),
+        is_error=(rng.random(n) < 0.05).astype(np.float32),
+        attr_key=rng.zipf(1.5, size=n).astype(np.uint64),
+    )
+    return tuple(
+        jnp.asarray(x)
+        for x in (
+            batch.svc, batch.lat_us, batch.is_error,
+            batch.trace_hi, batch.trace_lo, batch.attr_hi, batch.attr_lo,
+            batch.valid,
+        )
+    )
+
+
+@pytest.mark.parametrize("layout", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_step_matches_single_chip(rng, layout):
+    n_batch, n_sketch = layout
+    config = DetectorConfig(num_services=8, cms_depth=4)
+    mesh = make_mesh(n_batch, n_sketch)
+    step, state_sh = make_sharded_step(config, mesh)
+
+    state_ref = detector_init(config)
+    dt = jnp.float32(0.25)
+    for k in range(4):
+        args = _batch_args(rng, config.num_services)
+        rotate = jnp.asarray([k % 2 == 1, False, k == 3])
+        state_sh, rep_sh = step(state_sh, *args, dt, rotate)
+        state_ref, rep_ref = jax.jit(
+            lambda s, *a: detector_step(config, s, *a)
+        )(state_ref, *args, dt, rotate)
+
+    # Sketch banks are integer monoids: must match exactly.
+    np.testing.assert_array_equal(
+        np.asarray(state_sh.hll_bank), np.asarray(state_ref.hll_bank)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state_sh.cms_bank), np.asarray(state_ref.cms_bank)
+    )
+    # Float heads: reduction order differs across layouts.
+    for name in ("lat_mean", "lat_var", "err_mean", "rate_mean", "card_mean"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(state_sh, name)),
+            np.asarray(getattr(state_ref, name)),
+            rtol=1e-4, atol=1e-4, err_msg=name,
+        )
+    for name in ("lat_z", "err_z", "rate_z", "card_z", "hh_ratio"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(rep_sh, name)),
+            np.asarray(getattr(rep_ref, name)),
+            rtol=1e-3, atol=1e-3, err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rep_sh.svc_count), np.asarray(rep_ref.svc_count)
+    )
+
+
+def test_sharded_step_detects_fault(rng):
+    """End-to-end on the mesh: a latency fault still flags correctly."""
+    config = DetectorConfig(num_services=8, warmup_batches=5.0)
+    mesh = make_mesh(4, 2)
+    step, state = make_sharded_step(config, mesh)
+    tz = SpanTensorizer(num_services=8, batch_size=B)
+    dt = jnp.float32(0.25)
+    no_rot = jnp.zeros(3, bool)
+
+    def feed(scale):
+        n = B
+        svc = rng.integers(0, 4, size=n)
+        lat = rng.normal(200.0, 10.0, size=n)
+        lat[svc == 2] *= scale
+        batch = tz.pack_arrays(
+            svc=svc,
+            lat_us=lat.astype(np.float32),
+            trace_id=rng.integers(0, 2**63, size=n, dtype=np.uint64),
+        )
+        return tuple(
+            jnp.asarray(x)
+            for x in (
+                batch.svc, batch.lat_us, batch.is_error,
+                batch.trace_hi, batch.trace_lo, batch.attr_hi, batch.attr_lo,
+                batch.valid,
+            )
+        )
+
+    for _ in range(30):
+        state, rep = step(state, *feed(1.0), dt, no_rot)
+    assert not bool(np.asarray(rep.flags).any())
+    state, rep = step(state, *feed(10.0), dt, no_rot)
+    flags = np.asarray(rep.flags)
+    assert flags[2] and flags.sum() == 1
+
+
+@pytest.mark.parametrize("op,ring_fn", [("max", ring_merge_max), ("sum", ring_merge_sum)])
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_allreduce_matches_direct(rng, op, ring_fn, n):
+    mesh = Mesh(np.asarray(jax.devices()[:n]), axis_names=("hosts",))
+    # Deliberately non-divisible element count to exercise padding.
+    x = rng.integers(0, 100, size=(n, 13, 7)).astype(np.int32)
+
+    ring = shard_map(
+        lambda s: ring_fn(s[0], "hosts")[None],
+        mesh=mesh,
+        in_specs=P("hosts"),
+        out_specs=P("hosts"),
+    )(x)
+    want = x.max(axis=0) if op == "max" else x.sum(axis=0)
+    assert ring.shape == x.shape
+    for shard in range(n):
+        np.testing.assert_array_equal(np.asarray(ring)[shard], want)
+
+
+def test_mesh_shapes():
+    m = make_mesh(4, 2)
+    assert m.shape == {"batch": 4, "sketch": 2}
+    m = make_mesh()
+    assert m.shape["batch"] == 8
