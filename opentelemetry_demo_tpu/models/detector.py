@@ -55,9 +55,19 @@ class DetectorConfig(NamedTuple):
     taus_s: tuple[float, ...] = (1.0, 10.0, 60.0)  # EWMA timescales
     z_threshold: float = 6.0
     card_alpha: float = 0.3  # EWMA weight per completed window
-    warmup_batches: float = 20.0  # z suppressed until this many obs
+    warmup_batches: float = 20.0  # CUSUM suppressed until this many obs
+    # Instant z needs a believable σ estimate, and tails take ~3x more
+    # samples to learn than means — so single-batch z-scores stay gated
+    # longer than the (drift-protected) CUSUM accumulators.
+    z_warmup_batches: float = 60.0
     warmup_windows: float = 5.0
     eps: float = 1e-6
+    # Page's CUSUM on standardized scores: catches sustained small
+    # shifts a single-batch z can't (sparse errors, gradual creep).
+    cusum_k: float = 0.5  # per-batch drift toward zero
+    cusum_h: float = 5.0  # alarm threshold
+    cusum_cap: float = 50.0  # bound accumulation (bounded recovery time)
+    err_slack: float = 0.01  # tolerated error-rate above baseline
 
     @property
     def num_windows(self) -> int:
@@ -83,13 +93,13 @@ class DetectorState(NamedTuple):
     lat_mean: jnp.ndarray  # float32[S, T]
     lat_var: jnp.ndarray  # float32[S, T]
     err_mean: jnp.ndarray  # float32[S, T]
-    err_var: jnp.ndarray  # float32[S, T]
     rate_mean: jnp.ndarray  # float32[S, T]
     rate_var: jnp.ndarray  # float32[S, T]
     card_mean: jnp.ndarray  # float32[S, W#]
     card_var: jnp.ndarray  # float32[S, W#]
     obs_batches: jnp.ndarray  # float32[S] — batches seen per service
     obs_windows: jnp.ndarray  # float32[S, W#] — completed windows seen
+    cusum: jnp.ndarray  # float32[S, 3] — {lat↑, err↑, rate↓} accumulators
     step_idx: jnp.ndarray  # int32[] — steps taken
 
 
@@ -103,6 +113,7 @@ class DetectorReport(NamedTuple):
     card_est: jnp.ndarray  # float32[S, W#] — completed-window distinct count
     hh_ratio: jnp.ndarray  # float32[S, W#] — max attr share of window traffic
     svc_count: jnp.ndarray  # float32[S] — valid spans this batch
+    cusum: jnp.ndarray  # float32[S, 3] — {lat↑, err↑, rate↓} accumulators
     flags: jnp.ndarray  # bool[S] — any signal over threshold
 
 
@@ -115,13 +126,13 @@ def detector_init(config: DetectorConfig) -> DetectorState:
         lat_mean=jnp.zeros((s, t), jnp.float32),
         lat_var=jnp.zeros((s, t), jnp.float32),
         err_mean=jnp.zeros((s, t), jnp.float32),
-        err_var=jnp.zeros((s, t), jnp.float32),
         rate_mean=jnp.zeros((s, t), jnp.float32),
         rate_var=jnp.zeros((s, t), jnp.float32),
         card_mean=jnp.zeros((s, nw), jnp.float32),
         card_var=jnp.zeros((s, nw), jnp.float32),
         obs_batches=jnp.zeros((s,), jnp.float32),
         obs_windows=jnp.zeros((s, nw), jnp.float32),
+        cusum=jnp.zeros((s, 3), jnp.float32),
         step_idx=jnp.zeros((), jnp.int32),
     )
 
@@ -178,14 +189,18 @@ def detector_step(
     rot_row = rotate[None, :]  # [1, W#]
     card_obs = rot_row & (card_x > 0.5)
     card_warm = state.obs_windows < config.warmup_windows
-    card_mean, card_var, card_z = ewma.ewma_update(
-        state.card_mean,
-        state.card_var,
-        card_x,
-        jnp.float32(config.card_alpha),
-        observed=card_obs,
-        warmup=card_warm,
-        eps=config.eps,
+    cm, cv = state.card_mean, state.card_var
+    card_delta = card_x - cm
+    # Variance floor covers HLL estimation noise (~1.6% std at p=12,
+    # 5% floor) plus an absolute term for near-empty windows.
+    card_z = card_delta / jnp.sqrt(cv + (0.05 * cm) ** 2 + 10.0)
+    card_z = jnp.where(card_obs & ~card_warm, card_z, 0.0)
+    a_card = jnp.maximum(
+        jnp.float32(config.card_alpha), 1.0 / (state.obs_windows + 1.0)
+    )
+    card_mean = jnp.where(card_obs, cm + a_card * card_delta, cm)
+    card_var = jnp.where(
+        card_obs, (1.0 - a_card) * (cv + a_card * card_delta * card_delta), cv
     )
     obs_windows = state.obs_windows + card_obs.astype(jnp.float32)
 
@@ -226,35 +241,103 @@ def detector_step(
     n_valid = comm.psum_batch(jnp.sum(valid_f))
     span_total = span_total.at[:, 0].add(n_valid)
 
-    # ---- 3b. EWMA heads ----------------------------------------------
+    # ---- 3b. count-aware detection heads -----------------------------
+    # Per-service batch counts vary wildly (a quiet service sees 1 span
+    # per batch, a hot one hundreds), so "batch mean vs EWMA variance of
+    # batch means" over-triggers on sparse services. Every z-score below
+    # is scaled by what the batch actually supports:
+    #   latency    x̄ of n spans → z = (x̄-μ)/sqrt(σ²/n), σ² = EWMA of
+    #              *per-span* variance (learned from the MXU sumsq)
+    #   error rate binomial      → z = (e - n·p)/sqrt(n·p(1-p) + 1)
+    #   throughput Poisson       → z = (n - λdt)/sqrt(λdt + 1)
     taus = jnp.asarray(config.taus_s, jnp.float32)  # [T]
     alphas = 1.0 - jnp.exp(-dt / taus)  # [T]
-    cnt, lat_sum, _ = ewma.segment_stats(lat_us, svc, s_axis, valid=valid)
+    # The latency head works in log space: RPC latency is heavy-tailed
+    # multiplicative (a single gamma draw can sit 6σ out in linear
+    # space), while log-latency is near-gaussian and a k× degradation
+    # is a clean +ln(k) shift at every timescale.
+    log_lat = jnp.log1p(jnp.maximum(lat_us, 0.0))
+    cnt, lat_sum, lat_sumsq = ewma.segment_stats(log_lat, svc, s_axis, valid=valid)
     _, err_sum, _ = ewma.segment_stats(is_error, svc, s_axis, valid=valid)
     cnt = comm.psum_batch(cnt)
     lat_sum = comm.psum_batch(lat_sum)
+    lat_sumsq = comm.psum_batch(lat_sumsq)
     err_sum = comm.psum_batch(err_sum)
     seen = cnt > 0  # [S]
+    obs2d = seen[:, None]
     warm = (state.obs_batches < config.warmup_batches)[:, None]  # [S,1]
+    z_warm = (state.obs_batches < config.z_warmup_batches)[:, None]  # [S,1]
+    n = jnp.maximum(cnt, 1.0)[:, None]  # [S,1]
+    # Bias-corrected smoothing: a long-τ EWMA started from zero spends
+    # hundreds of batches under-estimating the variance (α≈dt/τ), which
+    # inflates every early z-score. Until a service has seen ~1/α
+    # batches, use the running-average weight 1/(obs+1) instead — the
+    # Adam-style debias, done with a max instead of a divide.
+    alphas = jnp.maximum(
+        alphas, 1.0 / (state.obs_batches[:, None] + 1.0)
+    )  # [S,T]
+    # Variance gets its own (slow) smoothing: the per-span variance is a
+    # property of the service, not of the detection timescale — letting
+    # the 1s column estimate σ² from its last ~4 batches makes the noise
+    # floor itself noisy and singleton batches blow past any threshold.
+    alpha_var = jnp.maximum(
+        1.0 - jnp.exp(-dt / jnp.float32(max(config.taus_s))),
+        1.0 / (state.obs_batches[:, None] + 1.0),
+    )  # [S,1]
 
-    lat_x = (lat_sum / jnp.maximum(cnt, 1.0))[:, None]  # [S,1]
-    lat_mean, lat_var, lat_z = ewma.ewma_update(
-        state.lat_mean, state.lat_var, lat_x, alphas,
-        observed=seen[:, None], warmup=warm, eps=config.eps,
+    # Latency: per-span mean μ and per-span variance σ² per timescale.
+    # σ has a floor (in log space ≈ 15% latency noise): it keeps the
+    # z sane while σ² bootstraps and sets a sensible minimum detectable
+    # shift for singleton batches.
+    mu = state.lat_mean
+    sigma2 = state.lat_var
+    floor2 = jnp.float32(0.15 * 0.15)
+    xbar = (lat_sum / jnp.maximum(cnt, 1.0))[:, None]  # [S,1]
+    lat_z = (xbar - mu) / jnp.sqrt(sigma2 / n + floor2)
+    lat_z_cusum = jnp.where(obs2d & ~warm, lat_z, 0.0)
+    lat_z = jnp.where(obs2d & ~z_warm, lat_z, 0.0)
+    lat_mean = jnp.where(obs2d, mu + alphas * (xbar - mu), mu)
+    # E[(x-μ)²] against the *updated* mean — the first observation must
+    # not fold the distance-from-zero of an uninitialised μ into σ².
+    v_obs = (
+        (lat_sumsq / jnp.maximum(cnt, 1.0))[:, None]
+        - 2.0 * lat_mean * xbar
+        + lat_mean * lat_mean
+    )
+    lat_var = jnp.where(
+        obs2d, sigma2 + alpha_var * (jnp.maximum(v_obs, 0.0) - sigma2), sigma2
     )
 
-    err_x = (err_sum / jnp.maximum(cnt, 1.0))[:, None]
-    err_mean, err_var, err_z = ewma.ewma_update(
-        state.err_mean, state.err_var, err_x, alphas,
-        observed=seen[:, None], warmup=warm, eps=config.eps,
-    )
+    # Error rate: EWMA of p, binomial z on this batch's error count.
+    p = state.err_mean
+    err_cnt = err_sum[:, None]  # [S,1]
+    err_z = (err_cnt - n * p) / jnp.sqrt(n * p * (1.0 - p) + 1.0)
+    err_z = jnp.where(obs2d & ~z_warm, err_z, 0.0)
+    phat = err_cnt / n
+    err_mean = jnp.where(obs2d, p + alphas * (phat - p), p)
 
-    # Throughput: zero is an observation too, once a service exists.
+    # Throughput: EWMA of spans/sec; z on this batch's count with a
+    # variance that honours both Poisson noise and the empirically
+    # learned burstiness (task arrivals cluster, so pure Poisson
+    # under-estimates quiet-traffic variance).
+    lam = state.rate_mean
+    dt_c = jnp.maximum(dt, 1e-3)
+    expected = lam * dt_c
+    emp_var = state.rate_var * dt_c * dt_c  # (spans/s)² → count²
+    # step 0 carries a meaningless dt (the window clock has no previous
+    # tick), and a count divided by it would poison λ forever.
+    rate_obs = (seen | (state.obs_batches > 0))[:, None] & (state.step_idx > 0)
+    rate_z = (cnt[:, None] - expected) / jnp.sqrt(
+        jnp.maximum(expected, emp_var) + 1.0
+    )
+    rate_z_cusum = jnp.where(rate_obs & ~warm, rate_z, 0.0)
+    rate_z = jnp.where(rate_obs & ~z_warm, rate_z, 0.0)
     rate_x = (cnt / jnp.maximum(dt, 1e-3))[:, None]
-    rate_obs = (seen | (state.obs_batches > 0))[:, None]
-    rate_mean, rate_var, rate_z = ewma.ewma_update(
-        state.rate_mean, state.rate_var, rate_x, alphas,
-        observed=rate_obs, warmup=warm, eps=config.eps,
+    rate_mean = jnp.where(rate_obs, lam + alphas * (rate_x - lam), lam)
+    rate_var = jnp.where(
+        rate_obs,
+        state.rate_var + alpha_var * ((rate_x - lam) ** 2 - state.rate_var),
+        state.rate_var,
     )
 
     obs_batches = state.obs_batches + seen.astype(jnp.float32)
@@ -272,6 +355,32 @@ def detector_step(
     )  # [W#, S]
     hh_ratio = (per_svc_max / jnp.maximum(span_total[:, 0], 1.0)[:, None]).T
 
+    # ---- CUSUM layer: sustained small shifts --------------------------
+    # Scores use the slowest-τ column as the stable reference. Errors
+    # get a count-likelihood score (each error is strong evidence when
+    # the learned rate is ~0; n·(p+slack) forgives the baseline), so a
+    # trickle of failures — 1-2 per batch under a flagd percentage flag —
+    # integrates to an alarm within a few batches.
+    # No traffic = no evidence either way: sparse services HOLD their
+    # accumulators between observed batches (a decay per empty pump
+    # would erase the evidence of a 1-request-per-few-seconds service
+    # faster than it accrues).
+    k = jnp.float32(config.cusum_k)
+    active = seen & ~warm[:, 0]
+    s_lat = jnp.where(active, lat_z_cusum[:, -1] - k, 0.0)
+    s_err = jnp.where(
+        active,
+        2.0 * err_cnt[:, 0]
+        - n[:, 0] * (err_mean[:, -1] + config.err_slack)
+        - k,
+        0.0,
+    )
+    s_rate = jnp.where(
+        rate_obs[:, 0] & ~warm[:, 0], -rate_z_cusum[:, -1] - k, 0.0
+    )
+    scores = jnp.stack([s_lat, s_err, s_rate], axis=1)  # [S,3]
+    cusum = jnp.clip(state.cusum + scores, 0.0, config.cusum_cap)
+
     # ---- flags -------------------------------------------------------
     thr = config.z_threshold
     flags = (
@@ -279,6 +388,7 @@ def detector_step(
         | jnp.any(jnp.abs(err_z) > thr, axis=1)
         | jnp.any(jnp.abs(rate_z) > thr, axis=1)
         | jnp.any(jnp.abs(card_z) > thr, axis=1)
+        | jnp.any(cusum > config.cusum_h, axis=1)
     )
 
     new_state = DetectorState(
@@ -288,13 +398,13 @@ def detector_step(
         lat_mean=lat_mean,
         lat_var=lat_var,
         err_mean=err_mean,
-        err_var=err_var,
         rate_mean=rate_mean,
         rate_var=rate_var,
         card_mean=card_mean,
         card_var=card_var,
         obs_batches=obs_batches,
         obs_windows=obs_windows,
+        cusum=cusum,
         step_idx=state.step_idx + 1,
     )
     report = DetectorReport(
@@ -305,6 +415,7 @@ def detector_step(
         card_est=card_x,
         hh_ratio=hh_ratio,
         svc_count=cnt,
+        cusum=cusum,
         flags=flags,
     )
     return new_state, report

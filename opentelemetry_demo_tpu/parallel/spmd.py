@@ -52,13 +52,13 @@ def sharded_state_specs(config: DetectorConfig) -> DetectorState:
         lat_mean=per_service,
         lat_var=per_service,
         err_mean=per_service,
-        err_var=per_service,
         rate_mean=per_service,
         rate_var=per_service,
         card_mean=per_service,
         card_var=per_service,
         obs_batches=P("sketch"),
         obs_windows=per_service,
+        cusum=per_service,
         step_idx=P(),
     )
 
@@ -73,6 +73,7 @@ def report_specs() -> DetectorReport:
         card_est=P("sketch", None),
         hh_ratio=P("sketch", None),
         svc_count=P("sketch"),
+        cusum=P("sketch", None),
         flags=P("sketch"),
     )
 

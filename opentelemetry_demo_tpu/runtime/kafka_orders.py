@@ -1,0 +1,154 @@
+"""Kafka ``orders`` topic ingestion: OrderResult wire decode + consumer.
+
+Wire compatibility contract (field numbers from the reference schema,
+/root/reference/pb/demo.proto:203-214 — ``OrderResult{order_id=1,
+shipping_tracking_id=2, shipping_cost=3, shipping_address=4, items=5}``,
+``OrderItem{item=1 CartItem{product_id=1, quantity=2}, cost=2
+Money{currency_code=1, units=2, nanos=3}}``): any producer that feeds the
+reference's fraud-detection consumer
+(/root/reference/src/fraud-detection/src/main/kotlin/frauddetection/main.kt:64)
+feeds this one unchanged.
+
+The decoded order is projected onto the detector's span shape: one
+record per order, keyed by order id (cardinality signal = distinct
+orders), with item count/value as the monitored attribute (heavy-hitter
+signal = one product dominating order flow — the business-level anomaly
+the reference's accounting/fraud pair exists to catch).
+
+The consumer itself is dependency-gated: with ``confluent_kafka`` or
+``kafka-python`` absent (this image ships neither), :class:`OrdersSource`
+raises at construction with a clear message, and tests/sims feed decoded
+bytes straight through :func:`decode_order` / :func:`order_to_record`.
+Consumer-group offsets are surfaced on every poll so ``checkpoint`` can
+key sketch snapshots to them (exactly-once-ish resume; SURVEY.md §5
+"Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+from . import wire
+from .tensorize import SpanRecord
+
+
+class Order(NamedTuple):
+    order_id: str
+    tracking_id: str
+    shipping_cost_units: float
+    item_count: int
+    product_ids: tuple[str, ...]
+    total_quantity: int
+
+
+def _money_units(buf: bytes | None) -> float:
+    if not buf:
+        return 0.0
+    f = wire.scan_fields(buf)
+    units = wire.first(f, 2, 0)
+    nanos = wire.first(f, 3, 0)
+    # zigzag not used (int64/int32 plain varints in the schema)
+    return float(units) + float(nanos) * 1e-9
+
+
+def decode_order(payload: bytes) -> Order:
+    """Decode an OrderResult protobuf payload (see module docstring)."""
+    f = wire.scan_fields(payload)
+    order_id = (wire.first(f, 1, b"") or b"").decode("utf-8", "replace")
+    tracking = (wire.first(f, 2, b"") or b"").decode("utf-8", "replace")
+    shipping = _money_units(wire.first(f, 3))
+    products: list[str] = []
+    qty = 0
+    for item_buf in f.get(5, []):
+        item_f = wire.scan_fields(item_buf)
+        cart_buf = wire.first(item_f, 1)
+        if cart_buf:
+            cart_f = wire.scan_fields(cart_buf)
+            pid = wire.first(cart_f, 1, b"")
+            if pid:
+                products.append(pid.decode("utf-8", "replace"))
+            qty += int(wire.first(cart_f, 2, 0) or 0)
+    return Order(order_id, tracking, shipping, len(products), tuple(products), qty)
+
+
+def order_to_record(order: Order, duration_us: float = 0.0) -> SpanRecord:
+    """Project an order onto the detector's span shape.
+
+    Trace-id analogue = order id (distinct-order cardinality); monitored
+    attribute = the order's first product id (heavy-hitter per service
+    'checkout-orders'); latency lane carries order value so the EWMA head
+    doubles as an order-value anomaly tracker.
+    """
+    return SpanRecord(
+        service="checkout-orders",
+        duration_us=duration_us if duration_us else order.shipping_cost_units,
+        trace_id=order.order_id.encode() or b"\0",
+        is_error=False,
+        attr=order.product_ids[0] if order.product_ids else "",
+    )
+
+
+def encode_order(order: Order) -> bytes:
+    """Wire-compatible OrderResult encoder (simulator + tests).
+
+    Lets the in-proc shop (``services.checkout``) publish byte-identical
+    payloads to what the reference's Go producer emits, so the decode
+    path is exercised end-to-end without a broker.
+    """
+    items = b""
+    for pid in order.product_ids:
+        cart = wire.encode_len(1, pid.encode()) + wire.encode_int(
+            2, max(order.total_quantity // max(order.item_count, 1), 1)
+        )
+        items += wire.encode_len(5, wire.encode_len(1, cart))
+    money = wire.encode_len(1, b"USD") + wire.encode_int(
+        2, int(order.shipping_cost_units)
+    ) + wire.encode_int(
+        3, int((order.shipping_cost_units - int(order.shipping_cost_units)) * 1e9)
+    )
+    return (
+        wire.encode_len(1, order.order_id.encode())
+        + wire.encode_len(2, order.tracking_id.encode())
+        + wire.encode_len(3, money)
+        + items
+    )
+
+
+class OrdersSource:
+    """Kafka consumer for topic ``orders`` (dependency-gated).
+
+    Mirrors the reference consumer contract: own group id, auto-commit
+    offsets (/root/reference/src/accounting/Consumer.cs:77-80), value =
+    OrderResult bytes. Yields ``(offset_by_partition, SpanRecord)``.
+    """
+
+    TOPIC = "orders"
+
+    def __init__(self, bootstrap: str, group_id: str = "anomaly-detector"):
+        try:
+            from confluent_kafka import Consumer  # type: ignore
+        except ImportError as e:  # pragma: no cover - gated dependency
+            raise ImportError(
+                "confluent_kafka is not available in this image; use "
+                "runtime.replay.FileSource or the in-proc services bus "
+                "for ingestion, or install a Kafka client in deployment."
+            ) from e
+        self._consumer = Consumer(
+            {
+                "bootstrap.servers": bootstrap,
+                "group.id": group_id,
+                "auto.offset.reset": "earliest",
+                "enable.auto.commit": True,
+            }
+        )
+        self._consumer.subscribe([self.TOPIC])
+
+    def poll(self, timeout_s: float = 0.1) -> Iterator[tuple[dict, SpanRecord]]:
+        msg = self._consumer.poll(timeout_s)
+        if msg is None or msg.error():
+            return
+        # Next-offset semantics (Kafka committed-offset convention): a
+        # checkpoint taken after this message seeks *past* it on resume,
+        # so nothing is double-counted into the CMS.
+        offsets = {msg.partition(): msg.offset() + 1}
+        yield offsets, order_to_record(decode_order(msg.value()))

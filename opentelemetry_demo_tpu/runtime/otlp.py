@@ -1,0 +1,193 @@
+"""OTLP/HTTP span ingestion: the collector-export seam.
+
+The reference collector fans traces out to exporters
+(/root/reference/src/otel-collector/otelcol-config.yml:120-123); wiring
+the detector in means adding one more ``otlphttp`` exporter pointing at
+this receiver (deploy/otelcol-config-anomaly.yml does exactly that, the
+pattern of the Jaeger exporter at :85-88). The receiver accepts
+``POST /v1/traces`` with either protobuf (``application/x-protobuf``,
+decoded by the schema-projection below) or JSON OTLP bodies, and turns
+every span into a :class:`~..runtime.tensorize.SpanRecord`.
+
+Field numbers follow the public OTLP protocol (opentelemetry-proto
+trace/v1): ExportTraceServiceRequest{resource_spans=1},
+ResourceSpans{resource=1, scope_spans=2}, Resource{attributes=1},
+KeyValue{key=1, value=2}, AnyValue{string_value=1},
+ScopeSpans{spans=2}, Span{trace_id=1, name=5, start_time_unix_nano=7,
+end_time_unix_nano=8, attributes=9, status=15}, Status{code=3}.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from . import wire
+from .tensorize import SpanRecord
+
+_STATUS_ERROR = 2  # opentelemetry.proto.trace.v1.Status.StatusCode.ERROR
+
+# Attribute keys worth monitoring for heavy hitters, in priority order —
+# the ids the shop attaches to its spans (e.g. checkout's app.product.id,
+# session ids from baggage; SURVEY.md §5 "Tracing").
+MONITORED_ATTR_KEYS = (
+    "app.product.id",
+    "app.order.id",
+    "app.session.id",
+    "session.id",
+)
+
+
+def _anyvalue_str(buf: bytes) -> str | None:
+    f = wire.scan_fields(buf)
+    sv = wire.first(f, 1)
+    if isinstance(sv, bytes):
+        return sv.decode("utf-8", "replace")
+    return None
+
+
+def _attrs_to_dict(attr_bufs: list[bytes]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for kv_buf in attr_bufs:
+        kv = wire.scan_fields(kv_buf)
+        key = wire.first(kv, 1, b"")
+        val_buf = wire.first(kv, 2)
+        if key and isinstance(val_buf, bytes):
+            sval = _anyvalue_str(val_buf)
+            if sval is not None:
+                out[key.decode("utf-8", "replace")] = sval
+    return out
+
+
+def _pick_attr(attrs: dict[str, str]) -> str | None:
+    for key in MONITORED_ATTR_KEYS:
+        if key in attrs:
+            return attrs[key]
+    return None
+
+
+def decode_export_request(payload: bytes) -> list[SpanRecord]:
+    """ExportTraceServiceRequest protobuf → SpanRecords."""
+    records: list[SpanRecord] = []
+    req = wire.scan_fields(payload)
+    for rs_buf in req.get(1, []):
+        rs = wire.scan_fields(rs_buf)
+        service = "unknown"
+        res_buf = wire.first(rs, 1)
+        if res_buf:
+            res = wire.scan_fields(res_buf)
+            res_attrs = _attrs_to_dict(res.get(1, []))
+            service = res_attrs.get("service.name", service)
+        for ss_buf in rs.get(2, []):
+            ss = wire.scan_fields(ss_buf)
+            for span_buf in ss.get(2, []):
+                records.append(_decode_span(span_buf, service))
+    return records
+
+
+def _decode_span(span_buf: bytes, service: str) -> SpanRecord:
+    sp = wire.scan_fields(span_buf)
+    trace_id = wire.first(sp, 1, b"\0") or b"\0"
+    start = int(wire.first(sp, 7, 0) or 0)
+    end = int(wire.first(sp, 8, 0) or 0)
+    duration_us = max(end - start, 0) / 1000.0
+    attrs = _attrs_to_dict(sp.get(9, []))
+    is_error = False
+    status_buf = wire.first(sp, 15)
+    if status_buf:
+        st = wire.scan_fields(status_buf)
+        is_error = int(wire.first(st, 3, 0) or 0) == _STATUS_ERROR
+    return SpanRecord(
+        service=service,
+        duration_us=duration_us,
+        trace_id=trace_id,
+        is_error=is_error,
+        attr=_pick_attr(attrs),
+    )
+
+
+def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
+    """JSON-encoded OTLP (the collector's otlphttp json mode)."""
+    doc = json.loads(payload)
+    records: list[SpanRecord] = []
+    for rs in doc.get("resourceSpans", []):
+        service = "unknown"
+        for attr in rs.get("resource", {}).get("attributes", []):
+            if attr.get("key") == "service.name":
+                service = attr.get("value", {}).get("stringValue", service)
+        for ss in rs.get("scopeSpans", []):
+            for sp in ss.get("spans", []):
+                attrs = {
+                    a.get("key"): a.get("value", {}).get("stringValue")
+                    for a in sp.get("attributes", [])
+                }
+                start = int(sp.get("startTimeUnixNano", 0))
+                end = int(sp.get("endTimeUnixNano", 0))
+                records.append(
+                    SpanRecord(
+                        service=service,
+                        duration_us=max(end - start, 0) / 1000.0,
+                        trace_id=bytes.fromhex(sp.get("traceId", "00")),
+                        is_error=sp.get("status", {}).get("code") in (2, "STATUS_CODE_ERROR"),
+                        attr=_pick_attr({k: v for k, v in attrs.items() if v}),
+                    )
+                )
+    return records
+
+
+class OtlpHttpReceiver:
+    """Threaded OTLP/HTTP trace receiver feeding a callback.
+
+    ``on_records`` is called from the server thread with each request's
+    decoded SpanRecords; the callback enqueues into the pipeline (which
+    owns batching/tensorization on its own thread).
+    """
+
+    def __init__(
+        self,
+        on_records: Callable[[list[SpanRecord]], None],
+        host: str = "0.0.0.0",
+        port: int = 4318,
+    ):
+        receiver = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    if "json" in (self.headers.get("Content-Type") or ""):
+                        records = decode_export_request_json(body)
+                    else:
+                        records = decode_export_request(body)
+                    receiver.on_records(records)
+                except (wire.WireError, json.JSONDecodeError, ValueError):
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-protobuf")
+                self.end_headers()
+                self.wfile.write(b"")  # empty ExportTraceServiceResponse
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self.on_records = on_records
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="otlp-receiver", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

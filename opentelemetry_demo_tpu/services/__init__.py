@@ -1,0 +1,18 @@
+"""The Astronomy Shop capability layer, in-process.
+
+Behavioural re-implementations of the reference's business services
+(SURVEY.md §2.1) as one-process Python components wired by
+:class:`~.shop.Shop` — the docker-compose analogue — emitting spans
+through ``telemetry.Tracer`` into the anomaly-detector pipeline. Each
+module's docstring cites the reference service whose observable
+behaviour it mirrors (APIs, failure flags, latency profiles); none of
+them translate reference code — the stack here is Python-in-proc +
+the framework's native/TPU components, not Go/C#/Java/PHP/Ruby ports.
+
+Failure injection parity (SURVEY.md §5): every reference flagd flag has
+an equivalent here and flips real behaviour the detector must catch.
+"""
+
+from .shop import Shop, ShopConfig
+
+__all__ = ["Shop", "ShopConfig"]

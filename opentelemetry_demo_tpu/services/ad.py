@@ -1,0 +1,67 @@
+"""Ad service: category-targeted ads + CPU/GC/error fault flags.
+
+Mirrors the reference Java AdService's observable behaviour
+(/root/reference/src/ad/src/main/java/.../AdService.java:135-213 and
+problempattern/*): ads served by category keyword with a random
+fallback; session-id baggage drives targeting (AdService.java:160-168);
+``adFailure`` errors 1-in-10 requests, ``adHighCpu`` burns latency,
+``adManualGc`` injects long stop-the-world pauses.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase, ServiceError
+from ..telemetry.tracer import TraceContext
+
+FLAG_AD_FAILURE = "adFailure"
+FLAG_AD_HIGH_CPU = "adHighCpu"
+FLAG_AD_MANUAL_GC = "adManualGc"
+
+ADS = {
+    "telescopes": ["Aperture fever sale: 10% off Dobsonians"],
+    "eyepieces": ["Sharper views: premium Plossl set"],
+    "filters": ["See the veil: OIII filters in stock"],
+    "mounts": ["Track perfectly: Go-To mounts"],
+    "cameras": ["Image the sky: cooled astro cams"],
+    "binoculars": ["Grab-and-go: big binoculars"],
+    "books": ["Navigate the deep sky: laminated atlas"],
+    "accessories": ["Never lose a target: red dot finders"],
+    "power": ["All-night power in the field"],
+}
+
+
+class AdService(ServiceBase):
+    name = "ad"
+    base_latency_us = 700.0
+
+    def get_ads(self, ctx: TraceContext, context_keys: list[str]) -> list[str]:
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add(
+                "app_ads_requests_total", 1.0,
+                targeted=str(bool(context_keys)).lower(),
+            )
+        # Fault flags, in the order the reference applies them.
+        if bool(self.flag(FLAG_AD_FAILURE, False, ctx)):
+            if self.env.rng.random() < 0.1:  # 1-in-10, AdService.java:172
+                self.span("GetAds", ctx, error=True)
+                raise ServiceError(self.name, "flagged ad failure")
+        extra_us = 0.0
+        if bool(self.flag(FLAG_AD_HIGH_CPU, False, ctx)):
+            extra_us += float(self.env.rng.gamma(4.0, 2000.0))
+        if bool(self.flag(FLAG_AD_MANUAL_GC, False, ctx)):
+            # Full-GC pause: rare but enormous.
+            if self.env.rng.random() < 0.05:
+                extra_us += 300_000.0
+
+        picks: list[str] = []
+        for key in context_keys:
+            picks.extend(ADS.get(key, []))
+        if not picks:
+            flat = [a for ads in ADS.values() for a in ads]
+            idx = self.env.rng.integers(0, len(flat), size=2)
+            picks = [flat[i] for i in idx]
+        self.span(
+            "GetAds", ctx, extra_us=extra_us,
+            attr=ctx.baggage.get("session.id"),
+        )
+        return picks

@@ -1,0 +1,77 @@
+"""Shared service scaffolding: env wiring + simulated latency model.
+
+Every shop service boots from a :class:`ServiceEnv` (tracer, flag
+evaluator, RNG, virtual clock) — the analogue of the reference's shared
+boot shape (SURVEY.md §3.5: env config → tracer/meter → OpenFeature →
+server). Latencies are drawn from a gamma distribution around each
+service's base (long right tail, like real RPC latency) and stretched by
+fault flags, so every injected failure has the observable signature the
+detector is supposed to catch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..telemetry.tracer import TraceContext, Tracer
+from ..utils.flags import FlagEvaluator
+
+
+@dataclass
+class ServiceEnv:
+    tracer: Tracer
+    flags: FlagEvaluator
+    rng: np.random.Generator
+    clock: Callable[[], float]
+    metrics: object | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class ServiceBase:
+    """A shop service: named span source with a latency profile."""
+
+    name = "service"
+    base_latency_us = 500.0
+
+    def __init__(self, env: ServiceEnv):
+        self.env = env
+
+    # -- latency / span helpers ---------------------------------------
+
+    def _latency(self, scale: float = 1.0) -> float:
+        # gamma(k=4) ⇒ mean 4θ; θ chosen so mean = base_latency_us.
+        theta = self.base_latency_us * scale / 4.0
+        return float(self.env.rng.gamma(4.0, theta))
+
+    def span(
+        self,
+        op: str,
+        ctx: TraceContext,
+        scale: float = 1.0,
+        extra_us: float = 0.0,
+        error: bool = False,
+        attr: str | None = None,
+    ) -> float:
+        """Emit one server span with simulated duration; returns µs."""
+        duration = self._latency(scale) + extra_us
+        self.env.tracer.emit(
+            self.name, op, ctx, duration, is_error=error, attr=attr
+        )
+        return duration
+
+    def flag(self, key: str, default, ctx: TraceContext | None = None):
+        targeting = ""
+        if ctx is not None:
+            targeting = ctx.baggage.get("session.id", "")
+        return self.env.flags.evaluate(key, default, targeting)
+
+
+class ServiceError(RuntimeError):
+    """A service-level failure (maps to span status ERROR upstream)."""
+
+    def __init__(self, service: str, message: str):
+        super().__init__(f"{service}: {message}")
+        self.service = service

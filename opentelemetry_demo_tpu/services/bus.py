@@ -1,0 +1,81 @@
+"""In-proc event bus: the Kafka ``orders`` topic analogue.
+
+A single-partition append-only log with independent consumer-group
+cursors — the exact consumption model the reference demonstrates with
+``accounting`` and ``fraud-detection`` as two groups on one topic
+(/root/reference/src/accounting/Consumer.cs:77,
+/root/reference/src/fraud-detection/.../main.kt:27). Offsets are
+first-class (``group_offset``) so checkpointing can key sketch snapshots
+to them just like real Kafka offsets. Values are wire-compatible
+OrderResult bytes (``runtime.kafka_orders``) with trace headers attached
+the way the reference injects context into Kafka headers
+(/root/reference/src/checkout/main.go:631-637).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+
+class BusMessage(NamedTuple):
+    offset: int
+    key: bytes
+    value: bytes
+    headers: dict[str, str]
+
+
+class Topic:
+    def __init__(self, name: str):
+        self.name = name
+        self._log: list[BusMessage] = []
+        self._cursors: dict[str, int] = {}
+
+    def produce(self, key: bytes, value: bytes, headers: dict[str, str] | None = None) -> int:
+        offset = len(self._log)
+        self._log.append(BusMessage(offset, key, value, dict(headers or {})))
+        return offset
+
+    def poll(self, group: str, max_messages: int = 64) -> list[BusMessage]:
+        cursor = self._cursors.get(group, 0)
+        out = self._log[cursor : cursor + max_messages]
+        self._cursors[group] = cursor + len(out)
+        return out
+
+    def group_offset(self, group: str) -> int:
+        return self._cursors.get(group, 0)
+
+    def seek(self, group: str, offset: int) -> None:
+        self._cursors[group] = max(0, min(offset, len(self._log)))
+
+    @property
+    def end_offset(self) -> int:
+        return len(self._log)
+
+    def lag(self, group: str) -> int:
+        return self.end_offset - self.group_offset(group)
+
+
+class Bus:
+    """Topic registry + pump for subscribed consumers."""
+
+    def __init__(self):
+        self._topics: dict[str, Topic] = {}
+        self._consumers: list[tuple[str, str, Callable[[BusMessage], None]]] = []
+
+    def topic(self, name: str) -> Topic:
+        if name not in self._topics:
+            self._topics[name] = Topic(name)
+        return self._topics[name]
+
+    def subscribe(self, topic: str, group: str, handler: Callable[[BusMessage], None]) -> None:
+        self.topic(topic)
+        self._consumers.append((topic, group, handler))
+
+    def pump(self, max_messages: int = 64) -> int:
+        """Deliver pending messages to all consumer groups; returns count."""
+        delivered = 0
+        for topic, group, handler in self._consumers:
+            for msg in self._topics[topic].poll(group, max_messages):
+                handler(msg)
+                delivered += 1
+        return delivered

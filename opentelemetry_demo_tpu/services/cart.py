@@ -1,0 +1,82 @@
+"""Cart service: add/get/empty over a pluggable KV store.
+
+Mirrors the reference C# cart
+(/root/reference/src/cart/src/services/CartService.cs:13-101 over Valkey,
+ValkeyCartStore.cs): per-user item dict, quantity accumulation on
+re-add, and the ``cartFailure`` flag swapping in a store whose writes
+fail (CartService.cs:83-90). Latency histograms per op mirror the
+custom ``app.cart.*.latency`` metrics (ValkeyCartStore.cs:30-43).
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase, ServiceError
+from ..telemetry.tracer import TraceContext
+
+FLAG_CART_FAILURE = "cartFailure"
+
+
+class InMemoryCartStore:
+    """Valkey-analogue KV store: user id → {product id: quantity}."""
+
+    def __init__(self):
+        self._data: dict[str, dict[str, int]] = {}
+
+    def add(self, user_id: str, product_id: str, quantity: int) -> None:
+        cart = self._data.setdefault(user_id, {})
+        cart[product_id] = cart.get(product_id, 0) + quantity
+
+    def get(self, user_id: str) -> dict[str, int]:
+        return dict(self._data.get(user_id, {}))
+
+    def empty(self, user_id: str) -> None:
+        self._data.pop(user_id, None)
+
+
+class FailingCartStore(InMemoryCartStore):
+    """The cartFailure stand-in: every write raises."""
+
+    def add(self, user_id: str, product_id: str, quantity: int) -> None:
+        raise ServiceError("cart", "bad cart store (cartFailure active)")
+
+    def empty(self, user_id: str) -> None:
+        raise ServiceError("cart", "bad cart store (cartFailure active)")
+
+
+class CartService(ServiceBase):
+    name = "cart"
+    base_latency_us = 400.0
+
+    def __init__(self, env):
+        super().__init__(env)
+        self._store = InMemoryCartStore()
+        self._bad_store = FailingCartStore()
+
+    def _active_store(self, ctx: TraceContext):
+        if bool(self.flag(FLAG_CART_FAILURE, False, ctx)):
+            return self._bad_store
+        return self._store
+
+    def add_item(self, ctx: TraceContext, user_id: str, product_id: str, quantity: int) -> None:
+        store = self._active_store(ctx)
+        try:
+            store.add(user_id, product_id, quantity)
+        except ServiceError:
+            self.span("AddItem", ctx, scale=2.0, error=True, attr=product_id)
+            raise
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add("app_cart_add_item_total", 1.0)
+        self.span("AddItem", ctx, attr=product_id)
+
+    def get_cart(self, ctx: TraceContext, user_id: str) -> dict[str, int]:
+        self.span("GetCart", ctx)
+        return self._active_store(ctx).get(user_id)
+
+    def empty_cart(self, ctx: TraceContext, user_id: str) -> None:
+        store = self._active_store(ctx)
+        try:
+            store.empty(user_id)
+        except ServiceError:
+            self.span("EmptyCart", ctx, scale=2.0, error=True)
+            raise
+        self.span("EmptyCart", ctx)

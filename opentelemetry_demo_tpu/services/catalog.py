@@ -1,0 +1,112 @@
+"""Product catalog: list/get/search over a JSON-loadable product set.
+
+Mirrors the reference service's observable behaviour
+(/root/reference/src/product-catalog/main.go:277-349): products served
+from data files reloadable on an interval; search is substring match;
+the ``productCatalogFailure`` flag fails ``get_product`` for exactly one
+featured product id (the reference fails only ``OLJCESPC7Z``,
+main.go:339-349 — here the first catalog entry plays that role).
+
+The product data is this framework's own astronomy-shop set (original
+content, same *shape* as the reference's JSON: id, name, categories,
+price) — see ``DEFAULT_PRODUCTS``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .base import ServiceBase, ServiceError
+from .money import Money
+from ..telemetry.tracer import TraceContext
+
+FLAG_CATALOG_FAILURE = "productCatalogFailure"
+
+DEFAULT_PRODUCTS = [
+    {"id": "TEL-DOB-10", "name": "10-inch Dobsonian Telescope",
+     "categories": ["telescopes"], "priceUsd": 649.99},
+    {"id": "TEL-REF-80", "name": "80mm Apochromatic Refractor",
+     "categories": ["telescopes"], "priceUsd": 929.00},
+    {"id": "EYE-PLO-25", "name": "25mm Plossl Eyepiece",
+     "categories": ["eyepieces", "accessories"], "priceUsd": 54.50},
+    {"id": "FIL-OIII-2", "name": "2-inch OIII Nebula Filter",
+     "categories": ["filters", "accessories"], "priceUsd": 129.95},
+    {"id": "MNT-EQ6-GT", "name": "EQ6 Go-To Equatorial Mount",
+     "categories": ["mounts"], "priceUsd": 1799.00},
+    {"id": "CAM-ASI-294", "name": "Cooled Astro Camera IMX294",
+     "categories": ["cameras"], "priceUsd": 1080.00},
+    {"id": "BIN-15X70", "name": "15x70 Astronomy Binoculars",
+     "categories": ["binoculars"], "priceUsd": 159.00},
+    {"id": "RED-DOT-F", "name": "Red Dot Finder",
+     "categories": ["accessories"], "priceUsd": 34.90},
+    {"id": "CHA-ATLAS", "name": "Deep Sky Atlas (Laminated)",
+     "categories": ["books"], "priceUsd": 42.00},
+    {"id": "PWR-TANK-12", "name": "12V Field Power Tank",
+     "categories": ["accessories", "power"], "priceUsd": 119.00},
+]
+
+
+class ProductCatalog(ServiceBase):
+    name = "product-catalog"
+    base_latency_us = 300.0
+
+    def __init__(self, env, products_path: str | None = None):
+        super().__init__(env)
+        self._path = products_path
+        self._mtime = -1.0
+        self._products: list[dict] = []
+        self._reload(force=True)
+        # The flag-failure target: the catalog's featured product.
+        self.failure_product_id = self._products[0]["id"]
+
+    # -- data loading (reference reloads on a ticker, main.go:183-205) --
+
+    def _reload(self, force: bool = False) -> None:
+        if self._path is None:
+            if force:
+                self._products = [dict(p) for p in DEFAULT_PRODUCTS]
+            return
+        try:
+            mtime = os.stat(self._path).st_mtime
+            if force or mtime != self._mtime:
+                with open(self._path) as f:
+                    self._products = json.load(f)["products"]
+                self._mtime = mtime
+        except (OSError, json.JSONDecodeError, KeyError):
+            if force:
+                self._products = [dict(p) for p in DEFAULT_PRODUCTS]
+
+    # -- API -----------------------------------------------------------
+
+    def list_products(self, ctx: TraceContext) -> list[dict]:
+        self._reload()
+        self.span("ListProducts", ctx)
+        return list(self._products)
+
+    def get_product(self, ctx: TraceContext, product_id: str) -> dict:
+        self._reload()
+        fail = (
+            bool(self.flag(FLAG_CATALOG_FAILURE, False, ctx))
+            and product_id == self.failure_product_id
+        )
+        self.span("GetProduct", ctx, error=fail, attr=product_id)
+        if fail:
+            raise ServiceError(self.name, f"flagged failure for {product_id}")
+        for p in self._products:
+            if p["id"] == product_id:
+                return dict(p)
+        self.span("GetProduct", ctx, error=True, attr=product_id)
+        raise ServiceError(self.name, f"no product {product_id}")
+
+    def search_products(self, ctx: TraceContext, query: str) -> list[dict]:
+        self._reload()
+        self.span("SearchProducts", ctx)
+        q = query.lower()
+        return [p for p in self._products if q in p["name"].lower()]
+
+    def price_of(self, product_id: str) -> Money:
+        for p in self._products:
+            if p["id"] == product_id:
+                return Money.from_float("USD", p["priceUsd"])
+        raise ServiceError(self.name, f"no product {product_id}")

@@ -1,0 +1,126 @@
+"""Checkout: the order orchestrator — the shop's money path.
+
+Mirrors the reference Go checkout's PlaceOrder flow
+(/root/reference/src/checkout/main.go:246-331 and §3.1 of SURVEY.md):
+get cart → per-item product lookup + currency convert → shipping quote
+→ convert → charge card → ship → empty cart → email confirmation →
+publish OrderResult to the orders topic with trace context in headers
+(main.go:549-637). The ``kafkaQueueProblems`` flag floods the topic with
+extra messages the way the reference's producer loop does
+(main.go:603-613).
+
+Every failure path emits an error span *and* propagates, so the detector
+sees exactly what Jaeger would show a human.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import NamedTuple
+
+from .base import ServiceBase, ServiceError
+from .bus import Bus
+from .cart import CartService
+from .catalog import ProductCatalog
+from .currency import CurrencyService
+from .email import EmailService
+from .money import Money
+from .payment import PaymentService
+from .shipping import ShippingService
+from ..runtime.kafka_orders import Order, encode_order
+from ..telemetry.tracer import TraceContext
+
+FLAG_KAFKA_PROBLEMS = "kafkaQueueProblems"
+ORDERS_TOPIC = "orders"
+
+
+class PlacedOrder(NamedTuple):
+    order_id: str
+    tracking_id: str
+    total: Money
+    items: tuple[str, ...]
+
+
+class CheckoutService(ServiceBase):
+    name = "checkout"
+    base_latency_us = 1000.0
+
+    def __init__(
+        self,
+        env,
+        cart: CartService,
+        catalog: ProductCatalog,
+        currency: CurrencyService,
+        payment: PaymentService,
+        shipping: ShippingService,
+        email: EmailService,
+        bus: Bus,
+    ):
+        super().__init__(env)
+        self.cart = cart
+        self.catalog = catalog
+        self.currency = currency
+        self.payment = payment
+        self.shipping = shipping
+        self.email = email
+        self.bus = bus
+
+    def place_order(
+        self,
+        ctx: TraceContext,
+        user_id: str,
+        user_currency: str,
+        email: str,
+        card_number: str = "4432801561520454",
+        expiry_year: int = 2030,
+        expiry_month: int = 1,
+    ) -> PlacedOrder:
+        try:
+            items = self.cart.get_cart(ctx, user_id)
+            if not items:
+                raise ServiceError(self.name, "empty cart")
+
+            total = Money(user_currency, 0, 0)
+            product_ids = []
+            for product_id, qty in items.items():
+                self.catalog.get_product(ctx, product_id)
+                usd = self.catalog.price_of(product_id).multiply(qty)
+                total = total.add(self.currency.convert(ctx, usd, user_currency))
+                product_ids.append(product_id)
+
+            ship_usd = self.shipping.get_quote(ctx, sum(items.values()))
+            total = total.add(self.currency.convert(ctx, ship_usd, user_currency))
+
+            self.payment.charge(ctx, total, card_number, expiry_year, expiry_month)
+            tracking_id = self.shipping.ship_order(ctx)
+            self.cart.empty_cart(ctx, user_id)
+
+            order_id = str(uuid.uuid5(uuid.NAMESPACE_DNS, ctx.trace_id.hex()))
+            self.email.send_order_confirmation(ctx, email, order_id)
+
+            order = Order(
+                order_id=order_id,
+                tracking_id=tracking_id,
+                shipping_cost_units=ship_usd.to_float(),
+                item_count=len(product_ids),
+                product_ids=tuple(product_ids),
+                total_quantity=sum(items.values()),
+            )
+            self._publish(ctx, order)
+            self.span("PlaceOrder", ctx, attr=product_ids[0] if product_ids else None)
+            return PlacedOrder(order_id, tracking_id, total, tuple(product_ids))
+        except ServiceError:
+            self.span("PlaceOrder", ctx, scale=1.5, error=True)
+            raise
+
+    def _publish(self, ctx: TraceContext, order: Order) -> None:
+        """Async post-processing boundary (main.go:549-614)."""
+        topic = self.bus.topic(ORDERS_TOPIC)
+        value = encode_order(order)
+        headers = ctx.to_headers()  # context over the async boundary
+        topic.produce(order.order_id.encode(), value, headers)
+        self.span("orders publish", ctx, scale=0.3)
+        # kafkaQueueProblems: flood the topic so consumers lag.
+        flood = int(self.flag(FLAG_KAFKA_PROBLEMS, 0, ctx))
+        for _ in range(max(flood, 0)):
+            topic.produce(order.order_id.encode(), value, headers)

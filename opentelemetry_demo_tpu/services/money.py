@@ -1,0 +1,64 @@
+"""Money arithmetic: (currency, units, nanos) with carry/borrow.
+
+Mirrors the semantics of the reference's money package
+(/root/reference/src/checkout/money/money.go: validation, signs must
+agree, nanos in ±1e9, Sum with carry) and the proto Money shape
+(/root/reference/pb/demo.proto:146-160). Implemented from the documented
+invariants, not the Go code.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+NANOS_PER_UNIT = 1_000_000_000
+
+
+class MoneyError(ValueError):
+    pass
+
+
+class Money(NamedTuple):
+    currency: str
+    units: int
+    nanos: int
+
+    def validate(self) -> "Money":
+        if abs(self.nanos) >= NANOS_PER_UNIT:
+            raise MoneyError(f"nanos out of range: {self.nanos}")
+        if self.units > 0 and self.nanos < 0 or self.units < 0 and self.nanos > 0:
+            raise MoneyError("units and nanos signs disagree")
+        if not self.currency:
+            raise MoneyError("missing currency code")
+        return self
+
+    @classmethod
+    def from_float(cls, currency: str, value: float) -> "Money":
+        units = int(value)
+        nanos = int(round((value - units) * NANOS_PER_UNIT))
+        if nanos == NANOS_PER_UNIT or nanos == -NANOS_PER_UNIT:
+            units += 1 if nanos > 0 else -1
+            nanos = 0
+        return cls(currency, units, nanos).validate()
+
+    def to_float(self) -> float:
+        return self.units + self.nanos / NANOS_PER_UNIT
+
+    def add(self, other: "Money") -> "Money":
+        self.validate()
+        other.validate()
+        if self.currency != other.currency:
+            raise MoneyError(
+                f"currency mismatch: {self.currency} != {other.currency}"
+            )
+        total = (self.units + other.units) * NANOS_PER_UNIT + self.nanos + other.nanos
+        units, nanos = divmod(abs(total), NANOS_PER_UNIT)
+        sign = -1 if total < 0 else 1
+        return Money(self.currency, sign * units, sign * nanos)
+
+    def multiply(self, factor: int) -> "Money":
+        self.validate()
+        total = (self.units * NANOS_PER_UNIT + self.nanos) * factor
+        units, nanos = divmod(abs(total), NANOS_PER_UNIT)
+        sign = -1 if total < 0 else 1
+        return Money(self.currency, sign * units, sign * nanos)

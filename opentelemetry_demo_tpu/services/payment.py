@@ -1,0 +1,92 @@
+"""Payment service: card validation + flag-driven failure injection.
+
+Mirrors the reference Node payment service's observable behaviour
+(/root/reference/src/payment/charge.js:25-91): cards are validated
+(Luhn + type by prefix + expiry), only visa/mastercard are accepted,
+``paymentFailure`` fails a configurable fraction of charges
+(demo.flagd.json percentage variants), and ``synthetic_request`` baggage
+marks the charge unfunded (charge.js:77-82) — the loadgen's traffic is
+test traffic, after all. A transaction counter mirrors
+``app.payment.transactions`` (charge.js:15).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from .base import ServiceBase, ServiceError
+from .money import Money
+from ..telemetry.tracer import TraceContext
+
+FLAG_PAYMENT_FAILURE = "paymentFailure"
+FLAG_PAYMENT_UNREACHABLE = "paymentUnreachable"
+
+
+def luhn_valid(number: str) -> bool:
+    digits = [int(c) for c in number if c.isdigit()]
+    if len(digits) < 12:
+        return False
+    checksum = 0
+    for i, d in enumerate(reversed(digits)):
+        if i % 2 == 1:
+            d *= 2
+            if d > 9:
+                d -= 9
+        checksum += d
+    return checksum % 10 == 0
+
+
+def card_type(number: str) -> str:
+    if number.startswith("4"):
+        return "visa"
+    if number[:2] in {"51", "52", "53", "54", "55"}:
+        return "mastercard"
+    if number[:2] in {"34", "37"}:
+        return "amex"
+    return "unknown"
+
+
+class PaymentService(ServiceBase):
+    name = "payment"
+    base_latency_us = 800.0
+
+    def charge(
+        self,
+        ctx: TraceContext,
+        amount: Money,
+        card_number: str,
+        expiry_year: int,
+        expiry_month: int,
+        now_year: int = 2026,
+        now_month: int = 1,
+    ) -> str:
+        # flagd-driven probabilistic failure (percentage variants).
+        fail_rate = float(self.flag(FLAG_PAYMENT_FAILURE, 0.0, ctx))
+        if self.flag(FLAG_PAYMENT_UNREACHABLE, False, ctx):
+            self.span("Charge", ctx, scale=5.0, error=True)
+            raise ServiceError(self.name, "payment service unreachable")
+        if fail_rate > 0 and self.env.rng.random() < fail_rate:
+            self.span("Charge", ctx, scale=1.5, error=True)
+            raise ServiceError(self.name, "charge failed (paymentFailure active)")
+
+        ctype = card_type(card_number)
+        if not luhn_valid(card_number):
+            self.span("Charge", ctx, error=True)
+            raise ServiceError(self.name, "invalid card number")
+        if ctype not in ("visa", "mastercard"):
+            self.span("Charge", ctx, error=True)
+            raise ServiceError(self.name, f"{ctype} not accepted")
+        if (expiry_year, expiry_month) < (now_year, now_month):
+            self.span("Charge", ctx, error=True)
+            raise ServiceError(
+                self.name, f"card expired {expiry_month}/{expiry_year}"
+            )
+
+        charged = ctx.baggage.get("synthetic_request") != "true"
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add(
+                "app_payment_transactions_total", 1.0,
+                currency=amount.currency, charged=str(charged).lower(),
+            )
+        self.span("Charge", ctx, attr=ctype)
+        return str(uuid.uuid5(uuid.NAMESPACE_OID, ctx.trace_id.hex()))

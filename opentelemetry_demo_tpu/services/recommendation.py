@@ -1,0 +1,49 @@
+"""Recommendation service: random product picks + cache-leak flag.
+
+Mirrors the reference Python service
+(/root/reference/src/recommendation/recommendation_server.py:67-114):
+returns up to 5 random catalog products excluding the ones in the
+request, and under ``recommendationCacheFailure`` simulates an unbounded
+cache whose growth degrades latency (the reference leaks a growing list
+and re-reads the full catalog, :79-93) — observable as a slow latency
+ramp, the kind of creeping degradation the EWMA's long timescale exists
+to catch.
+"""
+
+from __future__ import annotations
+
+from .base import ServiceBase
+from .catalog import ProductCatalog
+from ..telemetry.tracer import TraceContext
+
+FLAG_RECO_CACHE = "recommendationCacheFailure"
+
+
+class RecommendationService(ServiceBase):
+    name = "recommendation"
+    base_latency_us = 900.0
+
+    def __init__(self, env, catalog: ProductCatalog):
+        super().__init__(env)
+        self.catalog = catalog
+        self._cache_entries = 0  # simulated leak size
+
+    def list_recommendations(
+        self, ctx: TraceContext, exclude_ids: list[str]
+    ) -> list[str]:
+        leak = bool(self.flag(FLAG_RECO_CACHE, False, ctx))
+        extra_us = 0.0
+        if leak:
+            # Each hit grows the "cache"; latency grows with it.
+            self._cache_entries += 1
+            extra_us = min(self._cache_entries * 15.0, 50_000.0)
+        else:
+            self._cache_entries = 0
+        products = self.catalog.list_products(ctx)
+        pool = [p["id"] for p in products if p["id"] not in set(exclude_ids)]
+        k = min(5, len(pool))
+        picks = list(self.env.rng.choice(pool, size=k, replace=False)) if k else []
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add("app_recommendations_total", float(k))
+        self.span("ListRecommendations", ctx, extra_us=extra_us)
+        return [str(p) for p in picks]

@@ -1,0 +1,50 @@
+"""Shipping + quote: the HTTP-JSON leg of the order path.
+
+Mirrors the reference pair: the Rust shipping service
+(/root/reference/src/shipping/src/shipping_service/quote.rs:15-69
+delegates cost to the PHP quote service via HTTP POST /getquote;
+tracking.rs issues tracking ids) and the PHP quote's per-item random
+cost (/root/reference/src/quote/app/routes.php:16-74). Here shipping is
+one hop (quote is a separate service object, same call structure), with
+the quote cost = per-item uniform cost — the same observable shape.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from .base import ServiceBase
+from .money import Money
+from ..telemetry.tracer import TraceContext
+
+
+class QuoteService(ServiceBase):
+    name = "quote"
+    base_latency_us = 600.0
+
+    def get_quote(self, ctx: TraceContext, item_count: int) -> Money:
+        self.span("getquote", ctx)
+        if self.env.metrics is not None:
+            self.env.metrics.counter_add("app_quotes_total", 1.0)
+        if item_count <= 0:
+            return Money("USD", 0, 0)
+        per_item = float(self.env.rng.uniform(8.0, 12.5))
+        return Money.from_float("USD", round(per_item * item_count, 2))
+
+
+class ShippingService(ServiceBase):
+    name = "shipping"
+    base_latency_us = 500.0
+
+    def __init__(self, env, quote: QuoteService):
+        super().__init__(env)
+        self.quote = quote
+
+    def get_quote(self, ctx: TraceContext, item_count: int) -> Money:
+        cost = self.quote.get_quote(ctx, item_count)
+        self.span("get-quote", ctx)
+        return cost
+
+    def ship_order(self, ctx: TraceContext) -> str:
+        self.span("ship-order", ctx)
+        return str(uuid.uuid5(uuid.NAMESPACE_URL, ctx.trace_id.hex()))

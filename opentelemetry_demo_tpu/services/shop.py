@@ -1,0 +1,136 @@
+"""Shop: the whole Astronomy Shop wired in one process.
+
+The docker-compose analogue (/root/reference/docker-compose.yml wires 20+
+containers; SURVEY.md §1): builds every service with shared telemetry,
+flags, and the orders bus; attaches the two reference consumers; drives
+the Locust-profile load generator on a virtual clock; and streams every
+emitted span into the anomaly-detector pipeline. One object, fully
+deterministic under a seed — the "run the real system, assert on traces"
+test philosophy (SURVEY.md §4) without a container runtime.
+
+Flag control works live mid-run exactly like flipping flags in flagd-ui:
+``shop.set_flag("paymentFailure", 0.5)`` changes behaviour of the next
+simulated request.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from .ad import AdService
+from .base import ServiceEnv
+from .bus import Bus
+from .cart import CartService
+from .catalog import ProductCatalog
+from .checkout import CheckoutService
+from .consumers import AccountingService, FraudDetectionService
+from .currency import CurrencyService
+from .email import EmailService
+from .frontend import Frontend
+from .loadgen import LoadGenerator
+from .payment import PaymentService
+from .recommendation import RecommendationService
+from .shipping import QuoteService, ShippingService
+from ..runtime.tensorize import SpanRecord
+from ..telemetry.metrics import MetricRegistry
+from ..telemetry.tracer import Tracer
+from ..utils.flags import FlagEvaluator
+
+
+@dataclass
+class ShopConfig:
+    users: int = 5
+    seed: int = 0
+    pump_interval_s: float = 0.25  # how often spans flush downstream
+
+
+class Shop:
+    def __init__(self, config: ShopConfig | None = None):
+        self.config = config or ShopConfig()
+        self._t = 0.0
+        self._span_buffer: list[SpanRecord] = []
+        self.flags = FlagEvaluator({"flags": {}})
+        self.metrics = MetricRegistry()
+        self.tracer = Tracer(self._span_buffer.append)
+        rng = np.random.default_rng(self.config.seed)
+        env = ServiceEnv(
+            tracer=self.tracer,
+            flags=self.flags,
+            rng=rng,
+            clock=lambda: self._t,
+            metrics=self.metrics,
+        )
+        self.env = env
+
+        self.bus = Bus()
+        self.catalog = ProductCatalog(env)
+        self.currency = CurrencyService(env)
+        self.cart = CartService(env)
+        self.payment = PaymentService(env)
+        self.quote = QuoteService(env)
+        self.shipping = ShippingService(env, self.quote)
+        self.email = EmailService(env)
+        self.recommendation = RecommendationService(env, self.catalog)
+        self.ad = AdService(env)
+        self.checkout = CheckoutService(
+            env, self.cart, self.catalog, self.currency, self.payment,
+            self.shipping, self.email, self.bus,
+        )
+        self.frontend = Frontend(
+            env, self.catalog, self.cart, self.checkout, self.currency,
+            self.recommendation, self.ad,
+        )
+        self.accounting = AccountingService(env, self.bus)
+        self.fraud = FraudDetectionService(env, self.bus)
+        self.loadgen = LoadGenerator(self.frontend, rng, users=self.config.users)
+
+    # -- flag control (flagd-ui analogue) ------------------------------
+
+    def set_flag(self, key: str, value, variants: dict | None = None) -> None:
+        doc = {"flags": dict(self.flags._doc.get("flags", {}))}
+        variants = variants or {"on": value}
+        doc["flags"][key] = {
+            "state": "ENABLED",
+            "variants": variants,
+            "defaultVariant": next(iter(variants)),
+        }
+        self.flags.replace(doc)
+
+    def clear_flag(self, key: str) -> None:
+        doc = {"flags": dict(self.flags._doc.get("flags", {}))}
+        doc["flags"].pop(key, None)
+        self.flags.replace(doc)
+
+    # -- simulation loop ----------------------------------------------
+
+    @property
+    def now(self) -> float:
+        return self._t
+
+    def run(
+        self,
+        seconds: float,
+        on_spans: Callable[[float, list[SpanRecord]], None] | None = None,
+    ) -> None:
+        """Advance the shop ``seconds`` of virtual time.
+
+        Every ``pump_interval_s`` the bus delivers to consumers and the
+        accumulated spans flush to ``on_spans`` (typically
+        ``pipeline.submit`` + ``pipeline.pump``).
+        """
+        end = self._t + seconds
+        step = self.config.pump_interval_s
+        while self._t < end:
+            self._t = min(self._t + step, end)
+            self.loadgen.run_until(self._t)
+            self.bus.pump()
+            if self._span_buffer:
+                # Copy-and-clear, never rebind: the tracer holds a
+                # reference to this exact list's append method.
+                spans = list(self._span_buffer)
+                self._span_buffer.clear()
+                if on_spans is not None:
+                    on_spans(self._t, spans)

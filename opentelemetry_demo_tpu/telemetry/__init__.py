@@ -1,0 +1,19 @@
+"""Telemetry: in-proc tracing SDK + metric export.
+
+The reference instruments every service with an OTel SDK and ships
+three signals through the collector (SURVEY.md §3.2). Here the tracer is
+in-process (spans go straight to the detector pipeline and/or an OTLP
+exporter), and metrics export in Prometheus text format — the same
+surfaces Grafana scrapes in the reference stack.
+"""
+
+from .tracer import Baggage, Tracer, TraceContext
+from .metrics import MetricRegistry, PrometheusExporter
+
+__all__ = [
+    "Baggage",
+    "Tracer",
+    "TraceContext",
+    "MetricRegistry",
+    "PrometheusExporter",
+]

@@ -1,0 +1,143 @@
+"""Metrics: counters/histograms + Prometheus text exposition.
+
+The detector surfaces its results the way the reference's services
+surface theirs — app-level OTel metrics scraped into Prometheus and
+graphed in Grafana (SURVEY.md §5 "Metrics"; custom metric examples:
+``app.frontend.requests`` InstrumentationMiddleware.ts:10,
+``app.payment.transactions`` charge.js:15). The ``app.anomaly.*`` family
+exported here drives deploy/grafana-anomaly-dashboard.json.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class MetricRegistry:
+    """Thread-safe counters and gauges with Prometheus text output."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._help: dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def counter_add(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        seen: set[str] = set()
+
+        def emit(store: dict, kind: str) -> Iterable[str]:
+            for (name, labels), value in sorted(store.items()):
+                if name not in seen:
+                    seen.add(name)
+                    if name in self._help:
+                        yield f"# HELP {name} {self._help[name]}"
+                    yield f"# TYPE {name} {kind}"
+                yield f"{name}{_fmt_labels(dict(labels))} {value}"
+
+        lines.extend(emit(counters, "counter"))
+        lines.extend(emit(gauges, "gauge"))
+        return "\n".join(lines) + "\n"
+
+
+class PrometheusExporter:
+    """Serves a registry at ``/metrics`` (the scrape surface)."""
+
+    def __init__(self, registry: MetricRegistry, host: str = "0.0.0.0", port: int = 9464):
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="prom-exporter", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# Metric names for the anomaly detector's export family.
+ANOMALY_FLAG_TOTAL = "app_anomaly_flags_total"
+ANOMALY_Z_SCORE = "app_anomaly_z_score"
+ANOMALY_CARDINALITY = "app_anomaly_distinct_traces"
+ANOMALY_HEAVY_HITTER = "app_anomaly_heavy_hitter_ratio"
+ANOMALY_SPANS_TOTAL = "app_anomaly_spans_processed_total"
+ANOMALY_LAG_P99 = "app_anomaly_detection_lag_p99_ms"
+
+
+def export_report(
+    registry: MetricRegistry,
+    service_names: list[str],
+    report,
+    flagged: list[str],
+) -> None:
+    """Publish one DetectorReport into the registry (host-side, cheap)."""
+    import numpy as np
+
+    lat_z = np.asarray(report.lat_z)
+    err_z = np.asarray(report.err_z)
+    rate_z = np.asarray(report.rate_z)
+    card_z = np.asarray(report.card_z)
+    card = np.asarray(report.card_est)
+    hh = np.asarray(report.hh_ratio)
+    for i, name in enumerate(service_names):
+        registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(lat_z[i]).max()),
+                           service=name, signal="latency")
+        registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(err_z[i]).max()),
+                           service=name, signal="error_rate")
+        registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(rate_z[i]).max()),
+                           service=name, signal="throughput")
+        registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(card_z[i]).max()),
+                           service=name, signal="cardinality")
+        registry.gauge_set(ANOMALY_CARDINALITY, float(card[i].max()), service=name)
+        registry.gauge_set(ANOMALY_HEAVY_HITTER, float(hh[i].max()), service=name)
+    for name in flagged:
+        registry.counter_add(ANOMALY_FLAG_TOTAL, 1.0, service=name)

@@ -1,0 +1,88 @@
+"""In-proc tracing: trace context, baggage, span emission.
+
+Behavioural contract mirrored from the reference (SURVEY.md §5
+"Tracing"): W3C-style trace ids propagate across every service hop —
+including the async Kafka boundary, where the reference injects context
+into message headers (/root/reference/src/checkout/main.go:631-637) —
+and baggage carries ``session.id`` / ``synthetic_request`` from the load
+generator down to payment/ad targeting
+(/root/reference/src/load-generator/locustfile.py:176-178,
+/root/reference/src/payment/charge.js:77-82).
+
+Durations are *simulated* (each service models its latency profile and
+fault-flag effects) and the clock is injectable, so a minute of shop
+traffic runs in milliseconds of wall time while producing span streams
+with realistic per-service structure — the property the detector tests
+need.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..runtime.tensorize import SpanRecord
+
+Baggage = dict  # key → str value; propagated verbatim
+
+
+@dataclass
+class TraceContext:
+    """One distributed trace: id + baggage, passed across every hop."""
+
+    trace_id: bytes
+    baggage: Baggage = field(default_factory=dict)
+
+    @classmethod
+    def new(cls, baggage: Baggage | None = None) -> "TraceContext":
+        return cls(trace_id=secrets.token_bytes(16), baggage=dict(baggage or {}))
+
+    def to_headers(self) -> dict[str, str]:
+        """W3C-traceparent-shaped header injection (Kafka/HTTP boundary)."""
+        headers = {"traceparent": f"00-{self.trace_id.hex()}-{'0' * 16}-01"}
+        if self.baggage:
+            headers["baggage"] = ",".join(
+                f"{k}={v}" for k, v in self.baggage.items()
+            )
+        return headers
+
+    @classmethod
+    def from_headers(cls, headers: dict[str, str]) -> "TraceContext":
+        tp = headers.get("traceparent", "")
+        parts = tp.split("-")
+        trace_id = bytes.fromhex(parts[1]) if len(parts) >= 2 else secrets.token_bytes(16)
+        baggage: Baggage = {}
+        for item in headers.get("baggage", "").split(","):
+            if "=" in item:
+                k, v = item.split("=", 1)
+                baggage[k.strip()] = v.strip()
+        return cls(trace_id=trace_id, baggage=baggage)
+
+
+class Tracer:
+    """Emits SpanRecords into a sink; one instance per shop."""
+
+    def __init__(self, sink: Callable[[SpanRecord], None]):
+        self._sink = sink
+        self.spans_emitted = 0
+
+    def emit(
+        self,
+        service: str,
+        name: str,
+        ctx: TraceContext,
+        duration_us: float,
+        is_error: bool = False,
+        attr: str | None = None,
+    ) -> None:
+        self.spans_emitted += 1
+        self._sink(
+            SpanRecord(
+                service=service,
+                duration_us=float(duration_us),
+                trace_id=ctx.trace_id,
+                is_error=is_error,
+                attr=attr,
+            )
+        )

@@ -1,0 +1,15 @@
+"""Config (env contract) and flagd-style feature flags."""
+
+from .config import ConfigError, env_float, env_int, env_str, must_map_env
+from .flags import FlagEvaluator, FlagFileStore, OfrepClient
+
+__all__ = [
+    "ConfigError",
+    "env_float",
+    "env_int",
+    "env_str",
+    "must_map_env",
+    "FlagEvaluator",
+    "FlagFileStore",
+    "OfrepClient",
+]

@@ -1,0 +1,60 @@
+"""Environment config contract: hard-fail on missing keys.
+
+Mirrors the reference's two-tier config system (SURVEY.md §5 "Config /
+flag system"): static configuration arrives exclusively through
+environment variables and a service must refuse to boot when a required
+key is absent — the behaviour every reference service implements
+(Go ``mustMapEnv`` /root/reference/src/checkout/main.go:230-236, Python
+``must_map_env`` /root/reference/src/recommendation/recommendation_server.py:116-120,
+Kotlin /root/reference/src/fraud-detection/src/main/kotlin/frauddetection/main.kt:42-46).
+Failing fast at boot beats a half-configured service discovered at 3am.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class ConfigError(RuntimeError):
+    """A required environment key is missing or malformed."""
+
+
+def must_map_env(target: dict, key: str, env_name: str) -> None:
+    """Fetch ``env_name`` into ``target[key]`` or refuse to boot."""
+    value = os.environ.get(env_name, "")
+    if not value:
+        raise ConfigError(f"environment variable {env_name} not set")
+    target[key] = value
+
+
+def env_str(env_name: str, default: str | None = None) -> str:
+    value = os.environ.get(env_name, "")
+    if value:
+        return value
+    if default is None:
+        raise ConfigError(f"environment variable {env_name} not set")
+    return default
+
+
+def env_int(env_name: str, default: int | None = None) -> int:
+    raw = os.environ.get(env_name, "")
+    if not raw:
+        if default is None:
+            raise ConfigError(f"environment variable {env_name} not set")
+        return default
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise ConfigError(f"{env_name}={raw!r} is not an integer") from e
+
+
+def env_float(env_name: str, default: float | None = None) -> float:
+    raw = os.environ.get(env_name, "")
+    if not raw:
+        if default is None:
+            raise ConfigError(f"environment variable {env_name} not set")
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise ConfigError(f"{env_name}={raw!r} is not a number") from e

@@ -1,0 +1,143 @@
+"""flagd-style feature flags: file-backed evaluation + OFREP client.
+
+The reference's entire fault-injection surface is a flagd JSON file
+(/root/reference/src/flagd/demo.flagd.json) evaluated by OpenFeature SDKs
+in every service, editable live via flagd-ui (SURVEY.md §5). This module
+implements the same control plane for the TPU framework:
+
+- :class:`FlagFileStore` — watches a flagd-schema JSON file and reloads
+  on mtime change (flagd's own file-backed mode;
+  /root/reference/docker-compose.yml:614-623 mounts the file the same way).
+- :class:`FlagEvaluator` — evaluates ``state``/``variants``/
+  ``defaultVariant`` plus the ``fractional`` targeting rule (weighted
+  bucket on a targeting key, e.g. session id) — the subset the demo's
+  flags actually use (percentage paymentFailure variants etc.).
+- :class:`OfrepClient` — OpenFeature REST (OFREP) evaluation against a
+  live flagd, for deployments where the detector sidecar shares the
+  shop's flagd instead of a local file (the reference's load generator
+  uses OFREP the same way,
+  /root/reference/src/load-generator/locustfile.py:72-74).
+
+The detector reads its own switches through this layer:
+``anomalyDetectorEnabled``, ``anomalyDetectorZThreshold`` — per the
+north-star requirement that the sidecar is gated by a flagd flag.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+import zlib
+from typing import Any
+
+
+class FlagEvaluator:
+    """Evaluate flags from a flagd-schema dict ``{"flags": {...}}``."""
+
+    def __init__(self, doc: dict | None = None):
+        self._doc = doc or {"flags": {}}
+
+    def replace(self, doc: dict) -> None:
+        self._doc = doc or {"flags": {}}
+
+    def flag_keys(self) -> list[str]:
+        return list(self._doc.get("flags", {}))
+
+    def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
+        """Return the flag's value, or ``default`` if absent/disabled."""
+        flag = self._doc.get("flags", {}).get(key)
+        if not isinstance(flag, dict):
+            return default
+        if str(flag.get("state", "ENABLED")).upper() == "DISABLED":
+            return default
+        variants = flag.get("variants", {})
+        variant = flag.get("defaultVariant")
+        targeting = flag.get("targeting") or {}
+        frac = targeting.get("fractional")
+        if isinstance(frac, list) and frac:
+            variant = self._fractional(key, frac, targeting_key, variant)
+        if variant in variants:
+            return variants[variant]
+        return default
+
+    @staticmethod
+    def _fractional(
+        key: str, rule: list, targeting_key: str, fallback: Any
+    ) -> Any:
+        """Weighted variant pick, sticky per targeting key.
+
+        flagd buckets ``hash(flagKey + targetingKey)`` over the weight
+        sum; we use crc32 for the same stable-bucket property (the exact
+        hash need not match flagd's murmur3 — stickiness and weighting
+        are the contract that matters to the demo's percentage flags).
+        """
+        pairs = []
+        for entry in rule:
+            if isinstance(entry, list) and len(entry) == 2:
+                pairs.append((str(entry[0]), float(entry[1])))
+        total = sum(w for _, w in pairs)
+        if total <= 0:
+            return fallback
+        bucket = zlib.crc32(f"{key}{targeting_key}".encode()) % int(total)
+        acc = 0.0
+        for name, weight in pairs:
+            acc += weight
+            if bucket < acc:
+                return name
+        return fallback
+
+
+class FlagFileStore(FlagEvaluator):
+    """File-backed evaluator with mtime-based hot reload."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._mtime = -1.0
+        self._maybe_reload(force=True)
+
+    def _maybe_reload(self, force: bool = False) -> None:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return
+        if force or mtime != self._mtime:
+            try:
+                with open(self.path) as f:
+                    self.replace(json.load(f))
+                self._mtime = mtime
+            except (OSError, json.JSONDecodeError):
+                # Keep serving the previous snapshot on a torn write —
+                # flagd-ui rewrites the file in place.
+                pass
+
+    def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
+        self._maybe_reload()
+        return super().evaluate(key, default, targeting_key)
+
+
+class OfrepClient:
+    """Minimal OFREP client (stdlib-only; gated by reachability).
+
+    ``evaluate`` degrades to the default on any transport error so the
+    detector never hard-depends on the flag service being up — matching
+    the OpenFeature SDK's error-default semantics.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 1.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
+        url = f"{self.base_url}/ofrep/v1/evaluate/flags/{key}"
+        body = json.dumps({"context": {"targetingKey": targeting_key}}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = json.load(resp)
+            return payload.get("value", default)
+        except Exception:
+            return default

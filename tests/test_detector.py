@@ -44,7 +44,9 @@ def make_stream(rng, t, n, lat_scale=None, err_rate=0.0, svc_weights=None,
 
 @pytest.fixture
 def det():
-    return AnomalyDetector(DetectorConfig(num_services=8, warmup_batches=5.0))
+    return AnomalyDetector(
+        DetectorConfig(num_services=8, warmup_batches=5.0, z_warmup_batches=20.0)
+    )
 
 
 class TestWindowClock:
@@ -155,13 +157,17 @@ class TestDetectorScenarios:
             for b in tz.tensorize(make_stream(rng, k, 200)):
                 det.observe(b, 1000.0 + k / 4)
         trough = 0.0
+        flagged_any = False
         for k in range(60, 80):
             for b in tz.tensorize(make_stream(rng, k, 4)):
                 rep = det.observe(b, 1000.0 + k / 4)
                 trough = min(trough, float(np.asarray(rep.rate_z).min()))
-        # Onset event again: the 1s-tau mean re-adapts within ~4 batches,
-        # so the deep negative z appears on the first starved batch.
-        assert trough < -det.config.z_threshold
+                flagged_any |= bool(np.asarray(rep.flags).any())
+        # The per-batch Poisson z is strongly negative at onset and the
+        # rate-deficit CUSUM integrates the sustained starvation into a
+        # definite alarm.
+        assert trough < -4.0
+        assert flagged_any, "throughput collapse never flagged"
 
     def test_cardinality_window_reset(self, rng):
         """Distinct counts must reset at window boundaries (tumbling)."""

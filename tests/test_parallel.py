@@ -93,7 +93,9 @@ def test_sharded_step_matches_single_chip(rng, layout):
 
 def test_sharded_step_detects_fault(rng):
     """End-to-end on the mesh: a latency fault still flags correctly."""
-    config = DetectorConfig(num_services=8, warmup_batches=5.0)
+    config = DetectorConfig(
+        num_services=8, warmup_batches=5.0, z_warmup_batches=20.0
+    )
     mesh = make_mesh(4, 2)
     step, state = make_sharded_step(config, mesh)
     tz = SpanTensorizer(num_services=8, batch_size=B)

@@ -1,0 +1,364 @@
+"""Runtime tests: wire decode, OTLP receiver, pipeline, checkpoint, flags."""
+
+import json
+import os
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime import SpanRecord, SpanTensorizer
+from opentelemetry_demo_tpu.runtime import checkpoint, wire
+from opentelemetry_demo_tpu.runtime.kafka_orders import (
+    Order,
+    decode_order,
+    encode_order,
+    order_to_record,
+)
+from opentelemetry_demo_tpu.runtime.otlp import (
+    OtlpHttpReceiver,
+    decode_export_request,
+    decode_export_request_json,
+)
+from opentelemetry_demo_tpu.runtime.pipeline import (
+    FLAG_ENABLED,
+    DetectorPipeline,
+)
+from opentelemetry_demo_tpu.utils.flags import FlagEvaluator, FlagFileStore
+from opentelemetry_demo_tpu.utils.config import ConfigError, env_int, must_map_env
+
+
+class TestWire:
+    def test_varint_roundtrip(self):
+        for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+            buf = wire.encode_varint(v)
+            got, pos = wire.read_varint(buf, 0)
+            assert got == v and pos == len(buf)
+
+    def test_scan_skips_unknown_fields(self):
+        msg = (
+            wire.encode_int(1, 42)
+            + wire.encode_len(99, b"future-field")
+            + wire.encode_fixed64(3, 7)
+            + wire.encode_double(4, 1.5)
+        )
+        f = wire.scan_fields(msg)
+        assert wire.first(f, 1) == 42
+        assert wire.first(f, 99) == b"future-field"
+        assert wire.first(f, 3) == 7
+        assert struct.unpack("<d", wire.first(f, 4).to_bytes(8, "little"))[0] == 1.5
+
+    def test_truncated_raises(self):
+        msg = wire.encode_len(1, b"hello")[:-2]
+        with pytest.raises(wire.WireError):
+            wire.scan_fields(msg)
+
+
+class TestOrders:
+    def test_order_roundtrip(self):
+        order = Order(
+            order_id="ord-123",
+            tracking_id="trk-9",
+            shipping_cost_units=12.75,
+            item_count=2,
+            product_ids=("P-A", "P-B"),
+            total_quantity=4,
+        )
+        decoded = decode_order(encode_order(order))
+        assert decoded.order_id == "ord-123"
+        assert decoded.tracking_id == "trk-9"
+        assert decoded.product_ids == ("P-A", "P-B")
+        assert decoded.shipping_cost_units == pytest.approx(12.75, abs=1e-6)
+
+    def test_order_to_record(self):
+        order = Order("o", "t", 3.5, 1, ("P-X",), 1)
+        rec = order_to_record(order)
+        assert rec.service == "checkout-orders"
+        assert rec.attr == "P-X"
+        assert rec.trace_id == b"o"
+
+
+def _otlp_request(service, spans):
+    """Build an ExportTraceServiceRequest via the wire encoders."""
+
+    def anyval(s):
+        return wire.encode_len(1, s.encode())
+
+    def kv(k, v):
+        return wire.encode_len(1, k.encode()) + wire.encode_len(2, anyval(v))
+
+    span_bufs = b""
+    for name, trace_id, start, end, attrs, err in spans:
+        span = (
+            wire.encode_len(1, trace_id)
+            + wire.encode_len(5, name.encode())
+            + wire.encode_fixed64(7, start)
+            + wire.encode_fixed64(8, end)
+        )
+        for k, v in attrs.items():
+            span += wire.encode_len(9, kv(k, v))
+        if err:
+            span += wire.encode_len(15, wire.encode_int(3, 2))
+        span_bufs += wire.encode_len(2, span)
+    resource = wire.encode_len(1, kv("service.name", service))
+    scope_spans = wire.encode_len(2, span_bufs)
+    rs = wire.encode_len(1, resource) + scope_spans
+    return wire.encode_len(1, rs)
+
+
+class TestOtlp:
+    def test_decode_protobuf_request(self):
+        req = _otlp_request(
+            "payment",
+            [
+                ("charge", b"\x01" * 16, 1_000_000_000, 1_250_000_000,
+                 {"app.product.id": "P-7"}, True),
+                ("charge", b"\x02" * 16, 1_000_000_000, 1_100_000_000, {}, False),
+            ],
+        )
+        recs = decode_export_request(req)
+        assert len(recs) == 2
+        assert recs[0].service == "payment"
+        assert recs[0].duration_us == pytest.approx(250_000.0)
+        assert recs[0].is_error and not recs[1].is_error
+        assert recs[0].attr == "P-7"
+        assert recs[1].attr is None
+
+    def test_decode_json_request(self):
+        doc = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {"key": "service.name",
+                             "value": {"stringValue": "cart"}}
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "spans": [
+                                {
+                                    "traceId": "ab" * 16,
+                                    "startTimeUnixNano": 0,
+                                    "endTimeUnixNano": 5_000_000,
+                                    "status": {"code": 2},
+                                    "attributes": [
+                                        {"key": "session.id",
+                                         "value": {"stringValue": "s-1"}}
+                                    ],
+                                }
+                            ]
+                        }
+                    ],
+                }
+            ]
+        }
+        recs = decode_export_request_json(json.dumps(doc).encode())
+        assert len(recs) == 1
+        assert recs[0].service == "cart"
+        assert recs[0].duration_us == pytest.approx(5000.0)
+        assert recs[0].is_error
+        assert recs[0].attr == "s-1"
+
+    def test_http_receiver_roundtrip(self):
+        got = []
+        rx = OtlpHttpReceiver(got.extend, host="127.0.0.1", port=0)
+        rx.start()
+        try:
+            req = _otlp_request(
+                "frontend", [("GET /", b"\x03" * 16, 0, 2_000_000, {}, False)]
+            )
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{rx.port}/v1/traces",
+                data=req,
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            with urllib.request.urlopen(r, timeout=5) as resp:
+                assert resp.status == 200
+            deadline = time.time() + 2
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            rx.stop()
+        assert len(got) == 1 and got[0].service == "frontend"
+
+    def test_http_receiver_rejects_garbage(self):
+        rx = OtlpHttpReceiver(lambda r: None, host="127.0.0.1", port=0)
+        rx.start()
+        try:
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{rx.port}/v1/traces",
+                data=b"\xff\xff\xff",
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(r, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            rx.stop()
+
+
+class TestFlags:
+    DOC = {
+        "flags": {
+            "anomalyDetectorEnabled": {
+                "state": "ENABLED",
+                "variants": {"on": True, "off": False},
+                "defaultVariant": "on",
+            },
+            "paymentFailure": {
+                "state": "ENABLED",
+                "variants": {"on": 1.0, "off": 0.0, "50%": 0.5},
+                "defaultVariant": "off",
+            },
+            "disabledFlag": {
+                "state": "DISABLED",
+                "variants": {"on": True},
+                "defaultVariant": "on",
+            },
+            "fractionalFlag": {
+                "state": "ENABLED",
+                "variants": {"a": "A", "b": "B"},
+                "defaultVariant": "a",
+                "targeting": {"fractional": [["a", 50], ["b", 50]]},
+            },
+        }
+    }
+
+    def test_basic_evaluation(self):
+        ev = FlagEvaluator(self.DOC)
+        assert ev.evaluate("anomalyDetectorEnabled", False) is True
+        assert ev.evaluate("paymentFailure", -1.0) == 0.0
+        assert ev.evaluate("missing", "dflt") == "dflt"
+        assert ev.evaluate("disabledFlag", False) is False
+
+    def test_fractional_sticky_and_split(self):
+        ev = FlagEvaluator(self.DOC)
+        vals = [ev.evaluate("fractionalFlag", "?", f"user-{i}") for i in range(400)]
+        assert vals == [
+            ev.evaluate("fractionalFlag", "?", f"user-{i}") for i in range(400)
+        ]
+        frac_b = sum(v == "B" for v in vals) / len(vals)
+        assert 0.3 < frac_b < 0.7
+
+    def test_file_store_hot_reload(self, tmp_path):
+        path = tmp_path / "flags.json"
+        path.write_text(json.dumps(self.DOC))
+        store = FlagFileStore(str(path))
+        assert store.evaluate("anomalyDetectorEnabled", False) is True
+        doc2 = json.loads(json.dumps(self.DOC))
+        doc2["flags"]["anomalyDetectorEnabled"]["defaultVariant"] = "off"
+        path.write_text(json.dumps(doc2))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert store.evaluate("anomalyDetectorEnabled", True) is False
+
+    def test_file_store_survives_torn_write(self, tmp_path):
+        path = tmp_path / "flags.json"
+        path.write_text(json.dumps(self.DOC))
+        store = FlagFileStore(str(path))
+        path.write_text('{"flags": {bad json')
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert store.evaluate("anomalyDetectorEnabled", False) is True
+
+
+class TestConfig:
+    def test_must_map_env(self, monkeypatch):
+        monkeypatch.setenv("FOO_ADDR", "host:1")
+        target = {}
+        must_map_env(target, "foo", "FOO_ADDR")
+        assert target == {"foo": "host:1"}
+        with pytest.raises(ConfigError):
+            must_map_env(target, "bar", "MISSING_ADDR")
+
+    def test_env_int(self, monkeypatch):
+        monkeypatch.setenv("N", "5")
+        assert env_int("N") == 5
+        assert env_int("MISSING_N", 7) == 7
+        monkeypatch.setenv("BAD", "xyz")
+        with pytest.raises(ConfigError):
+            env_int("BAD")
+
+
+class TestPipeline:
+    def _records(self, rng, n, svc="checkout", lat=300.0):
+        return [
+            SpanRecord(
+                service=svc,
+                duration_us=float(rng.normal(lat, 10.0)),
+                trace_id=int(rng.integers(0, 2**63)),
+                attr="P-1",
+            )
+            for _ in range(n)
+        ]
+
+    def test_pipeline_flags_fault_and_reports(self, rng):
+        det = AnomalyDetector(DetectorConfig(num_services=8, warmup_batches=5.0))
+        reports = []
+        pipe = DetectorPipeline(
+            det,
+            on_report=lambda t, rep, flagged: reports.append((t, flagged)),
+            batch_size=256,
+        )
+        for k in range(30):
+            pipe.submit(self._records(rng, 200))
+            pipe.pump(1000.0 + k / 4)
+        pipe.submit(self._records(rng, 200, lat=4000.0))
+        pipe.pump(1007.6)
+        pipe.drain()
+        assert pipe.stats.batches == 31
+        assert pipe.stats.spans == 31 * 200
+        flagged = [f for _, f in reports if f]
+        assert flagged and flagged[-1] == ["checkout"]
+        assert pipe.stats.lag_p99_ms() > 0
+
+    def test_pipeline_disabled_by_flag(self, rng):
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        ev = FlagEvaluator(
+            {"flags": {FLAG_ENABLED: {
+                "state": "ENABLED",
+                "variants": {"on": True, "off": False},
+                "defaultVariant": "off",
+            }}}
+        )
+        pipe = DetectorPipeline(det, flags=ev, batch_size=256)
+        pipe.submit(self._records(rng, 100))
+        pipe.pump(1000.0)
+        assert pipe.stats.batches == 0
+        assert pipe.stats.dropped_disabled == 100
+
+
+class TestCheckpoint:
+    def test_roundtrip_resume(self, rng, tmp_path):
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        tz = SpanTensorizer(num_services=8, batch_size=128)
+        recs = [
+            SpanRecord("a", float(rng.normal(100, 5)), int(rng.integers(0, 2**62)))
+            for _ in range(128)
+        ]
+        for b in tz.tensorize(recs):
+            det.observe(b, 1000.0)
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(path, det, offsets={"0": 1234}, service_names=tz.service_names)
+        assert checkpoint.exists(path)
+
+        det2, meta = checkpoint.load(path)
+        assert meta["offsets"] == {"0": 1234}
+        assert meta["service_names"] == ["a"]
+        assert int(det2.state.step_idx) == int(det.state.step_idx)
+        np.testing.assert_array_equal(
+            np.asarray(det2.state.hll_bank), np.asarray(det.state.hll_bank)
+        )
+        # The restored detector keeps working (donation-safe arrays).
+        for b in tz.tensorize(recs):
+            det2.observe(b, 1001.0)
+        assert int(det2.state.step_idx) == int(det.state.step_idx) + 1
+
+    def test_config_mismatch_rejected(self, tmp_path):
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(path, det)
+        with pytest.raises(ValueError):
+            checkpoint.load(path, config=DetectorConfig(num_services=16))

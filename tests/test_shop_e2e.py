@@ -1,0 +1,234 @@
+"""End-to-end: the shop capability layer driving the anomaly detector.
+
+This is the framework's version of the reference's trace-based test
+strategy (SURVEY.md §4): run the (simulated) system under the Locust
+profile, flip a fault-injection flag mid-run, and assert the detector
+surfaces the right anomaly on the right service — the full
+BASELINE north-star loop (load → spans → sketches → flags) in one
+process with no containers.
+"""
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
+from opentelemetry_demo_tpu.services import Shop, ShopConfig
+from opentelemetry_demo_tpu.services.base import ServiceError
+from opentelemetry_demo_tpu.services.money import Money, MoneyError
+from opentelemetry_demo_tpu.telemetry.tracer import TraceContext
+
+
+def make_rig(users=5, seed=0, z_threshold=6.0):
+    shop = Shop(ShopConfig(users=users, seed=seed))
+    det = AnomalyDetector(
+        DetectorConfig(
+            num_services=16, warmup_batches=10.0, z_warmup_batches=30.0,
+            z_threshold=z_threshold,
+        )
+    )
+    events = []
+    pipe = DetectorPipeline(
+        det,
+        flags=shop.flags,
+        on_report=lambda t, rep, flagged: events.append((t, flagged, rep)),
+        batch_size=512,
+    )
+
+    def on_spans(t, spans):
+        pipe.submit(spans)
+        pipe.pump(t)
+
+    return shop, det, pipe, events, on_spans
+
+
+class TestShopMechanics:
+    def test_traffic_flows_everywhere(self):
+        shop, det, pipe, events, on_spans = make_rig(seed=3)
+        shop.run(120.0, on_spans)
+        pipe.drain()
+        # All the main services appeared in the span stream.
+        names = set(pipe.tensorizer.service_names)
+        for svc in ("frontend", "product-catalog", "currency", "cart",
+                    "checkout", "payment", "shipping", "quote", "email",
+                    "accounting", "fraud-detection"):
+            assert svc in names, f"{svc} missing from stream ({names})"
+        # Orders flowed through the bus to both consumer groups.
+        assert shop.accounting.orders_seen > 0
+        assert shop.fraud.orders_checked == shop.accounting.orders_seen
+        assert shop.loadgen.requests > 50
+        # Metrics registry saw the app counters.
+        text = shop.metrics.render()
+        assert "app_frontend_requests_total" in text
+        assert "app_payment_transactions_total" in text
+
+    def test_trace_context_crosses_kafka_boundary(self):
+        shop, det, pipe, events, on_spans = make_rig(seed=1)
+        shop.run(60.0, on_spans)
+        # Consumer spans reuse the producing trace id (header propagation).
+        consumer_traces = set()
+        producer_traces = set()
+        sink = []
+        shop.tracer._sink = sink.append
+        shop.run(60.0)
+        for rec in sink:
+            if rec.service in ("accounting", "fraud-detection"):
+                consumer_traces.add(rec.trace_id)
+            if rec.service == "checkout":
+                producer_traces.add(rec.trace_id)
+        assert consumer_traces and consumer_traces <= producer_traces
+
+    def test_quiet_run_no_flags(self):
+        shop, det, pipe, events, on_spans = make_rig(seed=5)
+        shop.run(180.0, on_spans)
+        pipe.drain()
+        flagged = [f for _, f, _ in events if f]
+        assert flagged == [], f"false positives: {flagged[:5]}"
+
+
+class TestFaultScenarios:
+    def _run_fault(self, flag_key, value, fault_svc, signal, seed=7,
+                   warm_s=150.0, fault_s=60.0, variants=None):
+        shop, det, pipe, events, on_spans = make_rig(seed=seed)
+        shop.run(warm_s, on_spans)
+        n_before = len(events)
+        shop.set_flag(flag_key, value, variants)
+        shop.run(fault_s, on_spans)
+        pipe.drain()
+        flagged_svcs = set()
+        for _, flagged, rep in events[n_before:]:
+            flagged_svcs.update(flagged)
+        return shop, pipe, events, n_before, flagged_svcs
+
+    def test_payment_failure_flags_payment_or_checkout(self):
+        # Failures arrive at checkout cadence (~4/min under 5 users), so
+        # evidence accrues via the error CUSUM over a couple of minutes.
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "paymentFailure", 0.9, "payment", "err", fault_s=150.0
+        )
+        # The error wave hits payment and cascades up the money path.
+        assert flagged & {"payment", "checkout", "frontend"}, flagged
+
+    def test_ad_high_cpu_flags_ad(self):
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "adHighCpu", True, "ad", "lat"
+        )
+        assert "ad" in flagged, flagged
+
+    def test_image_slow_load_flags_image_provider(self):
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "imageSlowLoad", True, "image-provider", "lat"
+        )
+        assert "image-provider" in flagged, flagged
+
+    def test_flood_homepage_rate_anomaly(self):
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "loadGeneratorFloodHomepage", 15, "frontend", "rate",
+            variants={"on": 15, "off": 0},
+        )
+        assert flagged & {"frontend", "product-catalog", "currency"}, flagged
+
+    def test_kafka_queue_problems_floods_consumers(self):
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "kafkaQueueProblems", 40, "fraud-detection", "lat/rate",
+            variants={"on": 40, "off": 0},
+        )
+        assert flagged & {"fraud-detection", "accounting"}, flagged
+
+
+class TestServiceUnits:
+    """Direct service behaviour (the reference has almost no unit tests —
+    SURVEY.md §4 — but our services are plain objects, so testing is free)."""
+
+    def _ctx(self):
+        return TraceContext.new({"session.id": "s-test"})
+
+    def test_money_arithmetic(self):
+        a = Money.from_float("USD", 1.75)
+        b = Money.from_float("USD", 0.50)
+        assert a.add(b).to_float() == pytest.approx(2.25)
+        assert a.multiply(3).to_float() == pytest.approx(5.25)
+        neg = Money.from_float("USD", -1.75)
+        assert neg.units == -1 and neg.nanos == -750_000_000
+        with pytest.raises(MoneyError):
+            a.add(Money.from_float("EUR", 1.0))
+        with pytest.raises(MoneyError):
+            Money("USD", 1, -5).validate()
+
+    def test_currency_convert_roundtrip(self):
+        shop = Shop()
+        ctx = self._ctx()
+        usd = Money.from_float("USD", 100.0)
+        eur = shop.currency.convert(ctx, usd, "EUR")
+        back = shop.currency.convert(ctx, eur, "USD")
+        assert back.to_float() == pytest.approx(100.0, abs=0.01)
+        with pytest.raises(ServiceError):
+            shop.currency.convert(ctx, Money.from_float("XXX", 1.0), "USD")
+
+    def test_catalog_failure_flag_targets_one_product(self):
+        shop = Shop()
+        ctx = self._ctx()
+        shop.set_flag("productCatalogFailure", True)
+        bad = shop.catalog.failure_product_id
+        ok = [p for p in shop.catalog.list_products(ctx) if p["id"] != bad][0]
+        assert shop.catalog.get_product(ctx, ok["id"])["id"] == ok["id"]
+        with pytest.raises(ServiceError):
+            shop.catalog.get_product(ctx, bad)
+
+    def test_payment_card_validation(self):
+        shop = Shop()
+        ctx = self._ctx()
+        amount = Money.from_float("USD", 10.0)
+        # Valid visa (Luhn-correct test number).
+        assert shop.payment.charge(ctx, amount, "4111111111111111", 2030, 1)
+        with pytest.raises(ServiceError):  # amex rejected
+            shop.payment.charge(ctx, amount, "378282246310005", 2030, 1)
+        with pytest.raises(ServiceError):  # expired
+            shop.payment.charge(ctx, amount, "4111111111111111", 2020, 1)
+        with pytest.raises(ServiceError):  # luhn-invalid
+            shop.payment.charge(ctx, amount, "4111111111111112", 2030, 1)
+
+    def test_cart_failure_flag(self):
+        shop = Shop()
+        ctx = self._ctx()
+        shop.cart.add_item(ctx, "u1", "TEL-DOB-10", 2)
+        shop.cart.add_item(ctx, "u1", "TEL-DOB-10", 1)
+        assert shop.cart.get_cart(ctx, "u1") == {"TEL-DOB-10": 3}
+        shop.set_flag("cartFailure", True)
+        with pytest.raises(ServiceError):
+            shop.cart.add_item(ctx, "u1", "EYE-PLO-25", 1)
+
+    def test_recommendations_exclude_inputs(self):
+        shop = Shop()
+        ctx = self._ctx()
+        recs = shop.recommendation.list_recommendations(ctx, ["TEL-DOB-10"])
+        assert recs and "TEL-DOB-10" not in recs
+
+    def test_checkout_places_order_end_to_end(self):
+        shop = Shop()
+        ctx = self._ctx()
+        shop.cart.add_item(ctx, "u9", "EYE-PLO-25", 2)
+        order = shop.checkout.place_order(ctx, "u9", "EUR", "u9@example.com")
+        assert order.total.currency == "EUR"
+        assert order.total.to_float() > 0
+        assert shop.cart.get_cart(ctx, "u9") == {}
+        assert shop.email.sent == 1
+        # The order reached the bus, wire-encoded.
+        topic = shop.bus.topic("orders")
+        assert topic.end_offset == 1
+        shop.bus.pump()
+        assert shop.accounting.orders_seen == 1
+        assert shop.fraud.orders_checked == 1
+
+    def test_bus_offsets_and_seek(self):
+        shop = Shop()
+        ctx = self._ctx()
+        for i in range(3):
+            shop.cart.add_item(ctx, "u", "EYE-PLO-25", 1)
+            shop.checkout.place_order(ctx, "u", "USD", "u@example.com")
+        topic = shop.bus.topic("orders")
+        shop.bus.pump()
+        assert topic.group_offset("accounting") == 3
+        assert topic.lag("accounting") == 0
+        topic.seek("accounting", 1)
+        assert len(topic.poll("accounting", 10)) == 2
