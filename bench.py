@@ -69,12 +69,19 @@ def main():
 
     n_pool = 8
     pool = make_batch_pool(config, batch_size, n_pool, rng)
-    dt = jnp.float32(batch_size / BASELINE_SPANS_PER_SEC)
+    # dt stays a Python-derived constant end to end: fetching even one
+    # device scalar to host (e.g. float(dt)) degrades axon tunnel
+    # dispatch ~20x for the rest of the process with no recovery
+    # (measured directly: 68us/step before a single float(dt), then
+    # 1.3-3ms/step on every later fetch-free loop), so the timed loop
+    # and everything before it must be fetch-free.
+    dt_host = batch_size / BASELINE_SPANS_PER_SEC
+    dt = jnp.float32(dt_host)
 
     # Rotation cadence as seen by a stream at the baseline rate: the 1s
     # window rotates every ~1s/dt steps, the 10s/60s windows at 1/10 and
     # 1/60 of that.
-    steps_per_sec = max(int(1.0 / float(dt)), 1)
+    steps_per_sec = max(int(1.0 / dt_host), 1)
     masks = []
     for i in range(steps_per_sec * 60):
         masks.append(

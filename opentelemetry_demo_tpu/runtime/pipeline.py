@@ -22,6 +22,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable
 
+import jax
 import numpy as np
 
 from ..models.detector import AnomalyDetector, DetectorReport
@@ -115,8 +116,12 @@ class DetectorPipeline:
     # -- report handling ----------------------------------------------
 
     def _harvest_one(self) -> None:
-        t_batch, t_dispatch, report = self._inflight.popleft()
-        flags_np = np.asarray(report.flags)  # device sync happens here
+        t_batch, t_dispatch, dev_report = self._inflight.popleft()
+        # One transfer for the whole report pytree: every np.asarray on a
+        # device array is a separate host round trip, and round trips are
+        # the dominant cost on tunneled/remote device topologies.
+        report = jax.device_get(dev_report)
+        flags_np = report.flags
         lag_ms = (time.monotonic() - t_dispatch) * 1e3
         self.stats.lag_ms.append(lag_ms)
         threshold = float(
@@ -129,16 +134,13 @@ class DetectorPipeline:
             # flag only tunes the instantaneous-z sensitivity.
             z = np.maximum.reduce(
                 [
-                    np.abs(np.asarray(report.lat_z)).max(axis=1),
-                    np.abs(np.asarray(report.err_z)).max(axis=1),
-                    np.abs(np.asarray(report.rate_z)).max(axis=1),
-                    np.abs(np.asarray(report.card_z)).max(axis=1),
+                    np.abs(report.lat_z).max(axis=1),
+                    np.abs(report.err_z).max(axis=1),
+                    np.abs(report.rate_z).max(axis=1),
+                    np.abs(report.card_z).max(axis=1),
                 ]
             )
-            cusum_alarm = (
-                np.asarray(report.cusum).max(axis=1)
-                > self.detector.config.cusum_h
-            )
+            cusum_alarm = report.cusum.max(axis=1) > self.detector.config.cusum_h
             flags_np = (z > threshold) | cusum_alarm
         if flags_np.any():
             self.stats.flag_events += 1

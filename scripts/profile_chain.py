@@ -1,0 +1,51 @@
+"""Isolate why chained+donated steps are slower than repeated static calls."""
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from opentelemetry_demo_tpu.models import DetectorConfig, detector_init, detector_step
+from bench import BASELINE_SPANS_PER_SEC, make_batch_pool
+
+config = DetectorConfig()
+B = 2048
+rng = np.random.default_rng(0)
+pool = make_batch_pool(config, B, 4, rng)
+dt = jnp.float32(B / BASELINE_SPANS_PER_SEC)
+rot = jnp.asarray([False, False, False])
+rot_t = jnp.asarray([True, False, False])
+iters = 300
+
+
+def run(name, donate, chain, vary_mask, fetch_report=False):
+    step = jax.jit(
+        partial(detector_step, config), donate_argnums=0 if donate else ()
+    )
+    state = detector_init(config)
+    state, rep = step(state, *pool[0], dt, rot)
+    jax.block_until_ready(state)
+    s = state
+    t0 = time.perf_counter()
+    for i in range(iters):
+        mask = rot_t if (vary_mask and i % 7 == 0) else rot
+        out, rep = step(s if chain else state, *pool[i % 4], dt, mask)
+        if chain:
+            s = out
+        if fetch_report:
+            np.asarray(rep.flags)
+    jax.block_until_ready(out)
+    per = (time.perf_counter() - t0) / iters
+    print(f"{name:45s} {per*1e6:9.1f} us/step")
+
+
+run("no-donate, no-chain, fixed mask", False, False, False)
+run("no-donate, chain, fixed mask", False, True, False)
+run("donate, chain, fixed mask", True, True, False)
+run("donate, chain, varying mask", True, True, True)
+run("donate, chain, vary mask, fetch flags", True, True, True, True)

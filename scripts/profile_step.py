@@ -1,0 +1,88 @@
+"""Component-level timing of detector_step to locate fixed per-step cost."""
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from opentelemetry_demo_tpu.models import DetectorConfig, detector_init, detector_step
+from opentelemetry_demo_tpu.ops import cms, ewma, hll
+from bench import BASELINE_SPANS_PER_SEC, make_batch_pool
+
+config = DetectorConfig()
+B = 2048
+rng = np.random.default_rng(0)
+pool = make_batch_pool(config, B, 4, rng)
+state = detector_init(config)
+
+
+def timeit(name, fn, *args, iters=200):
+    jfn = jax.jit(fn)
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:30s} {dt*1e6:9.1f} us")
+    return dt
+
+
+svc, lat_us, is_error, trace_hi, trace_lo, attr_hi, attr_lo, valid = pool[0]
+dt = jnp.float32(B / BASELINE_SPANS_PER_SEC)
+rot = jnp.asarray([False, False, False])
+rot_t = jnp.asarray([True, False, False])
+
+# Full step
+step = partial(detector_step, config)
+timeit("full step (no rotate)", step, state, *pool[0], dt, rot)
+timeit("full step (rotate w0)", step, state, *pool[0], dt, rot_t)
+
+# Components
+hll_bank = state.hll_bank
+cms_bank = state.cms_bank
+
+
+def f_hll(bank, th, tl, s, v):
+    bucket, rank = hll.hll_indices(th, tl, p=config.hll_p)
+    upd = jax.vmap(hll.hll_update, in_axes=(0, None, None, None, None))
+    return bank.at[:, 0].set(upd(bank[:, 0], s, bucket, rank, v))
+
+
+def f_cms(bank, ah, al, v):
+    cidx = cms.cms_indices(ah, al, config.cms_depth, config.cms_width)
+    upd = jax.vmap(cms.cms_update, in_axes=(0, None, None, None))
+    return bank.at[:, 0].set(upd(bank[:, 0], cidx, None, v))
+
+
+def f_est(bank):
+    return hll.hll_estimate(bank[:, 0])
+
+
+def f_rot(bank, mask):
+    rolled = jnp.stack([jnp.zeros_like(bank[:, 0]), bank[:, 0]], axis=1)
+    m = mask.reshape((-1,) + (1,) * (bank.ndim - 1))
+    return jnp.where(m, rolled, bank)
+
+
+def f_seg(lat, s, v):
+    return ewma.segment_stats(jnp.log1p(lat), s, config.num_services, valid=v)
+
+
+def f_cmsq(bank, ah, al):
+    cidx = cms.cms_indices(ah, al, config.cms_depth, config.cms_width)
+    return jax.vmap(cms.cms_query, in_axes=(0, None))(bank[:, 0], cidx)
+
+
+timeit("hll scatter-max (3 win)", f_hll, hll_bank, trace_hi, trace_lo, svc, valid)
+timeit("cms scatter-add (3 win)", f_cms, cms_bank, attr_hi, attr_lo, valid)
+timeit("hll estimate (3 win)", f_est, hll_bank)
+timeit("rotate hll bank", f_rot, hll_bank, rot_t)
+timeit("segment stats", f_seg, lat_us, svc, valid)
+timeit("cms query (3 win)", f_cmsq, cms_bank, attr_hi, attr_lo)
