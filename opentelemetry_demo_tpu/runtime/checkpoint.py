@@ -11,10 +11,13 @@ and the sketches continue as if never interrupted. Anything replayed
 twice would double-count in CMS — seeking to the recorded offset is what
 prevents that; HLL/EWMA are idempotent/robust to small overlaps anyway.
 
-Format: ``<path>.npz`` (state arrays) + ``<path>.json`` (offsets, intern
-table, config fingerprint). Writes go through a temp file + ``os.replace``
-so a crash mid-write leaves the previous snapshot intact — the same
-torn-write discipline flagd-ui needs for its JSON file (SURVEY.md §2.2).
+Format: one ``<path>.npz`` holding the state arrays plus the metadata
+(offsets, intern table, config fingerprint) as an embedded JSON entry —
+a single file so that state and offsets can never be torn apart by a
+crash between two writes. The write goes through a temp file +
+``os.replace`` so a crash mid-write leaves the previous snapshot intact
+— the same torn-write discipline flagd-ui needs for its JSON file
+(SURVEY.md §2.2).
 """
 
 from __future__ import annotations
@@ -39,27 +42,37 @@ def save(
     state_np = {
         k: np.asarray(v) for k, v in detector.state._asdict().items()
     }
-    tmp = path + ".tmp.npz"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **state_np)
-    os.replace(tmp, path + ".npz")
-
     meta = {
         "offsets": offsets or {},
         "service_names": service_names or [],
         "config": list(detector.config),
         "clock_t_prev": detector.clock._t_prev,
     }
-    tmp = path + ".tmp.json"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, path + ".json")
+    # Metadata rides inside the npz (as a unicode scalar) so snapshot
+    # and offsets commit in ONE os.replace — a crash can only ever leave
+    # the previous complete (state, offsets) pair, never a mixed one.
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __meta__=np.asarray(json.dumps(meta)), **state_np)
+    os.replace(tmp, path + ".npz")
+    # Clean up a sidecar left by the old two-file format so it can't
+    # shadow or confuse a later inspection of the snapshot directory.
+    try:
+        os.remove(path + ".json")
+    except OSError:
+        pass
 
 
 def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetector, dict]:
     """Restore a detector (state + clock) and return (detector, meta)."""
-    with open(path + ".json") as f:
-        meta = json.load(f)
+    with np.load(path + ".npz") as data:
+        if "__meta__" not in data.files:
+            raise ValueError(
+                f"{path}.npz is not a self-contained checkpoint (missing "
+                "__meta__); it was written by an incompatible version"
+            )
+        meta = json.loads(str(data["__meta__"][()]))
+        arrays = {k: data[k] for k in data.files if k != "__meta__"}
     saved_cfg = DetectorConfig(
         *[tuple(v) if isinstance(v, list) else v for v in meta["config"]]
     )
@@ -68,13 +81,12 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
             f"checkpoint config {saved_cfg} does not match requested {config}"
         )
     detector = AnomalyDetector(saved_cfg)
-    with np.load(path + ".npz") as data:
-        detector.state = DetectorState(
-            **{k: jax.device_put(data[k]) for k in data.files}
-        )
+    detector.state = DetectorState(
+        **{k: jax.device_put(v) for k, v in arrays.items()}
+    )
     detector.clock._t_prev = meta.get("clock_t_prev")
     return detector, meta
 
 
 def exists(path: str) -> bool:
-    return os.path.exists(path + ".npz") and os.path.exists(path + ".json")
+    return os.path.exists(path + ".npz")

@@ -162,11 +162,19 @@ class OtlpHttpReceiver:
                         records = decode_export_request_json(body)
                     else:
                         records = decode_export_request(body)
-                    receiver.on_records(records)
-                except (wire.WireError, json.JSONDecodeError, ValueError):
+                except Exception:
+                    # Anything a malformed body can raise while being
+                    # picked apart (WireError, JSONDecodeError, but also
+                    # TypeError/AttributeError from structurally-wrong
+                    # shapes) is the client's fault: answer 400 rather
+                    # than letting http.server abort the connection.
+                    # Only decoding is in scope — a failure in the ingest
+                    # callback below is a server bug and must surface,
+                    # not masquerade as a client error.
                     self.send_response(400)
                     self.end_headers()
                     return
+                receiver.on_records(records)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-protobuf")
                 self.end_headers()

@@ -90,6 +90,10 @@ def first(fields: dict[int, list], field_no: int, default=None):
 
 
 def encode_varint(value: int) -> bytes:
+    if value < 0:
+        # Protobuf encodes negative int32/int64 as the 64-bit two's
+        # complement (always 10 bytes on the wire).
+        value &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = value & 0x7F

@@ -90,14 +90,15 @@ class ProductCatalog(ServiceBase):
             bool(self.flag(FLAG_CATALOG_FAILURE, False, ctx))
             and product_id == self.failure_product_id
         )
-        self.span("GetProduct", ctx, error=fail, attr=product_id)
+        found = next((p for p in self._products if p["id"] == product_id), None)
+        # Exactly one span per request — a second error span would halve
+        # the error rate the detector sees for this service.
+        self.span("GetProduct", ctx, error=fail or found is None, attr=product_id)
         if fail:
             raise ServiceError(self.name, f"flagged failure for {product_id}")
-        for p in self._products:
-            if p["id"] == product_id:
-                return dict(p)
-        self.span("GetProduct", ctx, error=True, attr=product_id)
-        raise ServiceError(self.name, f"no product {product_id}")
+        if found is None:
+            raise ServiceError(self.name, f"no product {product_id}")
+        return dict(found)
 
     def search_products(self, ctx: TraceContext, query: str) -> list[dict]:
         self._reload()

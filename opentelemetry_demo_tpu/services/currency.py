@@ -17,7 +17,7 @@ fallback keeps the capability dependency-free.
 from __future__ import annotations
 
 from .base import ServiceBase, ServiceError
-from .money import NANOS_PER_UNIT, Money
+from .money import NANOS_PER_UNIT, Money, MoneyError
 from ..telemetry.tracer import TraceContext
 
 # EUR = 1.0; own values (shape of the reference's table, not its data).
@@ -65,10 +65,19 @@ class CurrencyService(ServiceBase):
         return sorted(EUR_RATES)
 
     def convert(self, ctx: TraceContext, money: Money, to_code: str) -> Money:
-        self.span("Convert", ctx)
-        money.validate()
-        if money.currency not in EUR_RATES or to_code not in EUR_RATES:
-            self.env.tracer.emit(self.name, "Convert", ctx, 100.0, is_error=True)
+        # Validate before emitting: one span per request, its error bit
+        # reflecting the outcome — a success span followed by an error
+        # span would dilute the error rate the detector measures.
+        invalid: MoneyError | None = None
+        try:
+            money.validate()
+        except MoneyError as e:
+            invalid = e
+        unsupported = money.currency not in EUR_RATES or to_code not in EUR_RATES
+        self.span("Convert", ctx, error=unsupported or invalid is not None)
+        if invalid is not None:
+            raise invalid
+        if unsupported:
             raise ServiceError(
                 self.name, f"unsupported currency {money.currency}->{to_code}"
             )

@@ -109,15 +109,13 @@ class LoadGenerator:
             elif task == "checkout_multi":
                 self._checkout(ctx, user, n_items=int(self.rng.integers(2, 5)))
             elif task == "flood_home":
-                if bool(
+                n_flood = int(
                     self.frontend.env.flags.evaluate(
                         FLAG_FLOOD_HOMEPAGE, 0, user.session_id
                     )
-                ):
-                    for _ in range(int(self.frontend.env.flags.evaluate(
-                        FLAG_FLOOD_HOMEPAGE, 0, user.session_id
-                    ))):
-                        self.frontend.index(self._ctx(user))
+                )
+                for _ in range(n_flood):
+                    self.frontend.index(self._ctx(user))
             elif task == "index":
                 self.frontend.index(ctx)
         except ServiceError:

@@ -128,7 +128,10 @@ def export_report(
     card_z = np.asarray(report.card_z)
     card = np.asarray(report.card_est)
     hh = np.asarray(report.hh_ratio)
-    for i, name in enumerate(service_names):
+    # The intern table can outgrow the sketch's service axis (overflow
+    # names share the last id but keep their own table entries), so cap
+    # at the report's actual row count.
+    for i, name in enumerate(service_names[: lat_z.shape[0]]):
         registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(lat_z[i]).max()),
                            service=name, signal="latency")
         registry.gauge_set(ANOMALY_Z_SCORE, float(np.abs(err_z[i]).max()),

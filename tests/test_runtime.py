@@ -38,6 +38,16 @@ class TestWire:
             got, pos = wire.read_varint(buf, 0)
             assert got == v and pos == len(buf)
 
+    def test_varint_negative_two_complement(self):
+        # Protobuf int64 semantics: negatives go out as 64-bit two's
+        # complement (10 wire bytes) and decode to the unsigned image.
+        for v in (-1, -42, -(2**62)):
+            buf = wire.encode_varint(v)
+            assert len(buf) == 10
+            got, pos = wire.read_varint(buf, 0)
+            assert pos == len(buf)
+            assert got - (1 << 64) == v
+
     def test_scan_skips_unknown_fields(self):
         msg = (
             wire.encode_int(1, 42)
@@ -193,6 +203,17 @@ class TestOtlp:
                 f"http://127.0.0.1:{rx.port}/v1/traces",
                 data=b"\xff\xff\xff",
                 headers={"Content-Type": "application/x-protobuf"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(r, timeout=5)
+            assert ei.value.code == 400
+
+            # Structurally wrong JSON (attributes as a string, not a
+            # list) must also answer 400, not abort the connection.
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{rx.port}/v1/traces",
+                data=b'{"resourceSpans":[{"scopeSpans":[{"spans":[{"attributes":"x"}]}]}]}',
+                headers={"Content-Type": "application/json"},
             )
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(r, timeout=5)
@@ -355,6 +376,17 @@ class TestCheckpoint:
         for b in tz.tensorize(recs):
             det2.observe(b, 1001.0)
         assert int(det2.state.step_idx) == int(det.state.step_idx) + 1
+
+    def test_snapshot_is_one_file(self, tmp_path):
+        # State and offsets must commit atomically: a single npz, no
+        # sidecar that a crash could leave out of step with the arrays.
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(path, det, offsets={"0": 7})
+        assert os.path.exists(path + ".npz")
+        assert not os.path.exists(path + ".json")
+        _, meta = checkpoint.load(path)
+        assert meta["offsets"] == {"0": 7}
 
     def test_config_mismatch_rejected(self, tmp_path):
         det = AnomalyDetector(DetectorConfig(num_services=8))

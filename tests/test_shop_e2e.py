@@ -165,6 +165,30 @@ class TestServiceUnits:
         with pytest.raises(ServiceError):
             shop.currency.convert(ctx, Money.from_float("XXX", 1.0), "USD")
 
+    def test_failed_requests_emit_exactly_one_error_span(self):
+        # A failure must not leave a success span next to its error span
+        # — that would halve the error rate the detector measures.
+        shop = Shop(ShopConfig())
+        ctx = TraceContext.new()
+
+        start = len(shop._span_buffer)
+        with pytest.raises(MoneyError):
+            shop.currency.convert(ctx, Money("USD", 1, -5), "EUR")
+        spans = shop._span_buffer[start:]
+        assert len(spans) == 1 and spans[0].is_error
+
+        start = len(shop._span_buffer)
+        with pytest.raises(ServiceError):
+            shop.currency.convert(ctx, Money("XXX", 1, 0), "USD")
+        spans = shop._span_buffer[start:]
+        assert len(spans) == 1 and spans[0].is_error
+
+        start = len(shop._span_buffer)
+        with pytest.raises(ServiceError):
+            shop.catalog.get_product(ctx, "NO-SUCH-PRODUCT")
+        spans = shop._span_buffer[start:]
+        assert len(spans) == 1 and spans[0].is_error
+
     def test_catalog_failure_flag_targets_one_product(self):
         shop = Shop()
         ctx = self._ctx()
