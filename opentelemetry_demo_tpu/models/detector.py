@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import cms, ewma, hll
+from ..ops import cms, fused, hll
 from ..ops.collectives import NO_COMM, Comm
 from ..runtime.tensorize import TensorBatch
 from .windows import WindowClock
@@ -68,6 +68,10 @@ class DetectorConfig(NamedTuple):
     cusum_h: float = 5.0  # alarm threshold
     cusum_cap: float = 50.0  # bound accumulation (bounded recovery time)
     err_slack: float = 0.01  # tolerated error-rate above baseline
+    # Batch→delta sketch implementation: None auto-selects (the fused
+    # Pallas kernel on TPU, XLA scatters elsewhere); "xla" / "pallas" /
+    # "interpret" force a path (see ops.fused).
+    sketch_impl: str | None = None
 
     @property
     def num_windows(self) -> int:
@@ -216,28 +220,44 @@ def detector_step(
     span_total = rot_bank(state.span_total, rotate)
 
     # ---- 3a. absorb batch into sketch banks --------------------------
-    # HLL: local scatter-max, then max-union across batch shards. The
-    # bank enters replicated (over the batch axis), so pmax of the
-    # updated banks IS the union — the one-collective merge that makes
-    # sketches the right abstraction for SPMD ingest.
-    bucket, rank = hll.hll_indices(trace_hi, trace_lo, p=config.hll_p)
-    upd_hll = jax.vmap(hll.hll_update, in_axes=(0, None, None, None, None))
-    hll_bank = hll_bank.at[:, 0].set(
-        comm.pmax_batch(upd_hll(hll_bank[:, 0], svc, bucket, rank, valid))
-    )
-
-    # CMS: rows are hash-independent, so the sketch axis shards the
+    # The batch is first reduced to one mergeable *delta sketch* (max
+    # HLL rank per cell, count per CMS counter, moment stats per
+    # service) — the fused Pallas kernel on TPU, XLA scatters elsewhere
+    # (ops.fused). Deltas, not banks, then cross the batch-axis
+    # collectives (windows× less ICI traffic) and fan into every
+    # tumbling window with one broadcast max/add.
+    # The latency head works in log space: RPC latency is heavy-tailed
+    # multiplicative (a single gamma draw can sit 6σ out in linear
+    # space), while log-latency is near-gaussian and a k× degradation
+    # is a clean +ln(k) shift at every timescale.
+    log_lat = jnp.log1p(jnp.maximum(lat_us, 0.0))
+    # CMS rows are hash-independent, so the sketch axis shards the
     # depth dimension; this shard updates its own row slice with the
-    # matching global row hashes, and batch shards sum-merge deltas.
+    # matching global row hashes.
     cidx_full = cms.cms_indices(
         attr_hi, attr_lo, config.cms_depth, config.cms_width
     )
     cidx = jax.lax.dynamic_slice_in_dim(cidx_full, shard * d_local, d_local, 0)
-    upd_cms = jax.vmap(cms.cms_update, in_axes=(0, None, None, None))
-    cms_cur = upd_cms(cms_bank[:, 0], cidx, None, valid)
-    cms_bank = cms_bank.at[:, 0].set(
-        cms_bank[:, 0] + comm.psum_batch(cms_cur - cms_bank[:, 0])
+    delta = fused.sketch_batch_delta(
+        svc,
+        log_lat,
+        is_error,
+        trace_hi,
+        trace_lo,
+        cidx,
+        valid,
+        num_services=s_axis,
+        hll_p=config.hll_p,
+        cms_width=config.cms_width,
+        impl=fused.resolve_impl(config.sketch_impl),
     )
+    hll_delta = comm.pmax_batch(delta.hll)
+    cms_delta = comm.psum_batch(delta.cms)
+    stats = comm.psum_batch(delta.stats)
+    hll_bank = hll_bank.at[:, 0].set(
+        jnp.maximum(hll_bank[:, 0], hll_delta[None])
+    )
+    cms_bank = cms_bank.at[:, 0].set(cms_bank[:, 0] + cms_delta[None])
     n_valid = comm.psum_batch(jnp.sum(valid_f))
     span_total = span_total.at[:, 0].add(n_valid)
 
@@ -252,17 +272,7 @@ def detector_step(
     #   throughput Poisson       → z = (n - λdt)/sqrt(λdt + 1)
     taus = jnp.asarray(config.taus_s, jnp.float32)  # [T]
     alphas = 1.0 - jnp.exp(-dt / taus)  # [T]
-    # The latency head works in log space: RPC latency is heavy-tailed
-    # multiplicative (a single gamma draw can sit 6σ out in linear
-    # space), while log-latency is near-gaussian and a k× degradation
-    # is a clean +ln(k) shift at every timescale.
-    log_lat = jnp.log1p(jnp.maximum(lat_us, 0.0))
-    cnt, lat_sum, lat_sumsq = ewma.segment_stats(log_lat, svc, s_axis, valid=valid)
-    _, err_sum, _ = ewma.segment_stats(is_error, svc, s_axis, valid=valid)
-    cnt = comm.psum_batch(cnt)
-    lat_sum = comm.psum_batch(lat_sum)
-    lat_sumsq = comm.psum_batch(lat_sumsq)
-    err_sum = comm.psum_batch(err_sum)
+    cnt, lat_sum, lat_sumsq, err_sum = stats
     seen = cnt > 0  # [S]
     obs2d = seen[:, None]
     warm = (state.obs_batches < config.warmup_batches)[:, None]  # [S,1]
@@ -357,10 +367,12 @@ def detector_step(
 
     # ---- CUSUM layer: sustained small shifts --------------------------
     # Scores use the slowest-τ column as the stable reference. Errors
-    # get a count-likelihood score (each error is strong evidence when
-    # the learned rate is ~0; n·(p+slack) forgives the baseline), so a
-    # trickle of failures — 1-2 per batch under a flagd percentage flag —
-    # integrates to an alarm within a few batches.
+    # score the batch's error count against the slack-forgiven baseline,
+    # standardized by the binomial σ — when the learned rate is ~0 the
+    # denominator is 1 and each error is strong evidence (a trickle of
+    # failures under a flagd percentage flag integrates to an alarm
+    # within a few batches), while a service with a real baseline error
+    # rate gets its routine singles absorbed as the noise they are.
     # No traffic = no evidence either way: sparse services HOLD their
     # accumulators between observed batches (a decay per empty pump
     # would erase the evidence of a 1-request-per-few-seconds service
@@ -368,10 +380,11 @@ def detector_step(
     k = jnp.float32(config.cusum_k)
     active = seen & ~warm[:, 0]
     s_lat = jnp.where(active, lat_z_cusum[:, -1] - k, 0.0)
+    p_ref = err_mean[:, -1]
+    err_sigma = jnp.sqrt(n[:, 0] * p_ref * (1.0 - p_ref) + 1.0)
     s_err = jnp.where(
         active,
-        2.0 * err_cnt[:, 0]
-        - n[:, 0] * (err_mean[:, -1] + config.err_slack)
+        (err_cnt[:, 0] - n[:, 0] * (p_ref + config.err_slack)) / err_sigma
         - k,
         0.0,
     )

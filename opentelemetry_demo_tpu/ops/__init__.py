@@ -27,8 +27,12 @@ from .cms import (
     cms_update,
 )
 from .ewma import ewma_init, ewma_update, segment_stats
+from .fused import SketchDelta, resolve_impl, sketch_batch_delta
 
 __all__ = [
+    "SketchDelta",
+    "sketch_batch_delta",
+    "resolve_impl",
     "fmix32",
     "hash_spans_synthetic",
     "splitmix64_np",

@@ -42,10 +42,13 @@ def save(
     state_np = {
         k: np.asarray(v) for k, v in detector.state._asdict().items()
     }
+    # sketch_impl is an execution-backend knob, not state: a snapshot
+    # written on TPU (pallas) must restore on a CPU box (xla) and vice
+    # versa, so it is excluded from the persisted config fingerprint.
     meta = {
         "offsets": offsets or {},
         "service_names": service_names or [],
-        "config": list(detector.config),
+        "config": list(detector.config._replace(sketch_impl=None)),
         "clock_t_prev": detector.clock._t_prev,
     }
     # Metadata rides inside the npz (as a unicode scalar) so snapshot
@@ -76,10 +79,15 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
     saved_cfg = DetectorConfig(
         *[tuple(v) if isinstance(v, list) else v for v in meta["config"]]
     )
-    if config is not None and list(config) != list(saved_cfg):
-        raise ValueError(
-            f"checkpoint config {saved_cfg} does not match requested {config}"
-        )
+    # Compare/restore ignoring the backend knob (see save()): the caller
+    # keeps their own sketch_impl choice for this process.
+    if config is not None:
+        saved_cfg = saved_cfg._replace(sketch_impl=config.sketch_impl)
+        if list(config) != list(saved_cfg):
+            raise ValueError(
+                f"checkpoint config {saved_cfg} does not match "
+                f"requested {config}"
+            )
     detector = AnomalyDetector(saved_cfg)
     detector.state = DetectorState(
         **{k: jax.device_put(v) for k, v in arrays.items()}

@@ -1,0 +1,99 @@
+"""End-to-end detection drives through the public AnomalyDetector API.
+
+These mirror how the reference system is tested (SURVEY.md §4: run the
+real system, inject a fault via flagd, assert the telemetry lights up)
+— here the "system" is a synthetic span stream and the faults are the
+same shapes the shop's flags produce: a latency degradation
+(imageSlowLoad/adHighCpu analogue) and an error-rate burst
+(paymentFailure analogue). Clean traffic must produce zero flags; the
+fault must be flagged on the right service within a few batches.
+"""
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime import SpanTensorizer
+
+S = 8
+B = 256
+NAMES = [f"svc{i}" for i in range(S)]
+
+
+def _stream(det, rng, n_steps, fault_from, mutate):
+    """Drive det over a synthetic stream; returns first flagged step/svcs."""
+    tz = SpanTensorizer(num_services=S, batch_size=B)
+    flagged_at, flagged_svcs = None, set()
+    for step in range(n_steps):
+        lat = rng.gamma(4.0, 250.0, size=B).astype(np.float32)
+        svc = rng.integers(0, S, size=B)
+        err = (rng.random(B) < 0.01).astype(np.float32)
+        if step >= fault_from:
+            lat, err = mutate(svc, lat, err)
+        tb = tz.pack_arrays(
+            svc=svc,
+            lat_us=lat,
+            trace_id=rng.integers(0, 2**63, size=B, dtype=np.uint64),
+            is_error=err,
+            attr_key=rng.zipf(1.5, size=B).astype(np.uint64),
+        )
+        report = det.observe(tb, step * 0.05)
+        hits = det.flagged_services(report, NAMES)
+        if step < fault_from:
+            assert not hits, f"false positive at clean step {step}: {hits}"
+        elif hits:
+            flagged_svcs.update(hits)
+            if flagged_at is None:
+                flagged_at = step
+    return flagged_at, flagged_svcs
+
+
+@pytest.fixture
+def det():
+    config = DetectorConfig(num_services=S, hll_p=8, cms_width=512)
+    return AnomalyDetector(config)
+
+
+def test_latency_degradation_flagged(det):
+    """8× latency on one service (imageSlowLoad-style) flags fast."""
+    rng = np.random.default_rng(7)
+
+    def mutate(svc, lat, err):
+        return np.where(svc == 3, lat * 8.0, lat).astype(np.float32), err
+
+    flagged_at, svcs = _stream(det, rng, 140, fault_from=120, mutate=mutate)
+    assert flagged_at is not None and flagged_at <= 123
+    assert svcs == {"svc3"}
+
+
+def test_error_burst_flagged(det):
+    """Error rate 1%→25% on one service (paymentFailure-style)."""
+    rng = np.random.default_rng(11)
+
+    def mutate(svc, lat, err):
+        burst = (rng.random(B) < 0.25).astype(np.float32)
+        return lat, np.where(svc == 5, np.maximum(err, burst), err).astype(
+            np.float32
+        )
+
+    flagged_at, svcs = _stream(det, rng, 140, fault_from=120, mutate=mutate)
+    assert flagged_at is not None and flagged_at <= 126
+    assert svcs == {"svc5"}
+
+
+def test_error_trickle_integrates_to_alarm(det):
+    """A sustained trickle (~2 errors/batch on one quiet-baseline
+    service) is below any single-batch threshold but must integrate to
+    a CUSUM alarm — the sustained-small-shift case single-batch
+    z-scores cannot catch."""
+    rng = np.random.default_rng(13)
+
+    def mutate(svc, lat, err):
+        trickle = (rng.random(B) < 0.06).astype(np.float32)
+        return lat, np.where(svc == 2, np.maximum(err, trickle), err).astype(
+            np.float32
+        )
+
+    flagged_at, svcs = _stream(det, rng, 160, fault_from=120, mutate=mutate)
+    assert flagged_at is not None, "trickle never integrated to an alarm"
+    assert "svc2" in svcs

@@ -1,0 +1,145 @@
+"""Fused batch→delta op: Pallas (interpret) vs XLA scatter semantics.
+
+The fused kernel (ops.fused, BASELINE config #4) must be a drop-in
+replacement for the scatter formulation: identical HLL/CMS deltas
+(integer state ⇒ bit-exact) and float-close segment stats, including
+masked lanes and out-of-slice service ids (the SPMD localisation
+contract). On CPU the kernel runs in interpret mode; on real TPU the
+same tests hold natively (validated on v5e-1).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opentelemetry_demo_tpu.models import (
+    DetectorConfig,
+    detector_init,
+    detector_step,
+)
+from opentelemetry_demo_tpu.ops import cms, fused
+from opentelemetry_demo_tpu.ops.hashing import splitmix64_np, split_hi_lo_np
+
+
+def _batch(rng, b, num_services, cms_depth, cms_width, svc_lo=0, svc_hi=None):
+    svc_hi = num_services if svc_hi is None else svc_hi
+    t_hi, t_lo = split_hi_lo_np(
+        splitmix64_np(rng.integers(0, 2**63, size=b, dtype=np.uint64))
+    )
+    a_hi, a_lo = split_hi_lo_np(
+        splitmix64_np(rng.integers(0, 2**20, size=b, dtype=np.uint64))
+    )
+    cidx = cms.cms_indices(
+        jnp.asarray(a_hi), jnp.asarray(a_lo), cms_depth, cms_width
+    )
+    return dict(
+        svc=jnp.asarray(rng.integers(svc_lo, svc_hi, size=b), jnp.int32),
+        log_lat=jnp.asarray(rng.gamma(2.0, 1.0, size=b), jnp.float32),
+        is_error=jnp.asarray(rng.random(b) < 0.1, jnp.float32),
+        trace_hi=jnp.asarray(t_hi),
+        trace_lo=jnp.asarray(t_lo),
+        cidx=cidx,
+        valid=jnp.asarray(rng.random(b) < 0.9),
+    )
+
+
+def _assert_delta_equal(ref: fused.SketchDelta, got: fused.SketchDelta):
+    np.testing.assert_array_equal(np.asarray(ref.hll), np.asarray(got.hll))
+    np.testing.assert_array_equal(np.asarray(ref.cms), np.asarray(got.cms))
+    np.testing.assert_allclose(
+        np.asarray(ref.stats), np.asarray(got.stats), rtol=1e-5, atol=1e-4
+    )
+
+
+class TestSketchBatchDelta:
+    @pytest.mark.parametrize(
+        "b,s,p,d,w",
+        [
+            (256, 32, 8, 4, 1024),
+            (128, 8, 10, 2, 512),  # odd geometry: few services, 2 rows
+            (512, 32, 8, 4, 1024),
+        ],
+    )
+    def test_pallas_matches_xla(self, rng, b, s, p, d, w):
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        # svc range includes out-of-slice ids on both sides, mimicking a
+        # sketch-sharded shard seeing global ids localised by subtraction.
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        ref = fused.sketch_batch_delta(*batch.values(), impl="xla", **kw)
+        got = fused.sketch_batch_delta(*batch.values(), impl="interpret", **kw)
+        _assert_delta_equal(ref, got)
+
+    def test_all_invalid_lanes_produce_empty_delta(self, rng):
+        kw = dict(num_services=8, hll_p=8, cms_width=512)
+        batch = _batch(rng, 64, 8, 4, 512)
+        batch["valid"] = jnp.zeros(64, bool)
+        got = fused.sketch_batch_delta(*batch.values(), impl="interpret", **kw)
+        assert int(jnp.sum(got.hll)) == 0
+        assert int(jnp.sum(got.cms)) == 0
+        np.testing.assert_allclose(np.asarray(got.stats), 0.0)
+
+    def test_delta_is_mergeable_monoid(self, rng):
+        """delta(A ∪ B) == merge(delta(A), delta(B)) — the property that
+        lets batch shards psum/pmax deltas instead of banks."""
+        kw = dict(num_services=8, hll_p=8, cms_width=512)
+        a = _batch(rng, 128, 8, 4, 512)
+        b = _batch(rng, 128, 8, 4, 512)
+        joint = {
+            k: jnp.concatenate([a[k], b[k]], axis=-1) for k in a
+        }
+        da = fused.sketch_batch_delta(*a.values(), impl="interpret", **kw)
+        db = fused.sketch_batch_delta(*b.values(), impl="interpret", **kw)
+        dj = fused.sketch_batch_delta(*joint.values(), impl="xla", **kw)
+        np.testing.assert_array_equal(
+            np.asarray(jnp.maximum(da.hll, db.hll)), np.asarray(dj.hll)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(da.cms + db.cms), np.asarray(dj.cms)
+        )
+        np.testing.assert_allclose(
+            np.asarray(da.stats + db.stats),
+            np.asarray(dj.stats),
+            rtol=1e-5,
+            atol=1e-4,
+        )
+
+    def test_resolve_impl(self):
+        assert fused.resolve_impl("xla") == "xla"
+        assert fused.resolve_impl(None) in ("xla", "pallas")
+        with pytest.raises(ValueError):
+            fused.resolve_impl("cuda")
+
+
+class TestDetectorWithFusedKernel:
+    def test_detector_step_identical_across_impls(self, rng):
+        """The full flagship step must not care which impl ran."""
+        config = DetectorConfig(
+            num_services=8, hll_p=8, cms_width=512, sketch_impl="xla"
+        )
+        config_pl = config._replace(sketch_impl="interpret")
+        b = 256
+        batch = _batch(rng, b, 8, config.cms_depth, config.cms_width)
+        args = (
+            batch["svc"],
+            jnp.expm1(batch["log_lat"]),  # step takes raw latency µs
+            batch["is_error"],
+            batch["trace_hi"],
+            batch["trace_lo"],
+            batch["trace_hi"],  # reuse as attr hashes — fine for parity
+            batch["trace_lo"],
+            batch["valid"],
+            jnp.float32(0.05),
+            jnp.asarray([True, False, False]),
+        )
+        s1, r1 = detector_step(config, detector_init(config), *args)
+        s2, r2 = detector_step(config_pl, detector_init(config_pl), *args)
+        for name, x, y in zip(s1._fields, s1, s2):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-5, err_msg=name
+            )
+        for name, x, y in zip(r1._fields, r1, r2):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-5, err_msg=name
+            )
