@@ -1,0 +1,547 @@
+// Native ingest: protobuf wire → columnar span tensors, C ABI.
+//
+// The host side of the ≥200k spans/sec target (SURVEY.md §7 hard part
+// (a)): protobuf decode and attribute hashing must not be a per-record
+// Python loop. This library decodes the two ingest seams directly into
+// columnar arrays the tensorizer turns into device batches:
+//
+//   - OTLP ExportTraceServiceRequest (the collector-export seam; field
+//     numbers per opentelemetry-proto trace/v1, mirrored from
+//     runtime/otlp.py which mirrors the reference collector config
+//     /root/reference/src/otel-collector/otelcol-config.yml:120-123).
+//   - OrderResult from the Kafka `orders` topic (field numbers per
+//     /root/reference/pb/demo.proto:203-214, same contract as the
+//     reference consumers Consumer.cs:59-70 / main.kt:64).
+//
+// Parity contract with runtime/wire.py + runtime/otlp.py +
+// runtime/kafka_orders.py (enforced by tests/test_native_ingest.py):
+// identical columns on well-formed payloads AND identical error
+// verdicts on malformed ones — the HTTP receiver answers 400 where the
+// Python path would, never 200-and-drop. The Python decoders' field
+// semantics fall into a few categories, modelled explicitly below:
+//
+//   submessage-list  — every occurrence descended, any non-LEN value
+//                      is an error (Python: scan_fields(int) raises).
+//   submessage-first — first occurrence claims the slot; LEN descends,
+//                      numeric 0 is "absent" (falsy), numeric nonzero
+//                      is an error (truthy int hits scan_fields).
+//   bytes-first      — first occurrence claims the slot; LEN is the
+//                      value, numeric 0 falls to the default, numeric
+//                      nonzero is an error (int.decode()).
+//   numeric-first    — first occurrence claims the slot; any numeric
+//                      wire type is the value (wire.py decodes varint/
+//                      fixed alike), empty LEN is falsy-skip, nonempty
+//                      LEN is an error (int(bytes) raises).
+//
+// Strings are hashed with zlib-compatible CRC32 exactly as the Python
+// tensorizer does.
+//
+// Build: g++ -O3 -shared -fPIC (no dependencies). Loaded via ctypes by
+// opentelemetry_demo_tpu/runtime/native.py.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32
+// IEEE CRC-32 (zlib/zip polynomial 0xEDB88320), table-driven; must
+// match Python's zlib.crc32 bit-for-bit (tensorize.py attr keys).
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const Crc32Table kCrc;
+
+uint32_t crc32(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = kCrc.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------ wire scan
+constexpr int kVarint = 0;
+constexpr int kFixed64 = 1;
+constexpr int kLen = 2;
+constexpr int kFixed32 = 5;
+
+struct Slice {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool done() const { return pos >= n; }
+};
+
+// Decode one base-128 varint; false on truncation/overlength (parity
+// with wire.read_varint's 64-bit cap).
+bool read_varint(Slice& s, uint64_t& out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (true) {
+    if (s.pos >= s.n) return false;
+    uint8_t b = s.p[s.pos++];
+    result |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      out = result;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+}
+
+// One field header + payload. For LEN fields `val`/`len` hold the bytes;
+// for varint/fixed the numeric value lands in `num`. Returns false on
+// malformed input (the caller surfaces it as a WireError analogue).
+struct Field {
+  uint32_t no;
+  int wt;
+  uint64_t num;
+  const uint8_t* val;
+  size_t len;
+};
+
+bool next_field(Slice& s, Field& f) {
+  uint64_t tag;
+  if (!read_varint(s, tag)) return false;
+  f.no = uint32_t(tag >> 3);
+  f.wt = int(tag & 0x7);
+  if (f.no == 0) return false;
+  switch (f.wt) {
+    case kVarint:
+      return read_varint(s, f.num);
+    case kFixed64:
+      if (s.pos + 8 > s.n) return false;
+      std::memcpy(&f.num, s.p + s.pos, 8);  // little-endian hosts only
+      s.pos += 8;
+      return true;
+    case kFixed32: {
+      if (s.pos + 4 > s.n) return false;
+      uint32_t v;
+      std::memcpy(&v, s.p + s.pos, 4);
+      s.pos += 4;
+      f.num = v;
+      return true;
+    }
+    case kLen: {
+      uint64_t ln;
+      if (!read_varint(s, ln)) return false;
+      if (ln > s.n - s.pos) return false;
+      f.val = s.p + s.pos;
+      f.len = size_t(ln);
+      s.pos += size_t(ln);
+      return true;
+    }
+    default:
+      return false;  // SGROUP/EGROUP etc: wire.py raises on these
+  }
+}
+
+bool numeric(const Field& f) {
+  return f.wt == kVarint || f.wt == kFixed64 || f.wt == kFixed32;
+}
+
+struct Str {
+  const uint8_t* p = nullptr;
+  size_t n = 0;
+  bool set = false;
+};
+
+// --- the Python decoders' field-slot semantics (see file header) -----
+
+// submessage-list: every occurrence must be LEN. ok=false ⇒ caller
+// errors; descend=true ⇒ this occurrence is a submessage to parse.
+bool sub_list(const Field& f, bool& descend) {
+  descend = (f.wt == kLen);
+  return f.wt == kLen;
+}
+
+// submessage-first: `claimed` is the slot. Sets descend for a LEN first
+// occurrence; numeric 0 claims the slot as "absent"; numeric nonzero
+// is an error.
+bool sub_first(const Field& f, bool& claimed, bool& descend) {
+  descend = false;
+  if (claimed) return true;
+  claimed = true;
+  if (f.wt == kLen) {
+    descend = true;
+    return true;
+  }
+  return numeric(f) && f.num == 0;
+}
+
+// bytes-first: LEN claims with the value; numeric 0 claims with the
+// default; numeric nonzero errors.
+bool bytes_first(const Field& f, Str& out) {
+  if (out.set) return true;
+  if (f.wt == kLen) {
+    out.p = f.val;
+    out.n = f.len;
+    out.set = true;
+    return true;
+  }
+  if (numeric(f) && f.num == 0) {
+    out.set = true;  // claimed, stays at default (empty)
+    return true;
+  }
+  return false;
+}
+
+// numeric-first: numeric claims with the value; empty LEN is falsy and
+// claims with the default; nonempty LEN errors (int(bytes)).
+bool numeric_first(const Field& f, bool& claimed, uint64_t& out) {
+  if (claimed) return true;
+  if (numeric(f)) {
+    claimed = true;
+    out = f.num;
+    return true;
+  }
+  if (f.wt == kLen && f.len == 0) {
+    claimed = true;
+    return true;
+  }
+  return false;
+}
+
+bool str_eq(const Str& s, const char* lit) {
+  size_t n = std::strlen(lit);
+  return s.set && s.n == n && std::memcmp(s.p, lit, n) == 0;
+}
+
+// AnyValue{string_value=1}: first occurrence of a LEN field 1 is the
+// string; any other type/field is ignored (otlp._anyvalue_str returns
+// None for non-string values, raising nothing).
+bool anyvalue_str(const uint8_t* p, size_t n, Str& out) {
+  Slice s{p, n};
+  Field f;
+  while (!s.done()) {
+    if (!next_field(s, f)) return false;
+    if (f.no == 1 && f.wt == kLen && !out.set) {
+      out.p = f.val;
+      out.n = f.len;
+      out.set = true;
+    }
+  }
+  return true;
+}
+
+// KeyValue{key=1, value=2}. Mirrors otlp._attrs_to_dict exactly: the
+// pair only materialises when the key is truthy, the value is LEN, and
+// the AnyValue holds a string; a truthy *numeric* key is an error only
+// in that same case (Python reaches key.decode() only then).
+bool keyvalue(const uint8_t* p, size_t n, Str& key, Str& val) {
+  Slice s{p, n};
+  Field f;
+  Str raw_val;
+  bool key_numeric_bad = false;
+  bool key_claimed = false;
+  while (!s.done()) {
+    if (!next_field(s, f)) return false;
+    if (f.no == 1 && !key_claimed) {
+      key_claimed = true;
+      if (f.wt == kLen) {
+        key.p = f.val;
+        key.n = f.len;
+        key.set = true;
+      } else if (numeric(f) && f.num != 0) {
+        key_numeric_bad = true;  // only fatal if a string value exists
+      }
+    } else if (f.no == 2 && f.wt == kLen && !raw_val.set) {
+      raw_val.p = f.val;
+      raw_val.n = f.len;
+      raw_val.set = true;
+    }
+  }
+  if (raw_val.set && !anyvalue_str(raw_val.p, raw_val.n, val)) return false;
+  if (val.set && key_numeric_bad) return false;  // int.decode() analogue
+  if (!(key.set && key.n > 0)) val.set = false;  // falsy key: pair skipped
+  return true;
+}
+
+// First 8 bytes little-endian, zero-padded — matches
+// tensorize._pack's `bytes(trace_id[:8]).ljust(8, b"\0")`.
+uint64_t key8(const uint8_t* p, size_t n) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, n < 8 ? n : 8);
+  return v;
+}
+
+constexpr int kMaxAttrKeys = 16;
+
+}  // namespace
+
+extern "C" {
+
+// Error codes (negative returns).
+// -1 malformed wire data; -2 record capacity exceeded; -3 service-name
+// buffer exceeded; -4 too many monitored keys.
+
+// Decode an ExportTraceServiceRequest into columns. One output row per
+// span, in document order. `svc_idx[i]` indexes the i-th record's
+// resource-spans entry; service names are written back-to-back into
+// `svc_buf` with per-entry byte lengths in `svc_len` (length -1 ⇒ the
+// resource had no service.name — distinct from a present-but-empty
+// name, which the record path interns as ""). Monitored attribute keys
+// come in priority order; the chosen value's CRC32 goes to attr_crc
+// with attr_present=1.
+int otd_decode_otlp(const uint8_t* buf, size_t len,              //
+                    const char* const* attr_keys, int n_keys,    //
+                    int cap,                                     //
+                    float* duration_us, uint64_t* trace_key,     //
+                    uint8_t* is_error, uint32_t* attr_crc,       //
+                    uint8_t* attr_present, int32_t* svc_idx,     //
+                    char* svc_buf, size_t svc_buf_cap,           //
+                    int32_t* svc_len, int rs_cap,                //
+                    int32_t* n_services) {
+  if (n_keys > kMaxAttrKeys) return -4;
+  int n_rec = 0;
+  int n_svc = 0;
+  size_t svc_pos = 0;
+  Slice top{buf, len};
+  Field rs_f;
+  bool descend;
+  while (!top.done()) {
+    if (!next_field(top, rs_f)) return -1;
+    if (rs_f.no != 1) continue;  // unknown top-level fields: skipped
+    if (!sub_list(rs_f, descend)) return -1;
+
+    // ResourceSpans{resource=1 (first), scope_spans=2 (repeated)}.
+    Str svc_name;
+    bool have_name = false;
+    bool resource_claimed = false;
+    Slice rs{rs_f.val, rs_f.len};
+    Field f;
+    // Pass 1: the resource can appear after scope_spans on the wire;
+    // Python's two-phase scan (scan_fields then descend) is order-
+    // independent, so find the service name before emitting records.
+    while (!rs.done()) {
+      if (!next_field(rs, f)) return -1;
+      if (f.no == 1) {
+        if (!sub_first(f, resource_claimed, descend)) return -1;
+        if (!descend) continue;
+        Slice res{f.val, f.len};
+        Field rf;
+        while (!res.done()) {
+          if (!next_field(res, rf)) return -1;
+          if (rf.no == 1) {  // repeated KeyValue (submessage-list)
+            if (!sub_list(rf, descend)) return -1;
+            Str key, val;
+            if (!keyvalue(rf.val, rf.len, key, val)) return -1;
+            // Last occurrence wins (dict-assignment semantics).
+            if (val.set && str_eq(key, "service.name")) {
+              svc_name = val;
+              have_name = true;
+            }
+          }
+        }
+      }
+    }
+    if (n_svc >= rs_cap) return -3;
+    if (svc_pos + svc_name.n > svc_buf_cap) return -3;
+    if (svc_name.n) std::memcpy(svc_buf + svc_pos, svc_name.p, svc_name.n);
+    svc_pos += svc_name.n;
+    svc_len[n_svc++] = have_name ? int32_t(svc_name.n) : -1;
+
+    // Pass 2: emit one record per span.
+    rs = Slice{rs_f.val, rs_f.len};
+    while (!rs.done()) {
+      if (!next_field(rs, f)) return -1;
+      if (f.no != 2) continue;  // ScopeSpans (submessage-list)
+      if (!sub_list(f, descend)) return -1;
+      Slice ss{f.val, f.len};
+      Field sf;
+      while (!ss.done()) {
+        if (!next_field(ss, sf)) return -1;
+        if (sf.no != 2) continue;  // Span (submessage-list)
+        if (!sub_list(sf, descend)) return -1;
+        if (n_rec >= cap) return -2;
+
+        Str tid;
+        uint64_t tid_num = 0;
+        bool tid_is_num = false;
+        uint64_t start = 0, end = 0;
+        bool start_claimed = false, end_claimed = false;
+        bool err = false;
+        bool status_claimed = false;
+        Str attr_val[kMaxAttrKeys];
+
+        Slice sp{sf.val, sf.len};
+        Field pf;
+        while (!sp.done()) {
+          if (!next_field(sp, pf)) return -1;
+          switch (pf.no) {
+            case 1:  // trace_id: first; bytes OR numeric both accepted
+                     // (SpanRecord.trace_id is bytes | int)
+              if (!tid.set && !tid_is_num) {
+                if (pf.wt == kLen) {
+                  tid.p = pf.val;
+                  tid.n = pf.len;
+                  tid.set = true;
+                } else if (numeric(pf)) {
+                  tid_num = pf.num;
+                  tid_is_num = true;
+                }
+              }
+              break;
+            case 7:  // start_time_unix_nano (numeric-first)
+              if (!numeric_first(pf, start_claimed, start)) return -1;
+              break;
+            case 8:  // end_time_unix_nano (numeric-first)
+              if (!numeric_first(pf, end_claimed, end)) return -1;
+              break;
+            case 9: {  // attributes: repeated KeyValue (submessage-list)
+              if (!sub_list(pf, descend)) return -1;
+              Str key, val;
+              if (!keyvalue(pf.val, pf.len, key, val)) return -1;
+              if (val.set)
+                for (int k = 0; k < n_keys; ++k)
+                  if (str_eq(key, attr_keys[k])) attr_val[k] = val;
+              break;
+            }
+            case 15: {  // Status{code=3} (submessage-first)
+              if (!sub_first(pf, status_claimed, descend)) return -1;
+              if (!descend) break;
+              Slice st{pf.val, pf.len};
+              Field stf;
+              bool code_claimed = false;
+              uint64_t code = 0;
+              while (!st.done()) {
+                if (!next_field(st, stf)) return -1;
+                if (stf.no == 3 &&
+                    !numeric_first(stf, code_claimed, code))
+                  return -1;
+              }
+              err = (code == 2);  // STATUS_CODE_ERROR
+              break;
+            }
+            default:
+              break;  // unknown: skipped, not descended
+          }
+        }
+
+        duration_us[n_rec] =
+            end > start ? float(double(end - start) / 1000.0) : 0.0f;
+        trace_key[n_rec] = tid_is_num ? tid_num : key8(tid.p, tid.n);
+        is_error[n_rec] = err ? 1 : 0;
+        uint32_t crc = 0;
+        uint8_t present = 0;
+        for (int k = 0; k < n_keys; ++k)
+          if (attr_val[k].set) {  // priority order: first hit wins
+            crc = crc32(attr_val[k].p, attr_val[k].n);
+            present = 1;
+            break;
+          }
+        attr_crc[n_rec] = crc;
+        attr_present[n_rec] = present;
+        svc_idx[n_rec] = n_svc - 1;
+        ++n_rec;
+      }
+    }
+  }
+  *n_services = n_svc;
+  return n_rec;
+}
+
+// Decode a batch of OrderResult payloads (one Kafka message each) into
+// the detector's order-record columns: order-id key (first 8 bytes of
+// the id string), shipping cost in currency units (the value lane), and
+// the CRC of the first *non-empty* product id (heavy-hitter attribute —
+// kafka_orders.decode_order skips falsy ids). Mirrors decode_order +
+// order_to_record, including error verdicts.
+int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
+                      int n,                                     //
+                      float* value_units, uint64_t* order_key,   //
+                      uint32_t* attr_crc) {
+  for (int i = 0; i < n; ++i) {
+    Slice top{bufs[i], lens[i]};
+    Field f;
+    bool descend;
+    Str order_id, tracking, first_product;
+    bool money_claimed = false;
+    uint64_t units = 0, nanos = 0;
+    bool units_claimed = false, nanos_claimed = false;
+    while (!top.done()) {
+      if (!next_field(top, f)) return -1;
+      switch (f.no) {
+        case 1:  // order_id (bytes-first)
+          if (!bytes_first(f, order_id)) return -1;
+          break;
+        case 2:  // shipping_tracking_id (bytes-first; decoded by Python
+                 // even though unused here, so verdicts must match)
+          if (!bytes_first(f, tracking)) return -1;
+          break;
+        case 3: {  // shipping_cost Money{units=2, nanos=3}
+          if (!sub_first(f, money_claimed, descend)) return -1;
+          if (!descend) break;
+          Slice m{f.val, f.len};
+          Field mf;
+          while (!m.done()) {
+            if (!next_field(m, mf)) return -1;
+            if (mf.no == 2) {
+              if (!numeric_first(mf, units_claimed, units)) return -1;
+            } else if (mf.no == 3) {
+              if (!numeric_first(mf, nanos_claimed, nanos)) return -1;
+            }
+          }
+          break;
+        }
+        case 5: {  // items: OrderItem{item=1 CartItem{product_id=1,
+                   // quantity=2}} (submessage-list)
+          if (!sub_list(f, descend)) return -1;
+          Slice it{f.val, f.len};
+          Field itf;
+          bool cart_claimed = false;
+          while (!it.done()) {
+            if (!next_field(it, itf)) return -1;
+            if (itf.no != 1) continue;
+            if (!sub_first(itf, cart_claimed, descend)) return -1;
+            if (!descend) continue;
+            Slice cart{itf.val, itf.len};
+            Field cf;
+            Str pid;
+            bool qty_claimed = false;
+            uint64_t qty = 0;
+            while (!cart.done()) {
+              if (!next_field(cart, cf)) return -1;
+              if (cf.no == 1) {
+                if (!bytes_first(cf, pid)) return -1;
+              } else if (cf.no == 2) {
+                if (!numeric_first(cf, qty_claimed, qty)) return -1;
+              }
+            }
+            // decode_order: `if pid: products.append(...)` — empty ids
+            // are skipped, so the first NON-empty product wins.
+            if (pid.set && pid.n > 0 && !first_product.set)
+              first_product = pid;
+          }
+          break;
+        }
+        default:
+          break;
+      }
+    }
+    // Parity with wire.py: varints decode unsigned, and _money_units
+    // floats the raw value (negative money is producer error; both
+    // sides treat it identically).
+    value_units[i] = float(double(units) + double(nanos) * 1e-9);
+    order_key[i] =
+        order_id.set && order_id.n ? key8(order_id.p, order_id.n) : 0;
+    attr_crc[i] =
+        first_product.set ? crc32(first_product.p, first_product.n) : 0;
+  }
+  return n;
+}
+
+// CRC32 of one buffer — exposed so Python-side fallbacks/tests can
+// assert the hash contract without zlib.
+uint32_t otd_crc32(const uint8_t* p, size_t n) { return crc32(p, n); }
+
+}  // extern "C"

@@ -26,10 +26,14 @@ key sketch snapshots to them (exactly-once-ish resume; SURVEY.md §5
 
 from __future__ import annotations
 
-from typing import Iterator, NamedTuple
+from typing import Iterator, NamedTuple, Sequence
 
-from . import wire
-from .tensorize import SpanRecord
+import numpy as np
+
+from . import native, wire
+from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
+
+ORDERS_SERVICE = "checkout-orders"
 
 
 class Order(NamedTuple):
@@ -86,6 +90,31 @@ def order_to_record(order: Order, duration_us: float = 0.0) -> SpanRecord:
         is_error=False,
         attr=order.product_ids[0] if order.product_ids else "",
     )
+
+
+def decode_orders_columnar(
+    payloads: Sequence[bytes], tensorizer: SpanTensorizer
+) -> SpanColumns:
+    """Batch-decode OrderResult payloads straight to pipeline columns.
+
+    Uses the native C++ decoder when available (one call for the whole
+    poll batch), the per-message Python path otherwise — identical
+    columns either way (pinned by tests/test_native_ingest.py). Feed the
+    result to ``DetectorPipeline.submit_columns``.
+    """
+    sid = tensorizer.service_id(ORDERS_SERVICE)
+    n = len(payloads)
+    if native.available():
+        cols = native.decode_orders(payloads)
+        return SpanColumns(
+            svc=np.full(n, sid, np.int32),
+            lat_us=cols.value_units,
+            is_error=np.zeros(n, np.float32),
+            trace_key=cols.order_key,
+            attr_crc=cols.attr_crc.astype(np.uint64),
+        )
+    records = [order_to_record(decode_order(p)) for p in payloads]
+    return tensorizer.columns_from_records(records)
 
 
 def encode_order(order: Order) -> bytes:

@@ -1,0 +1,225 @@
+"""ctypes bindings for the native ingest library (native/ingest.cc).
+
+The C++ side decodes protobuf payloads straight into columnar numpy
+arrays — the host half of the ≥200k spans/sec budget (SURVEY.md §7 hard
+part (a): "protobuf decode and hashing must be vectorized/C-accelerated
+and batched"). This module owns the build/load lifecycle and the
+array-capacity retry loop; decode *semantics* live in the C++ and are
+pinned to the Python reference decoders by tests/test_native_ingest.py.
+
+Build-on-demand: the library is one translation unit compiled with
+``g++ -O3`` (~1 s, cached by mtime against the source). Environments
+without a compiler simply report ``available() == False`` and callers
+fall back to the pure-Python decoders — same results, less throughput.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_DIR, "ingest.cc")
+_LIB = os.path.join(_DIR, "_build", "libotd_ingest.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed: str | None = None
+
+
+class ColumnarSpans(NamedTuple):
+    """Decoded OTLP spans as columns (one row per span, document order).
+
+    ``svc_idx`` points into ``services`` (one entry per resource-spans
+    block). ``None`` means the resource had no service.name — the
+    record-level decoder's "unknown" — which is distinct from a
+    present-but-empty name (interned as ``""``, exactly as the record
+    path does).
+    """
+
+    duration_us: np.ndarray  # float32[N]
+    trace_key: np.ndarray  # uint64[N] — first 8 bytes of trace_id, LE
+    is_error: np.ndarray  # uint8[N]
+    attr_crc: np.ndarray  # uint32[N] — CRC32 of the chosen attr value
+    attr_present: np.ndarray  # uint8[N]
+    svc_idx: np.ndarray  # int32[N]
+    services: list[str | None]
+
+
+class ColumnarOrders(NamedTuple):
+    """Decoded OrderResult batch as columns (one row per message)."""
+
+    value_units: np.ndarray  # float32[N] — shipping cost (value lane)
+    order_key: np.ndarray  # uint64[N] — first 8 bytes of order id
+    attr_crc: np.ndarray  # uint32[N] — CRC32 of first product id
+
+
+def _build() -> str | None:
+    """Compile the library if missing/stale; returns an error string."""
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+        _SRC
+    ):
+        return None
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3",
+        "-std=c++17",
+        "-fPIC",
+        "-Wall",
+        "-shared",
+        "-o",
+        _LIB,
+        _SRC,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"{cmd[0]}: {e}"
+    if proc.returncode != 0:
+        return proc.stderr.strip() or f"{cmd[0]} exited {proc.returncode}"
+    return None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _load_failed = err
+            return None
+        lib = ctypes.CDLL(_LIB)
+        # Payload pointers are declared c_char_p so Python bytes pass
+        # zero-copy (the C side only reads; lengths travel separately,
+        # so embedded NULs are fine).
+        lib.otd_decode_otlp.restype = ctypes.c_int
+        lib.otd_decode_otlp.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,           # buf, len
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,  # keys
+            ctypes.c_int,                               # cap
+            ctypes.c_void_p, ctypes.c_void_p,           # duration, trace
+            ctypes.c_void_p, ctypes.c_void_p,           # err, crc
+            ctypes.c_void_p, ctypes.c_void_p,           # present, svc_idx
+            ctypes.c_char_p, ctypes.c_size_t,           # svc_buf, cap
+            ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
+            ctypes.POINTER(ctypes.c_int32),             # n_services
+        ]
+        lib.otd_decode_orders.restype = ctypes.c_int
+        lib.otd_decode_orders.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.otd_crc32.restype = ctypes.c_uint32
+        lib.otd_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    """Why the native library is unavailable (None when it loaded)."""
+    _load()
+    return _load_failed
+
+
+def crc32(data: bytes) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(lib.otd_crc32(data, len(data)))
+
+
+def decode_otlp(
+    payload: bytes, attr_keys: Sequence[str]
+) -> ColumnarSpans:
+    """Columnar decode of an ExportTraceServiceRequest.
+
+    Raises ``ValueError`` on malformed wire data — the same verdicts as
+    ``otlp.decode_export_request`` (the HTTP receiver maps either to a
+    400).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_load_failed}")
+    keys = (ctypes.c_char_p * len(attr_keys))(
+        *[k.encode() for k in attr_keys]
+    )
+    cap = len(payload) // 16 + 64
+    # One name byte per payload byte is the ceiling (names are payload
+    # substrings); one resource-spans entry needs ≥2 payload bytes.
+    svc_cap = len(payload) + 1
+    rs_cap = len(payload) // 2 + 2
+    svc_buf = ctypes.create_string_buffer(svc_cap)
+    svc_len = np.empty(rs_cap, np.int32)
+    n_services = ctypes.c_int32(0)
+    retried = False
+    while True:
+        duration = np.empty(cap, np.float32)
+        trace = np.empty(cap, np.uint64)
+        err = np.empty(cap, np.uint8)
+        crc = np.empty(cap, np.uint32)
+        present = np.empty(cap, np.uint8)
+        svc_idx = np.empty(cap, np.int32)
+        n = lib.otd_decode_otlp(
+            payload, len(payload), keys, len(attr_keys), cap,
+            duration.ctypes.data, trace.ctypes.data,
+            err.ctypes.data, crc.ctypes.data,
+            present.ctypes.data, svc_idx.ctypes.data,
+            svc_buf, svc_cap,
+            svc_len.ctypes.data, rs_cap,
+            ctypes.byref(n_services),
+        )
+        if n == -2 and not retried:  # pathological tiny-span payloads
+            cap = len(payload) // 2 + 64
+            retried = True
+            continue
+        if n < 0:
+            raise ValueError(f"malformed OTLP payload (code {n})")
+        services: list[str | None] = []
+        pos = 0
+        for ln in svc_len[: n_services.value]:
+            if ln < 0:
+                services.append(None)
+            else:
+                services.append(
+                    svc_buf.raw[pos : pos + ln].decode("utf-8", "replace")
+                )
+                pos += ln
+        return ColumnarSpans(
+            duration[:n].copy(), trace[:n].copy(), err[:n].copy(),
+            crc[:n].copy(), present[:n].copy(), svc_idx[:n].copy(),
+            services,
+        )
+
+
+def decode_orders(payloads: Sequence[bytes]) -> ColumnarOrders:
+    """Columnar decode of a batch of OrderResult payloads."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_load_failed}")
+    n = len(payloads)
+    bufs = (ctypes.c_char_p * max(n, 1))(*payloads) if n else (
+        ctypes.c_char_p * 1
+    )()
+    lens = np.asarray([len(p) for p in payloads] or [0], np.uint64)
+    value = np.empty(max(n, 1), np.float32)
+    key = np.empty(max(n, 1), np.uint64)
+    crc = np.empty(max(n, 1), np.uint32)
+    rc = lib.otd_decode_orders(
+        bufs, lens.ctypes.data, n,
+        value.ctypes.data, key.ctypes.data, crc.ctypes.data,
+    )
+    if rc < 0:
+        raise ValueError(f"malformed OrderResult payload (code {rc})")
+    return ColumnarOrders(value[:n], key[:n], crc[:n])

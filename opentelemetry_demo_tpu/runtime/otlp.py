@@ -24,7 +24,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
-from . import wire
+from . import native, wire
 from .tensorize import SpanRecord
 
 _STATUS_ERROR = 2  # opentelemetry.proto.trace.v1.Status.StatusCode.ERROR
@@ -137,12 +137,28 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
     return records
 
 
+def decode_export_request_columnar(payload: bytes):
+    """Protobuf request → native columnar batch, or None to fall back.
+
+    Returns a ``runtime.native.ColumnarSpans`` when the C++ decoder is
+    available (feed it to ``DetectorPipeline.submit_columnar``); None
+    when the native library can't load — callers then take the
+    record-level Python path with identical results.
+    """
+    if not native.available():
+        return None
+    return native.decode_otlp(payload, MONITORED_ATTR_KEYS)
+
+
 class OtlpHttpReceiver:
     """Threaded OTLP/HTTP trace receiver feeding a callback.
 
     ``on_records`` is called from the server thread with each request's
     decoded SpanRecords; the callback enqueues into the pipeline (which
-    owns batching/tensorization on its own thread).
+    owns batching/tensorization on its own thread). When ``on_columnar``
+    is provided and the native decoder is available, protobuf bodies
+    skip Python record objects entirely: C++ wire decode → columnar
+    arrays → ``on_columnar`` (the pipeline's fast path).
     """
 
     def __init__(
@@ -150,6 +166,7 @@ class OtlpHttpReceiver:
         on_records: Callable[[list[SpanRecord]], None],
         host: str = "0.0.0.0",
         port: int = 4318,
+        on_columnar: Callable | None = None,
     ):
         receiver = self
 
@@ -157,9 +174,14 @@ class OtlpHttpReceiver:
             def do_POST(self):  # noqa: N802 (http.server API)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                columnar = None
                 try:
                     if "json" in (self.headers.get("Content-Type") or ""):
                         records = decode_export_request_json(body)
+                    elif receiver.on_columnar is not None:
+                        columnar = decode_export_request_columnar(body)
+                        if columnar is None:
+                            records = decode_export_request(body)
                     else:
                         records = decode_export_request(body)
                 except Exception:
@@ -174,7 +196,10 @@ class OtlpHttpReceiver:
                     self.send_response(400)
                     self.end_headers()
                     return
-                receiver.on_records(records)
+                if columnar is not None:
+                    receiver.on_columnar(columnar)
+                else:
+                    receiver.on_records(records)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-protobuf")
                 self.end_headers()
@@ -184,6 +209,7 @@ class OtlpHttpReceiver:
                 pass
 
         self.on_records = on_records
+        self.on_columnar = on_columnar
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="otlp-receiver", daemon=True

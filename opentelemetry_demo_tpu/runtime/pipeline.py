@@ -17,6 +17,7 @@ recompiling (the jitted step's threshold only feeds the report's
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -27,7 +28,7 @@ import numpy as np
 
 from ..models.detector import AnomalyDetector, DetectorReport
 from ..utils.flags import FlagEvaluator
-from .tensorize import SpanRecord, SpanTensorizer
+from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
 FLAG_ENABLED = "anomalyDetectorEnabled"
 FLAG_THRESHOLD = "anomalyDetectorZThreshold"
@@ -68,7 +69,16 @@ class DetectorPipeline:
         )
         self.max_wait_s = max_wait_s
         self.stats = PipelineStats()
-        self._pending: deque = deque()
+        # Pending work is columnar (SpanColumns chunks + a total row
+        # count): both the per-record path and the native decoder land
+        # here, and batch assembly is array slicing, not object pops.
+        # The lock covers queue+counter as a unit — producers are
+        # receiver/consumer threads, the consumer is the pump thread,
+        # and the row counter plus multi-chunk batch assembly are
+        # read-modify-write sequences a bare deque can't make atomic.
+        self._pending: deque[SpanColumns] = deque()
+        self._pending_rows = 0
+        self._pending_lock = threading.Lock()
         self._inflight: deque = deque()  # (t_batch, dispatch_clock, report)
         self._last_t: float | None = None
 
@@ -76,7 +86,19 @@ class DetectorPipeline:
 
     def submit(self, records: Iterable[SpanRecord]) -> None:
         """Queue records; called from receiver/consumer threads."""
-        self._pending.extend(records)
+        records = list(records)
+        if records:
+            self.submit_columns(self.tensorizer.columns_from_records(records))
+
+    def submit_columnar(self, columnar) -> None:
+        """Queue a native-decoder batch (runtime.native.ColumnarSpans)."""
+        self.submit_columns(self.tensorizer.columns_from_columnar(columnar))
+
+    def submit_columns(self, cols: SpanColumns) -> None:
+        if cols.rows:
+            with self._pending_lock:
+                self._pending.append(cols)
+                self._pending_rows += cols.rows
 
     def pump(self, t_now: float | None = None) -> None:
         """Form at most one batch and dispatch it (non-blocking).
@@ -90,14 +112,30 @@ class DetectorPipeline:
             t_now = self._last_t if self._last_t is not None else time.monotonic()
         self._last_t = t_now
         if not self.flags.evaluate(FLAG_ENABLED, True):
-            self.stats.dropped_disabled += len(self._pending)
-            self._pending.clear()
+            with self._pending_lock:
+                self.stats.dropped_disabled += self._pending_rows
+                self._pending.clear()
+                self._pending_rows = 0
             return
-        if not self._pending:
-            return
-        take = min(len(self._pending), self.tensorizer.batch_size)
-        chunk = [self._pending.popleft() for _ in range(take)]
-        (batch,) = self.tensorizer.tensorize(chunk)
+        # Assemble up to one batch of rows from the columnar queue;
+        # an oversized head chunk is split and its tail re-queued.
+        with self._pending_lock:
+            if not self._pending:
+                return
+            budget = self.tensorizer.batch_size
+            parts: list[SpanColumns] = []
+            while self._pending and budget:
+                head = self._pending.popleft()
+                if head.rows > budget:
+                    parts.append(head.slice(0, budget))
+                    self._pending.appendleft(head.slice(budget, head.rows))
+                    budget = 0
+                else:
+                    parts.append(head)
+                    budget -= head.rows
+            self._pending_rows -= sum(p.rows for p in parts)
+        cols = SpanColumns.concat(parts)
+        batch = self.tensorizer.pack_columns(cols)
         report = self.detector.observe(batch, t_now)  # async dispatch
         self.stats.batches += 1
         self.stats.spans += batch.num_valid

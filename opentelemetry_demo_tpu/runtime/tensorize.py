@@ -31,6 +31,7 @@ the ≥200k spans/sec target.
 
 from __future__ import annotations
 
+import threading
 import zlib
 from dataclasses import dataclass
 from typing import Iterable, NamedTuple
@@ -48,6 +49,39 @@ class SpanRecord(NamedTuple):
     trace_id: bytes | int
     is_error: bool = False
     attr: str | None = None
+
+
+class SpanColumns(NamedTuple):
+    """Interned columnar records — the pipeline's pending currency.
+
+    The service axis is already resolved to small int ids; the attr key
+    is the bare value CRC (the service fold and splitmix happen at pack
+    time, in ``pack_arrays``). Both decode paths produce this shape: the
+    per-record Python loop (``columns_from_records``) and the native C++
+    decoder (``columns_from_columnar``), so batching, padding and
+    device feed are one code path regardless of origin.
+    """
+
+    svc: np.ndarray  # int32 — interned service ids
+    lat_us: np.ndarray  # float32
+    is_error: np.ndarray  # float32
+    trace_key: np.ndarray  # uint64 — first 8 bytes of trace id, LE
+    attr_crc: np.ndarray  # uint64 — CRC32 of the monitored attr value
+
+    @property
+    def rows(self) -> int:
+        return self.svc.shape[0]
+
+    def slice(self, start: int, stop: int) -> "SpanColumns":
+        return SpanColumns(*(a[start:stop] for a in self))
+
+    @staticmethod
+    def concat(parts: list["SpanColumns"]) -> "SpanColumns":
+        if len(parts) == 1:
+            return parts[0]
+        return SpanColumns(
+            *(np.concatenate(cols) for cols in zip(*parts))
+        )
 
 
 class TensorBatch(NamedTuple):
@@ -86,41 +120,47 @@ class SpanTensorizer:
 
     def __post_init__(self) -> None:
         self._svc_ids: dict[str, int] = {}
+        # Interning is check-then-act; decode now happens on receiver
+        # threads (ThreadingHTTPServer spawns one per request), so two
+        # concurrent first-sightings of different names must not race
+        # to the same id.
+        self._intern_lock = threading.Lock()
 
     @property
     def service_names(self) -> list[str]:
         return list(self._svc_ids)
 
     def service_id(self, name: str) -> int:
-        sid = self._svc_ids.get(name)
+        sid = self._svc_ids.get(name)  # racy fast path: hit is stable
         if sid is None:
-            if len(self._svc_ids) < self.num_services - 1:
-                sid = len(self._svc_ids)
-            else:
-                sid = self.num_services - 1  # overflow bucket
-            self._svc_ids[name] = sid
+            with self._intern_lock:
+                sid = self._svc_ids.get(name)
+                if sid is None:
+                    if len(self._svc_ids) < self.num_services - 1:
+                        sid = len(self._svc_ids)
+                    else:
+                        sid = self.num_services - 1  # overflow bucket
+                    self._svc_ids[name] = sid
         return sid
 
     def tensorize(self, records: Iterable[SpanRecord]) -> list[TensorBatch]:
         """Pack records into one or more fixed-width batches."""
-        records = list(records)
+        cols = self.columns_from_records(list(records))
         out: list[TensorBatch] = []
-        for start in range(0, max(len(records), 1), self.batch_size):
-            chunk = records[start : start + self.batch_size]
-            out.append(self._pack(chunk))
+        for start in range(0, max(cols.rows, 1), self.batch_size):
+            out.append(self.pack_columns(cols.slice(start, start + self.batch_size)))
         return out
 
-    def _pack(self, chunk: list[SpanRecord]) -> TensorBatch:
-        b = self.batch_size
-        svc = np.zeros(b, np.int32)
-        lat = np.zeros(b, np.float32)
-        err = np.zeros(b, np.float32)
-        tid = np.zeros(b, np.uint64)
-        akey = np.zeros(b, np.uint64)
-        valid = np.zeros(b, bool)
-        for i, r in enumerate(chunk):
-            sid = self.service_id(r.service)
-            svc[i] = sid
+    def columns_from_records(self, records: list[SpanRecord]) -> SpanColumns:
+        """Per-record Python path (portable fallback; see module doc)."""
+        n = len(records)
+        svc = np.zeros(n, np.int32)
+        lat = np.zeros(n, np.float32)
+        err = np.zeros(n, np.float32)
+        tid = np.zeros(n, np.uint64)
+        crc = np.zeros(n, np.uint64)
+        for i, r in enumerate(records):
+            svc[i] = self.service_id(r.service)
             lat[i] = r.duration_us
             err[i] = 1.0 if r.is_error else 0.0
             if isinstance(r.trace_id, (bytes, bytearray)):
@@ -129,14 +169,44 @@ class SpanTensorizer:
             else:
                 tid[i] = np.uint64(r.trace_id & 0xFFFFFFFFFFFFFFFF)
             attr = r.attr if r.attr is not None else ""
-            # Fold service into the attr key (ops.cms contract).
-            akey[i] = np.uint64(zlib.crc32(attr.encode())) | (
-                np.uint64(sid) << np.uint64(32)
-            )
-            valid[i] = True
-        t_hi, t_lo = split_hi_lo_np(splitmix64_np(tid))
-        a_hi, a_lo = split_hi_lo_np(splitmix64_np(akey))
-        return TensorBatch(svc, lat, err, t_hi, t_lo, a_hi, a_lo, valid)
+            crc[i] = zlib.crc32(attr.encode())
+        return SpanColumns(svc, lat, err, tid, crc)
+
+    def columns_from_columnar(self, cols) -> SpanColumns:
+        """Adopt a native-decoder batch (runtime.native.ColumnarSpans).
+
+        Interns the handful of per-request service names (``None`` —
+        no service.name attribute — becomes the record decoder's
+        "unknown"; a present-but-empty name interns as ``""``, exactly
+        as the record path does) and maps the per-row resource indices
+        through — the only per-string work left on the Python side of
+        the native path. Only names actually referenced by a span are
+        interned (a span-less resource block must not claim a service
+        id the record path would never assign); ``svc_idx`` is monotone
+        in document order, so ``np.unique``'s sorted order IS
+        first-appearance order.
+        """
+        ids = np.zeros(max(len(cols.services), 1), np.int32)
+        for i in np.unique(cols.svc_idx):
+            name = cols.services[i]
+            ids[i] = self.service_id("unknown" if name is None else name)
+        return SpanColumns(
+            svc=ids[cols.svc_idx],
+            lat_us=cols.duration_us.astype(np.float32, copy=False),
+            is_error=cols.is_error.astype(np.float32),
+            trace_key=cols.trace_key,
+            attr_crc=cols.attr_crc.astype(np.uint64),
+        )
+
+    def pack_columns(self, cols: SpanColumns) -> TensorBatch:
+        """Columns → one padded, hashed, device-ready batch."""
+        return self.pack_arrays(
+            cols.svc,
+            cols.lat_us,
+            cols.trace_key,
+            cols.is_error,
+            cols.attr_crc,
+        )
 
     def pack_arrays(
         self,

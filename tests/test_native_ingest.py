@@ -1,0 +1,274 @@
+"""Native C++ ingest decoder vs the Python reference decoders.
+
+The C++ library (native/ingest.cc) must produce exactly the columns the
+Python record path produces — same service interning, same first/last
+occurrence semantics, same CRC32 hashes, same error verdicts on
+malformed payloads. These tests are the parity pin; throughput is
+scripts/bench_ingest.py's job.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime import native, wire
+from opentelemetry_demo_tpu.runtime.kafka_orders import (
+    Order,
+    decode_order,
+    decode_orders_columnar,
+    encode_order,
+    order_to_record,
+)
+from opentelemetry_demo_tpu.runtime.otlp import (
+    MONITORED_ATTR_KEYS,
+    decode_export_request,
+)
+from opentelemetry_demo_tpu.runtime.tensorize import SpanTensorizer
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native ingest unavailable: {native.load_error()}",
+)
+
+
+def _anyval(s):
+    return wire.encode_len(1, s.encode())
+
+
+def _kv(k, v):
+    return wire.encode_len(1, k.encode()) + wire.encode_len(2, _anyval(v))
+
+
+def _span(trace_id, start, end, attrs=(), err=False, extra=b""):
+    span = (
+        wire.encode_len(1, trace_id)
+        + wire.encode_len(5, b"op")
+        + wire.encode_fixed64(7, start)
+        + wire.encode_fixed64(8, end)
+    )
+    for k, v in attrs:
+        span += wire.encode_len(9, _kv(k, v))
+    if err:
+        span += wire.encode_len(15, wire.encode_int(3, 2))
+    return span + extra
+
+
+def _rs(service, span_bufs, with_resource=True):
+    rs = b""
+    if with_resource:
+        resource = wire.encode_len(1, _kv("service.name", service))
+        rs += wire.encode_len(1, resource)
+    rs += wire.encode_len(2, b"".join(wire.encode_len(2, s) for s in span_bufs))
+    return wire.encode_len(1, rs)
+
+
+def _parity(payload: bytes):
+    """Decode both ways and compare the resulting columns."""
+    tz_py = SpanTensorizer(num_services=16)
+    tz_nat = SpanTensorizer(num_services=16)
+    ref = tz_py.columns_from_records(decode_export_request(payload))
+    got = tz_nat.columns_from_columnar(
+        native.decode_otlp(payload, MONITORED_ATTR_KEYS)
+    )
+    assert tz_py.service_names == tz_nat.service_names
+    np.testing.assert_array_equal(ref.svc, got.svc)
+    np.testing.assert_allclose(ref.lat_us, got.lat_us, rtol=1e-6)
+    np.testing.assert_array_equal(ref.is_error, got.is_error)
+    np.testing.assert_array_equal(ref.trace_key, got.trace_key)
+    np.testing.assert_array_equal(ref.attr_crc, got.attr_crc)
+    return got
+
+
+class TestOtlpParity:
+    def test_basic_request(self):
+        payload = _rs(
+            "payment",
+            [
+                _span(b"\x01" * 16, 10**9, 10**9 + 250 * 10**6,
+                      [("app.product.id", "P-7")], err=True),
+                _span(b"\x02" * 16, 10**9, 10**9 + 10**6),
+            ],
+        )
+        got = _parity(payload)
+        assert got.rows == 2
+        assert got.is_error.tolist() == [1.0, 0.0]
+
+    def test_multi_resource_spans_and_missing_resource(self):
+        payload = (
+            _rs("checkout", [_span(b"\x03" * 16, 0, 5000)])
+            + _rs("ignored", [], with_resource=True)
+            + _rs("", [_span(b"\x04" * 16, 0, 1000)], with_resource=False)
+            + _rs("cart", [_span(b"\x05" * 16, 7, 7)])
+        )
+        got = _parity(payload)
+        assert got.rows == 3  # middle rs has no spans
+
+    def test_attr_priority_and_last_wins(self):
+        # session.id present but app.product.id should win; duplicate
+        # keys: the LAST occurrence's value is hashed (dict semantics).
+        payload = _rs(
+            "ad",
+            [
+                _span(
+                    b"\x06" * 16, 0, 10,
+                    [("session.id", "s-1"),
+                     ("app.product.id", "P-old"),
+                     ("app.product.id", "P-new")],
+                )
+            ],
+        )
+        got = _parity(payload)
+        assert got.attr_crc[0] == zlib.crc32(b"P-new")
+
+    def test_unknown_fields_skipped(self):
+        # Unknown span field 99 (LEN) containing garbage must be skipped
+        # without descent — and unknown top-level fields too.
+        junk = wire.encode_len(99, b"\xff\xff\xff")
+        payload = (
+            _rs("quote", [_span(b"\x07" * 16, 0, 10, extra=junk)])
+            + wire.encode_len(9, b"\xde\xad")
+        )
+        got = _parity(payload)
+        assert got.rows == 1
+
+    def test_short_and_empty_trace_ids(self):
+        payload = _rs(
+            "email",
+            [_span(b"abc", 0, 10), _span(b"", 0, 10)],
+        )
+        got = _parity(payload)
+        assert got.trace_key[0] == int.from_bytes(
+            b"abc".ljust(8, b"\0"), "little"
+        )
+        assert got.trace_key[1] == 0
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            b"\x0a\xff",  # truncated length
+            wire.encode_len(1, wire.encode_len(2, b"\x12\x7f")),  # bad span
+            b"\x00\x01",  # field number 0
+            b"\x0b",  # SGROUP wire type
+        ],
+    )
+    def test_malformed_raises_both_ways(self, bad):
+        with pytest.raises(wire.WireError):
+            decode_export_request(bad)
+        with pytest.raises(ValueError):
+            native.decode_otlp(bad, MONITORED_ATTR_KEYS)
+
+    def test_empty_payload(self):
+        got = _parity(b"")
+        assert got.rows == 0
+
+    def test_nul_byte_in_service_name(self):
+        # Length-prefixed name transport: a NUL inside one name must not
+        # shift later names (the record path has no separator to confuse).
+        payload = _rs("a\0b", [_span(b"\x08" * 16, 0, 1)]) + _rs(
+            "c", [_span(b"\x09" * 16, 0, 1)]
+        )
+        _parity(payload)
+
+    def test_empty_vs_missing_service_name(self):
+        # service.name present-but-empty interns as ""; absent interns
+        # as "unknown" — two different services, both ways.
+        payload = _rs("", [_span(b"\x0a" * 16, 0, 1)]) + _rs(
+            "x", [_span(b"\x0b" * 16, 0, 1)], with_resource=False
+        )
+        got = _parity(payload)
+        assert got.rows == 2
+
+    def test_wrong_wire_type_verdicts_match(self):
+        # Known fields with a wire type the Python path chokes on must
+        # be errors natively too (400, never 200-and-drop) — and the
+        # cases Python tolerates (falsy zeros) must decode natively.
+        span = _span(b"\x0c" * 16, 0, 10)
+        rs_body = wire.encode_len(2, wire.encode_len(2, span))
+        cases_error = [
+            wire.encode_int(1, 5),  # resource_spans as varint
+            wire.encode_len(1, wire.encode_int(2, 1)),  # scope_spans int
+            wire.encode_len(1, wire.encode_int(1, 7) + rs_body),  # resource int
+            wire.encode_len(  # attributes as varint inside a span
+                1,
+                wire.encode_len(
+                    2,
+                    wire.encode_len(2, span + wire.encode_int(9, 3)),
+                ),
+            ),
+        ]
+        for bad in cases_error:
+            with pytest.raises(Exception):
+                decode_export_request(bad)
+            with pytest.raises(ValueError):
+                native.decode_otlp(bad, MONITORED_ATTR_KEYS)
+        # Falsy zeros: resource=0 (varint) is "no resource", not an error.
+        ok = wire.encode_len(1, wire.encode_int(1, 0) + rs_body)
+        got = _parity(ok)
+        assert got.rows == 1
+
+    def test_large_request_many_services(self):
+        rng = np.random.default_rng(3)
+        payload = b""
+        for i in range(12):
+            spans = [
+                _span(
+                    bytes(rng.integers(0, 256, 16, dtype=np.uint8)),
+                    0,
+                    int(rng.integers(1, 10**9)),
+                    [("app.session.id", f"sess-{int(rng.integers(0, 50))}")],
+                    err=bool(rng.random() < 0.3),
+                )
+                for _ in range(40)
+            ]
+            payload += _rs(f"svc-{i % 5}", spans)
+        got = _parity(payload)
+        assert got.rows == 480
+
+
+class TestOrdersParity:
+    def _payloads(self):
+        orders = [
+            Order("ord-1", "trk", 3.5, 2, ("P-A", "P-B"), 3),
+            Order("", "", 0.0, 0, (), 0),
+            Order("ord-with-long-id-123456", "t", 19.99, 1, ("P-Z",), 1),
+        ]
+        return [encode_order(o) for o in orders]
+
+    def test_columnar_matches_record_path(self):
+        payloads = self._payloads()
+        tz_py = SpanTensorizer(num_services=8)
+        tz_nat = SpanTensorizer(num_services=8)
+        ref = tz_py.columns_from_records(
+            [order_to_record(decode_order(p)) for p in payloads]
+        )
+        got = decode_orders_columnar(payloads, tz_nat)
+        np.testing.assert_array_equal(ref.svc, got.svc)
+        np.testing.assert_allclose(ref.lat_us, got.lat_us, rtol=1e-6)
+        np.testing.assert_array_equal(ref.trace_key, got.trace_key)
+        np.testing.assert_array_equal(ref.attr_crc, got.attr_crc)
+
+    def test_empty_batch(self):
+        got = decode_orders_columnar([], SpanTensorizer())
+        assert got.rows == 0
+
+    def test_empty_product_id_skipped(self):
+        # decode_order skips falsy product ids; the first NON-empty one
+        # is the heavy-hitter attribute.
+        items = (
+            wire.encode_len(5, wire.encode_len(1, wire.encode_len(1, b"")))
+            + wire.encode_len(
+                5, wire.encode_len(1, wire.encode_len(1, b"P1"))
+            )
+        )
+        payload = wire.encode_len(1, b"ord-9") + items
+        rec = order_to_record(decode_order(payload))
+        assert rec.attr == "P1"
+        got = decode_orders_columnar([payload], SpanTensorizer())
+        assert got.attr_crc[0] == zlib.crc32(b"P1")
+
+
+class TestCrc32:
+    def test_matches_zlib(self):
+        for s in (b"", b"P-7", b"abcdefgh" * 100, bytes(range(256))):
+            assert native.crc32(s) == zlib.crc32(s)
