@@ -1,0 +1,83 @@
+// Native currency kernel: Money conversion with exact carry, C ABI.
+//
+// The reference keeps currency conversion native (its currency service
+// is C++ — /root/reference/src/currency/src/server.cpp:103-120, rate
+// table :48-84); this framework keeps the same polyglot contract: the
+// conversion arithmetic lives here and services/currency.py is the
+// facade (with a pure-Python fallback for compiler-less environments).
+//
+// Semantics pinned to services/money.py + services/currency.py by
+// tests/test_native_currency.py:
+//   - validation: |nanos| < 1e9 and units/nanos signs must agree
+//   - conversion: total_nanos = units*1e9 + nanos; multiply by the
+//     EUR-cross rate in double (same precision path as Python's
+//     int*float); round ties-to-even (Python round()); split with
+//     divmod-on-absolute carry.
+// The rate table itself stays in Python (one source of truth; the rate
+// arrives here as the already-divided cross rate).
+//
+// Build: g++ -O3 -shared -fPIC (no dependencies); loaded via ctypes by
+// runtime/native.py.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+
+namespace {
+
+constexpr int64_t kNanosPerUnit = 1000000000;
+
+// money.validate(): nanos range and sign agreement.
+bool valid(int64_t units, int64_t nanos) {
+  if (nanos <= -kNanosPerUnit || nanos >= kNanosPerUnit) return false;
+  if ((units > 0 && nanos < 0) || (units < 0 && nanos > 0)) return false;
+  return true;
+}
+
+// divmod-on-absolute + reapplied sign (the carry split both services
+// use for every Money result).
+void split(int64_t total_nanos, int64_t* out_units, int32_t* out_nanos) {
+  int64_t a = total_nanos < 0 ? -total_nanos : total_nanos;
+  int64_t u = a / kNanosPerUnit;
+  int64_t n = a % kNanosPerUnit;
+  if (total_nanos < 0) {
+    u = -u;
+    n = -n;
+  }
+  *out_units = u;
+  *out_nanos = int32_t(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Convert (units, nanos) by `rate` (to-rate / from-rate, computed by
+// the caller from its table). Returns 0, or -2 for invalid money, or
+// -3 when the product overflows the int64 nanos domain (Python's
+// arbitrary-precision ints would keep going; the facade falls back).
+int otd_money_convert(double rate, int64_t units, int32_t nanos,
+                      int64_t* out_units, int32_t* out_nanos) {
+  if (!valid(units, nanos)) return -2;
+  // The double product mirrors Python's `total_nanos * rate` (int →
+  // float conversion, one rounding); llrint under the default
+  // round-to-nearest-even mode mirrors Python's round().
+  double total = double(__int128(units) * kNanosPerUnit + nanos);
+  double product = total * rate;
+  if (!(product >= -9.2e18 && product <= 9.2e18)) return -3;
+  split(llrint(product), out_units, out_nanos);
+  return 0;
+}
+
+// Sum two Money values of the same (caller-checked) currency with
+// exact carry. Returns 0, -2 for invalid input, -3 on int64 overflow.
+int otd_money_sum(int64_t u1, int32_t n1, int64_t u2, int32_t n2,
+                  int64_t* out_units, int32_t* out_nanos) {
+  if (!valid(u1, n1) || !valid(u2, n2)) return -2;
+  __int128 total = (__int128(u1) + u2) * kNanosPerUnit + n1 + n2;
+  if (total > __int128(INT64_MAX) || total < __int128(INT64_MIN)) return -3;
+  split(int64_t(total), out_units, out_nanos);
+  return 0;
+}
+
+}  // extern "C"

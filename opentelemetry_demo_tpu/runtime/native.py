@@ -1,16 +1,20 @@
-"""ctypes bindings for the native ingest library (native/ingest.cc).
+"""ctypes bindings for the native C++ libraries (native/*.cc).
 
-The C++ side decodes protobuf payloads straight into columnar numpy
-arrays — the host half of the ≥200k spans/sec budget (SURVEY.md §7 hard
-part (a): "protobuf decode and hashing must be vectorized/C-accelerated
-and batched"). This module owns the build/load lifecycle and the
-array-capacity retry loop; decode *semantics* live in the C++ and are
-pinned to the Python reference decoders by tests/test_native_ingest.py.
+Two kernels live behind this module:
 
-Build-on-demand: the library is one translation unit compiled with
-``g++ -O3`` (~1 s, cached by mtime against the source). Environments
+- **ingest** — protobuf payloads → columnar numpy arrays, the host
+  half of the ≥200k spans/sec budget (SURVEY.md §7 hard part (a):
+  "protobuf decode and hashing must be vectorized/C-accelerated and
+  batched"). Semantics pinned to the Python reference decoders by
+  tests/test_native_ingest.py.
+- **currency** — Money conversion/sum carry arithmetic (the reference
+  keeps currency native in C++, server.cpp; so does this framework).
+  Semantics pinned by tests/test_native_currency.py.
+
+Build-on-demand: each library is one translation unit compiled with
+``g++ -O3`` (~1 s, cached by mtime against its source). Environments
 without a compiler simply report ``available() == False`` and callers
-fall back to the pure-Python decoders — same results, less throughput.
+fall back to the pure-Python paths — same results, less throughput.
 """
 
 from __future__ import annotations
@@ -24,12 +28,10 @@ from typing import NamedTuple, Sequence
 import numpy as np
 
 _DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_SRC = os.path.join(_DIR, "ingest.cc")
-_LIB = os.path.join(_DIR, "_build", "libotd_ingest.so")
 
 _lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_load_failed: str | None = None
+_libs: dict[str, ctypes.CDLL] = {}
+_errors: dict[str, str] = {}
 
 
 class ColumnarSpans(NamedTuple):
@@ -59,12 +61,12 @@ class ColumnarOrders(NamedTuple):
     attr_crc: np.ndarray  # uint32[N] — CRC32 of first product id
 
 
-def _build() -> str | None:
-    """Compile the library if missing/stale; returns an error string."""
-    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
-        _SRC
-    ):
+def _build(name: str) -> str | None:
+    """Compile native/<name>.cc if missing/stale; returns error string."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    out = os.path.join(_DIR, "_build", f"libotd_{name}.so")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return None
     cmd = [
         os.environ.get("CXX", "g++"),
@@ -72,10 +74,11 @@ def _build() -> str | None:
         "-std=c++17",
         "-fPIC",
         "-Wall",
+        "-Wextra",
         "-shared",
         "-o",
-        _LIB,
-        _SRC,
+        out,
+        src,
     ]
     try:
         proc = subprocess.run(
@@ -88,40 +91,69 @@ def _build() -> str | None:
     return None
 
 
-def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
+def _lib_for(name: str) -> ctypes.CDLL | None:
+    """Build+load native/<name>.cc on first use (cached, thread-safe)."""
+    lib = _libs.get(name)  # lock-free hot path (GIL-safe dict read)
+    if lib is not None:
+        return lib
     with _lock:
-        if _lib is not None or _load_failed is not None:
-            return _lib
-        err = _build()
-        if err is not None:
-            _load_failed = err
+        if name in _libs:
+            return _libs[name]
+        if name in _errors:
             return None
-        lib = ctypes.CDLL(_LIB)
-        # Payload pointers are declared c_char_p so Python bytes pass
-        # zero-copy (the C side only reads; lengths travel separately,
-        # so embedded NULs are fine).
-        lib.otd_decode_otlp.restype = ctypes.c_int
-        lib.otd_decode_otlp.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,           # buf, len
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,  # keys
-            ctypes.c_int,                               # cap
-            ctypes.c_void_p, ctypes.c_void_p,           # duration, trace
-            ctypes.c_void_p, ctypes.c_void_p,           # err, crc
-            ctypes.c_void_p, ctypes.c_void_p,           # present, svc_idx
-            ctypes.c_char_p, ctypes.c_size_t,           # svc_buf, cap
-            ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
-            ctypes.POINTER(ctypes.c_int32),             # n_services
-        ]
-        lib.otd_decode_orders.restype = ctypes.c_int
-        lib.otd_decode_orders.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ]
-        lib.otd_crc32.restype = ctypes.c_uint32
-        lib.otd_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-        _lib = lib
-        return _lib
+        err = _build(name)
+        if err is not None:
+            _errors[name] = err
+            return None
+        lib = ctypes.CDLL(os.path.join(_DIR, "_build", f"libotd_{name}.so"))
+        _CONFIGURE[name](lib)
+        _libs[name] = lib
+        return lib
+
+
+def _configure_ingest(lib: ctypes.CDLL) -> None:
+    # Payload pointers are declared c_char_p so Python bytes pass
+    # zero-copy (the C side only reads; lengths travel separately,
+    # so embedded NULs are fine).
+    lib.otd_decode_otlp.restype = ctypes.c_int
+    lib.otd_decode_otlp.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,           # buf, len
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,  # keys
+        ctypes.c_int,                               # cap
+        ctypes.c_void_p, ctypes.c_void_p,           # duration, trace
+        ctypes.c_void_p, ctypes.c_void_p,           # err, crc
+        ctypes.c_void_p, ctypes.c_void_p,           # present, svc_idx
+        ctypes.c_char_p, ctypes.c_size_t,           # svc_buf, cap
+        ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
+        ctypes.POINTER(ctypes.c_int32),             # n_services
+    ]
+    lib.otd_decode_orders.restype = ctypes.c_int
+    lib.otd_decode_orders.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.otd_crc32.restype = ctypes.c_uint32
+    lib.otd_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+
+
+def _configure_currency(lib: ctypes.CDLL) -> None:
+    for fn in (lib.otd_money_convert, lib.otd_money_sum):
+        fn.restype = ctypes.c_int
+    lib.otd_money_convert.argtypes = [
+        ctypes.c_double, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.otd_money_sum.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+
+
+_CONFIGURE = {"ingest": _configure_ingest, "currency": _configure_currency}
+
+
+def _load() -> ctypes.CDLL | None:
+    return _lib_for("ingest")
 
 
 def available() -> bool:
@@ -129,9 +161,56 @@ def available() -> bool:
 
 
 def load_error() -> str | None:
-    """Why the native library is unavailable (None when it loaded)."""
+    """Why the ingest library is unavailable (None when it loaded)."""
     _load()
-    return _load_failed
+    return _errors.get("ingest")
+
+
+def currency_available() -> bool:
+    return _lib_for("currency") is not None
+
+
+_INT64_MIN, _INT64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def money_convert(
+    rate: float, units: int, nanos: int
+) -> tuple[int, int, int]:
+    """(code, units, nanos): code 0 ok, -2 invalid money, -3 overflow.
+
+    The facade (services.currency) maps -2 to MoneyError and falls back
+    to Python arithmetic on -3 (arbitrary-precision territory). Inputs
+    outside int64 report -3 here — ctypes would otherwise truncate them
+    to their low 64 bits before the C++ guard could see them.
+    """
+    if not (_INT64_MIN <= units <= _INT64_MAX):
+        return -3, 0, 0
+    lib = _lib_for("currency")
+    assert lib is not None
+    ou = ctypes.c_int64(0)
+    on = ctypes.c_int32(0)
+    code = lib.otd_money_convert(
+        rate, units, nanos, ctypes.byref(ou), ctypes.byref(on)
+    )
+    return code, ou.value, on.value
+
+
+def money_sum(
+    u1: int, n1: int, u2: int, n2: int
+) -> tuple[int, int, int]:
+    """(code, units, nanos) — same code contract as money_convert."""
+    if not (
+        _INT64_MIN <= u1 <= _INT64_MAX and _INT64_MIN <= u2 <= _INT64_MAX
+    ):
+        return -3, 0, 0
+    lib = _lib_for("currency")
+    assert lib is not None
+    ou = ctypes.c_int64(0)
+    on = ctypes.c_int32(0)
+    code = lib.otd_money_sum(
+        u1, n1, u2, n2, ctypes.byref(ou), ctypes.byref(on)
+    )
+    return code, ou.value, on.value
 
 
 def crc32(data: bytes) -> int:
@@ -151,7 +230,7 @@ def decode_otlp(
     """
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native ingest unavailable: {_load_failed}")
+        raise RuntimeError(f"native ingest unavailable: {load_error()}")
     keys = (ctypes.c_char_p * len(attr_keys))(
         *[k.encode() for k in attr_keys]
     )
@@ -207,7 +286,7 @@ def decode_orders(payloads: Sequence[bytes]) -> ColumnarOrders:
     """Columnar decode of a batch of OrderResult payloads."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native ingest unavailable: {_load_failed}")
+        raise RuntimeError(f"native ingest unavailable: {load_error()}")
     n = len(payloads)
     bufs = (ctypes.c_char_p * max(n, 1))(*payloads) if n else (
         ctypes.c_char_p * 1

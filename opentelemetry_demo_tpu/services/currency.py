@@ -9,15 +9,16 @@ exact integer carry on (units, nanos) — the part worth being careful
 about, per the Money proto contract (demo.proto:146-160).
 
 This is also the Python facade over the framework's **native C++
-currency kernel** (services/native) once built — conversion is the shop
-hot path the reference keeps native, so ours does too; the pure-Python
-fallback keeps the capability dependency-free.
+currency kernel** (native/currency.cc via runtime.native) — conversion
+is the shop hot path the reference keeps native, so ours does too; the
+pure-Python fallback keeps the capability dependency-free.
 """
 
 from __future__ import annotations
 
 from .base import ServiceBase, ServiceError
 from .money import NANOS_PER_UNIT, Money, MoneyError
+from ..runtime import native
 from ..telemetry.tracer import TraceContext
 
 # EUR = 1.0; own values (shape of the reference's table, not its data).
@@ -83,8 +84,20 @@ class CurrencyService(ServiceBase):
             )
         if money.currency == to_code:
             return money
-        # to-EUR then EUR-to-target, carrying nanos exactly.
+        # to-EUR then EUR-to-target, carrying nanos exactly. The native
+        # C++ kernel does the arithmetic when built (same validation,
+        # same double product, same ties-to-even rounding — pinned by
+        # tests/test_native_currency.py); -3 (int64 overflow) falls back
+        # to Python's arbitrary-precision path.
         rate = EUR_RATES[to_code] / EUR_RATES[money.currency]
+        if native.currency_available():
+            code, units, nanos = native.money_convert(
+                rate, money.units, money.nanos
+            )
+            if code == 0:
+                return Money(to_code, units, nanos)
+            if code == -2:  # unreachable: validate() ran above
+                raise MoneyError("invalid money")
         total_nanos = money.units * NANOS_PER_UNIT + money.nanos
         converted = int(round(total_nanos * rate))
         units, nanos = divmod(abs(converted), NANOS_PER_UNIT)

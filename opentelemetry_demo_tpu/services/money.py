@@ -51,6 +51,10 @@ class Money(NamedTuple):
             raise MoneyError(
                 f"currency mismatch: {self.currency} != {other.currency}"
             )
+        # Stays pure Python deliberately: two big-int ops beat a ctypes
+        # round trip ~7x (measured 0.63 vs 4.4 µs/add), and exactness is
+        # free. The native kernel's otd_money_sum mirrors this for
+        # native-side consumers and is parity-pinned by tests.
         total = (self.units + other.units) * NANOS_PER_UNIT + self.nanos + other.nanos
         units, nanos = divmod(abs(total), NANOS_PER_UNIT)
         sign = -1 if total < 0 else 1

@@ -1,0 +1,128 @@
+"""Native C++ money kernel vs the Python money/currency arithmetic.
+
+Conversion and summation must produce bit-identical (units, nanos)
+pairs for anything the Python path produces — including sign carry,
+ties-to-even rounding of the double product, and validation verdicts.
+"""
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime import native
+from opentelemetry_demo_tpu.services.currency import EUR_RATES
+from opentelemetry_demo_tpu.services.money import NANOS_PER_UNIT, Money
+
+pytestmark = pytest.mark.skipif(
+    not native.currency_available(),
+    reason="native currency kernel unavailable",
+)
+
+
+def _py_convert(rate, units, nanos):
+    total = units * NANOS_PER_UNIT + nanos
+    converted = int(round(total * rate))
+    u, n = divmod(abs(converted), NANOS_PER_UNIT)
+    sign = -1 if converted < 0 else 1
+    return sign * u, sign * n
+
+
+class TestConvertParity:
+    def test_random_amounts_all_rate_pairs(self):
+        rng = np.random.default_rng(0)
+        codes = list(EUR_RATES)
+        overflowed = 0
+        for _ in range(500):
+            frm, to = rng.choice(codes, 2)
+            rate = EUR_RATES[to] / EUR_RATES[frm]
+            units = int(rng.integers(-10**6, 10**6))
+            nanos = int(rng.integers(0, NANOS_PER_UNIT))
+            nanos = nanos if units >= 0 else -nanos
+            code, nu, nn = native.money_convert(rate, units, nanos)
+            total = units * NANOS_PER_UNIT + nanos
+            if abs(total * rate) > 9.2e18:
+                # Beyond the int64 nanos domain the kernel must report
+                # -3 (the facade then falls back to Python big ints) —
+                # e.g. 1M GBP→IDR. Never a silently-wrong result.
+                assert code == -3
+                overflowed += 1
+            else:
+                assert code == 0
+                assert (nu, nn) == _py_convert(rate, units, nanos)
+        assert overflowed < 50  # the common case stays native
+
+    def test_tie_rounding_matches_python_round(self):
+        # rate 0.5 with odd total nanos*? craft exact .5 products:
+        # total=1 nano, rate=0.5 -> 0.5 -> round-half-even -> 0.
+        code, u, n = native.money_convert(0.5, 0, 1)
+        assert code == 0 and (u, n) == (0, 0)
+        code, u, n = native.money_convert(0.5, 0, 3)
+        assert code == 0 and (u, n) == (0, 2)  # 1.5 -> 2 (even)
+        code, u, n = native.money_convert(0.5, 0, 5)
+        assert code == 0 and (u, n) == (0, 2)  # 2.5 -> 2 (even)
+        assert _py_convert(0.5, 0, 3) == (0, 2)
+        assert _py_convert(0.5, 0, 5) == (0, 2)
+
+    def test_invalid_money_rejected(self):
+        assert native.money_convert(1.0, 1, -5)[0] == -2  # sign disagreement
+        assert native.money_convert(1.0, 0, NANOS_PER_UNIT)[0] == -2
+
+    def test_overflow_reports_minus_3(self):
+        assert native.money_convert(1e30, 10**9, 0)[0] == -3
+
+
+class TestServiceLevel:
+    def test_service_convert_matches_python_formula(self):
+        """CurrencyService.convert must yield the Python-formula result
+        whether the kernel handled it (code 0) or the big-int fallback
+        did (code -3)."""
+        from opentelemetry_demo_tpu.services.shop import Shop
+        from opentelemetry_demo_tpu.telemetry.tracer import TraceContext
+
+        shop = Shop()
+        ctx = TraceContext.new()
+        cases = [
+            ("USD", "EUR", Money("USD", 100, 990_000_000)),
+            ("JPY", "KRW", Money("JPY", 123_456, 0)),
+            ("GBP", "IDR", Money("GBP", 10**6, 0)),  # overflow → fallback
+            ("EUR", "CHF", Money("EUR", -3, -250_000_000)),
+        ]
+        for frm, to, m in cases:
+            rate = EUR_RATES[to] / EUR_RATES[frm]
+            got = shop.currency.convert(ctx, m, to)
+            assert (got.units, got.nanos) == _py_convert(
+                rate, m.units, m.nanos
+            ), (frm, to)
+            assert got.currency == to
+
+
+class TestSumParity:
+    def test_random_sums(self):
+        rng = np.random.default_rng(1)
+        for _ in range(300):
+            a_u = int(rng.integers(-10**9, 10**9))
+            a_n = int(rng.integers(0, NANOS_PER_UNIT)) * (1 if a_u >= 0 else -1)
+            b_u = int(rng.integers(-10**9, 10**9))
+            b_n = int(rng.integers(0, NANOS_PER_UNIT)) * (1 if b_u >= 0 else -1)
+            code, u, n = native.money_sum(a_u, a_n, b_u, b_n)
+            assert code == 0
+            total = (a_u + b_u) * NANOS_PER_UNIT + a_n + b_n
+            eu, en = divmod(abs(total), NANOS_PER_UNIT)
+            s = -1 if total < 0 else 1
+            assert (u, n) == (s * eu, s * en)
+
+    def test_money_add_carry(self):
+        a = Money("USD", 3, 999_999_999)
+        b = Money("USD", 2, 1)
+        assert a.add(b) == Money("USD", 6, 0)
+        c = Money("USD", -1, -500_000_000)
+        assert a.add(c) == Money("USD", 2, 499_999_999)
+
+    def test_beyond_int64_inputs_never_reach_ctypes(self):
+        # ctypes would truncate a >=2^64 int to its low 64 bits before
+        # the C++ overflow guard could see it; the Python-side range
+        # check must report -3 instead so facades fall back to big ints.
+        big = 2**64 + 5
+        assert native.money_sum(big, 0, 1, 0)[0] == -3
+        assert native.money_convert(1.0, big, 0)[0] == -3
+        # And the facades stay exact.
+        assert Money("USD", big, 0).add(Money("USD", 1, 0)).units == big + 1
