@@ -1,0 +1,7 @@
+"""``python -m opentelemetry_demo_tpu`` runs the anomaly-detector
+sidecar daemon (runtime.daemon) — the container entry point used by
+deploy/Dockerfile.anomaly-detector."""
+
+from .runtime.daemon import main
+
+main()

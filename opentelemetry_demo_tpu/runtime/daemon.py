@@ -1,0 +1,201 @@
+"""The anomaly-detector sidecar daemon: the deployable service.
+
+This is what runs inside the ``anomaly-detector`` container that
+deploy/docker-compose.anomaly.yml adds to the shop (wired like the
+reference's fraud-detection consumer,
+/root/reference/docker-compose.yml:226-256): an OTLP/HTTP receiver for
+the collector's ``otlphttp/anomaly`` exporter, an optional Kafka
+``orders`` consumer, the device pipeline, a Prometheus ``/metrics``
+surface, flagd gating, and offset-keyed checkpoints.
+
+Configuration is environment-driven with hard failure on malformed
+values — the reference's ``mustMapEnv`` discipline
+(/root/reference/src/checkout/main.go:230-236): a service that boots
+with half a config is worse than one that refuses to boot.
+
+Env contract (all optional, sensible defaults):
+
+- ``ANOMALY_OTLP_PORT``      OTLP/HTTP listen port (default 4318)
+- ``ANOMALY_METRICS_PORT``   Prometheus listen port (default 9464)
+- ``ANOMALY_BATCH``          device batch size (default 2048)
+- ``ANOMALY_PUMP_INTERVAL_S``  batch cadence (default 0.05 — the <100ms
+                               detection-lag budget spends half on batching)
+- ``FLAGD_FILE``             flagd-schema JSON path (hot-reloaded)
+- ``OFREP_URL``              OFREP endpoint (used when FLAGD_FILE unset)
+- ``KAFKA_ADDR``             bootstrap servers for the orders topic
+                             (requires a Kafka client in the image)
+- ``ANOMALY_CHECKPOINT``       snapshot path prefix (enables resume)
+- ``ANOMALY_CHECKPOINT_INTERVAL_S``  snapshot cadence (default 30)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..models.detector import AnomalyDetector, DetectorConfig
+from ..telemetry import metrics as tele_metrics
+from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
+from . import checkpoint
+from .otlp import OtlpHttpReceiver
+from .pipeline import DetectorPipeline
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise SystemExit(f"bad {name}={raw!r}: {e}") from e
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise SystemExit(f"bad {name}={raw!r}: {e}") from e
+
+
+class DetectorDaemon:
+    """Wires receiver → pipeline → detector → metrics; owns the loop."""
+
+    def __init__(self, config: DetectorConfig | None = None):
+        self.otlp_port = _env_int("ANOMALY_OTLP_PORT", 4318)
+        self.metrics_port = _env_int("ANOMALY_METRICS_PORT", 9464)
+        self.batch_size = _env_int("ANOMALY_BATCH", 2048)
+        self.pump_interval_s = _env_float("ANOMALY_PUMP_INTERVAL_S", 0.05)
+        self.ckpt_path = os.environ.get("ANOMALY_CHECKPOINT") or None
+        self.ckpt_interval_s = _env_float("ANOMALY_CHECKPOINT_INTERVAL_S", 30.0)
+
+        flagd_file = os.environ.get("FLAGD_FILE")
+        ofrep = os.environ.get("OFREP_URL")
+        if flagd_file:
+            flags = FlagFileStore(flagd_file)
+        elif ofrep:
+            flags = OfrepClient(ofrep)  # type: ignore[assignment]
+        else:
+            flags = FlagEvaluator()
+
+        config = config or DetectorConfig()
+        if self.ckpt_path and checkpoint.exists(self.ckpt_path):
+            self.detector, meta = checkpoint.load(self.ckpt_path, config)
+            restored_names = meta.get("service_names", [])
+        else:
+            self.detector = AnomalyDetector(config)
+            restored_names = []
+
+        self.registry = tele_metrics.MetricRegistry()
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLAG_TOTAL,
+            "Anomaly flags raised, by service",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_Z_SCORE,
+            "Current |z| per service and signal",
+        )
+        self.pipeline = DetectorPipeline(
+            self.detector,
+            flags=flags,
+            on_report=self._on_report,
+            batch_size=self.batch_size,
+        )
+        for name in restored_names:  # re-intern in checkpoint order
+            self.pipeline.tensorizer.service_id(name)
+
+        self.receiver = OtlpHttpReceiver(
+            self.pipeline.submit,
+            port=self.otlp_port,
+            on_columnar=self.pipeline.submit_columnar,
+        )
+        self.exporter = tele_metrics.PrometheusExporter(
+            self.registry, port=self.metrics_port
+        )
+        self._orders = None
+        kafka_addr = os.environ.get("KAFKA_ADDR")
+        if kafka_addr:
+            from .kafka_orders import OrdersSource  # gated import
+
+            self._orders = OrdersSource(kafka_addr)
+        self._offsets: dict = {}
+        self._stop = threading.Event()
+        self._last_ckpt = time.monotonic()
+
+    # -- report → metrics ---------------------------------------------
+
+    def _on_report(self, t_batch, report, flagged) -> None:
+        names = self.pipeline.tensorizer.service_names
+        tele_metrics.export_report(self.registry, names, report, flagged)
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_LAG_P99, self.pipeline.stats.lag_p99_ms()
+        )
+        self.registry.counter_add(
+            tele_metrics.ANOMALY_SPANS_TOTAL,
+            float(self.pipeline.stats.spans - getattr(self, "_spans_seen", 0)),
+        )
+        self._spans_seen = self.pipeline.stats.spans
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self.receiver.start()
+        self.exporter.start()
+
+    def step(self, t_now: float | None = None) -> None:
+        """One pump + housekeeping tick (public for tests/sims)."""
+        if self._orders is not None:
+            for offsets, record in self._orders.poll(0.0):
+                self._offsets.update(offsets)
+                self.pipeline.submit([record])
+        self.pipeline.pump(t_now)
+        if (
+            self.ckpt_path
+            and time.monotonic() - self._last_ckpt >= self.ckpt_interval_s
+        ):
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        checkpoint.save(
+            self.ckpt_path,
+            self.detector,
+            offsets=dict(self._offsets),
+            service_names=self.pipeline.tensorizer.service_names,
+        )
+        self._last_ckpt = time.monotonic()
+
+    def run(self) -> None:
+        """Blocking serve loop; returns after :meth:`stop`."""
+        self.start()
+        try:
+            while not self._stop.wait(self.pump_interval_s):
+                self.step()
+        finally:
+            self.shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        self.receiver.stop()
+        self.pipeline.drain()
+        if self.ckpt_path:
+            self._checkpoint()
+        self.exporter.stop()
+
+
+def main() -> None:
+    daemon = DetectorDaemon()
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
+    signal.signal(signal.SIGINT, lambda *_: daemon.stop())
+    daemon.run()
+
+
+if __name__ == "__main__":
+    main()

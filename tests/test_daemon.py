@@ -1,0 +1,129 @@
+"""Daemon lifecycle: env-config boot, ingest→metrics, flags, resume.
+
+Drives the deployable sidecar (runtime.daemon) the way the compose
+overlay does — OTLP over HTTP in, Prometheus text out, flagd file
+gating, checkpoint on shutdown and resume on reboot.
+"""
+
+import http.client
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import DetectorConfig
+from opentelemetry_demo_tpu.runtime import wire
+from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+from opentelemetry_demo_tpu.telemetry import metrics as tele_metrics
+
+
+def _payload(service, n, rng, lat_ns=10**6):
+    def kv(k, v):
+        return wire.encode_len(1, k.encode()) + wire.encode_len(
+            2, wire.encode_len(1, v.encode())
+        )
+
+    spans = b""
+    for _ in range(n):
+        start = 10**18
+        spans += wire.encode_len(
+            2,
+            wire.encode_len(1, bytes(rng.integers(0, 256, 16, dtype=np.uint8)))
+            + wire.encode_fixed64(7, start)
+            + wire.encode_fixed64(8, start + lat_ns),
+        )
+    rs = wire.encode_len(
+        1, wire.encode_len(1, kv("service.name", service))
+    ) + wire.encode_len(2, spans)
+    return wire.encode_len(1, rs)
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    flags = {
+        "flags": {
+            "anomalyDetectorEnabled": {
+                "state": "ENABLED",
+                "variants": {"on": True, "off": False},
+                "defaultVariant": "on",
+            }
+        }
+    }
+    flag_path = tmp_path / "flags.json"
+    flag_path.write_text(json.dumps(flags))
+    monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+    monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+    monkeypatch.setenv("ANOMALY_BATCH", "256")
+    monkeypatch.setenv("FLAGD_FILE", str(flag_path))
+    monkeypatch.setenv("ANOMALY_CHECKPOINT", str(tmp_path / "ckpt"))
+    monkeypatch.delenv("KAFKA_ADDR", raising=False)
+    return flag_path
+
+
+def _post(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request(
+        "POST",
+        "/v1/traces",
+        body=body,
+        headers={"Content-Type": "application/x-protobuf"},
+    )
+    resp = conn.getresponse()
+    resp.read()
+    return resp.status
+
+
+def _scrape(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("GET", "/metrics")
+    return conn.getresponse().read().decode()
+
+
+def test_daemon_end_to_end(env):
+    config = DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+    daemon = DetectorDaemon(config)
+    daemon.start()
+    rng = np.random.default_rng(0)
+    try:
+        for step in range(30):
+            assert _post(daemon.receiver.port, _payload("payment", 50, rng)) == 200
+            daemon.step(step * 0.05)
+        daemon.pipeline.drain()
+        daemon._on_report  # report callback ran via drain
+        text = _scrape(daemon.exporter.port)
+        assert tele_metrics.ANOMALY_Z_SCORE in text
+        assert 'service="payment"' in text
+        assert tele_metrics.ANOMALY_SPANS_TOTAL in text
+
+        # Disable via the flag file: pending work drains and drops.
+        env.write_text(
+            json.dumps(
+                {
+                    "flags": {
+                        "anomalyDetectorEnabled": {
+                            "state": "ENABLED",
+                            "variants": {"on": True, "off": False},
+                            "defaultVariant": "off",
+                        }
+                    }
+                }
+            )
+        )
+        os.utime(env)  # ensure mtime moves even on coarse clocks
+        before = daemon.pipeline.stats.spans
+        _post(daemon.receiver.port, _payload("payment", 50, rng))
+        daemon.step(2.0)
+        assert daemon.pipeline.stats.spans == before
+        assert daemon.pipeline.stats.dropped_disabled >= 50
+    finally:
+        daemon.shutdown()
+
+    # Reboot: state and intern table come back from the checkpoint.
+    daemon2 = DetectorDaemon(config)
+    try:
+        assert "payment" in daemon2.pipeline.tensorizer.service_names
+        assert int(daemon2.detector.state.step_idx) > 0
+    finally:
+        daemon2.exporter.stop()
+        daemon2.receiver.stop()
