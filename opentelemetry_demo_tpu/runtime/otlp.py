@@ -223,5 +223,8 @@ class OtlpHttpReceiver:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # BaseServer.shutdown() blocks on an event only serve_forever sets;
+        # calling it on a never-started server would wait forever.
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
