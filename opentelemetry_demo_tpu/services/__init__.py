@@ -13,6 +13,7 @@ Failure injection parity (SURVEY.md §5): every reference flagd flag has
 an equivalent here and flips real behaviour the detector must catch.
 """
 
+from .gateway import ShopGateway
 from .shop import Shop, ShopConfig
 
-__all__ = ["Shop", "ShopConfig"]
+__all__ = ["Shop", "ShopConfig", "ShopGateway"]

@@ -20,6 +20,8 @@ from .catalog import ProductCatalog
 from .checkout import CheckoutService, PlacedOrder
 from .currency import CurrencyService
 from .recommendation import RecommendationService
+from .money import Money
+from .shipping import ShippingService
 from ..telemetry.tracer import TraceContext
 
 FLAG_IMAGE_SLOW_LOAD = "imageSlowLoad"
@@ -38,6 +40,7 @@ class Frontend(ServiceBase):
         currency: CurrencyService,
         recommendation: RecommendationService,
         ad: AdService,
+        shipping: ShippingService | None = None,
     ):
         super().__init__(env)
         self.catalog = catalog
@@ -46,6 +49,7 @@ class Frontend(ServiceBase):
         self.currency = currency
         self.recommendation = recommendation
         self.ad = ad
+        self.shipping = shipping
 
     def _count(self):
         if self.env.metrics is not None:
@@ -95,6 +99,11 @@ class Frontend(ServiceBase):
             raise
         self.span("POST /api/cart", ctx)
 
+    def api_cart_empty(self, ctx: TraceContext, user_id: str) -> None:
+        self._count()
+        self.cart.empty_cart(ctx, user_id)
+        self.span("DELETE /api/cart", ctx)
+
     def api_cart_get(self, ctx: TraceContext, user_id: str) -> dict[str, int]:
         self._count()
         items = self.cart.get_cart(ctx, user_id)
@@ -116,6 +125,24 @@ class Frontend(ServiceBase):
             raise
         self.span("GET /api/data", ctx)
         return ads
+
+    def api_shipping(
+        self, ctx: TraceContext, item_count: int, currency_code: str = "USD"
+    ) -> Money:
+        """Shipping quote via the HTTP gateway leg (pages/api/shipping.ts:
+        frontend → shipping /get-quote → quote, then currency convert)."""
+        self._count()
+        if self.shipping is None:
+            raise ServiceError(self.name, "shipping gateway not wired")
+        try:
+            cost = self.shipping.get_quote(ctx, item_count)
+            if currency_code and currency_code != cost.currency:
+                cost = self.currency.convert(ctx, cost, currency_code)
+        except ServiceError:
+            self.span("GET /api/shipping", ctx, error=True)
+            raise
+        self.span("GET /api/shipping", ctx)
+        return cost
 
     def api_checkout(self, ctx: TraceContext, user_id: str, currency: str, email: str) -> PlacedOrder:
         self._count()

@@ -1,0 +1,330 @@
+"""HTTP edge: the Envoy frontend-proxy + Next.js API surface, over real sockets.
+
+The reference exposes the whole shop through one Envoy listener
+(/root/reference/src/frontend-proxy/envoy.tmpl.yaml:39-54 routes ``/``,
+``/images/``, ``/otlp-http/``, ``/feature``, ``/loadgen``, ``/metrics`` …)
+in front of the Next.js API routes
+(/root/reference/src/frontend/pages/api/{products,cart,checkout,currency,
+data,recommendations,shipping}.ts). :class:`ShopGateway` is both tiers in
+one threaded server:
+
+- the Envoy behaviours: route table, W3C trace-context extraction,
+  an access-log span per request tagged ``frontend-proxy`` (the
+  ``spawn_upstream_span`` analogue, envoy.tmpl.yaml:18-31), and the
+  fault-injection HTTP filter — header-triggered delay
+  (``x-fault-delay-ms``, envoy.tmpl.yaml:57-64) plus the
+  ``imageSlowLoad`` flag on the image route;
+- the frontend behaviours: JSON API routes fanning out to the business
+  services, ``app_frontend_requests_total`` counting via
+  :class:`~.frontend.Frontend`;
+- the image-provider tier (/root/reference/src/image-provider/
+  nginx.conf.template): ``/images/<product-id>`` serves a deterministic
+  per-product SVG with its own ``image-provider`` span;
+- the browser-telemetry seam: ``POST /otlp-http/v1/traces`` accepts OTLP
+  (protobuf or JSON) exactly like the collector route the reference
+  rewrites for the browser tracer
+  (/root/reference/src/frontend/utils/telemetry/FrontendTracer.ts:36-41),
+  feeding decoded spans into the same sink as the shop's own.
+
+The wrapped :class:`~.shop.Shop` stays single-threaded: a lock
+serializes service calls, and the gateway drives the shop's virtual
+clock from wall time (``Shop.pump``) so bus consumers and span flushes
+happen between requests, not inside them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+from xml.sax.saxutils import escape as _xml_escape
+
+from .base import ServiceError
+from .frontend import FLAG_IMAGE_SLOW_LOAD
+from .shop import Shop
+from ..runtime import otlp
+from ..telemetry.tracer import TraceContext
+
+MAX_FAULT_DELAY_S = 10.0  # cap on header-triggered fault delays
+
+
+def _product_image_svg(product_id: str) -> bytes:
+    """Deterministic placeholder artwork, one color per product id."""
+    # crc32, not hash(): str hashes are salted per process, and the color
+    # must be stable across server restarts.
+    hue = zlib.crc32(product_id.encode()) % 360
+    label = _xml_escape(product_id)
+    return (
+        '<svg xmlns="http://www.w3.org/2000/svg" width="320" height="320">'
+        f'<rect width="320" height="320" fill="hsl({hue},45%,35%)"/>'
+        f'<circle cx="160" cy="140" r="70" fill="hsl({hue},60%,70%)"/>'
+        f'<text x="160" y="280" text-anchor="middle" fill="#fff" '
+        f'font-family="monospace" font-size="20">{label}</text></svg>'
+    ).encode()
+
+
+class ShopGateway:
+    """Threaded HTTP server exposing a Shop at one edge address."""
+
+    def __init__(
+        self,
+        shop: Shop,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        on_spans=None,
+    ):
+        self.shop = shop
+        self.on_spans = on_spans  # Callable[[float, list[SpanRecord]], None]
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests_served = 0
+        # Mount point for the flag editor (flagd-ui analogue): an object
+        # with handle(method, path, body) -> (status, content_type, bytes).
+        self.feature_ui = None
+
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _respond(self, status: int, body: bytes, ctype: str = "application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self, method: str):
+                t_start = time.monotonic()
+                parsed = urlparse(self.path)
+                route = parsed.path
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                ctx = TraceContext.from_headers(
+                    {k.lower(): v for k, v in self.headers.items()}
+                )
+                # Envoy-style fault filter: header-triggered delay.
+                delay_ms = self.headers.get("x-fault-delay-ms")
+                if delay_ms:
+                    try:
+                        time.sleep(
+                            min(max(float(delay_ms), 0.0) / 1000.0, MAX_FAULT_DELAY_S)
+                        )
+                    except ValueError:
+                        pass
+                try:
+                    status, ctype, payload = gateway._route(
+                        method, route, query, body, ctx,
+                        self.headers.get("Content-Type") or "",
+                    )
+                except ServiceError as e:
+                    status, ctype = 500, "application/json"
+                    payload = json.dumps({"error": str(e)}).encode()
+                except (json.JSONDecodeError, ValueError, KeyError) as e:
+                    # Malformed client input (bad JSON body, non-numeric
+                    # query params) is the client's fault: 4xx, so it
+                    # doesn't inflate the edge error rate the detector
+                    # watches (is_error tracks status >= 500).
+                    status, ctype = 400, "application/json"
+                    payload = json.dumps({"error": f"bad request: {e}"}).encode()
+                except Exception as e:  # route bug ≠ connection abort
+                    status, ctype = 500, "application/json"
+                    payload = json.dumps({"error": f"internal: {e}"}).encode()
+                # Log before writing the response: once the client sees
+                # the reply, the edge span is already in the sink (tests
+                # and the pipeline may pump immediately after).
+                gateway._access_log(
+                    method, route, ctx, status,
+                    (time.monotonic() - t_start) * 1e6,
+                )
+                self._respond(status, payload, ctype)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                self._handle("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._handle("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._handle("DELETE")
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="shop-gateway", daemon=True
+        )
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        # BaseServer.shutdown() blocks on an event only serve_forever
+        # sets; calling it on a never-started server would wait forever.
+        if self._thread.is_alive():
+            self._server.shutdown()
+        self._server.server_close()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _access_log(self, method, route, ctx, status, duration_us):
+        """Edge span per request — Envoy's access-log/upstream span."""
+        with self._lock:
+            self.requests_served += 1
+            self.shop.tracer.emit(
+                "frontend-proxy",
+                f"{method} {route}",
+                ctx,
+                duration_us,
+                is_error=status >= 500,
+            )
+
+    def _pump_locked(self):
+        """Advance the shop clock to wall elapsed; flush bus + spans."""
+        self.shop.pump(time.monotonic() - self._t0, on_spans=self.on_spans)
+
+    def _route(self, method, route, query, body, ctx, req_ctype):
+        """Dispatch one request; returns (status, content_type, bytes)."""
+        if route == "/health":
+            return 200, "application/json", b'{"status":"ok"}'
+
+        if route.startswith("/otlp-http/"):
+            # Browser-telemetry seam; no shop lock needed (pure decode).
+            if "json" in req_ctype:
+                records = otlp.decode_export_request_json(body)
+            else:
+                records = otlp.decode_export_request(body)
+            if self.on_spans is not None and records:
+                self.on_spans(time.monotonic() - self._t0, records)
+            return 200, "application/json", b"{}"
+
+        if route.startswith("/feature"):
+            if self.feature_ui is None:
+                return 503, "text/plain", b"flag UI not mounted"
+            sub = route[len("/feature"):] or "/"
+            return self.feature_ui.handle(method, sub, body)
+
+        if route.startswith("/images/"):
+            product_id = route[len("/images/"):].removesuffix(".svg")
+            with self._lock:
+                self._pump_locked()
+                fe = self.shop.frontend
+                fe.api_image(ctx, product_id)  # emits image-provider span
+                slow = bool(fe.flag(FLAG_IMAGE_SLOW_LOAD, False, ctx))
+            if slow:
+                # The envoy fault filter delays the *real* response too;
+                # the span already carries the full simulated 3-5s, so
+                # cap the wall-clock stall at 1s — outside the shop lock,
+                # other routes keep flowing (Envoy only stalls this one).
+                time.sleep(1.0)
+            return 200, "image/svg+xml", _product_image_svg(product_id)
+
+        with self._lock:
+            self._pump_locked()
+            return self._route_shop(method, route, query, body, ctx)
+
+    def _route_shop(self, method, route, query, body, ctx):
+        fe = self.shop.frontend
+        ok = 200, "application/json"
+
+        if route == "/" or route == "/index":
+            fe.index(ctx)
+            return (*ok, b'{"page":"home"}')
+
+        if route == "/metrics":
+            return 200, "text/plain; version=0.0.4", self.shop.metrics.render().encode()
+
+        if route == "/loadgen":
+            stats = {
+                "requests_served": self.requests_served,
+                "spans_emitted": self.shop.tracer.spans_emitted,
+                "virtual_time_s": self.shop.now,
+            }
+            return (*ok, json.dumps(stats).encode())
+
+        if route == "/api/products" and method == "GET":
+            return (*ok, json.dumps({"products": fe.api_products(ctx)}).encode())
+
+        if route.startswith("/api/products/") and method == "GET":
+            product_id = route[len("/api/products/"):]
+            return (*ok, json.dumps(fe.api_product(ctx, product_id)).encode())
+
+        if route == "/api/currency" and method == "GET":
+            return (*ok, json.dumps({"currencyCodes": fe.api_currency(ctx)}).encode())
+
+        if route == "/api/cart":
+            user = query.get("sessionId") or ctx.baggage.get("session.id", "anon")
+            if method == "GET":
+                items = fe.api_cart_get(ctx, user)
+                return (*ok, json.dumps({
+                    "userId": user,
+                    "items": [
+                        {"productId": p, "quantity": q} for p, q in items.items()
+                    ],
+                }).encode())
+            if method == "POST":
+                doc = json.loads(body or b"{}")
+                item = doc.get("item", {})
+                fe.api_cart_add(
+                    ctx,
+                    doc.get("userId", user),
+                    item.get("productId", ""),
+                    int(item.get("quantity", 1)),
+                )
+                return (*ok, b'{"status":"ok"}')
+            if method == "DELETE":
+                fe.api_cart_empty(ctx, user)
+                return (*ok, b'{"status":"ok"}')
+
+        if route == "/api/recommendations" and method == "GET":
+            exclude = [p for p in query.get("productIds", "").split(",") if p]
+            recs = fe.api_recommendations(ctx, exclude)
+            return (*ok, json.dumps({"productIds": recs}).encode())
+
+        if route == "/api/data" and method == "GET":
+            keys = [k for k in query.get("contextKeys", "").split(",") if k]
+            ads = fe.api_ads(ctx, keys)
+            return (*ok, json.dumps({"ads": ads}).encode())
+
+        if route == "/api/shipping" and method == "GET":
+            count = int(query.get("itemCount", 1))
+            cost = fe.api_shipping(ctx, count, query.get("currencyCode", "USD"))
+            return (*ok, json.dumps({
+                "costUsd": {
+                    "currencyCode": cost.currency,
+                    "units": cost.units,
+                    "nanos": cost.nanos,
+                }
+            }).encode())
+
+        if route == "/api/checkout" and method == "POST":
+            doc = json.loads(body or b"{}")
+            user = doc.get("userId") or ctx.baggage.get("session.id", "anon")
+            order = fe.api_checkout(
+                ctx, user,
+                doc.get("currencyCode", "USD"),
+                doc.get("email", "someone@example.com"),
+            )
+            return (*ok, json.dumps({
+                "orderId": order.order_id,
+                "shippingTrackingId": order.tracking_id,
+                "total": {
+                    "currencyCode": order.total.currency,
+                    "units": order.total.units,
+                    "nanos": order.total.nanos,
+                },
+                "items": list(order.items),
+            }).encode())
+
+        return 404, "application/json", b'{"error":"no route"}'

@@ -1,0 +1,159 @@
+"""HTTP load generator: the Locust profile over real sockets.
+
+Drives a :class:`~.gateway.ShopGateway` address with the reference's
+Locust user model (/root/reference/src/load-generator/locustfile.py:
+107-220): N concurrent users, 1-10 s waits, the weighted task mix
+(browse×10, recommendations×3, ads×3, view-cart×3, add-to-cart×2,
+checkout×1, checkout-multi×1, flood-home×5 when enabled, index×1), and
+``session.id`` + ``synthetic_request=true`` baggage attached per session
+(:175-179) so payment/ad targeting sees the same keys.
+
+The in-proc :class:`~.loadgen.LoadGenerator` is the deterministic
+virtual-clock simulator for tests; this one exists to exercise the real
+network edge (serialization, trace-header propagation, fault filters,
+concurrent request interleaving) exactly as the reference's load
+generator exercises Envoy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+
+from .loadgen import TASK_WEIGHTS
+
+
+class HttpLoadGenerator:
+    """N user threads issuing the Locust task mix against a base URL."""
+
+    def __init__(
+        self,
+        base_url: str,
+        users: int = 5,
+        wait_range_s: tuple[float, float] = (1.0, 10.0),
+        seed: int = 0,
+        flood_enabled: bool = False,
+        timeout_s: float = 10.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.users = users
+        self.wait_range_s = wait_range_s
+        self.flood_enabled = flood_enabled
+        self.timeout_s = timeout_s
+        self._seed = seed
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.requests_sent = 0
+        self.errors = 0
+        self._count_lock = threading.Lock()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _headers(self, session_id: str) -> dict[str, str]:
+        trace_id = uuid.uuid4().hex
+        return {
+            "traceparent": f"00-{trace_id}-{'0' * 16}-01",
+            "baggage": f"session.id={session_id},synthetic_request=true",
+            "Content-Type": "application/json",
+        }
+
+    def _request(self, method: str, path: str, session_id: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            headers=self._headers(session_id),
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = resp.read()
+            with self._count_lock:
+                self.requests_sent += 1
+            return json.loads(payload) if payload[:1] in (b"{", b"[") else None
+        except Exception:
+            with self._count_lock:
+                self.requests_sent += 1
+                self.errors += 1
+            return None
+
+    def _products(self, session_id: str) -> list[str]:
+        doc = self._request("GET", "/api/products", session_id) or {}
+        return [p["id"] for p in doc.get("products", [])]
+
+    # -- the Locust tasks ----------------------------------------------
+
+    def _run_task(self, rng: np.random.Generator, task: str, session_id: str, products: list[str]):
+        pick = lambda: products[int(rng.integers(len(products)))]  # noqa: E731
+        if task == "browse_product" and products:
+            pid = pick()
+            self._request("GET", f"/api/products/{pid}", session_id)
+            self._request("GET", f"/images/{pid}.svg", session_id)
+        elif task == "get_recommendations" and products:
+            self._request("GET", f"/api/recommendations?productIds={pick()}", session_id)
+        elif task == "get_ads":
+            self._request("GET", "/api/data?contextKeys=telescopes", session_id)
+        elif task == "view_cart":
+            self._request("GET", f"/api/cart?sessionId={session_id}", session_id)
+        elif task == "add_to_cart" and products:
+            self._request("POST", "/api/cart", session_id, {
+                "userId": session_id,
+                "item": {"productId": pick(), "quantity": int(rng.integers(1, 4))},
+            })
+        elif task in ("checkout", "checkout_multi") and products:
+            n = 1 if task == "checkout" else int(rng.integers(2, 5))
+            for _ in range(n):
+                self._request("POST", "/api/cart", session_id, {
+                    "userId": session_id,
+                    "item": {"productId": pick(), "quantity": 1},
+                })
+            self._request("POST", "/api/checkout", session_id, {
+                "userId": session_id,
+                "email": f"{session_id[:8]}@example.com",
+                "currencyCode": "USD",
+            })
+        elif task == "flood_home":
+            if self.flood_enabled:
+                for _ in range(10):
+                    self._request("GET", "/", session_id)
+        else:  # index
+            self._request("GET", "/", session_id)
+
+    def _user_loop(self, user_idx: int):
+        rng = np.random.default_rng(self._seed + user_idx)
+        session_id = str(uuid.UUID(int=int(rng.integers(0, 2**63)) << 64))
+        products = self._products(session_id)
+        names = [n for n, _ in TASK_WEIGHTS]
+        weights = np.array([w for _, w in TASK_WEIGHTS], dtype=np.float64)
+        weights /= weights.sum()
+        lo, hi = self.wait_range_s
+        while not self._stop.is_set():
+            task = names[int(rng.choice(len(names), p=weights))]
+            self._run_task(rng, task, session_id, products)
+            self._stop.wait(float(rng.uniform(lo, hi)))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        for i in range(self.users):
+            t = threading.Thread(
+                target=self._user_loop, args=(i,),
+                name=f"http-loadgen-{i}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.stop()

@@ -81,7 +81,7 @@ class Shop:
         )
         self.frontend = Frontend(
             env, self.catalog, self.cart, self.checkout, self.currency,
-            self.recommendation, self.ad,
+            self.recommendation, self.ad, self.shipping,
         )
         self.accounting = AccountingService(env, self.bus)
         self.fraud = FraudDetectionService(env, self.bus)
@@ -110,6 +110,24 @@ class Shop:
     def now(self) -> float:
         return self._t
 
+    def pump(self, t_now: float, on_spans=None) -> None:
+        """Advance the clock to ``t_now`` without load generation.
+
+        The gateway's mode of driving the shop: external (HTTP) callers
+        make the requests; this just moves virtual time forward, lets
+        the bus deliver to consumers, and flushes accumulated spans.
+        """
+        if t_now > self._t:
+            self._t = t_now
+        self.bus.pump()
+        if self._span_buffer:
+            # Copy-and-clear, never rebind: the tracer holds a reference
+            # to this exact list's append method.
+            spans = list(self._span_buffer)
+            self._span_buffer.clear()
+            if on_spans is not None:
+                on_spans(self._t, spans)
+
     def run(
         self,
         seconds: float,
@@ -126,11 +144,4 @@ class Shop:
         while self._t < end:
             self._t = min(self._t + step, end)
             self.loadgen.run_until(self._t)
-            self.bus.pump()
-            if self._span_buffer:
-                # Copy-and-clear, never rebind: the tracer holds a
-                # reference to this exact list's append method.
-                spans = list(self._span_buffer)
-                self._span_buffer.clear()
-                if on_spans is not None:
-                    on_spans(self._t, spans)
+            self.pump(self._t, on_spans)

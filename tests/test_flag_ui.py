@@ -1,0 +1,136 @@
+"""Flag editor (flagd-ui analogue): API routes, validation, live effect."""
+
+import json
+
+import pytest
+
+from opentelemetry_demo_tpu.services import Shop, ShopConfig
+from opentelemetry_demo_tpu.utils.flag_ui import (
+    FlagEditorUI,
+    FlagValidationError,
+    validate_flag_doc,
+)
+from opentelemetry_demo_tpu.utils.flags import FlagFileStore
+
+GOOD_DOC = {
+    "flags": {
+        "paymentFailure": {
+            "state": "ENABLED",
+            "variants": {"on": 1.0, "off": 0.0},
+            "defaultVariant": "off",
+        }
+    }
+}
+
+
+def test_validation_rejects_bad_docs():
+    validate_flag_doc(GOOD_DOC)
+    with pytest.raises(FlagValidationError):
+        validate_flag_doc({"not_flags": {}})
+    with pytest.raises(FlagValidationError):
+        validate_flag_doc({"flags": {"x": {"variants": {}, "defaultVariant": "on",
+                                          "state": "ENABLED"}}})
+    with pytest.raises(FlagValidationError):
+        validate_flag_doc({"flags": {"x": {"variants": {"on": 1},
+                                           "defaultVariant": "off",
+                                           "state": "ENABLED"}}})
+    with pytest.raises(FlagValidationError):
+        validate_flag_doc({"flags": {"x": {"variants": {"on": 1},
+                                           "defaultVariant": "on",
+                                           "state": "weird"}}})
+
+
+def test_pages_and_rw_roundtrip_in_memory():
+    shop = Shop(ShopConfig(users=0))
+    ui = FlagEditorUI(shop.flags)
+
+    status, ctype, body = ui.handle("GET", "/", b"")
+    assert status == 200 and "html" in ctype and b"Feature Flags" in body
+
+    status, _, _ = ui.handle(
+        "POST", "/api/write-to-file", json.dumps({"data": GOOD_DOC}).encode()
+    )
+    assert status == 200
+    status, _, body = ui.handle("GET", "/api/read-file", b"")
+    assert json.loads(body) == GOOD_DOC
+    assert b"paymentFailure" in ui.handle("GET", "/advanced", b"")[2]
+
+    # Basic-page action: flip defaultVariant, evaluation follows.
+    assert shop.flags.evaluate("paymentFailure", -1.0) == 0.0
+    status, _, _ = ui.handle(
+        "POST", "/api/set-variant",
+        json.dumps({"flag": "paymentFailure", "variant": "on"}).encode(),
+    )
+    assert status == 200
+    assert shop.flags.evaluate("paymentFailure", -1.0) == 1.0
+
+    status, _, _ = ui.handle("POST", "/api/set-variant",
+                             json.dumps({"flag": "nope", "variant": "on"}).encode())
+    assert status == 404
+    # A rejected set-variant must not corrupt the live store.
+    status, _, _ = ui.handle(
+        "POST", "/api/set-variant",
+        json.dumps({"flag": "paymentFailure", "variant": "bogus"}).encode(),
+    )
+    assert status == 400
+    assert shop.flags.evaluate("paymentFailure", -1.0) == 1.0
+    status, _, _ = ui.handle("POST", "/api/write-to-file", b'{"data": {"flags": 3}}')
+    assert status == 400
+    assert ui.handle("GET", "/nope", b"")[0] == 404
+
+
+def test_file_backed_write_hot_reloads(tmp_path):
+    path = tmp_path / "demo.flagd.json"
+    path.write_text(json.dumps(GOOD_DOC))
+    store = FlagFileStore(str(path))
+    ui = FlagEditorUI(store)
+
+    doc = json.loads(ui.handle("GET", "/api/read-file", b"")[2])
+    doc["flags"]["paymentFailure"]["defaultVariant"] = "on"
+    status, _, _ = ui.handle(
+        "POST", "/api/write-to-file", json.dumps({"data": doc}).encode()
+    )
+    assert status == 200
+    # The file was rewritten (atomically) and the store sees the flip.
+    assert json.loads(path.read_text())["flags"]["paymentFailure"]["defaultVariant"] == "on"
+    assert store.evaluate("paymentFailure", -1.0) == 1.0
+    # A rejected write leaves the file untouched.
+    status, _, _ = ui.handle("POST", "/api/write-to-file", b'{"data": {"flags": 3}}')
+    assert status == 400
+    assert json.loads(path.read_text())["flags"]["paymentFailure"]["defaultVariant"] == "on"
+    assert list(tmp_path.iterdir()) == [path]  # no leftover temp files
+
+
+def test_mounted_behind_gateway_flips_live_behaviour():
+    import urllib.error
+    import urllib.request
+
+    from opentelemetry_demo_tpu.services import ShopGateway
+
+    shop = Shop(ShopConfig(users=0, seed=3))
+    gw = ShopGateway(shop, host="127.0.0.1", port=0)
+    gw.feature_ui = FlagEditorUI(shop.flags)
+    gw.start()
+    try:
+        base = f"http://127.0.0.1:{gw.port}"
+        with urllib.request.urlopen(base + "/feature", timeout=10) as r:
+            assert b"Feature Flags" in r.read()
+        doc = {"flags": {"productCatalogFailure": {
+            "state": "ENABLED", "variants": {"on": True, "off": False},
+            "defaultVariant": "on",
+        }}}
+        req = urllib.request.Request(
+            base + "/feature/api/write-to-file",
+            data=json.dumps({"data": doc}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                base + f"/api/products/{shop.catalog.failure_product_id}",
+                timeout=10,
+            )
+        assert exc.value.code == 500
+    finally:
+        gw.stop()

@@ -1,0 +1,212 @@
+"""HTTP edge tests: the Envoy/Next.js-API surface over real sockets.
+
+Exercises the gateway the way the reference's Cypress/Locust traffic
+exercises Envoy + the frontend API routes (SURVEY.md §3.3-3.4): real
+HTTP requests, trace-header propagation, fault-injection filters, and
+spans flowing out the back into the detector's sink.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opentelemetry_demo_tpu.services import Shop, ShopConfig, ShopGateway
+from opentelemetry_demo_tpu.services.http_load import HttpLoadGenerator
+
+
+@pytest.fixture
+def rig():
+    shop = Shop(ShopConfig(users=0, seed=7))
+    sink = []
+    sink_lock = threading.Lock()
+
+    def on_spans(t, spans):
+        with sink_lock:
+            sink.extend(spans)
+
+    gw = ShopGateway(shop, host="127.0.0.1", port=0, on_spans=on_spans)
+    gw.start()
+    try:
+        yield shop, gw, sink
+    finally:
+        gw.stop()
+
+
+def _get(gw, path, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}{path}", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+def _post(gw, path, doc, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_products_and_detail(rig):
+    shop, gw, sink = rig
+    status, ctype, body = _get(gw, "/api/products")
+    assert status == 200 and "json" in ctype
+    products = json.loads(body)["products"]
+    assert len(products) >= 5
+    pid = products[0]["id"]
+    status, _, body = _get(gw, f"/api/products/{pid}")
+    assert json.loads(body)["id"] == pid
+
+
+def test_cart_roundtrip_and_checkout(rig):
+    shop, gw, sink = rig
+    pid = json.loads(_get(gw, "/api/products")[2])["products"][0]["id"]
+    _post(gw, "/api/cart", {"userId": "u1", "item": {"productId": pid, "quantity": 2}})
+    status, _, body = _get(gw, "/api/cart?sessionId=u1")
+    items = json.loads(body)["items"]
+    assert items == [{"productId": pid, "quantity": 2}]
+    status, body = _post(gw, "/api/checkout", {"userId": "u1", "currencyCode": "EUR"})
+    order = json.loads(body)
+    assert order["orderId"] and order["total"]["currencyCode"] == "EUR"
+    # Checkout emptied the cart (reference PlaceOrder main.go:437).
+    assert json.loads(_get(gw, "/api/cart?sessionId=u1")[2])["items"] == []
+
+
+def test_supporting_routes(rig):
+    shop, gw, sink = rig
+    assert "USD" in json.loads(_get(gw, "/api/currency")[2])["currencyCodes"]
+    recs = json.loads(_get(gw, "/api/recommendations?productIds=")[2])["productIds"]
+    assert len(recs) == 5
+    ads = json.loads(_get(gw, "/api/data?contextKeys=telescopes")[2])["ads"]
+    assert isinstance(ads, list)
+    quote = json.loads(_get(gw, "/api/shipping?itemCount=3&currencyCode=CAD")[2])
+    assert quote["costUsd"]["currencyCode"] == "CAD"
+    assert _get(gw, "/health")[0] == 200
+    assert _get(gw, "/")[0] == 200
+    status, ctype, body = _get(gw, "/images/OLJCESPC7Z.svg")
+    assert status == 200 and ctype == "image/svg+xml" and b"<svg" in body
+    status, ctype, body = _get(gw, "/metrics")
+    assert status == 200 and "app_frontend_requests_total" in body.decode()
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(gw, "/api/nope")
+    assert exc.value.code == 404
+
+
+def test_trace_headers_propagate_to_spans(rig):
+    shop, gw, sink = rig
+    trace_id = "ab" * 16
+    _get(gw, "/api/products", headers={
+        "traceparent": f"00-{trace_id}-{'0' * 16}-01",
+        "baggage": "session.id=sess-1,synthetic_request=true",
+    })
+    with gw._lock:
+        gw._pump_locked()
+    services = {s.service for s in sink}
+    # Edge access-log span + downstream fan-out, all on the same trace.
+    assert "frontend-proxy" in services and "product-catalog" in services
+    assert all(s.trace_id == bytes.fromhex(trace_id) for s in sink)
+
+
+def test_flagged_failure_maps_to_500_and_error_span(rig):
+    shop, gw, sink = rig
+    shop.set_flag("productCatalogFailure", True)
+    bad_id = shop.catalog.failure_product_id
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(gw, f"/api/products/{bad_id}")
+    assert exc.value.code == 500
+    with gw._lock:
+        gw._pump_locked()
+    errors = [s for s in sink if s.is_error]
+    assert any(s.service == "product-catalog" for s in errors)
+    assert any(s.service == "frontend-proxy" for s in errors)
+
+
+def test_malformed_input_is_4xx_not_error_span(rig):
+    shop, gw, sink = rig
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/api/cart",
+        data=b"not json", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(gw, "/api/shipping?itemCount=abc")
+    assert exc.value.code == 400
+    with gw._lock:
+        gw._pump_locked()
+    # Client garbage must not inflate the edge error rate (is_error
+    # tracks >= 500 only).
+    assert not any(s.is_error for s in sink if s.service == "frontend-proxy")
+
+
+def test_cart_delete_goes_through_frontend(rig):
+    shop, gw, sink = rig
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/api/cart?sessionId=u9", method="DELETE"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+    with gw._lock:
+        gw._pump_locked()
+    assert any(s.service == "frontend" for s in sink)
+
+
+def test_svg_stable_and_escaped():
+    from opentelemetry_demo_tpu.services.gateway import _product_image_svg
+
+    a, b = _product_image_svg("OLJCESPC7Z"), _product_image_svg("OLJCESPC7Z")
+    assert a == b
+    assert b"<script" not in _product_image_svg("x<script>alert(1)</script>")
+
+
+def test_fault_delay_header(rig):
+    shop, gw, sink = rig
+    t0 = time.monotonic()
+    status, _, _ = _get(gw, "/health", headers={"x-fault-delay-ms": "300"})
+    assert status == 200 and time.monotonic() - t0 >= 0.3
+
+
+def test_otlp_http_browser_seam(rig):
+    shop, gw, sink = rig
+    body = {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "browser"}}
+            ]},
+            "scopeSpans": [{"spans": [{
+                "traceId": "cd" * 16,
+                "name": "documentFetch",
+                "startTimeUnixNano": 0,
+                "endTimeUnixNano": 5_000_000,
+            }]}],
+        }]
+    }
+    status, _ = _post(gw, "/otlp-http/v1/traces", body)
+    assert status == 200
+    browser = [s for s in sink if s.service == "browser"]
+    assert browser and browser[0].duration_us == 5000.0
+
+
+def test_http_loadgen_drives_traffic(rig):
+    shop, gw, sink = rig
+    lg = HttpLoadGenerator(
+        f"http://127.0.0.1:{gw.port}", users=3,
+        wait_range_s=(0.01, 0.05), seed=1,
+    )
+    lg.run_for(2.0)
+    assert lg.requests_sent > 20
+    assert lg.errors <= lg.requests_sent * 0.2  # checkout w/ empty cart etc.
+    with gw._lock:
+        gw._pump_locked()
+    services = {s.service for s in sink}
+    assert {"frontend-proxy", "frontend", "product-catalog"} <= services
