@@ -99,12 +99,14 @@ def _decode_span(span_buf: bytes, service: str) -> SpanRecord:
     if status_buf:
         st = wire.scan_fields(status_buf)
         is_error = int(wire.first(st, 3, 0) or 0) == _STATUS_ERROR
+    name_raw = wire.first(sp, 5)
     return SpanRecord(
         service=service,
         duration_us=duration_us,
         trace_id=trace_id,
         is_error=is_error,
         attr=_pick_attr(attrs),
+        name=name_raw.decode("utf-8", "replace") if isinstance(name_raw, bytes) else None,
     )
 
 
@@ -132,6 +134,7 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
                         trace_id=bytes.fromhex(sp.get("traceId", "00")),
                         is_error=sp.get("status", {}).get("code") in (2, "STATUS_CODE_ERROR"),
                         attr=_pick_attr({k: v for k, v in attrs.items() if v}),
+                        name=sp.get("name"),
                     )
                 )
     return records

@@ -49,6 +49,9 @@ class SpanRecord(NamedTuple):
     trace_id: bytes | int
     is_error: bool = False
     attr: str | None = None
+    # Operation name — carried for trace-based assertions (the tracetest
+    # harness selects spans by it); the tensorizer ignores it.
+    name: str | None = None
 
 
 class SpanColumns(NamedTuple):

@@ -51,6 +51,11 @@ from ..telemetry.tracer import TraceContext
 MAX_FAULT_DELAY_S = 10.0  # cap on header-triggered fault delays
 
 
+def _money_json(m) -> dict:
+    """Money → the proto-JSON shape the reference APIs use."""
+    return {"currencyCode": m.currency, "units": m.units, "nanos": m.nanos}
+
+
 def _product_image_svg(product_id: str) -> bytes:
     """Deterministic placeholder artwork, one color per product id."""
     # crc32, not hash(): str hashes are salted per process, and the color
@@ -101,22 +106,27 @@ class ShopGateway:
                 t_start = time.monotonic()
                 parsed = urlparse(self.path)
                 route = parsed.path
-                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                ctx = TraceContext.from_headers(
-                    {k.lower(): v for k, v in self.headers.items()}
-                )
-                # Envoy-style fault filter: header-triggered delay.
-                delay_ms = self.headers.get("x-fault-delay-ms")
-                if delay_ms:
-                    try:
-                        time.sleep(
-                            min(max(float(delay_ms), 0.0) / 1000.0, MAX_FAULT_DELAY_S)
-                        )
-                    except ValueError:
-                        pass
+                ctx = None
                 try:
+                    # Header/body parsing is inside the guard: a
+                    # malformed traceparent or Content-Length is client
+                    # input too, and must produce a 400 + an access-log
+                    # span, never a dropped connection.
+                    query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    ctx = TraceContext.from_headers(
+                        {k.lower(): v for k, v in self.headers.items()}
+                    )
+                    # Envoy-style fault filter: header-triggered delay.
+                    delay_ms = self.headers.get("x-fault-delay-ms")
+                    if delay_ms:
+                        try:
+                            time.sleep(
+                                min(max(float(delay_ms), 0.0) / 1000.0, MAX_FAULT_DELAY_S)
+                            )
+                        except ValueError:
+                            pass
                     status, ctype, payload = gateway._route(
                         method, route, query, body, ctx,
                         self.headers.get("Content-Type") or "",
@@ -134,6 +144,8 @@ class ShopGateway:
                 except Exception as e:  # route bug ≠ connection abort
                     status, ctype = 500, "application/json"
                     payload = json.dumps({"error": f"internal: {e}"}).encode()
+                if ctx is None:  # header parse failed before extraction
+                    ctx = TraceContext.new()
                 # Log before writing the response: once the client sees
                 # the reply, the edge span is already in the sink (tests
                 # and the pipeline may pump immediately after).
@@ -300,13 +312,7 @@ class ShopGateway:
         if route == "/api/shipping" and method == "GET":
             count = int(query.get("itemCount", 1))
             cost = fe.api_shipping(ctx, count, query.get("currencyCode", "USD"))
-            return (*ok, json.dumps({
-                "costUsd": {
-                    "currencyCode": cost.currency,
-                    "units": cost.units,
-                    "nanos": cost.nanos,
-                }
-            }).encode())
+            return (*ok, json.dumps({"costUsd": _money_json(cost)}).encode())
 
         if route == "/api/checkout" and method == "POST":
             doc = json.loads(body or b"{}")
@@ -319,11 +325,7 @@ class ShopGateway:
             return (*ok, json.dumps({
                 "orderId": order.order_id,
                 "shippingTrackingId": order.tracking_id,
-                "total": {
-                    "currencyCode": order.total.currency,
-                    "units": order.total.units,
-                    "nanos": order.total.nanos,
-                },
+                "total": _money_json(order.total),
                 "items": list(order.items),
             }).encode())
 

@@ -26,6 +26,7 @@ import uuid
 import numpy as np
 
 from .loadgen import TASK_WEIGHTS
+from ..telemetry.tracer import TraceContext
 
 
 class HttpLoadGenerator:
@@ -55,12 +56,10 @@ class HttpLoadGenerator:
     # -- plumbing ------------------------------------------------------
 
     def _headers(self, session_id: str) -> dict[str, str]:
-        trace_id = uuid.uuid4().hex
-        return {
-            "traceparent": f"00-{trace_id}-{'0' * 16}-01",
-            "baggage": f"session.id={session_id},synthetic_request=true",
-            "Content-Type": "application/json",
-        }
+        ctx = TraceContext.new({
+            "session.id": session_id, "synthetic_request": "true",
+        })
+        return {**ctx.to_headers(), "Content-Type": "application/json"}
 
     def _request(self, method: str, path: str, session_id: str, body: dict | None = None):
         data = json.dumps(body).encode() if body is not None else None

@@ -84,5 +84,6 @@ class Tracer:
                 trace_id=ctx.trace_id,
                 is_error=is_error,
                 attr=attr,
+                name=name,
             )
         )

@@ -1,0 +1,325 @@
+"""Trace-based testing: the framework's Tracetest analogue.
+
+The reference's primary test strategy is trace-based integration testing
+(SURVEY.md §4): a Tracetest server triggers one real request against the
+live stack and asserts on the *resulting distributed trace* — e.g.
+test/tracetesting/checkout/place-order.yaml triggers
+``CheckoutService/PlaceOrder`` and asserts the response body, the
+``rpc.grpc.status_code`` on the checkout span, and the existence of a
+Kafka ``orders publish`` producer span; run.bash fans suites out in
+parallel and max-reduces their exit codes (:88-108).
+
+This module is that harness for the TPU build, speaking the same spec
+shape (YAML, ``type: Test`` / ``spec.trigger`` / ``spec.specs`` with
+selectors + assertions) against the real HTTP edge:
+
+- **Trigger**: one HTTP request to a :class:`~.services.gateway.ShopGateway`
+  (plus optional ``setup`` requests, e.g. filling a cart before
+  checkout), with a fresh generated trace id in the ``traceparent``
+  header — the Tracetest trigger span analogue.
+- **Selector**: ``{service: ..., name: ...}`` picks spans of the
+  triggered trace (name = substring match, like tracetest's
+  ``span[name=...]`` selectors on our reduced span model).
+- **Assertions**: over the selected span set (``count``/``error_count``
+  with ``gte/lte/eq/ne/lt/gt`` ops, ``duration_us`` bounds, ``attr``
+  values) or over the JSON response body (``json_path`` dotted paths,
+  the ``tracetest.response.body | json_path`` analogue).
+
+Suites live in ``tracetesting/<service>/*.yaml`` at the repo root,
+mirroring the reference's per-service directories; the runner
+(`python -m opentelemetry_demo_tpu.tracetest`) boots a Shop + gateway,
+fans the suites out across worker threads, prints per-test results, and
+exits with the max status — the run.bash contract.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .runtime.tensorize import SpanRecord
+from .telemetry.tracer import TraceContext
+
+_OPS = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "lte": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "gte": lambda a, b: a >= b,
+    "contains": lambda a, b: b in str(a),
+}
+
+
+@dataclass
+class CheckResult:
+    test_id: str
+    name: str
+    passed: bool
+    detail: str = ""
+
+
+@dataclass
+class TestResult:
+    test_id: str
+    name: str
+    checks: list[CheckResult] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return all(c.passed for c in self.checks)
+
+
+def _json_path(doc, path: str):
+    """Dotted-path lookup (the json_path subset the reference specs use)."""
+    cur = doc
+    for part in path.split("."):
+        if isinstance(cur, list):
+            cur = cur[int(part)]
+        elif isinstance(cur, dict):
+            if part not in cur:
+                return None
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def _select(spans: list[SpanRecord], selector: dict) -> list[SpanRecord]:
+    out = spans
+    if "service" in selector:
+        out = [s for s in out if s.service == selector["service"]]
+    if "name" in selector:
+        out = [s for s in out if selector["name"] in (s.name or "")]
+    if selector.get("error") is not None:
+        out = [s for s in out if s.is_error == bool(selector["error"])]
+    return out
+
+
+def _check_assertion(spec: dict, spans: list[SpanRecord], response) -> tuple[bool, str]:
+    """One assertion against the selected span set / response body."""
+    op_name = spec.get("op", "eq")
+    op = _OPS.get(op_name)
+    if op is None:
+        return False, f"unknown op {op_name!r}"
+    expect = spec.get("value")
+
+    if "json_path" in spec:
+        actual = _json_path(response or {}, spec["json_path"])
+        ok = actual is not None and op(actual, expect)
+        return ok, f"json_path {spec['json_path']} = {actual!r} (want {op_name} {expect!r})"
+
+    metric = spec.get("metric", "count")
+    if metric == "count":
+        actual = len(spans)
+    elif metric == "error_count":
+        actual = sum(1 for s in spans if s.is_error)
+    elif metric == "duration_us_max":
+        actual = max((s.duration_us for s in spans), default=0.0)
+    elif metric == "duration_us_min":
+        actual = min((s.duration_us for s in spans), default=0.0)
+    elif metric == "attr":
+        # Every selected span's monitored attribute must satisfy the op.
+        bad = [s.attr for s in spans if not op(s.attr or "", expect)]
+        return (len(spans) > 0 and not bad), f"attr values bad={bad!r} over {len(spans)} spans"
+    else:
+        return False, f"unknown metric {metric!r}"
+    return op(actual, expect), f"{metric} = {actual!r} (want {op_name} {expect!r})"
+
+
+class TraceTestClient:
+    """Triggers spec'd requests against a gateway and collects the trace.
+
+    ``span_log`` must be the (shared) list every gateway ``on_spans``
+    flush appends to; the client filters it by the trigger's trace id.
+    """
+
+    def __init__(self, base_url: str, span_log: list, pump, lock: threading.Lock):
+        self.base_url = base_url.rstrip("/")
+        self.span_log = span_log
+        self.pump = pump  # flushes pending shop spans into span_log
+        self.lock = lock
+
+    def _request(self, http_spec: dict, trace_id: str):
+        body = http_spec.get("body")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + http_spec["path"],
+            data=data,
+            method=http_spec.get("method", "GET"),
+            headers={
+                "Content-Type": "application/json",
+                **TraceContext(trace_id=bytes.fromhex(trace_id)).to_headers(),
+                **http_spec.get("headers", {}),
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            status = e.code
+        try:
+            doc = json.loads(payload) if payload else None
+        except json.JSONDecodeError:
+            doc = None
+        return status, doc
+
+    def run_test(self, doc: dict) -> TestResult:
+        spec = doc.get("spec", doc)
+        result = TestResult(test_id=spec.get("id", "?"), name=spec.get("name", "?"))
+        trigger = spec["trigger"]["http"]
+        trace_id = uuid.uuid4().hex
+
+        # Setup requests ride the same trace (cart fill before checkout).
+        for setup in trigger.get("setup", []):
+            self._request(setup, trace_id)
+        status, response = self._request(trigger, trace_id)
+        self.pump()
+        with self.lock:
+            spans = [
+                s for s in self.span_log
+                if isinstance(s.trace_id, bytes) and s.trace_id.hex() == trace_id
+            ]
+
+        want_status = trigger.get("expect_status", 200)
+        result.checks.append(CheckResult(
+            result.test_id, "trigger status",
+            status == want_status, f"HTTP {status} (want {want_status})",
+        ))
+        for check in spec.get("specs", []):
+            selected = _select(spans, check.get("selector", {}))
+            for assertion in check.get("assertions", []):
+                ok, detail = _check_assertion(assertion, selected, response)
+                result.checks.append(
+                    CheckResult(result.test_id, check.get("name", "?"), ok, detail)
+                )
+        return result
+
+
+def load_suites(root: str | Path) -> dict[str, list[dict]]:
+    """``tracetesting/<service>/*.yaml`` → {suite name: [test docs]}."""
+    import yaml
+
+    suites: dict[str, list[dict]] = {}
+    root = Path(root)
+    for path in sorted(root.glob("*/*.yaml")):
+        docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+        suites.setdefault(path.parent.name, []).extend(
+            d for d in docs if d.get("type") == "Test"
+        )
+    return suites
+
+
+def run_suites(
+    client: TraceTestClient,
+    suites: dict[str, list[dict]],
+    parallel: bool = True,
+) -> tuple[list[TestResult], int]:
+    """Fan suites out, max-reduce exit codes (run.bash:88-108)."""
+    results: list[TestResult] = []
+    results_lock = threading.Lock()
+    exit_codes: dict[str, int] = {}
+
+    def run_suite(name: str, tests: list[dict]):
+        code = 0
+        for doc in tests:
+            try:
+                res = client.run_test(doc)
+            except Exception as e:  # a broken spec fails its suite
+                res = TestResult(test_id=name, name=str(doc.get("spec", {}).get("name", "?")))
+                res.checks.append(CheckResult(name, "harness", False, f"exception: {e}"))
+            with results_lock:
+                results.append(res)
+            if not res.passed:
+                code = 1
+        exit_codes[name] = code
+
+    if parallel:
+        threads = [
+            threading.Thread(target=run_suite, args=(n, t), name=f"suite-{n}")
+            for n, t in suites.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        for n, t in suites.items():
+            run_suite(n, t)
+    return results, max(exit_codes.values(), default=0)
+
+
+def make_rig(seed: int = 0):
+    """Boot a Shop + gateway + span log; returns (gateway, client, stop)."""
+    from .services import Shop, ShopConfig, ShopGateway
+    from .utils.flag_ui import FlagEditorUI
+
+    shop = Shop(ShopConfig(users=0, seed=seed))
+    span_log: list[SpanRecord] = []
+    lock = threading.Lock()
+
+    def on_spans(t, spans):
+        with lock:
+            span_log.extend(spans)
+
+    gw = ShopGateway(shop, host="127.0.0.1", port=0, on_spans=on_spans)
+    gw.feature_ui = FlagEditorUI(shop.flags)
+    gw.start()
+
+    def pump():
+        with gw._lock:
+            gw._pump_locked()
+
+    client = TraceTestClient(
+        f"http://127.0.0.1:{gw.port}", span_log, pump, lock
+    )
+    return gw, client, gw.stop
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: boot the shop, run every suite, print results, max exit code."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="trace-based test runner")
+    parser.add_argument(
+        "suites_dir", nargs="?", default="tracetesting",
+        help="directory of per-service suite dirs (default: tracetesting)",
+    )
+    parser.add_argument("--serial", action="store_true", help="no suite fan-out")
+    args = parser.parse_args(argv)
+
+    suites = load_suites(args.suites_dir)
+    if not suites:
+        print(f"no suites under {args.suites_dir}")
+        return 2
+    gw, client, stop = make_rig()
+    try:
+        results, code = run_suites(client, suites, parallel=not args.serial)
+    finally:
+        stop()
+    print(format_results(results))
+    return code
+
+
+def format_results(results: list[TestResult]) -> str:
+    lines = []
+    for res in sorted(results, key=lambda r: r.test_id):
+        mark = "PASS" if res.passed else "FAIL"
+        lines.append(f"[{mark}] {res.test_id}: {res.name}")
+        for c in res.checks:
+            if not c.passed:
+                lines.append(f"       ✗ {c.name}: {c.detail}")
+    n_pass = sum(r.passed for r in results)
+    lines.append(f"{n_pass}/{len(results)} trace tests passed")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -149,6 +149,17 @@ def test_malformed_input_is_4xx_not_error_span(rig):
     assert not any(s.is_error for s in sink if s.service == "frontend-proxy")
 
 
+def test_malformed_trace_header_is_400_with_edge_span(rig):
+    shop, gw, sink = rig
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(gw, "/health", headers={"traceparent": "00-nothex-0-01"})
+    assert exc.value.code == 400
+    with gw._lock:
+        gw._pump_locked()
+    # The request still shows up at the edge (not a dropped connection).
+    assert any(s.service == "frontend-proxy" for s in sink)
+
+
 def test_cart_delete_goes_through_frontend(rig):
     shop, gw, sink = rig
     req = urllib.request.Request(

@@ -1,0 +1,70 @@
+"""The trace-based test harness: unit checks + full suite run.
+
+The suites under tracetesting/ are the framework's Tracetest analogue
+(SURVEY.md §4); this test runs them all against a live gateway so
+`pytest tests/` keeps the trace-level contracts green.
+"""
+
+from pathlib import Path
+
+from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
+from opentelemetry_demo_tpu import tracetest as tt
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def span(service, name, error=False, attr=None, dur=100.0):
+    return SpanRecord(service=service, duration_us=dur, trace_id=b"\1" * 16,
+                      is_error=error, attr=attr, name=name)
+
+
+def test_json_path():
+    doc = {"a": {"b": [{"c": 5}]}}
+    assert tt._json_path(doc, "a.b.0.c") == 5
+    assert tt._json_path(doc, "a.missing") is None
+    assert tt._json_path(doc, "a.b.0.c.d") is None
+
+
+def test_select_and_assert():
+    spans = [
+        span("checkout", "PlaceOrder"),
+        span("checkout", "orders publish"),
+        span("payment", "Charge", error=True, attr="card"),
+    ]
+    assert len(tt._select(spans, {"service": "checkout"})) == 2
+    assert len(tt._select(spans, {"service": "checkout", "name": "publish"})) == 1
+    assert len(tt._select(spans, {"error": True})) == 1
+
+    ok, _ = tt._check_assertion(
+        {"metric": "count", "op": "eq", "value": 2},
+        tt._select(spans, {"service": "checkout"}), None)
+    assert ok
+    ok, _ = tt._check_assertion(
+        {"metric": "error_count", "op": "eq", "value": 0},
+        tt._select(spans, {"service": "payment"}), None)
+    assert not ok
+    ok, _ = tt._check_assertion(
+        {"metric": "attr", "op": "eq", "value": "card"},
+        tt._select(spans, {"service": "payment"}), None)
+    assert ok
+    ok, _ = tt._check_assertion(
+        {"json_path": "order.id", "op": "ne", "value": ""},
+        [], {"order": {"id": "x1"}})
+    assert ok
+    ok, detail = tt._check_assertion(
+        {"metric": "nope", "op": "eq", "value": 1}, spans, None)
+    assert not ok and "unknown metric" in detail
+
+
+def test_all_suites_pass_against_live_gateway():
+    suites = tt.load_suites(REPO / "tracetesting")
+    # The reference tests 10 services (test/tracetesting/run.bash:10).
+    assert len(suites) == 10
+    gw, client, stop = tt.make_rig(seed=5)
+    try:
+        results, code = tt.run_suites(client, suites, parallel=True)
+    finally:
+        stop()
+    report = tt.format_results(results)
+    assert code == 0, report
+    assert len(results) == sum(len(t) for t in suites.values())
