@@ -27,6 +27,9 @@ class ServiceEnv:
     rng: np.random.Generator
     clock: Callable[[], float]
     metrics: object | None = None
+    # Structured-log sink: (service, severity, body, attrs, trace_id) →
+    # the collector's logs pipeline (OpenSearch-analogue index "otel").
+    logger: Callable | None = None
     extra: dict = field(default_factory=dict)
 
 
@@ -61,6 +64,27 @@ class ServiceBase:
             self.name, op, ctx, duration, is_error=error, attr=attr
         )
         return duration
+
+    def log(
+        self,
+        severity: str,
+        body: str,
+        ctx: TraceContext | None = None,
+        **attrs,
+    ) -> None:
+        """Structured log → collector logs pipeline (if wired).
+
+        The analogue of the reference's per-service structured JSON
+        logging shipped over OTLP (e.g. checkout's zap-style logger,
+        /root/reference/src/checkout/main.go:61-73)."""
+        if self.env.logger is not None:
+            self.env.logger(
+                self.name,
+                severity,
+                body,
+                attrs or None,
+                ctx.trace_id if ctx is not None else None,
+            )
 
     def flag(self, key: str, default, ctx: TraceContext | None = None):
         targeting = ""

@@ -108,9 +108,15 @@ class CheckoutService(ServiceBase):
             )
             self._publish(ctx, order)
             self.span("PlaceOrder", ctx, attr=product_ids[0] if product_ids else None)
+            self.log(
+                "INFO", "order placed", ctx,
+                order_id=order_id, items=len(product_ids),
+                total=f"{total.currency} {total.to_float():.2f}",
+            )
             return PlacedOrder(order_id, tracking_id, total, tuple(product_ids))
-        except ServiceError:
+        except ServiceError as err:
             self.span("PlaceOrder", ctx, scale=1.5, error=True)
+            self.log("ERROR", f"order failed: {err}", ctx, user=user_id)
             raise
 
     def _publish(self, ctx: TraceContext, order: Order) -> None:

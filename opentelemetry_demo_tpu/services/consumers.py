@@ -63,4 +63,8 @@ class FraudDetectionService(ServiceBase):
         # A toy score: many units of one product in one order is "fraud".
         if order.total_quantity >= 9:
             self.suspicious.append(order.order_id)
+            self.log(
+                "WARN", "suspicious order", ctx,
+                order_id=order.order_id, quantity=order.total_quantity,
+            )
         self.span("orders consume", ctx, extra_us=extra_us, attr=order.order_id)

@@ -67,6 +67,7 @@ class PaymentService(ServiceBase):
             raise ServiceError(self.name, "payment service unreachable")
         if fail_rate > 0 and self.env.rng.random() < fail_rate:
             self.span("Charge", ctx, scale=1.5, error=True)
+            self.log("WARN", "charge failed (paymentFailure active)", ctx)
             raise ServiceError(self.name, "charge failed (paymentFailure active)")
 
         ctype = card_type(card_number)
@@ -89,4 +90,9 @@ class PaymentService(ServiceBase):
                 currency=amount.currency, charged=str(charged).lower(),
             )
         self.span("Charge", ctx, attr=ctype)
+        self.log(
+            "INFO", "transaction processed", ctx,
+            card_type=ctype, amount=f"{amount.currency} {amount.to_float():.2f}",
+            charged=charged,
+        )
         return str(uuid.uuid5(uuid.NAMESPACE_OID, ctx.trace_id.hex()))

@@ -35,6 +35,7 @@ from .payment import PaymentService
 from .recommendation import RecommendationService
 from .shipping import QuoteService, ShippingService
 from ..runtime.tensorize import SpanRecord
+from ..telemetry.collector import Collector
 from ..telemetry.metrics import MetricRegistry
 from ..telemetry.tracer import Tracer
 from ..utils.flags import FlagEvaluator
@@ -55,6 +56,12 @@ class Shop:
         self.flags = FlagEvaluator({"flags": {}})
         self.metrics = MetricRegistry()
         self.tracer = Tracer(self._span_buffer.append)
+        # The telemetry backend tier (SURVEY.md §3.2): every flushed
+        # span batch also enters the collector, which fans out to the
+        # Jaeger/Prometheus/OpenSearch-analogue stores and to any
+        # subscribed exporters (the anomaly-detector seam).
+        self.collector = Collector(clock=lambda: self._t)
+        self.collector.add_scrape_target("shop", self.metrics)
         rng = np.random.default_rng(self.config.seed)
         env = ServiceEnv(
             tracer=self.tracer,
@@ -62,6 +69,7 @@ class Shop:
             rng=rng,
             clock=lambda: self._t,
             metrics=self.metrics,
+            logger=self.collector.receive_log,
         )
         self.env = env
 
@@ -125,8 +133,10 @@ class Shop:
             # to this exact list's append method.
             spans = list(self._span_buffer)
             self._span_buffer.clear()
+            self.collector.receive_spans(spans)
             if on_spans is not None:
                 on_spans(self._t, spans)
+        self.collector.pump(self._t)
 
     def run(
         self,

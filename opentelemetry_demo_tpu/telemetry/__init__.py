@@ -1,14 +1,23 @@
-"""Telemetry: in-proc tracing SDK + metric export.
+"""Telemetry: in-proc tracing SDK, metrics, and the backend tier.
 
 The reference instruments every service with an OTel SDK and ships
-three signals through the collector (SURVEY.md §3.2). Here the tracer is
-in-process (spans go straight to the detector pipeline and/or an OTLP
-exporter), and metrics export in Prometheus text format — the same
-surfaces Grafana scrapes in the reference stack.
+three signals through the collector into Jaeger / Prometheus /
+OpenSearch / Grafana (SURVEY.md §3.2). Here the whole tier exists as a
+library on a virtual clock: tracer → :class:`Collector` (processors,
+spanmetrics connector, exporter fan-out) → :class:`TraceStore` (Jaeger
+analogue), :class:`MetricTSDB` + :class:`Scraper` (Prometheus
+analogue), :class:`LogStore` (OpenSearch analogue), with provisioned
+dashboards (Grafana analogue) evaluated straight against the stores.
 """
 
 from .tracer import Baggage, Tracer, TraceContext
 from .metrics import MetricRegistry, PrometheusExporter
+from .collector import Collector, CollectorConfig, normalize_span_name
+from .tracestore import TraceStore
+from .tsdb import MetricTSDB, Scraper
+from .logstore import LogDoc, LogStore
+from .hostmetrics import HostMetricsReceiver
+from . import dashboards
 
 __all__ = [
     "Baggage",
@@ -16,4 +25,14 @@ __all__ = [
     "TraceContext",
     "MetricRegistry",
     "PrometheusExporter",
+    "Collector",
+    "CollectorConfig",
+    "normalize_span_name",
+    "TraceStore",
+    "MetricTSDB",
+    "Scraper",
+    "LogDoc",
+    "LogStore",
+    "HostMetricsReceiver",
+    "dashboards",
 ]

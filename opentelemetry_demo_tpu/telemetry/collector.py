@@ -1,0 +1,203 @@
+"""Telemetry collector: the in-proc OTel Collector analogue.
+
+Mirrors the reference collector's pipeline graph
+(/root/reference/src/otel-collector/otelcol-config.yml):
+
+    receivers (otlp :5-13, hostmetrics :24-81)
+      → processors: memory_limiter → transform (span-name
+        normalization :106-113) → batch
+      → traces fan-out :120-123 → trace store (Jaeger analogue)
+                                 + spanmetrics connector :115-116
+      → spanmetrics re-enters the metrics pipeline :125 → TSDB
+        (Prometheus analogue, the otlphttp/prometheus exporter :89-92)
+      → logs pipeline :128-131 → log store (OpenSearch analogue,
+        index "otel" :93-98)
+
+plus collector self-telemetry at detailed level, 10 s cadence
+(:132-142). Extra trace exporters can subscribe — that is the seam the
+anomaly-detector taps (deploy/otelcol-config-anomaly.yml adds exactly
+such an exporter), the pattern of the Jaeger exporter at :85-88.
+
+Everything runs on an injectable virtual clock so pipelines are
+deterministic under test.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .logstore import LogDoc, LogStore
+from .metrics import MetricRegistry
+from .tracestore import TraceStore
+from .tsdb import MetricTSDB, Scraper
+from ..runtime.tensorize import SpanRecord
+
+# Default spanmetrics explicit duration buckets, in milliseconds — the
+# connector's default histogram layout the spanmetrics dashboard's
+# histogram_quantile queries ride on.
+SPANMETRICS_BUCKETS_MS: tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1, 2, 4, 6, 8, 10, 50, 100, 200, 400, 800,
+    1000, 1400, 2000, 5000, 15000,
+)
+
+CALLS_TOTAL = "traces_span_metrics_calls_total"
+DURATION_MS = "traces_span_metrics_duration_milliseconds"
+
+# Span-name normalization: the reference's transform processor rewrites
+# high-cardinality span names (otelcol-config.yml:106-113). Same intent
+# here: collapse id-looking path segments so span_name stays a bounded
+# metric dimension.
+_ID_SEGMENT = re.compile(
+    r"/(?:[0-9a-f]{8,}|[0-9]+|[A-Z0-9]{8,})(?=/|\?|$)"
+)
+
+
+def normalize_span_name(name: str) -> str:
+    """Collapse id-like path segments: ``GET /api/products/OLJCESPC7Z``
+    → ``GET /api/products/{id}``."""
+    return _ID_SEGMENT.sub("/{id}", name)
+
+
+@dataclass
+class CollectorConfig:
+    batch_max_spans: int = 512          # batch processor send_batch_size
+    batch_timeout_s: float = 0.2        # batch processor timeout
+    memory_limit_spans: int = 50_000    # memory_limiter as a span budget
+    spanmetrics_buckets_ms: tuple[float, ...] = SPANMETRICS_BUCKETS_MS
+    scrape_interval_s: float = 5.0      # prometheus-config.yaml:5
+    self_telemetry_interval_s: float = 10.0  # otelcol-config.yml:133-141
+    retention_s: float = 3600.0         # prometheus 1h retention
+
+
+class Collector:
+    """Receiver → processors → connector/exporters, on a virtual clock."""
+
+    def __init__(self, clock: Callable[[], float], config: CollectorConfig | None = None):
+        self.clock = clock
+        self.config = config or CollectorConfig()
+        # Backends (the exporters' destinations).
+        self.trace_store = TraceStore()
+        self.log_store = LogStore()
+        self.tsdb = MetricTSDB(retention_s=self.config.retention_s)
+        # The spanmetrics connector writes RED metrics here; the scraper
+        # pulls this registry into the TSDB like any other job.
+        self.spanmetrics = MetricRegistry()
+        # Collector self-telemetry (otelcol_* family).
+        self.self_metrics = MetricRegistry()
+        self.scraper = Scraper(self.tsdb, interval_s=self.config.scrape_interval_s)
+        self.scraper.add_target("spanmetrics", self.spanmetrics)
+        self.scraper.add_target("otel-collector", self.self_metrics)
+        # Extra trace-batch subscribers — the anomaly-detector seam.
+        self.trace_exporters: list[Callable[[float, list[SpanRecord]], None]] = []
+        self._pending_spans: list[SpanRecord] = []
+        self._last_batch_flush: float | None = None
+        self._last_self_report: float | None = None
+        self.dropped_spans = 0
+
+    # -- receivers ----------------------------------------------------
+
+    def add_scrape_target(self, job: str, registry: MetricRegistry) -> None:
+        """Register a service registry for the 5 s scrape cycle."""
+        self.scraper.add_target(job, registry)
+
+    def receive_spans(self, records: list[SpanRecord]) -> None:
+        """OTLP trace receiver → memory_limiter → transform → batch."""
+        now = self.clock()
+        accepted = 0
+        for record in records:
+            # memory_limiter: above the budget the collector refuses
+            # data rather than OOMing (otelcol-config.yml:100-104).
+            if len(self._pending_spans) >= self.config.memory_limit_spans:
+                self.dropped_spans += 1
+                self.self_metrics.counter_add(
+                    "otelcol_processor_refused_spans", 1.0, processor="memory_limiter"
+                )
+                continue
+            if record.name:
+                normalized = normalize_span_name(record.name)
+                if normalized != record.name:
+                    record = record._replace(name=normalized)
+            self._pending_spans.append(record)
+            accepted += 1
+        if accepted:
+            self.self_metrics.counter_add(
+                "otelcol_receiver_accepted_spans", float(accepted), receiver="otlp"
+            )
+        if len(self._pending_spans) >= self.config.batch_max_spans:
+            self._flush_spans(now)
+
+    def receive_log(
+        self,
+        service: str,
+        severity: str,
+        body: str,
+        attrs: dict | None = None,
+        trace_id: bytes | None = None,
+    ) -> None:
+        """Logs pipeline → OpenSearch-analogue index ``otel``."""
+        self.log_store.add(
+            LogDoc(
+                ts=self.clock(),
+                service=service,
+                severity=severity,
+                body=body,
+                attrs=dict(attrs or {}),
+                trace_id=trace_id,
+            )
+        )
+        self.self_metrics.counter_add(
+            "otelcol_receiver_accepted_log_records", 1.0, receiver="otlp"
+        )
+
+    # -- pipeline pump ------------------------------------------------
+
+    def pump(self, now: float | None = None) -> None:
+        """Advance timers: batch timeout, scrape cycle, self-telemetry."""
+        now = self.clock() if now is None else now
+        # Sample queue depth BEFORE the flush below drains it, so the
+        # gauge reflects backlog rather than always reading zero.
+        if (
+            self._last_self_report is None
+            or now - self._last_self_report >= self.config.self_telemetry_interval_s
+        ):
+            self._last_self_report = now
+            self.self_metrics.gauge_set(
+                "otelcol_exporter_queue_size", float(len(self._pending_spans))
+            )
+        if self._pending_spans and (
+            self._last_batch_flush is None
+            or now - self._last_batch_flush >= self.config.batch_timeout_s
+        ):
+            self._flush_spans(now)
+        self.scraper.maybe_scrape(now)
+
+    def _flush_spans(self, now: float) -> None:
+        batch, self._pending_spans = self._pending_spans, []
+        self._last_batch_flush = now
+        # Exporter fan-out: trace store + spanmetrics + subscribers.
+        for record in batch:
+            self.trace_store.add_span(now, record)
+            self._spanmetrics_update(record)
+        for exporter in self.trace_exporters:
+            exporter(now, batch)
+        self.self_metrics.counter_add(
+            "otelcol_exporter_sent_spans", float(len(batch)), exporter="traces"
+        )
+
+    # -- spanmetrics connector ----------------------------------------
+
+    def _spanmetrics_update(self, record: SpanRecord) -> None:
+        labels = {
+            "service_name": record.service,
+            "span_name": record.name or "unknown",
+            "status_code": "STATUS_CODE_ERROR" if record.is_error else "STATUS_CODE_UNSET",
+        }
+        self.spanmetrics.counter_add(CALLS_TOTAL, 1.0, **labels)
+        self.spanmetrics.histogram_observe(
+            DURATION_MS,
+            record.duration_us / 1000.0,
+            self.config.spanmetrics_buckets_ms,
+            **labels,
+        )

@@ -1,0 +1,167 @@
+"""Dashboards: the in-proc Grafana analogue.
+
+The reference provisions four dashboards
+(/root/reference/src/grafana/provisioning/dashboards/demo/
+{demo-dashboard,spanmetrics-dashboard,exemplars-dashboard,
+opentelemetry-collector}.json) over three datasources
+(provisioning/datasources/{default,jaeger,opensearch}.yaml). Here a
+dashboard is data — panels carrying structured queries against the
+:class:`~.tsdb.MetricTSDB` / :class:`~.tracestore.TraceStore` /
+:class:`~.logstore.LogStore` — and evaluation returns the numbers the
+reference's panels would plot, e.g. the spanmetrics p95 panel's
+``histogram_quantile(0.95, sum by (service_name)
+(rate(traces_span_metrics_duration_milliseconds_bucket[1m])))``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .collector import CALLS_TOTAL, DURATION_MS, Collector
+
+
+@dataclass
+class Query:
+    kind: str                      # "rate" | "quantile" | "instant" | "traces" | "logs"
+    metric: str = ""
+    matchers: dict = field(default_factory=dict)
+    by: tuple = ()
+    q: float = 0.95
+    window_s: float = 60.0
+    # traces/logs query knobs
+    service: str | None = None
+    error_only: bool = False
+    severity: str | None = None
+
+
+@dataclass
+class Panel:
+    title: str
+    query: Query
+    unit: str = ""
+
+
+@dataclass
+class Dashboard:
+    uid: str
+    title: str
+    panels: list[Panel]
+
+
+def provisioned_dashboards() -> list[Dashboard]:
+    """The four dashboards the reference provisions, re-expressed."""
+    return [
+        Dashboard(
+            uid="demo",
+            title="Demo Dashboard",
+            panels=[
+                Panel("Requests by service",
+                      Query("rate", CALLS_TOTAL, by=("service_name",)), "req/s"),
+                Panel("Error rate by service",
+                      Query("rate", CALLS_TOTAL,
+                            matchers={"status_code": "STATUS_CODE_ERROR"},
+                            by=("service_name",)), "err/s"),
+                Panel("Recent error traces",
+                      Query("traces", error_only=True), "traces"),
+            ],
+        ),
+        Dashboard(
+            uid="spanmetrics",
+            title="Span Metrics Demo Dashboard",
+            panels=[
+                Panel("p95 latency by service",
+                      Query("quantile", DURATION_MS + "_bucket",
+                            by=("service_name",), q=0.95), "ms"),
+                Panel("p50 latency by service",
+                      Query("quantile", DURATION_MS + "_bucket",
+                            by=("service_name",), q=0.50), "ms"),
+                Panel("Call rate by operation",
+                      Query("rate", CALLS_TOTAL,
+                            by=("service_name", "span_name")), "req/s"),
+            ],
+        ),
+        Dashboard(
+            uid="opentelemetry-collector",
+            title="OpenTelemetry Collector",
+            panels=[
+                Panel("Accepted spans",
+                      Query("rate", "otelcol_receiver_accepted_spans"), "spans/s"),
+                Panel("Exported spans",
+                      Query("rate", "otelcol_exporter_sent_spans"), "spans/s"),
+                Panel("Queue size",
+                      Query("instant", "otelcol_exporter_queue_size"), "spans"),
+            ],
+        ),
+        Dashboard(
+            uid="anomaly",
+            title="TPU Anomaly Detector",
+            panels=[
+                Panel("Max |z| by service/signal",
+                      Query("instant", "app_anomaly_z_score",
+                            by=("service", "signal"))),
+                Panel("Distinct traces (HLL)",
+                      Query("instant", "app_anomaly_distinct_traces",
+                            by=("service",))),
+                Panel("Anomaly flags",
+                      Query("rate", "app_anomaly_flags_total",
+                            by=("service",)), "flags/s"),
+                Panel("Recent warnings",
+                      Query("logs", severity="WARN"), "docs"),
+            ],
+        ),
+    ]
+
+
+def evaluate_panel(panel: Panel, collector: Collector, at: float):
+    """Run one panel's query against the backends; returns rows."""
+    q = panel.query
+    if q.kind == "rate":
+        grouped = collector.tsdb.sum_rate(
+            q.metric, q.matchers, q.window_s, at, by=q.by
+        )
+        return sorted(grouped.items())
+    if q.kind == "quantile":
+        grouped = collector.tsdb.histogram_quantile(
+            q.q, q.metric, q.matchers, q.window_s, at, by=q.by
+        )
+        return sorted(grouped.items())
+    if q.kind == "instant":
+        rows = collector.tsdb.instant(q.metric, q.matchers, at)
+        if q.by:
+            return sorted(
+                (tuple(labels.get(k, "") for k in q.by), v) for labels, v in rows
+            )
+        return [((), v) for _, v in rows]
+    if q.kind == "traces":
+        traces = collector.trace_store.find_traces(
+            service=q.service, error_only=q.error_only, limit=20
+        )
+        return [((t.trace_id.hex(),), t.duration_us) for t in traces]
+    if q.kind == "logs":
+        docs = collector.log_store.search(
+            service=q.service, severity=q.severity, limit=20
+        )
+        return [((d.service, d.severity), d.body) for d in docs]
+    raise ValueError(f"unknown query kind {q.kind!r}")
+
+
+def evaluate(dashboard: Dashboard, collector: Collector, at: float) -> dict:
+    return {p.title: evaluate_panel(p, collector, at) for p in dashboard.panels}
+
+
+def render_text(dashboard: Dashboard, collector: Collector, at: float) -> str:
+    """Plain-text dashboard render (the ops-console view)."""
+    lines = [f"== {dashboard.title} ({dashboard.uid}) @ t={at:.1f}s =="]
+    results = evaluate(dashboard, collector, at)
+    for panel in dashboard.panels:
+        lines.append(f"-- {panel.title}" + (f" [{panel.unit}]" if panel.unit else ""))
+        rows = results[panel.title]
+        if not rows:
+            lines.append("   (no data)")
+        for key, value in rows[:10]:
+            label = "/".join(str(k) for k in key) if key else "total"
+            if isinstance(value, float):
+                lines.append(f"   {label:<40} {value:,.3f}")
+            else:
+                lines.append(f"   {label:<40} {value}")
+    return "\n".join(lines)

@@ -1,0 +1,76 @@
+"""Log store: the in-proc OpenSearch analogue.
+
+The reference ships logs OTLP → collector logs pipeline → OpenSearch
+single-node, security disabled, index ``otel``
+(/root/reference/src/otel-collector/otelcol-config.yml:93-98,128-131;
+/root/reference/docker-compose.yml:806-839). This store keeps that
+contract as a library: named indices of structured log documents with a
+bounded ring per index, and the search verbs Grafana's OpenSearch
+datasource uses against the demo — filter by service / severity /
+body substring / trace id, most-recent-first.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+SEVERITIES = ("DEBUG", "INFO", "WARN", "ERROR", "FATAL")
+
+
+@dataclass
+class LogDoc:
+    ts: float
+    service: str
+    severity: str
+    body: str
+    attrs: dict = field(default_factory=dict)
+    trace_id: bytes | None = None
+
+
+class LogStore:
+    """Bounded per-index document store with OpenSearch-shaped search."""
+
+    def __init__(self, max_docs_per_index: int = 100_000):
+        self.max_docs_per_index = max_docs_per_index
+        self._indices: dict[str, deque[LogDoc]] = {}
+
+    def add(self, doc: LogDoc, index: str = "otel") -> None:
+        if doc.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {doc.severity!r} not one of {SEVERITIES}"
+            )
+        ring = self._indices.get(index)
+        if ring is None:
+            ring = self._indices[index] = deque(maxlen=self.max_docs_per_index)
+        ring.append(doc)
+
+    def indices(self) -> list[str]:
+        return sorted(self._indices)
+
+    def count(self, index: str = "otel") -> int:
+        return len(self._indices.get(index, ()))
+
+    def search(
+        self,
+        index: str = "otel",
+        service: str | None = None,
+        severity: str | None = None,
+        query: str | None = None,
+        trace_id: bytes | None = None,
+        limit: int = 100,
+    ) -> list[LogDoc]:
+        out: list[LogDoc] = []
+        for doc in reversed(self._indices.get(index, ())):
+            if service is not None and doc.service != service:
+                continue
+            if severity is not None and doc.severity != severity:
+                continue
+            if query is not None and query not in doc.body:
+                continue
+            if trace_id is not None and doc.trace_id != trace_id:
+                continue
+            out.append(doc)
+            if len(out) >= limit:
+                break
+        return out

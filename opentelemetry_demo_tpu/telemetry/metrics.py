@@ -15,6 +15,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable
 
 
+def _fmt_le(le: float) -> str:
+    """Prometheus-style bucket bound rendering (ints without .0)."""
+    return str(int(le)) if float(le).is_integer() else repr(float(le))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
@@ -43,6 +48,51 @@ class MetricRegistry:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
+
+    def histogram_observe(
+        self,
+        name: str,
+        value: float,
+        buckets: tuple[float, ...],
+        **labels: str,
+    ) -> None:
+        """Explicit-bucket histogram as Prometheus counter series.
+
+        Emits cumulative ``{name}_bucket{le=...}`` (including ``+Inf``),
+        ``{name}_sum`` and ``{name}_count`` — the representation the
+        reference's cart latency histograms with bucket advice take on
+        the Prometheus side (ValkeyCartStore.cs:30-43) and the shape the
+        spanmetrics connector's duration histograms export.
+        """
+        # One sort per observation (this runs per span in the
+        # spanmetrics hot path); bucket keys splice in the "le" pair.
+        base = sorted(labels.items())
+        i = 0
+        while i < len(base) and base[i][0] < "le":
+            i += 1
+
+        def with_le(le_str: str) -> tuple:
+            return tuple(base[:i] + [("le", le_str)] + base[i:])
+
+        base_key = tuple(base)
+        with self._lock:
+            for le in buckets:
+                if value <= le:
+                    key = (name + "_bucket", with_le(_fmt_le(le)))
+                    self._counters[key] = self._counters.get(key, 0.0) + 1.0
+            key = (name + "_bucket", with_le("+Inf"))
+            self._counters[key] = self._counters.get(key, 0.0) + 1.0
+            key = (name + "_sum", base_key)
+            self._counters[key] = self._counters.get(key, 0.0) + value
+            key = (name + "_count", base_key)
+            self._counters[key] = self._counters.get(key, 0.0) + 1.0
+
+    def snapshot(self) -> tuple[dict, dict]:
+        """Point-in-time copy of (counters, gauges) — the scrape surface
+        the TSDB's virtual-clock scraper reads (telemetry.tsdb.Scraper),
+        the in-proc analogue of Prometheus GETting ``/metrics``."""
+        with self._lock:
+            return dict(self._counters), dict(self._gauges)
 
     def render(self) -> str:
         lines: list[str] = []

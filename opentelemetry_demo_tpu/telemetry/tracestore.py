@@ -1,0 +1,124 @@
+"""Trace store: the in-proc Jaeger analogue.
+
+The reference runs Jaeger all-in-one with in-memory storage capped at
+25,000 traces (/root/reference/docker-compose.yml:708-727, cap :712),
+fed by the collector's OTLP trace exporter
+(/root/reference/src/otel-collector/otelcol-config.yml:85-88,120-123).
+This store keeps the same contract: bounded in-memory trace retention
+with FIFO eviction, and the Jaeger query surface the demo's users
+actually exercise — get-trace by id, find-traces filtered by service /
+operation / min-duration / error, and the service & operation listings
+that populate the search UI dropdowns.
+
+Spans arrive as the framework's :class:`~..runtime.tensorize.SpanRecord`
+plus an ingest timestamp (virtual clock), grouped by trace id.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..runtime.tensorize import SpanRecord
+
+
+@dataclass
+class StoredSpan:
+    ts: float  # ingest time, virtual-clock seconds
+    record: SpanRecord
+
+
+@dataclass
+class Trace:
+    trace_id: bytes
+    spans: list[StoredSpan] = field(default_factory=list)
+
+    @property
+    def services(self) -> set[str]:
+        return {s.record.service for s in self.spans}
+
+    @property
+    def duration_us(self) -> float:
+        """Critical-path proxy: the longest single span (the root RPC in
+        the shop's traces — e.g. PlaceOrder encloses its children)."""
+        return max((s.record.duration_us for s in self.spans), default=0.0)
+
+    @property
+    def has_error(self) -> bool:
+        return any(s.record.is_error for s in self.spans)
+
+
+class TraceStore:
+    """Bounded in-memory trace storage with Jaeger-shaped queries."""
+
+    def __init__(self, max_traces: int = 25_000):
+        self.max_traces = max_traces
+        self._traces: "OrderedDict[bytes, Trace]" = OrderedDict()
+        self.evicted_traces = 0
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    @property
+    def span_count(self) -> int:
+        return sum(len(t.spans) for t in self._traces.values())
+
+    def add_span(self, ts: float, record: SpanRecord) -> None:
+        tid = record.trace_id if isinstance(record.trace_id, bytes) else (
+            int(record.trace_id).to_bytes(16, "little", signed=False)
+        )
+        trace = self._traces.get(tid)
+        if trace is None:
+            trace = Trace(trace_id=tid)
+            self._traces[tid] = trace
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+                self.evicted_traces += 1
+        trace.spans.append(StoredSpan(ts=ts, record=record))
+
+    # -- Jaeger query surface -----------------------------------------
+
+    def get_trace(self, trace_id: bytes) -> Trace | None:
+        return self._traces.get(trace_id)
+
+    def services(self) -> list[str]:
+        names: set[str] = set()
+        for t in self._traces.values():
+            names.update(t.services)
+        return sorted(names)
+
+    def operations(self, service: str) -> list[str]:
+        ops: set[str] = set()
+        for t in self._traces.values():
+            for s in t.spans:
+                if s.record.service == service and s.record.name:
+                    ops.add(s.record.name)
+        return sorted(ops)
+
+    def find_traces(
+        self,
+        service: str | None = None,
+        operation: str | None = None,
+        min_duration_us: float = 0.0,
+        error_only: bool = False,
+        limit: int = 20,
+    ) -> list[Trace]:
+        """Most-recent-first trace search (the Jaeger UI's default)."""
+        out: list[Trace] = []
+        for trace in reversed(self._traces.values()):
+            if service is not None and service not in trace.services:
+                continue
+            if operation is not None and not any(
+                s.record.name == operation
+                and (service is None or s.record.service == service)
+                for s in trace.spans
+            ):
+                continue
+            if trace.duration_us < min_duration_us:
+                continue
+            if error_only and not trace.has_error:
+                continue
+            out.append(trace)
+            if len(out) >= limit:
+                break
+        return out

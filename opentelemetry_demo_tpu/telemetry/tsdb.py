@@ -1,0 +1,263 @@
+"""Metric time-series store: the in-proc Prometheus analogue.
+
+The reference runs Prometheus with a 5 s scrape interval, native OTLP
+receive, exemplar storage and 1 h retention
+(/root/reference/src/prometheus/prometheus-config.yaml:4-21,
+/root/reference/docker-compose.yml:787-793); Grafana's spanmetrics
+dashboard queries it with ``rate()`` + ``histogram_quantile()`` over
+``traces_span_metrics_duration_milliseconds_bucket``
+(/root/reference/src/grafana/provisioning/dashboards/demo/
+spanmetrics-dashboard.json). This module provides those capabilities as
+a library: an append-only sample store with retention, a virtual-clock
+scraper that snapshots :class:`~.metrics.MetricRegistry` instances, and
+the two PromQL verbs the provisioned dashboards actually use —
+per-second counter ``rate`` and ``histogram_quantile`` with Prometheus'
+linear interpolation inside the winning bucket.
+
+Everything is keyed on the virtual clock, so an hour of series fits a
+deterministic test.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Iterable
+
+LabelKey = tuple  # tuple(sorted(labels.items()))
+
+
+def _labels_key(labels: dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+def _match(labels: dict[str, str], matchers: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in matchers.items())
+
+
+@dataclass
+class Series:
+    labels: dict[str, str]
+    ts: list[float] = field(default_factory=list)
+    values: list[float] = field(default_factory=list)
+
+    def append(self, t: float, v: float) -> None:
+        # Out-of-order tolerance like the reference's 30m OOO window
+        # (docker-compose.yml:791): accept any append, keep ts sorted.
+        if self.ts and t < self.ts[-1]:
+            i = bisect.bisect_right(self.ts, t)
+            self.ts.insert(i, t)
+            self.values.insert(i, v)
+        else:
+            self.ts.append(t)
+            self.values.append(v)
+
+    def trim_before(self, t: float) -> None:
+        i = bisect.bisect_left(self.ts, t)
+        if i:
+            del self.ts[:i]
+            del self.values[:i]
+
+    def at(self, t: float, staleness_s: float = 300.0) -> float | None:
+        """Latest sample at or before ``t`` within the staleness window."""
+        i = bisect.bisect_right(self.ts, t)
+        if i == 0:
+            return None
+        if t - self.ts[i - 1] > staleness_s:
+            return None
+        return self.values[i - 1]
+
+    def window(self, start: float, end: float) -> tuple[list[float], list[float]]:
+        i = bisect.bisect_left(self.ts, start)
+        j = bisect.bisect_right(self.ts, end)
+        return self.ts[i:j], self.values[i:j]
+
+
+class MetricTSDB:
+    """Append-only labelled sample store with retention + PromQL verbs."""
+
+    def __init__(self, retention_s: float = 3600.0):
+        self.retention_s = retention_s
+        self._series: dict[tuple[str, LabelKey], Series] = {}
+        self._last_trim = 0.0
+
+    # -- ingestion ----------------------------------------------------
+
+    def append(self, name: str, labels: dict[str, str], t: float, value: float) -> None:
+        key = (name, _labels_key(labels))
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = Series(labels=dict(labels))
+        series.append(t, value)
+        # Amortized retention sweep (Prometheus compacts on its own
+        # cadence; here: at most once per minute of virtual time).
+        if t - self._last_trim > 60.0:
+            self._last_trim = t
+            cutoff = t - self.retention_s
+            dead = []
+            for k, s in self._series.items():
+                s.trim_before(cutoff)
+                if not s.ts:
+                    dead.append(k)
+            for k in dead:
+                del self._series[k]
+
+    # -- queries ------------------------------------------------------
+
+    def series_names(self) -> set[str]:
+        return {name for name, _ in self._series}
+
+    def select(self, name: str, matchers: dict[str, str] | None = None) -> list[Series]:
+        matchers = matchers or {}
+        return [
+            s for (n, _), s in self._series.items()
+            if n == name and _match(s.labels, matchers)
+        ]
+
+    def instant(
+        self, name: str, matchers: dict[str, str] | None = None, at: float | None = None
+    ) -> list[tuple[dict[str, str], float]]:
+        """Instant vector: latest value per matching series."""
+        out = []
+        for s in self.select(name, matchers):
+            t = at if at is not None else (s.ts[-1] if s.ts else 0.0)
+            v = s.at(t)
+            if v is not None:
+                out.append((s.labels, v))
+        return out
+
+    def range_query(
+        self, name: str, matchers: dict[str, str] | None, start: float, end: float
+    ) -> list[tuple[dict[str, str], list[float], list[float]]]:
+        out = []
+        for s in self.select(name, matchers):
+            ts, vs = s.window(start, end)
+            if ts:
+                out.append((s.labels, ts, vs))
+        return out
+
+    def rate(
+        self,
+        name: str,
+        matchers: dict[str, str] | None,
+        window_s: float,
+        at: float,
+    ) -> list[tuple[dict[str, str], float]]:
+        """``rate(name{matchers}[window])`` — per-second counter rate.
+
+        Prometheus semantics for the parts that matter here: uses first
+        and last samples inside the window, clamps counter resets to 0,
+        extrapolates over the sample span (not the full window) so a
+        5 s-scrape series yields stable rates.
+        """
+        out = []
+        for s in self.select(name, matchers):
+            ts, vs = s.window(at - window_s, at)
+            if len(ts) < 2:
+                continue
+            # Reset handling: accumulate increases only, so an interior
+            # counter reset never hides growth on either side of it.
+            dv = sum(max(0.0, b - a) for a, b in zip(vs, vs[1:]))
+            dt = ts[-1] - ts[0]
+            if dt <= 0:
+                continue
+            out.append((s.labels, dv / dt))
+        return out
+
+    def sum_rate(
+        self,
+        name: str,
+        matchers: dict[str, str] | None,
+        window_s: float,
+        at: float,
+        by: tuple[str, ...] = (),
+    ) -> dict[tuple, float]:
+        """``sum by (labels) (rate(...))`` — the dashboards' workhorse."""
+        grouped: dict[tuple, float] = {}
+        for labels, r in self.rate(name, matchers, window_s, at):
+            key = tuple(labels.get(k, "") for k in by)
+            grouped[key] = grouped.get(key, 0.0) + r
+        return grouped
+
+    def histogram_quantile(
+        self,
+        q: float,
+        bucket_metric: str,
+        matchers: dict[str, str] | None,
+        window_s: float,
+        at: float,
+        by: tuple[str, ...] = (),
+    ) -> dict[tuple, float]:
+        """``histogram_quantile(q, sum by (le, by) (rate(..._bucket[w])))``.
+
+        The exact query shape of the spanmetrics dashboard's p95 panels
+        (spanmetrics-dashboard.json: ``histogram_quantile(0.95,
+        sum(rate(traces_span_metrics_duration_milliseconds_bucket...``).
+        Linear interpolation inside the winning bucket, Prometheus-style;
+        the lowest bucket interpolates from 0.
+        """
+        # Group bucket rates by (group key) → {le → rate}.
+        per_group: dict[tuple, dict[float, float]] = {}
+        for labels, r in self.rate(bucket_metric, matchers, window_s, at):
+            le_raw = labels.get("le", "+Inf")
+            le = float("inf") if le_raw == "+Inf" else float(le_raw)
+            key = tuple(labels.get(k, "") for k in by)
+            group = per_group.setdefault(key, {})
+            group[le] = group.get(le, 0.0) + r
+        out: dict[tuple, float] = {}
+        for key, buckets in per_group.items():
+            les = sorted(buckets)
+            if not les or les[-1] != float("inf"):
+                continue
+            total = buckets[les[-1]]
+            if total <= 0:
+                continue
+            target = q * total
+            cum = 0.0
+            prev_le, prev_cum = 0.0, 0.0
+            for le in les:
+                cum += buckets[le]
+                if cum >= target:
+                    if le == float("inf"):
+                        out[key] = prev_le  # Prometheus returns the last finite bound
+                        break
+                    frac = (target - prev_cum) / max(cum - prev_cum, 1e-12)
+                    out[key] = prev_le + (le - prev_le) * frac
+                    break
+                prev_le, prev_cum = le, cum
+        return out
+
+
+class Scraper:
+    """Virtual-clock scrape loop over :class:`MetricRegistry` targets.
+
+    The in-proc analogue of Prometheus' 5 s scrape cycle over service
+    ``/metrics`` endpoints (prometheus-config.yaml:4-8): each target is
+    a registry snapshot tagged with a ``job`` label, pulled whenever the
+    driving clock has advanced a full interval.
+    """
+
+    def __init__(self, tsdb: MetricTSDB, interval_s: float = 5.0):
+        self.tsdb = tsdb
+        self.interval_s = interval_s
+        self._targets: list[tuple[str, object]] = []
+        self._last_scrape: float | None = None
+
+    def add_target(self, job: str, registry) -> None:
+        self._targets.append((job, registry))
+
+    def maybe_scrape(self, now: float) -> bool:
+        if self._last_scrape is not None and now - self._last_scrape < self.interval_s:
+            return False
+        self._last_scrape = now
+        for job, registry in self._targets:
+            counters, gauges = registry.snapshot()
+            for (name, label_key), value in counters.items():
+                labels = dict(label_key)
+                labels["job"] = job
+                self.tsdb.append(name, labels, now, value)
+            for (name, label_key), value in gauges.items():
+                labels = dict(label_key)
+                labels["job"] = job
+                self.tsdb.append(name, labels, now, value)
+        return True

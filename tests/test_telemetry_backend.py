@@ -1,0 +1,277 @@
+"""Telemetry backend tier: collector pipeline, Jaeger/Prometheus/
+OpenSearch/Grafana analogues (SURVEY.md §3.2 span journey).
+
+Test style mirrors the reference's bet (SURVEY.md §4): run the real
+system (the full shop under load), assert on the resulting traces,
+metrics and logs in the backend stores.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
+from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig
+from opentelemetry_demo_tpu.telemetry import (
+    Collector,
+    CollectorConfig,
+    HostMetricsReceiver,
+    LogDoc,
+    LogStore,
+    MetricRegistry,
+    MetricTSDB,
+    Scraper,
+    TraceStore,
+    dashboards,
+    normalize_span_name,
+)
+from opentelemetry_demo_tpu.telemetry.collector import CALLS_TOTAL, DURATION_MS
+
+
+@pytest.fixture(scope="module")
+def busy_shop():
+    """One shop, 60 virtual seconds of the default Locust-profile load."""
+    shop = Shop(ShopConfig(users=5, seed=7))
+    shop.run(60.0)
+    return shop
+
+
+# -- span-name normalization (transform processor) --------------------
+
+def test_normalize_collapses_id_segments():
+    assert normalize_span_name("GET /api/products/OLJCESPC7Z") == "GET /api/products/{id}"
+    assert normalize_span_name("GET /api/data/123456789") == "GET /api/data/{id}"
+    assert normalize_span_name("PlaceOrder") == "PlaceOrder"
+    assert normalize_span_name("GET /api/cart") == "GET /api/cart"
+
+
+# -- trace store (Jaeger analogue) ------------------------------------
+
+def test_trace_store_collects_full_shop_traces(busy_shop):
+    store = busy_shop.collector.trace_store
+    assert len(store) > 0
+    services = store.services()
+    # The money path's services all show up (SURVEY.md §3.1).
+    for svc in ("checkout", "cart", "currency", "payment", "frontend"):
+        assert svc in services, f"{svc} missing from {services}"
+    # PlaceOrder traces span many services.
+    traces = store.find_traces(service="checkout", operation="PlaceOrder")
+    assert traces
+    assert any(len(t.services) >= 5 for t in traces)
+
+
+def test_trace_store_eviction_cap():
+    store = TraceStore(max_traces=10)
+    for i in range(25):
+        store.add_span(
+            float(i),
+            SpanRecord(service="s", duration_us=1.0, trace_id=i.to_bytes(16, "little")),
+        )
+    assert len(store) == 10
+    assert store.evicted_traces == 15
+    # Oldest evicted, newest retained.
+    assert store.get_trace((0).to_bytes(16, "little")) is None
+    assert store.get_trace((24).to_bytes(16, "little")) is not None
+
+
+def test_trace_store_error_search():
+    # Fresh shop with paymentFailure forced on → error traces findable.
+    shop = Shop(ShopConfig(users=5, seed=11))
+    shop.set_flag("paymentFailure", 1.0)
+    shop.run(40.0)
+    errs = shop.collector.trace_store.find_traces(
+        service="payment", error_only=True, limit=5
+    )
+    assert errs
+    assert all(t.has_error for t in errs)
+
+
+# -- spanmetrics connector + TSDB (Prometheus analogue) ---------------
+
+def test_spanmetrics_red_metrics_present(busy_shop):
+    tsdb = busy_shop.collector.tsdb
+    at = busy_shop.now
+    rates = tsdb.sum_rate(CALLS_TOTAL, None, 60.0, at, by=("service_name",))
+    assert rates, f"no call-rate series; names={tsdb.series_names()}"
+    # Busy shop: frontend handles multiple requests/sec.
+    frontend = rates.get(("frontend",), 0.0)
+    assert frontend > 0.1
+
+
+def test_spanmetrics_p95_is_plausible(busy_shop):
+    tsdb = busy_shop.collector.tsdb
+    at = busy_shop.now
+    p95 = tsdb.histogram_quantile(
+        0.95, DURATION_MS + "_bucket", None, 60.0, at, by=("service_name",)
+    )
+    assert p95
+    for (svc,), q in p95.items():
+        assert 0.0 <= q <= 15_000.0, (svc, q)
+    # Services' simulated base latencies are sub-second.
+    assert p95[("currency",)] < 1000.0
+
+
+def test_tsdb_rate_and_reset_handling():
+    tsdb = MetricTSDB()
+    for i, v in enumerate([0, 50, 100, 10, 60]):  # reset at i=3
+        tsdb.append("c_total", {"k": "a"}, float(i * 5), float(v))
+    [(labels, r)] = tsdb.rate("c_total", None, 100.0, 20.0)
+    # increases: 50+50+0(reset clamp)+50 = 150 over 20s
+    assert r == pytest.approx(150.0 / 20.0)
+
+
+def test_tsdb_retention_trims_old_samples():
+    tsdb = MetricTSDB(retention_s=100.0)
+    tsdb.append("g", {}, 0.0, 1.0)
+    for t in range(0, 400, 61):  # trigger the amortized sweep
+        tsdb.append("g", {}, float(t), float(t))
+    [series] = tsdb.select("g")
+    assert min(series.ts) >= 400 - 61 - 100.0 - 1
+
+
+def test_scraper_pulls_registry_into_tsdb():
+    reg = MetricRegistry()
+    tsdb = MetricTSDB()
+    scraper = Scraper(tsdb, interval_s=5.0)
+    scraper.add_target("svc", reg)
+    reg.counter_add("reqs_total", 3.0, route="/")
+    assert scraper.maybe_scrape(0.0)
+    assert not scraper.maybe_scrape(2.0)  # within interval
+    reg.counter_add("reqs_total", 2.0, route="/")
+    assert scraper.maybe_scrape(5.0)
+    [(labels, v)] = tsdb.instant("reqs_total", {"route": "/"}, at=5.0)
+    assert v == 5.0 and labels["job"] == "svc"
+
+
+def test_histogram_observe_exposition():
+    reg = MetricRegistry()
+    reg.histogram_observe("lat_ms", 3.0, (2.0, 5.0, 10.0), svc="a")
+    reg.histogram_observe("lat_ms", 7.0, (2.0, 5.0, 10.0), svc="a")
+    text = reg.render()
+    assert 'lat_ms_bucket{le="10",svc="a"} 2.0' in text
+    assert 'lat_ms_bucket{le="+Inf",svc="a"} 2.0' in text
+    assert 'lat_ms_count{svc="a"} 2.0' in text
+    assert 'lat_ms_sum{svc="a"} 10.0' in text
+
+
+# -- memory limiter / batcher -----------------------------------------
+
+def test_memory_limiter_refuses_above_budget():
+    t = [0.0]
+    col = Collector(clock=lambda: t[0], config=CollectorConfig(
+        memory_limit_spans=10, batch_max_spans=1000, batch_timeout_s=999.0,
+    ))
+    spans = [
+        SpanRecord(service="s", duration_us=1.0, trace_id=i.to_bytes(16, "little"))
+        for i in range(25)
+    ]
+    col.receive_spans(spans)
+    assert col.dropped_spans == 15
+    counters, _ = col.self_metrics.snapshot()
+    refused = sum(v for (n, _), v in counters.items()
+                  if n == "otelcol_processor_refused_spans")
+    assert refused == 15.0
+
+
+def test_batch_flush_on_size_and_timeout():
+    t = [0.0]
+    col = Collector(clock=lambda: t[0], config=CollectorConfig(
+        batch_max_spans=4, batch_timeout_s=1.0,
+    ))
+    seen = []
+    col.trace_exporters.append(lambda ts, batch: seen.append(len(batch)))
+    mk = lambda i: SpanRecord(service="s", duration_us=1.0,
+                              trace_id=i.to_bytes(16, "little"))
+    col.receive_spans([mk(0), mk(1)])
+    assert seen == []           # below size, before timeout
+    col.receive_spans([mk(2), mk(3)])
+    assert seen == [4]          # size-triggered flush
+    col.receive_spans([mk(4)])
+    t[0] = 2.0
+    col.pump()
+    assert seen == [4, 1]       # timeout-triggered flush
+
+
+# -- logs pipeline (OpenSearch analogue) ------------------------------
+
+def test_logs_flow_to_otel_index(busy_shop):
+    logs = busy_shop.collector.log_store
+    assert "otel" in logs.indices()
+    placed = logs.search(service="checkout", severity="INFO", query="order placed")
+    assert placed
+    doc = placed[0]
+    assert doc.trace_id is not None and "order_id" in doc.attrs
+
+
+def test_log_search_by_trace_id(busy_shop):
+    logs = busy_shop.collector.log_store
+    doc = logs.search(service="payment", severity="INFO", limit=1)[0]
+    same_trace = logs.search(trace_id=doc.trace_id)
+    assert any(d.service == "payment" for d in same_trace)
+
+
+def test_log_store_ring_bound():
+    store = LogStore(max_docs_per_index=5)
+    for i in range(12):
+        store.add(LogDoc(ts=float(i), service="s", severity="INFO", body=f"m{i}"))
+    assert store.count() == 5
+    assert store.search(limit=10)[0].body == "m11"
+    with pytest.raises(ValueError):
+        store.add(LogDoc(ts=0.0, service="s", severity="WARNING", body="bad"))
+
+
+# -- collector self-telemetry -----------------------------------------
+
+def test_collector_self_telemetry(busy_shop):
+    tsdb = busy_shop.collector.tsdb
+    at = busy_shop.now
+    accepted = tsdb.instant("otelcol_receiver_accepted_spans", at=at)
+    sent = tsdb.instant("otelcol_exporter_sent_spans", at=at)
+    assert accepted and sent
+    assert sum(v for _, v in accepted) >= sum(v for _, v in sent) > 0
+
+
+# -- hostmetrics receiver ---------------------------------------------
+
+def test_hostmetrics_scrape_real_proc():
+    recv = HostMetricsReceiver()
+    recv.scrape()
+    recv.scrape()  # second pass yields cpu utilization delta
+    _, gauges = recv.registry.snapshot()
+    names = {n for (n, _) in gauges}
+    assert "system_memory_usage_bytes" in names
+    assert "system_cpu_load_average_1m" in names
+    util = [v for (n, k), v in gauges.items() if n == "system_memory_utilization"]
+    assert util and 0.0 <= util[0] <= 1.0
+
+
+def test_hostmetrics_tolerates_missing_proc(tmp_path):
+    recv = HostMetricsReceiver(proc_root=str(tmp_path / "nope"))
+    recv.scrape()  # must not raise
+    _, gauges = recv.registry.snapshot()
+    assert gauges == {}
+
+
+# -- dashboards (Grafana analogue) ------------------------------------
+
+def test_provisioned_dashboards_evaluate(busy_shop):
+    at = busy_shop.now
+    boards = dashboards.provisioned_dashboards()
+    assert {b.uid for b in boards} >= {"demo", "spanmetrics", "opentelemetry-collector", "anomaly"}
+    by_uid = {b.uid: b for b in boards}
+    demo = dashboards.evaluate(by_uid["demo"], busy_shop.collector, at)
+    assert demo["Requests by service"], "demo dashboard empty"
+    span = dashboards.evaluate(by_uid["spanmetrics"], busy_shop.collector, at)
+    assert span["p95 latency by service"]
+    text = dashboards.render_text(by_uid["spanmetrics"], busy_shop.collector, at)
+    assert "p95 latency by service" in text and "frontend" in text
+
+
+def test_shop_metrics_scraped_into_tsdb(busy_shop):
+    """Service registries (app_* custom metrics, SURVEY.md §5) land in
+    the TSDB via the 5 s scrape cycle like any Prometheus target."""
+    tsdb = busy_shop.collector.tsdb
+    rows = tsdb.instant("app_payment_transactions_total", at=busy_shop.now)
+    assert rows
+    assert all(labels["job"] == "shop" for labels, _ in rows)
