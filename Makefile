@@ -1,0 +1,37 @@
+# Build/run surface — the analogue of the reference's Makefile
+# (/root/reference/Makefile:100-285: start/stop, run-tests,
+# run-tracetesting, generate-protobuf, check). JAX on CPU is forced for
+# local targets; bench runs on whatever accelerator jax.devices() finds.
+
+PY      := python
+CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+
+.PHONY: start start-load stop test tracetest bench gen-k8s build-native check clean
+
+start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
+	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
+
+start-load:     ## drive a remote gateway (TARGET=http://host:8080)
+	$(CPU_ENV) $(PY) scripts/serve_shop.py --load-only --target $(or $(TARGET),http://127.0.0.1:8080) --users 5
+
+test:           ## unit + integration suite (CPU mesh)
+	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
+
+tracetest:      ## trace-based suites over a live gateway (SURVEY.md §4)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.tracetest tracetesting
+
+bench:          ## flagship benchmark (ONE json line; real TPU if present)
+	$(PY) bench.py
+
+gen-k8s:        ## regenerate deploy/k8s manifests
+	$(PY) -m opentelemetry_demo_tpu.utils.k8s --out deploy/k8s
+
+build-native:   ## C++ ingest + currency kernels
+	$(MAKE) -C opentelemetry_demo_tpu/native
+
+check:          ## fast static sanity (no network, no device)
+	$(PY) -m compileall -q opentelemetry_demo_tpu tests scripts bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C opentelemetry_demo_tpu/native clean 2>/dev/null || true
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -217,8 +217,12 @@ class ShopGateway:
                 records = otlp.decode_export_request_json(body)
             else:
                 records = otlp.decode_export_request(body)
-            if self.on_spans is not None and records:
-                self.on_spans(time.monotonic() - self._t0, records)
+            if records:
+                # Same fan-out as server-side spans: detector feed AND
+                # the telemetry backend (trace store / spanmetrics).
+                if self.on_spans is not None:
+                    self.on_spans(time.monotonic() - self._t0, records)
+                self.shop.collector.receive_spans(records)
             return 200, "application/json", b"{}"
 
         if route.startswith("/feature"):

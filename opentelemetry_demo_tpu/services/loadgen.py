@@ -70,6 +70,8 @@ class LoadGenerator:
 
     def run_until(self, t_end: float) -> None:
         """Advance all users' schedules up to virtual time ``t_end``."""
+        if not self.users:  # users=0: external clients drive the shop
+            return
         while True:
             user = min(self.users, key=lambda u: u.next_at)
             if user.next_at >= t_end:

@@ -215,6 +215,9 @@ class MetricTSDB:
             target = q * total
             cum = 0.0
             prev_le, prev_cum = 0.0, 0.0
+            if len(les) == 1:  # only +Inf: no layout to interpolate in
+                out[key] = float("nan")
+                continue
             for le in les:
                 cum += buckets[le]
                 if cum >= target:

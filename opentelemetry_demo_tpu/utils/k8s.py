@@ -1,0 +1,258 @@
+"""Kubernetes deployment surface: the Helm-manifest analogue.
+
+The reference ships a 12,848-line Helm-generated manifest
+(/root/reference/kubernetes/opentelemetry-demo.yaml: 23 Deployments,
+25 Services, 7 ConfigMaps, 1 StatefulSet, RBAC + PodDisruptionBudget;
+regenerated via /root/reference/Makefile:163-176). This framework's
+deployable units are fewer — the in-proc shop collapses the storefront
+tier into one gateway process — so the generator emits exactly what a
+cluster needs, from code rather than templates:
+
+- **standalone stack**: shop-gateway (edge :8080 incl. flag editor +
+  in-proc telemetry backend), anomaly-detector (OTLP :4318, metrics
+  :9464, checkpoint PVC, PodDisruptionBudget), http load-generator.
+- **sidecar overlay**: just the detector, wired to an *existing*
+  reference-shop deployment the way deploy/docker-compose.anomaly.yml
+  does for compose (same env shape as the reference's fraud-detection
+  consumer, /root/reference/docker-compose.yml:226-256).
+
+Memory limits follow the reference's budget style (load-gen 1500M,
+detector sized like load-gen; docker-compose.yml resource limits).
+
+Regenerate with ``make gen-k8s`` (writes deploy/k8s/*.yaml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+APP_LABEL = "app.kubernetes.io/name"
+PART_OF = "app.kubernetes.io/part-of"
+STACK = "opentelemetry-demo-tpu"
+IMAGE_DETECTOR = "opentelemetry-demo-tpu:anomaly-detector"
+IMAGE_GATEWAY = "opentelemetry-demo-tpu:gateway"
+
+
+def _labels(name: str) -> dict:
+    return {APP_LABEL: name, PART_OF: STACK}
+
+
+def deployment(
+    name: str,
+    image: str,
+    *,
+    env: dict[str, str] | None = None,
+    ports: list[int] | None = None,
+    memory: str = "300Mi",
+    command: list[str] | None = None,
+    volume_mounts: list[dict] | None = None,
+    volumes: list[dict] | None = None,
+    readiness_http: tuple[str, int] | None = None,
+    replicas: int = 1,
+    strategy: str | None = None,
+) -> dict:
+    container: dict = {
+        "name": name,
+        "image": image,
+        "imagePullPolicy": "IfNotPresent",
+        "resources": {"limits": {"memory": memory}},
+    }
+    if command:
+        container["command"] = command
+    if env:
+        container["env"] = [{"name": k, "value": str(v)} for k, v in sorted(env.items())]
+    if ports:
+        container["ports"] = [{"containerPort": p} for p in ports]
+    if volume_mounts:
+        container["volumeMounts"] = volume_mounts
+    if readiness_http:
+        path, port = readiness_http
+        container["readinessProbe"] = {
+            "httpGet": {"path": path, "port": port},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+    spec: dict = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {APP_LABEL: name}},
+        "template": {
+            "metadata": {"labels": _labels(name)},
+            "spec": {"containers": [container]},
+        },
+    }
+    if volumes:
+        spec["template"]["spec"]["volumes"] = volumes
+    if strategy:
+        spec["strategy"] = {"type": strategy}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "spec": spec,
+    }
+
+
+def service(name: str, ports: list[int]) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "spec": {
+            "selector": {APP_LABEL: name},
+            "ports": [{"name": f"port-{p}", "port": p, "targetPort": p} for p in ports],
+        },
+    }
+
+
+def configmap(name: str, data: dict[str, str]) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "data": data,
+    }
+
+
+def pvc(name: str, size: str = "1Gi") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": size}},
+        },
+    }
+
+
+def pod_disruption_budget(name: str) -> dict:
+    return {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "spec": {
+            # maxUnavailable (not minAvailable): with replicas=1,
+            # minAvailable:1 would deadlock `kubectl drain` forever.
+            "maxUnavailable": 1,
+            "selector": {"matchLabels": {APP_LABEL: name}},
+        },
+    }
+
+
+def _detector_resources(kafka_addr: str | None) -> list[dict]:
+    """Detector Deployment + Service + PVC + PDB (shared by both bundles)."""
+    env = {
+        "ANOMALY_OTLP_PORT": "4318",
+        "ANOMALY_METRICS_PORT": "9464",
+        "ANOMALY_BATCH": "2048",
+        "ANOMALY_CHECKPOINT": "/var/lib/anomaly/detector",
+        "FLAGD_FILE": "/app/flagd/demo.flagd.json",
+    }
+    if kafka_addr:
+        env["KAFKA_ADDR"] = kafka_addr
+    return [
+        deployment(
+            "anomaly-detector",
+            IMAGE_DETECTOR,
+            env=env,
+            ports=[4318, 9464],
+            memory="1500Mi",
+            # Recreate: the RWO checkpoint PVC can't be attached by old
+            # and new pods at once; RollingUpdate would wedge on
+            # Multi-Attach when the replacement lands on another node.
+            strategy="Recreate",
+            volume_mounts=[
+                {"name": "anomaly-state", "mountPath": "/var/lib/anomaly"},
+                {"name": "flagd-config", "mountPath": "/app/flagd", "readOnly": True},
+            ],
+            volumes=[
+                {
+                    "name": "anomaly-state",
+                    "persistentVolumeClaim": {"claimName": "anomaly-state"},
+                },
+                {
+                    "name": "flagd-config",
+                    "configMap": {"name": "flagd-config"},
+                },
+            ],
+        ),
+        service("anomaly-detector", [4318, 9464]),
+        pvc("anomaly-state"),
+        pod_disruption_budget("anomaly-detector"),
+    ]
+
+
+def _flagd_configmap() -> dict:
+    """flagd file ConfigMap; content sourced from the deploy dir."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(here, "deploy", "demo.flagd.anomaly.json")
+    try:
+        with open(path) as f:
+            flags = f.read()
+    except OSError:
+        flags = '{"flags": {}}\n'
+    return configmap("flagd-config", {"demo.flagd.json": flags})
+
+
+def standalone_stack() -> list[dict]:
+    """The whole framework stack as cluster resources."""
+    docs: list[dict] = [_flagd_configmap()]
+    docs += [
+        deployment(
+            "shop-gateway",
+            IMAGE_GATEWAY,
+            env={"SHOP_PORT": "8080", "SHOP_USERS": "0"},
+            ports=[8080],
+            memory="500Mi",
+            readiness_http=("/health", 8080),
+        ),
+        service("shop-gateway", [8080]),
+        deployment(
+            "load-generator",
+            IMAGE_GATEWAY,
+            command=["python", "scripts/serve_shop.py", "--load-only",
+                     "--target", "http://shop-gateway:8080", "--users", "5"],
+            memory="1500Mi",
+        ),
+    ]
+    docs += _detector_resources(kafka_addr=None)
+    return docs
+
+
+def sidecar_overlay(kafka_addr: str = "kafka:9092") -> list[dict]:
+    """Detector-only bundle for an existing reference-shop cluster."""
+    return [_flagd_configmap()] + _detector_resources(kafka_addr=kafka_addr)
+
+
+def to_yaml(docs: list[dict]) -> str:
+    import yaml
+
+    return yaml.safe_dump_all(docs, sort_keys=False, default_flow_style=False)
+
+
+def write_manifests(outdir: str) -> list[str]:
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for fname, docs in (
+        ("opentelemetry-demo-tpu.yaml", standalone_stack()),
+        ("anomaly-detector-sidecar.yaml", sidecar_overlay()),
+    ):
+        path = os.path.join(outdir, fname)
+        with open(path, "w") as f:
+            f.write("# Generated by opentelemetry_demo_tpu.utils.k8s — do not edit.\n")
+            f.write(to_yaml(docs))
+        written.append(path)
+    return written
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="deploy/k8s")
+    args = parser.parse_args()
+    for path in write_manifests(args.out):
+        print(path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Serve the standalone shop stack — the ``make start`` entry point.
+
+One process = the reference's ``docker compose up`` for this framework:
+HTTP gateway at :8080 (Envoy-route analogue: /api/*, /images/*,
+/feature flag editor, /otlp-http ingest, /metrics), the in-proc
+telemetry backend (collector → trace/metric/log stores), and the TPU
+anomaly-detector pipeline subscribed to the span stream. Optional
+in-proc load (``--users``), or ``--load-only`` to drive a remote
+gateway the way the reference's load-generator container drives Envoy
+(/root/reference/docker-compose.yml:646-668).
+
+Examples:
+    python scripts/serve_shop.py --port 8080 --users 5
+    python scripts/serve_shop.py --load-only --target http://host:8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
+from opentelemetry_demo_tpu.services.gateway import ShopGateway
+from opentelemetry_demo_tpu.services.http_load import HttpLoadGenerator
+from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig
+from opentelemetry_demo_tpu.telemetry.metrics import export_report
+from opentelemetry_demo_tpu.utils.flag_ui import FlagEditorUI
+
+
+def serve(args) -> None:
+    shop = Shop(ShopConfig(users=0, seed=args.seed))
+    detector = AnomalyDetector(DetectorConfig(num_services=32))
+
+    def on_report(t, report, flagged):
+        export_report(
+            shop.metrics,
+            pipeline.tensorizer.service_names,
+            report,
+            flagged,
+        )
+
+    pipeline = DetectorPipeline(
+        detector, flags=shop.flags, on_report=on_report, batch_size=args.batch
+    )
+
+    def on_spans(t, spans):
+        pipeline.submit(spans)
+        pipeline.pump(t)
+
+    gw = ShopGateway(shop, host=args.host, port=args.port, on_spans=on_spans)
+    gw.feature_ui = FlagEditorUI(shop.flags)
+    gw.start()
+    print(f"shop gateway on http://{args.host}:{gw.port}  "
+          f"(flag editor at /feature, metrics at /metrics)", flush=True)
+
+    load = None
+    if args.users > 0:
+        load = HttpLoadGenerator(
+            f"http://127.0.0.1:{gw.port}", users=args.users, seed=args.seed
+        )
+        load.start()
+        print(f"in-proc load: {args.users} users", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    if load is not None:
+        load.stop()
+    gw.stop()
+    pipeline.drain()
+
+
+def load_only(args) -> None:
+    load = HttpLoadGenerator(args.target, users=args.users, seed=args.seed)
+    load.start()
+    print(f"load: {args.users} users → {args.target}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    load.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=int(os.getenv("SHOP_PORT", "8080")))
+    parser.add_argument("--users", type=int, default=int(os.getenv("SHOP_USERS", "0")))
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--load-only", action="store_true")
+    parser.add_argument("--target", default="http://127.0.0.1:8080")
+    args = parser.parse_args()
+    if args.load_only:
+        load_only(args)
+    else:
+        serve(args)
+
+
+if __name__ == "__main__":
+    main()

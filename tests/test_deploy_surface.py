@@ -1,0 +1,115 @@
+"""Deploy surface: k8s manifest generator + serve entry point.
+
+The reference's cluster story is a Helm-generated manifest
+(/root/reference/kubernetes/opentelemetry-demo.yaml) and a Makefile
+(/root/reference/Makefile:197-261); here both are code — these tests
+pin the generated resources' shape and the serve script's wiring.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from opentelemetry_demo_tpu.utils import k8s
+
+
+def _by_kind_name(docs):
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+class TestManifests:
+    def test_standalone_stack_resources(self):
+        docs = k8s.standalone_stack()
+        idx = _by_kind_name(docs)
+        assert ("Deployment", "shop-gateway") in idx
+        assert ("Deployment", "anomaly-detector") in idx
+        assert ("Deployment", "load-generator") in idx
+        assert ("Service", "anomaly-detector") in idx
+        assert ("PersistentVolumeClaim", "anomaly-state") in idx
+        assert ("PodDisruptionBudget", "anomaly-detector") in idx
+        assert ("ConfigMap", "flagd-config") in idx
+
+    def test_detector_wiring(self):
+        idx = _by_kind_name(k8s.sidecar_overlay(kafka_addr="kafka:9092"))
+        dep = idx[("Deployment", "anomaly-detector")]
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        # Same env shape as the compose overlay / reference consumer.
+        assert env["KAFKA_ADDR"] == "kafka:9092"
+        assert env["ANOMALY_OTLP_PORT"] == "4318"
+        assert env["FLAGD_FILE"] == "/app/flagd/demo.flagd.json"
+        ports = {p["containerPort"] for p in container["ports"]}
+        assert ports == {4318, 9464}
+        mounts = {m["mountPath"] for m in container["volumeMounts"]}
+        assert "/var/lib/anomaly" in mounts and "/app/flagd" in mounts
+
+    def test_selectors_match_pod_labels(self):
+        for docs in (k8s.standalone_stack(), k8s.sidecar_overlay()):
+            idx = _by_kind_name(docs)
+            for (kind, name), doc in idx.items():
+                if kind != "Deployment":
+                    continue
+                sel = doc["spec"]["selector"]["matchLabels"]
+                pod_labels = doc["spec"]["template"]["metadata"]["labels"]
+                assert set(sel.items()) <= set(pod_labels.items())
+                svc = idx.get(("Service", name))
+                if svc:
+                    assert set(svc["spec"]["selector"].items()) <= set(pod_labels.items())
+
+    def test_yaml_round_trip(self, tmp_path):
+        paths = k8s.write_manifests(str(tmp_path))
+        assert len(paths) == 2
+        for p in paths:
+            docs = list(yaml.safe_load_all(open(p)))
+            assert all("apiVersion" in d and "kind" in d for d in docs)
+
+    def test_flagd_configmap_carries_real_flags(self):
+        cm = k8s._flagd_configmap()
+        flags = yaml.safe_load(cm["data"]["demo.flagd.json"])
+        assert "flags" in flags
+        # The deploy dir's flag file gates the detector.
+        assert "anomalyDetectorEnabled" in flags["flags"]
+
+
+class TestServeScript:
+    def test_serve_shop_end_to_end(self, tmp_path):
+        """Boot the full stack on a random port; hit edge routes."""
+        proc = subprocess.Popen(
+            [sys.executable, "scripts/serve_shop.py", "--port", "0", "--users", "0"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": str(tmp_path),
+            },
+            cwd=".",
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "shop gateway on" in line, line
+            port = int(line.split(":")[2].split()[0].rstrip("/").split("/")[0])
+            base = f"http://127.0.0.1:{port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=30) as r:
+                    return r.status, r.read()
+
+            status, _ = get("/health")
+            assert status == 200
+            status, body = get("/api/products")
+            assert status == 200 and b"products" in body
+            status, body = get("/feature/")
+            assert status == 200
+            status, body = get("/metrics")
+            assert status == 200
+        finally:
+            proc.terminate()
+            proc.wait(timeout=20)

@@ -206,6 +206,10 @@ def test_otlp_http_browser_seam(rig):
     assert status == 200
     browser = [s for s in sink if s.service == "browser"]
     assert browser and browser[0].duration_us == 5000.0
+    # Client spans also reach the telemetry backend (same fan-out as
+    # server-side spans: trace store via the collector).
+    shop.collector.pump(shop.now + 1.0)
+    assert shop.collector.trace_store.find_traces(service="browser")
 
 
 def test_http_loadgen_drives_traffic(rig):

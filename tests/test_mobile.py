@@ -1,0 +1,87 @@
+"""Mobile client (react-native-app analogue): screens, session
+telemetry, both transports (SURVEY.md §2.2 react-native-app row)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.services.gateway import ShopGateway
+from opentelemetry_demo_tpu.services.mobile import (
+    HttpTransport,
+    InProcTransport,
+    MobileApp,
+    MobileSession,
+)
+from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig
+
+
+@pytest.fixture()
+def shop():
+    return Shop(ShopConfig(users=0, seed=5))
+
+
+def make_app(shop):
+    return MobileApp(
+        InProcTransport(shop.frontend),
+        tracer=shop.tracer,
+        session=MobileSession("mobile-test-session"),
+    )
+
+
+class TestInProc:
+    def test_shopping_journey_places_order(self, shop):
+        app = make_app(shop)
+        rng = np.random.default_rng(0)
+        order = app.shopping_journey(rng, n_items=2)
+        assert order["orderId"] and order["shippingTrackingId"]
+        assert order["total"]["currencyCode"] == "USD"  # same shape as HTTP
+        assert app.orders == [order]
+        # The order went through the real checkout: bus carries it.
+        shop.run(1.0)
+        assert shop.accounting.orders_seen >= 1
+
+    def test_client_spans_carry_session(self, shop):
+        app = make_app(shop)
+        app.product_list_screen()
+        shop.pump(1.0)
+        traces = shop.collector.trace_store.find_traces(
+            service="react-native-app", operation="GET /api/products"
+        )
+        assert traces
+        # Server-side spans share the trace (context propagated).
+        assert "frontend" in traces[0].services
+
+    def test_cart_screen_shape(self, shop):
+        app = make_app(shop)
+        products = app.product_list_screen()
+        app.add_to_cart(products[0]["id"], 3)
+        items = app.cart_screen()
+        assert items == [{"productId": products[0]["id"], "quantity": 3}]
+
+    def test_checkout_failure_emits_error_span(self, shop):
+        shop.set_flag("paymentFailure", 1.0)
+        app = make_app(shop)
+        products = app.product_list_screen()
+        app.add_to_cart(products[0]["id"], 1)
+        with pytest.raises(Exception):
+            app.checkout_flow()
+        shop.pump(1.0)
+        errs = shop.collector.trace_store.find_traces(
+            service="react-native-app", error_only=True
+        )
+        assert errs
+
+
+class TestHttp:
+    def test_journey_over_live_gateway(self, shop):
+        gw = ShopGateway(shop, host="127.0.0.1", port=0)
+        gw.start()
+        try:
+            app = MobileApp(HttpTransport(f"http://127.0.0.1:{gw.port}"))
+            rng = np.random.default_rng(1)
+            order = app.shopping_journey(rng, n_items=1)
+            assert order["orderId"]
+            assert order["total"]["currencyCode"] == "USD"
+        finally:
+            gw.stop()

@@ -112,6 +112,15 @@ def test_spanmetrics_p95_is_plausible(busy_shop):
     assert p95[("currency",)] < 1000.0
 
 
+def test_histogram_quantile_all_inf_is_nan():
+    """Only +Inf bucket mass → NaN (Prometheus), not a fake 0.0."""
+    tsdb = MetricTSDB()
+    for t in (0.0, 5.0, 10.0):
+        tsdb.append("lat_ms_bucket", {"le": "+Inf", "svc": "s"}, t, t)
+    out = tsdb.histogram_quantile(0.95, "lat_ms_bucket", None, 60.0, 10.0, by=("svc",))
+    assert np.isnan(out[("s",)])
+
+
 def test_tsdb_rate_and_reset_handling():
     tsdb = MetricTSDB()
     for i, v in enumerate([0, 50, 100, 10, 60]):  # reset at i=3
