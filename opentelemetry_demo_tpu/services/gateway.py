@@ -45,6 +45,7 @@ from xml.sax.saxutils import escape as _xml_escape
 from .base import ServiceError
 from .frontend import FLAG_IMAGE_SLOW_LOAD
 from .shop import Shop
+from .webui import WebStorefront
 from ..runtime import otlp
 from ..telemetry.tracer import TraceContext
 
@@ -89,16 +90,27 @@ class ShopGateway:
         # Mount point for the flag editor (flagd-ui analogue): an object
         # with handle(method, path, body) -> (status, content_type, bytes).
         self.feature_ui = None
+        # Server-rendered storefront at "/" (the Next.js tier analogue);
+        # HTML pages live beside the JSON /api routes.
+        self.web_ui = WebStorefront(shop.frontend)
 
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def _respond(self, status: int, body: bytes, ctype: str = "application/json"):
+            def _respond(
+                self,
+                status: int,
+                body: bytes,
+                ctype: str = "application/json",
+                extra: dict | None = None,
+            ):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -107,6 +119,7 @@ class ShopGateway:
                 parsed = urlparse(self.path)
                 route = parsed.path
                 ctx = None
+                extra = None
                 try:
                     # Header/body parsing is inside the guard: a
                     # malformed traceparent or Content-Length is client
@@ -127,10 +140,18 @@ class ShopGateway:
                             )
                         except ValueError:
                             pass
-                    status, ctype, payload = gateway._route(
+                    cookies = {}
+                    for part in (self.headers.get("Cookie") or "").split(";"):
+                        if "=" in part:
+                            k, v = part.split("=", 1)
+                            cookies[k.strip()] = v.strip()
+                    result = gateway._route(
                         method, route, query, body, ctx,
                         self.headers.get("Content-Type") or "",
+                        cookies,
                     )
+                    status, ctype, payload = result[:3]
+                    extra = result[3] if len(result) > 3 else None
                 except ServiceError as e:
                     status, ctype = 500, "application/json"
                     payload = json.dumps({"error": str(e)}).encode()
@@ -153,7 +174,7 @@ class ShopGateway:
                     method, route, ctx, status,
                     (time.monotonic() - t_start) * 1e6,
                 )
-                self._respond(status, payload, ctype)
+                self._respond(status, payload, ctype, extra)
 
             def do_GET(self):  # noqa: N802 (http.server API)
                 self._handle("GET")
@@ -206,10 +227,26 @@ class ShopGateway:
         """Advance the shop clock to wall elapsed; flush bus + spans."""
         self.shop.pump(time.monotonic() - self._t0, on_spans=self.on_spans)
 
-    def _route(self, method, route, query, body, ctx, req_ctype):
-        """Dispatch one request; returns (status, content_type, bytes)."""
+    WEB_ROUTES = ("/", "/cart", "/cart/add", "/cart/checkout")
+
+    def _route(self, method, route, query, body, ctx, req_ctype, cookies=None):
+        """Dispatch one request; returns (status, content_type, bytes)
+        or (status, content_type, bytes, extra_headers)."""
         if route == "/health":
             return 200, "application/json", b'{"status":"ok"}'
+
+        if self.web_ui is not None and (
+            route in self.WEB_ROUTES or route.startswith("/product/")
+        ):
+            # Server-rendered storefront (Next.js-page analogue).
+            form = {}
+            if body and "json" not in req_ctype:
+                form = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+            with self._lock:
+                self._pump_locked()
+                return self.web_ui.handle(
+                    method, route, query, form, cookies or {}, ctx
+                )
 
         if route.startswith("/otlp-http/"):
             # Browser-telemetry seam; no shop lock needed (pure decode).

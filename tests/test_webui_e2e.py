@@ -1,0 +1,170 @@
+"""Storefront e2e: the Cypress-spec analogue.
+
+The reference drives browser journeys with Cypress against the live
+stack (/root/reference/src/frontend/cypress/e2e/
+{Home,Checkout,ProductDetail}.cy.ts, run from a dedicated image in
+docker-compose-tests.yml:14-28). Same journeys here, over HTTP against
+a live gateway with a cookie jar: home grid → product detail →
+add-to-cart → cart → checkout confirmation, plus the failure-mode spec
+(paymentFailure → error page) and session-cookie persistence.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.request
+from http.cookiejar import CookieJar
+
+import pytest
+
+from opentelemetry_demo_tpu.services.gateway import ShopGateway
+from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig
+
+
+class Browser:
+    """Tiny Cypress stand-in: cookie-jar HTTP client with form posts."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.jar = CookieJar()
+        self.opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(self.jar)
+        )
+
+    def get(self, path: str) -> tuple[int, str]:
+        try:
+            with self.opener.open(self.base + path, timeout=30) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def post_form(self, path: str, **fields) -> tuple[int, str]:
+        data = "&".join(f"{k}={v}" for k, v in fields.items()).encode()
+        req = urllib.request.Request(
+            self.base + path, data=data,
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        try:
+            with self.opener.open(req, timeout=30) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def browser():
+    shop = Shop(ShopConfig(users=0, seed=9))
+    gw = ShopGateway(shop, host="127.0.0.1", port=0)
+    gw.start()
+    yield shop, Browser(f"http://127.0.0.1:{gw.port}")
+    gw.stop()
+
+
+class TestHomeSpec:
+    def test_home_renders_product_grid(self, browser):
+        shop, b = browser
+        status, html = b.get("/")
+        assert status == 200
+        # All 10 catalog products appear as cards with images.
+        assert html.count('class="card"') >= 10
+        assert "/images/" in html and "currency" in html
+
+    def test_session_cookie_set_once(self, browser):
+        shop, b = browser
+        b.get("/")
+        names = {c.name for c in b.jar}
+        assert "shop_session" in names
+        sid = next(c.value for c in b.jar if c.name == "shop_session")
+        b.get("/")
+        assert next(c.value for c in b.jar if c.name == "shop_session") == sid
+
+
+class TestProductDetailSpec:
+    def test_detail_shows_recommendations_and_form(self, browser):
+        shop, b = browser
+        _, home = b.get("/")
+        pid = re.search(r'href="/product/([A-Z0-9-]+)', home).group(1)
+        status, html = b.get(f"/product/{pid}")
+        assert status == 200
+        assert "Add to cart" in html
+        assert "You may also like" in html
+        assert html.count('href="/product/') >= 3  # rec links
+
+
+class TestCheckoutSpec:
+    def test_full_purchase_journey(self, browser):
+        shop, b = browser
+        _, home = b.get("/")
+        pid = re.search(r'href="/product/([A-Z0-9-]+)', home).group(1)
+        status, _ = b.post_form("/cart/add", productId=pid, quantity=2)
+        assert status == 200  # 303 followed to /cart
+        status, cart = b.get("/cart")
+        assert pid in cart and "Place order" in cart
+        status, conf = b.post_form(
+            "/cart/checkout",
+            email="e2e@example.com", currencyCode="EUR",
+            cardNumber="4432801561520454",
+        )
+        assert status == 200
+        assert "Order placed" in conf
+        order_id = re.search(r"order id: <b>([0-9a-f-]+)</b>", conf).group(1)
+        assert order_id
+        assert "EUR" in conf
+        # The order really went through the system: Kafka consumers see it.
+        shop.run(1.0)
+        assert shop.accounting.orders_seen >= 1
+
+    def test_cart_badge_counts_items(self, browser):
+        shop, b = browser
+        _, home = b.get("/")
+        pid = re.search(r'href="/product/([A-Z0-9-]+)', home).group(1)
+        b.post_form("/cart/add", productId=pid, quantity=3)
+        _, html = b.get("/")
+        assert "Cart (3)" in html
+
+    def test_cart_page_escapes_stored_product_ids(self, browser):
+        """Stored-XSS regression: hostile productId renders inert."""
+        shop, b = browser
+        b.get("/")  # establish session cookie
+        payload = "<img src=x onerror=alert(1)>"
+        from urllib.parse import quote
+        b.post_form("/cart/add", productId=quote(payload), quantity=1)
+        _, html = b.get("/cart")
+        assert "<img src=x" not in html
+        assert "&lt;img" in html
+
+    def test_home_escapes_currency_param(self, browser):
+        """Reflected-XSS regression: hostile currency stays quoted."""
+        shop, b = browser
+        status, html = b.get('/?currency=%22%3E%3Cscript%3Ealert(1)%3C/script%3E')
+        assert status == 200
+        assert "<script>alert(1)</script>" not in html
+
+    def test_ad_failure_degrades_banner_not_page(self, browser):
+        """adFailure errors 1-in-10 ad requests (reference
+        AdService.java:135-137); the page must stay 200 either way,
+        with the banner absent on the failing draws."""
+        shop, b = browser
+        shop.set_flag("adFailure", True)
+        bannerless = 0
+        for _ in range(40):
+            status, html = b.get("/")
+            assert status == 200
+            assert html.count('class="card"') >= 10
+            if 'class="ad"' not in html:
+                bannerless += 1
+        assert bannerless >= 1  # deterministic under the fixture seed
+
+    def test_payment_failure_renders_error_page(self, browser):
+        shop, b = browser
+        shop.set_flag("paymentFailure", 1.0)
+        _, home = b.get("/")
+        pid = re.search(r'href="/product/([A-Z0-9-]+)', home).group(1)
+        b.post_form("/cart/add", productId=pid, quantity=1)
+        status, html = b.post_form(
+            "/cart/checkout", email="x@example.com", currencyCode="USD",
+        )
+        assert status == 500
+        assert "Something went wrong" in html
+        # The storefront stays usable afterwards.
+        assert b.get("/")[0] == 200
