@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-load stop test tracetest bench gen-k8s build-native check clean
+.PHONY: start start-load test tracetest bench gen-k8s gen-proto build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -31,6 +31,10 @@ build-native:   ## C++ ingest + currency kernels
 
 check:          ## fast static sanity (no network, no device)
 	$(PY) -m compileall -q opentelemetry_demo_tpu tests scripts bench.py __graft_entry__.py
+	$(PY) scripts/sanitycheck.py
+
+gen-proto:      ## regenerate protobuf stubs (build artifact)
+	bash scripts/gen_proto.sh
 
 clean:
 	$(MAKE) -C opentelemetry_demo_tpu/native clean 2>/dev/null || true

@@ -25,6 +25,7 @@ deterministic under test.
 from __future__ import annotations
 
 import re
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -44,6 +45,21 @@ SPANMETRICS_BUCKETS_MS: tuple[float, ...] = (
 
 CALLS_TOTAL = "traces_span_metrics_calls_total"
 DURATION_MS = "traces_span_metrics_duration_milliseconds"
+
+
+@dataclass
+class Exemplar:
+    """Metric→trace link: Prometheus exemplar semantics.
+
+    The reference enables exemplar storage
+    (--enable-feature=exemplar-storage, docker-compose.yml:793) and
+    provisions an exemplars dashboard; spanmetrics attaches the trace id
+    of an observation to the histogram so a latency spike on a panel
+    clicks through to the exact trace in Jaeger."""
+
+    trace_id: bytes
+    value_ms: float
+    ts: float
 
 # Span-name normalization: the reference's transform processor rewrites
 # high-cardinality span names (otelcol-config.yml:106-113). Same intent
@@ -89,6 +105,9 @@ class Collector:
         self.scraper = Scraper(self.tsdb, interval_s=self.config.scrape_interval_s)
         self.scraper.add_target("spanmetrics", self.spanmetrics)
         self.scraper.add_target("otel-collector", self.self_metrics)
+        # Exemplar store: (service_name, span_name) → recent exemplars
+        # (bounded ring; latest-wins like Prometheus exemplar storage).
+        self.exemplars: dict[tuple[str, str], deque[Exemplar]] = {}
         # Extra trace-batch subscribers — the anomaly-detector seam.
         self.trace_exporters: list[Callable[[float, list[SpanRecord]], None]] = []
         self._pending_spans: list[SpanRecord] = []
@@ -179,16 +198,35 @@ class Collector:
         # Exporter fan-out: trace store + spanmetrics + subscribers.
         for record in batch:
             self.trace_store.add_span(now, record)
-            self._spanmetrics_update(record)
+            self._spanmetrics_update(record, now)
         for exporter in self.trace_exporters:
             exporter(now, batch)
         self.self_metrics.counter_add(
             "otelcol_exporter_sent_spans", float(len(batch)), exporter="traces"
         )
 
+    def slowest_exemplars(self, limit: int = 10) -> list[tuple[str, str, "Exemplar"]]:
+        """Across all series: the slowest recent exemplar observations,
+        each resolvable to a full trace in the trace store — the
+        exemplars-dashboard drill-down.
+
+        Exemplars whose trace has been FIFO-evicted from the bounded
+        store are dropped here (a dead click-through is worse than a
+        missing row) and pruned from their ring so slow-but-stale
+        entries can't dominate the panel forever."""
+        rows = []
+        for (svc, name), ring in self.exemplars.items():
+            live = [ex for ex in ring if self.trace_store.get_trace(ex.trace_id)]
+            if len(live) != len(ring):
+                ring.clear()
+                ring.extend(live)
+            rows.extend((svc, name, ex) for ex in live)
+        rows.sort(key=lambda r: r[2].value_ms, reverse=True)
+        return rows[:limit]
+
     # -- spanmetrics connector ----------------------------------------
 
-    def _spanmetrics_update(self, record: SpanRecord) -> None:
+    def _spanmetrics_update(self, record: SpanRecord, now: float) -> None:
         labels = {
             "service_name": record.service,
             "span_name": record.name or "unknown",
@@ -201,3 +239,17 @@ class Collector:
             self.config.spanmetrics_buckets_ms,
             **labels,
         )
+        # Exemplar: latest observations per (service, span) keep their
+        # trace id so dashboards can click through to the trace store.
+        if isinstance(record.trace_id, bytes):
+            key = (record.service, record.name or "unknown")
+            ring = self.exemplars.get(key)
+            if ring is None:
+                ring = self.exemplars[key] = deque(maxlen=8)
+            ring.append(
+                Exemplar(
+                    trace_id=record.trace_id,
+                    value_ms=record.duration_us / 1000.0,
+                    ts=now,
+                )
+            )

@@ -81,6 +81,17 @@ def provisioned_dashboards() -> list[Dashboard]:
             ],
         ),
         Dashboard(
+            uid="exemplars",
+            title="Exemplars Demo Dashboard",
+            panels=[
+                Panel("Slowest recent spans (click-through to trace)",
+                      Query("exemplars"), "ms"),
+                Panel("p95 latency (exemplar source)",
+                      Query("quantile", DURATION_MS + "_bucket",
+                            by=("service_name",), q=0.95), "ms"),
+            ],
+        ),
+        Dashboard(
             uid="opentelemetry-collector",
             title="OpenTelemetry Collector",
             panels=[
@@ -137,6 +148,11 @@ def evaluate_panel(panel: Panel, collector: Collector, at: float):
             service=q.service, error_only=q.error_only, limit=20
         )
         return [((t.trace_id.hex(),), t.duration_us) for t in traces]
+    if q.kind == "exemplars":
+        return [
+            ((svc, name, ex.trace_id.hex()), ex.value_ms)
+            for svc, name, ex in collector.slowest_exemplars(limit=10)
+        ]
     if q.kind == "logs":
         docs = collector.log_store.search(
             service=q.service, severity=q.severity, limit=20

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Generate Python stubs from proto/demo.proto (the analogue of the
+# reference's docker-gen-proto.sh / ide-gen-proto.sh codegen step).
+# Stubs land in build/proto_gen/ and are NOT sources: the runtime
+# decodes by field number via runtime/wire.py; the stubs exist for
+# interop testing (tests/test_proto_contract.py) and downstream users.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=build/proto_gen
+mkdir -p "$OUT"
+protoc --python_out="$OUT" proto/demo.proto
+echo "generated: $OUT/proto/demo_pb2.py"

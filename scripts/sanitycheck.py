@@ -1,0 +1,126 @@
+"""Repo invariants checker: the internal/tools sanitycheck analogue.
+
+The reference keeps a tooling dir with lint pins and a sanity script
+(/root/reference/internal/tools/, Makefile `check` target :93-96). This
+framework's equivalent checks the contracts the driver and judge rely
+on, without importing jax (fast, no device):
+
+  - bench.py exists and its contract (ONE json line with
+    metric/value/unit/vs_baseline) is declared in code;
+  - __graft_entry__ exposes entry() and dryrun_multichip();
+  - every tracetesting suite parses and targets a known service dir;
+  - proto/demo.proto compiles if protoc is available;
+  - deploy/k8s manifests parse as YAML k8s objects;
+  - no Python file accidentally imports from /root/reference.
+
+Run via `make check`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAILS: list[str] = []
+
+
+def check(ok: bool, msg: str) -> None:
+    print(("ok   " if ok else "FAIL ") + msg)
+    if not ok:
+        FAILS.append(msg)
+
+
+def main() -> int:
+    # bench contract
+    bench = os.path.join(ROOT, "bench.py")
+    check(os.path.exists(bench), "bench.py exists")
+    if os.path.exists(bench):
+        src = open(bench).read()
+        for key in ('"metric"', '"value"', '"unit"', '"vs_baseline"'):
+            check(key in src, f"bench.py emits {key}")
+
+    # graft entry contract
+    entry_path = os.path.join(ROOT, "__graft_entry__.py")
+    check(os.path.exists(entry_path), "__graft_entry__.py exists")
+    if os.path.exists(entry_path):
+        tree = ast.parse(open(entry_path).read())
+        fns = {n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)}
+        check("entry" in fns, "__graft_entry__.entry defined")
+        check("dryrun_multichip" in fns, "__graft_entry__.dryrun_multichip defined")
+
+    # tracetesting suites parse and target known services
+    import yaml
+
+    # Service names as the suites spell them (dirs use the reference's
+    # kebab-case; the services package registers the same names on its
+    # classes).
+    known_services = {
+        "ad", "cart", "checkout", "currency", "email", "frontend",
+        "payment", "product-catalog", "quote", "recommendation",
+        "shipping", "fraud-detection", "accounting",
+    }
+    tdir = os.path.join(ROOT, "tracetesting")
+    suites = sorted(os.listdir(tdir)) if os.path.isdir(tdir) else []
+    check(len(suites) >= 10, f"tracetesting covers {len(suites)} services (>=10)")
+    for svc in suites:
+        check(svc in known_services, f"tracetesting/{svc} targets a known service")
+        for fname in os.listdir(os.path.join(tdir, svc)):
+            path = os.path.join(tdir, svc, fname)
+            try:
+                docs = list(yaml.safe_load_all(open(path)))
+                check(all(d for d in docs), f"tracetesting/{svc}/{fname} parses")
+            except yaml.YAMLError as e:
+                check(False, f"tracetesting/{svc}/{fname} parses ({e})")
+
+    # proto compiles
+    if shutil.which("protoc"):
+        r = subprocess.run(
+            ["protoc", "--python_out", "/tmp", "proto/demo.proto"],
+            cwd=ROOT, capture_output=True,
+        )
+        check(r.returncode == 0, "proto/demo.proto compiles")
+    else:
+        print("skip proto (no protoc)")
+
+    # k8s manifests parse
+    kdir = os.path.join(ROOT, "deploy", "k8s")
+    check(os.path.isdir(kdir), "deploy/k8s exists")
+    for fname in sorted(os.listdir(kdir)) if os.path.isdir(kdir) else []:
+        docs = list(yaml.safe_load_all(open(os.path.join(kdir, fname))))
+        check(
+            all(d and "apiVersion" in d and "kind" in d for d in docs),
+            f"deploy/k8s/{fname} is valid k8s YAML",
+        )
+
+    # no imports from the read-only reference tree
+    bad = []
+    for dirpath, dirnames, filenames in os.walk(ROOT):
+        dirnames[:] = [d for d in dirnames if d not in (".git", "__pycache__", "build")]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.samefile(path, __file__):
+                continue  # this checker necessarily names the pattern
+            text = open(path, errors="replace").read()
+            if "/root/reference" in text:
+                # Citations in docstrings/comments are expected; an
+                # import or open() against the tree is not.
+                for line in text.splitlines():
+                    s = line.strip()
+                    if s.startswith(("#", '"', "'")) or "reference" not in s:
+                        continue
+                    if ("import" in s or "open(" in s) and "/root/reference" in s:
+                        bad.append(os.path.join(dirpath, fname))
+    check(not bad, f"no code imports/reads /root/reference {bad or ''}")
+
+    print(("\nSANITY OK" if not FAILS else f"\n{len(FAILS)} FAILURES"))
+    return 1 if FAILS else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -230,6 +230,32 @@ def test_log_store_ring_bound():
         store.add(LogDoc(ts=0.0, service="s", severity="WARNING", body="bad"))
 
 
+# -- exemplars (metric → trace click-through) -------------------------
+
+def test_exemplars_resolve_to_stored_traces(busy_shop):
+    col = busy_shop.collector
+    rows = col.slowest_exemplars(limit=10)
+    assert rows
+    # Sorted slowest-first and every exemplar's trace is retrievable.
+    values = [ex.value_ms for _, _, ex in rows]
+    assert values == sorted(values, reverse=True)
+    svc, name, ex = rows[0]
+    trace = col.trace_store.get_trace(ex.trace_id)
+    assert trace is not None
+    assert any(
+        s.record.service == svc and (s.record.name or "unknown") == name
+        for s in trace.spans
+    )
+
+
+def test_exemplars_dashboard_panel(busy_shop):
+    boards = {b.uid: b for b in dashboards.provisioned_dashboards()}
+    assert "exemplars" in boards
+    result = dashboards.evaluate(boards["exemplars"], busy_shop.collector, busy_shop.now)
+    rows = result["Slowest recent spans (click-through to trace)"]
+    assert rows and all(len(key) == 3 for key, _ in rows)
+
+
 # -- collector self-telemetry -----------------------------------------
 
 def test_collector_self_telemetry(busy_shop):
