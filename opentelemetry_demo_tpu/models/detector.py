@@ -121,6 +121,65 @@ class DetectorReport(NamedTuple):
     flags: jnp.ndarray  # bool[S] — any signal over threshold
 
 
+# Shape of each DetectorReport field as a function of config. Keyed by
+# field NAME and resolved through DetectorReport._fields, so adding a
+# report field without a shape entry raises KeyError at first use
+# instead of silently shifting every later field's slot in the packed
+# vector.
+_REPORT_FIELD_SHAPES = {
+    "lat_z": lambda c: (c.num_services, c.num_taus),
+    "err_z": lambda c: (c.num_services, c.num_taus),
+    "rate_z": lambda c: (c.num_services, c.num_taus),
+    "card_z": lambda c: (c.num_services, c.num_windows),
+    "card_est": lambda c: (c.num_services, c.num_windows),
+    "hh_ratio": lambda c: (c.num_services, c.num_windows),
+    "svc_count": lambda c: (c.num_services,),
+    "cusum": lambda c: (c.num_services, 3),
+    "flags": lambda c: (c.num_services,),  # bool → f32 on the wire
+}
+
+
+def _report_shapes(config: "DetectorConfig") -> list[tuple[int, ...]]:
+    """Field shapes of DetectorReport, in declaration order."""
+    return [_REPORT_FIELD_SHAPES[name](config) for name in DetectorReport._fields]
+
+
+def report_pack(report: DetectorReport) -> jnp.ndarray:
+    """Flatten the report to ONE float32 vector inside jit.
+
+    A pytree ``device_get`` pays one transfer per leaf; packing on
+    device makes the harvest a single transfer (the difference matters
+    most where per-transfer latency dominates bandwidth — remote or
+    tunneled device topologies). :func:`report_unpack` restores the
+    structure host-side."""
+    leaves = list(report[:-1]) + [report.flags.astype(jnp.float32)]
+    return jnp.concatenate([leaf.reshape(-1) for leaf in leaves])
+
+
+def report_unpack(flat, config: "DetectorConfig") -> DetectorReport:
+    """Host-side inverse of :func:`report_pack` (numpy fields)."""
+    flat = np.asarray(flat)
+    fields = []
+    pos = 0
+    for shape in _report_shapes(config):
+        n = int(np.prod(shape))
+        fields.append(flat[pos:pos + n].reshape(shape))
+        pos += n
+    if pos != flat.size:
+        raise ValueError(
+            f"packed report length {flat.size} != expected {pos} "
+            "(DetectorReport layout drifted from _REPORT_FIELD_SHAPES?)"
+        )
+    fields[-1] = fields[-1] > 0.5  # flags back to bool
+    return DetectorReport(*fields)
+
+
+def detector_step_packed(config: "DetectorConfig", state: DetectorState, *args):
+    """detector_step with the report pre-packed for single-fetch harvest."""
+    new_state, report = detector_step(config, state, *args)
+    return new_state, report_pack(report)
+
+
 def detector_init(config: DetectorConfig) -> DetectorState:
     nw, s, t = config.num_windows, config.num_services, config.num_taus
     return DetectorState(
@@ -453,11 +512,13 @@ class AnomalyDetector:
         self._step = jax.jit(
             partial(detector_step, self.config), donate_argnums=0
         )
+        self._step_packed = jax.jit(
+            partial(detector_step_packed, self.config), donate_argnums=0
+        )
 
-    def observe(self, batch: TensorBatch, t_now: float) -> DetectorReport:
+    def _args(self, batch: TensorBatch, t_now: float):
         dt, rotate = self.clock.tick(t_now)
-        self.state, report = self._step(
-            self.state,
+        return (
             jnp.asarray(batch.svc),
             jnp.asarray(batch.lat_us),
             jnp.asarray(batch.is_error),
@@ -469,7 +530,17 @@ class AnomalyDetector:
             jnp.float32(dt),
             jnp.asarray(rotate),
         )
+
+    def observe(self, batch: TensorBatch, t_now: float) -> DetectorReport:
+        self.state, report = self._step(self.state, *self._args(batch, t_now))
         return report
+
+    def observe_packed(self, batch: TensorBatch, t_now: float) -> jnp.ndarray:
+        """Like :meth:`observe` but the report comes back as one flat
+        device vector — the low-latency harvest path
+        (:func:`report_unpack` restores the structure host-side)."""
+        self.state, flat = self._step_packed(self.state, *self._args(batch, t_now))
+        return flat
 
     def flagged_services(self, report: DetectorReport, names: list[str]) -> list[str]:
         mask = np.asarray(report.flags)

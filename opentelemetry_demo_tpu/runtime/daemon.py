@@ -18,6 +18,8 @@ Env contract (all optional, sensible defaults):
 - ``ANOMALY_OTLP_PORT``      OTLP/HTTP listen port (default 4318)
 - ``ANOMALY_METRICS_PORT``   Prometheus listen port (default 9464)
 - ``ANOMALY_BATCH``          device batch size (default 2048)
+- ``ANOMALY_HARVEST_INTERVAL``  report readback cadence seconds (default 0
+  = every batch); ``ANOMALY_HARVEST_ASYNC=1`` fetches on a side thread
 - ``ANOMALY_PUMP_INTERVAL_S``  batch cadence (default 0.05 — the <100ms
                                detection-lag budget spends half on batching)
 - ``FLAGD_FILE``             flagd-schema JSON path (hot-reloaded)
@@ -104,6 +106,10 @@ class DetectorDaemon:
             flags=flags,
             on_report=self._on_report,
             batch_size=self.batch_size,
+            # Remote/tunneled devices: readback RTT dominates — set an
+            # interval (and/or async) so dispatch never waits on fetch.
+            harvest_interval_s=float(os.environ.get("ANOMALY_HARVEST_INTERVAL", "0")),
+            harvest_async=os.environ.get("ANOMALY_HARVEST_ASYNC", "") == "1",
         )
         for name in restored_names:  # re-intern in checkpoint order
             self.pipeline.tensorizer.service_id(name)
@@ -182,7 +188,7 @@ class DetectorDaemon:
 
     def shutdown(self) -> None:
         self.receiver.stop()
-        self.pipeline.drain()
+        self.pipeline.close()  # drain + stop the harvester thread if any
         if self.ckpt_path:
             self._checkpoint()
         self.exporter.stop()

@@ -15,6 +15,11 @@ from ..telemetry.tracer import TraceContext
 
 FLAG_CART_FAILURE = "cartFailure"
 
+# Bucket advice for the cart latency histograms — the explicit-bounds
+# hint the reference attaches to app.cart.{add_item,get_cart}.latency
+# (ValkeyCartStore.cs:30-43), in milliseconds here.
+CART_LATENCY_BUCKETS_MS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 50.0, 200.0, 1000.0)
+
 
 class InMemoryCartStore:
     """Valkey-analogue KV store: user id → {product id: quantity}."""
@@ -57,6 +62,14 @@ class CartService(ServiceBase):
             return self._bad_store
         return self._store
 
+    def _observe(self, op: str, duration_us: float) -> None:
+        if self.env.metrics is not None:
+            self.env.metrics.histogram_observe(
+                f"app_cart_{op}_latency_ms",
+                duration_us / 1000.0,
+                CART_LATENCY_BUCKETS_MS,
+            )
+
     def add_item(self, ctx: TraceContext, user_id: str, product_id: str, quantity: int) -> None:
         store = self._active_store(ctx)
         try:
@@ -66,10 +79,10 @@ class CartService(ServiceBase):
             raise
         if self.env.metrics is not None:
             self.env.metrics.counter_add("app_cart_add_item_total", 1.0)
-        self.span("AddItem", ctx, attr=product_id)
+        self._observe("add_item", self.span("AddItem", ctx, attr=product_id))
 
     def get_cart(self, ctx: TraceContext, user_id: str) -> dict[str, int]:
-        self.span("GetCart", ctx)
+        self._observe("get_cart", self.span("GetCart", ctx))
         return self._active_store(ctx).get(user_id)
 
     def empty_cart(self, ctx: TraceContext, user_id: str) -> None:

@@ -262,6 +262,39 @@ class ShopGateway:
                 self.shop.collector.receive_spans(records)
             return 200, "application/json", b"{}"
 
+        if route.startswith("/ofrep/v1/evaluate/flags/"):
+            # OFREP surface: flagd serves OFREP over HTTP (:8016 in the
+            # reference, consumed by the Python load generator via the
+            # OpenFeature OFREP provider, locustfile.py:72-74). Shape
+            # matches utils.flags.OfrepClient — client and server round
+            # trip against each other.
+            key = route.rsplit("/", 1)[1]
+            doc = json.loads(body or b"{}")
+            if not isinstance(doc, dict):
+                raise ValueError("OFREP body must be a JSON object")
+            context = doc.get("context") or {}
+            if not isinstance(context, dict):
+                raise ValueError("OFREP context must be a JSON object")
+            targeting = context.get("targetingKey", "")
+            flags = self.shop.flags
+            # Sentinel default: a DISABLED or unresolvable flag must get
+            # the FLAG_NOT_FOUND treatment so OFREP clients fall back to
+            # their own defaults (returning 200 {"value": null} would
+            # override the caller's default with None).
+            missing = object()
+            value = (
+                flags.evaluate(key, missing, targeting)
+                if key in flags.flag_keys()
+                else missing
+            )
+            if value is missing:
+                return 404, "application/json", json.dumps(
+                    {"key": key, "errorCode": "FLAG_NOT_FOUND"}
+                ).encode()
+            return 200, "application/json", json.dumps(
+                {"key": key, "value": value, "reason": "STATIC"}
+            ).encode()
+
         if route.startswith("/feature"):
             if self.feature_ui is None:
                 return 503, "text/plain", b"flag UI not mounted"

@@ -212,6 +212,41 @@ def test_otlp_http_browser_seam(rig):
     assert shop.collector.trace_store.find_traces(service="browser")
 
 
+def test_ofrep_evaluate_round_trip(rig):
+    """The gateway's OFREP surface serves utils.flags.OfrepClient — the
+    flagd OFREP-over-HTTP contract (reference flagd :8016, consumed by
+    locustfile.py:72-74)."""
+    from opentelemetry_demo_tpu.utils.flags import OfrepClient
+
+    shop, gw, sink = rig
+    shop.set_flag("paymentFailure", 0.25)
+    client = OfrepClient(f"http://127.0.0.1:{gw.port}")
+    assert client.evaluate("paymentFailure", 0.0) == 0.25
+    # Unknown flag → 404 → client degrades to the default.
+    assert client.evaluate("noSuchFlag", "fallback") == "fallback"
+    # DISABLED flag → FLAG_NOT_FOUND, never 200 {"value": null}: the
+    # caller's default must win (OpenFeature fallback semantics).
+    doc = {"flags": dict(shop.flags._doc.get("flags", {}))}
+    doc["flags"]["paymentFailure"]["state"] = "DISABLED"
+    shop.flags.replace(doc)
+    assert client.evaluate("paymentFailure", 0.125) == 0.125
+    # Malformed (non-object) OFREP body is the client's fault: 4xx.
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(gw, "/ofrep/v1/evaluate/flags/paymentFailure", [1, 2])
+    assert exc.value.code == 400
+
+
+def test_cart_latency_histogram_exported(rig):
+    shop, gw, sink = rig
+    _post(gw, "/api/cart", {"userId": "u1", "item": {"productId": "TEL-DOB-10", "quantity": 1}})
+    _get(gw, "/api/cart?sessionId=u1")
+    text = shop.metrics.render()
+    assert "app_cart_add_item_latency_ms_bucket" in text
+    assert "app_cart_get_cart_latency_ms_count" in text
+
+
 def test_http_loadgen_drives_traffic(rig):
     shop, gw, sink = rig
     lg = HttpLoadGenerator(

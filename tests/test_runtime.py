@@ -335,6 +335,78 @@ class TestPipeline:
         assert flagged and flagged[-1] == ["checkout"]
         assert pipe.stats.lag_p99_ms() > 0
 
+    def test_pipeline_harvest_interval_skips_stale_reports(self, rng):
+        """A positive harvest interval drops superseded reports
+        unfetched; batches/spans accounting is unaffected."""
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        reports = []
+        pipe = DetectorPipeline(
+            det,
+            on_report=lambda t, rep, flagged: reports.append(t),
+            batch_size=256,
+            harvest_interval_s=3600.0,  # never due inside the loop
+        )
+        for k in range(10):
+            pipe.submit(self._records(rng, 200))
+            pipe.pump(1000.0 + k / 4)
+        assert pipe.stats.batches == 10
+        # In-flight window capped at 2: the rest were skipped unfetched.
+        assert pipe.stats.reports_skipped == 8
+        assert reports == []  # nothing harvested yet
+        pipe.drain()
+        assert len(reports) == 2
+        assert pipe.stats.spans == 10 * 200
+
+    def test_pipeline_async_harvester(self, rng):
+        """Background harvester: dispatch never blocks on readback;
+        drain/close still deliver the newest report."""
+        import time as _time
+
+        det = AnomalyDetector(DetectorConfig(num_services=8, warmup_batches=5.0))
+        reports = []
+        pipe = DetectorPipeline(
+            det,
+            on_report=lambda t, rep, flagged: reports.append((t, flagged)),
+            batch_size=256,
+            harvest_async=True,
+        )
+        for k in range(30):
+            pipe.submit(self._records(rng, 200))
+            pipe.pump(1000.0 + k / 4)
+            _time.sleep(0.002)  # give the harvester a slice
+        pipe.submit(self._records(rng, 200, lat=4000.0))
+        pipe.pump(1007.6)
+        pipe.close()
+        assert pipe.stats.batches == 31
+        assert pipe.stats.spans == 31 * 200
+        assert reports, "async harvester delivered no reports"
+        # Every batch's device update happened; host saw a subset.
+        assert len(reports) + pipe.stats.reports_skipped == 31
+        # The fault batch is the newest → its report must be delivered.
+        flagged = [f for _, f in reports if f]
+        assert flagged and flagged[-1] == ["checkout"]
+
+    def test_async_harvester_survives_on_report_error(self, rng):
+        """A raising on_report must not kill the harvester or hang
+        drain/close."""
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        calls = []
+
+        def bad_on_report(t, rep, flagged):
+            calls.append(t)
+            if len(calls) == 1:
+                raise RuntimeError("boom")
+
+        pipe = DetectorPipeline(
+            det, on_report=bad_on_report, batch_size=256, harvest_async=True
+        )
+        for k in range(6):
+            pipe.submit(self._records(rng, 100))
+            pipe.pump(1000.0 + k / 4)
+        pipe.close()  # must not hang
+        assert pipe.stats.harvest_errors >= 1
+        assert len(calls) >= 2  # harvester kept delivering after the error
+
     def test_pipeline_disabled_by_flag(self, rng):
         det = AnomalyDetector(DetectorConfig(num_services=8))
         ev = FlagEvaluator(
