@@ -126,6 +126,8 @@ class DetectorReport(NamedTuple):
 # report field without a shape entry raises KeyError at first use
 # instead of silently shifting every later field's slot in the packed
 # vector.
+_BOOL_REPORT_FIELDS = {"flags"}  # carried as f32 on the packed wire
+
 _REPORT_FIELD_SHAPES = {
     "lat_z": lambda c: (c.num_services, c.num_taus),
     "err_z": lambda c: (c.num_services, c.num_taus),
@@ -151,8 +153,15 @@ def report_pack(report: DetectorReport) -> jnp.ndarray:
     device makes the harvest a single transfer (the difference matters
     most where per-transfer latency dominates bandwidth — remote or
     tunneled device topologies). :func:`report_unpack` restores the
-    structure host-side."""
-    leaves = list(report[:-1]) + [report.flags.astype(jnp.float32)]
+    structure host-side. Fields are handled by NAME (bool fields via
+    ``_BOOL_REPORT_FIELDS``) so field order/appends can't silently
+    scramble the layout."""
+    leaves = [
+        getattr(report, name).astype(jnp.float32)
+        if name in _BOOL_REPORT_FIELDS
+        else getattr(report, name)
+        for name in DetectorReport._fields
+    ]
     return jnp.concatenate([leaf.reshape(-1) for leaf in leaves])
 
 
@@ -161,16 +170,18 @@ def report_unpack(flat, config: "DetectorConfig") -> DetectorReport:
     flat = np.asarray(flat)
     fields = []
     pos = 0
-    for shape in _report_shapes(config):
+    for name, shape in zip(DetectorReport._fields, _report_shapes(config)):
         n = int(np.prod(shape))
-        fields.append(flat[pos:pos + n].reshape(shape))
+        leaf = flat[pos:pos + n].reshape(shape)
+        if name in _BOOL_REPORT_FIELDS:
+            leaf = leaf > 0.5
+        fields.append(leaf)
         pos += n
     if pos != flat.size:
         raise ValueError(
             f"packed report length {flat.size} != expected {pos} "
             "(DetectorReport layout drifted from _REPORT_FIELD_SHAPES?)"
         )
-    fields[-1] = fields[-1] > 0.5  # flags back to bool
     return DetectorReport(*fields)
 
 

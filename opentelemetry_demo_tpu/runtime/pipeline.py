@@ -95,6 +95,7 @@ class DetectorPipeline:
         self._harvest_idle = threading.Event()
         self._harvest_idle.set()
         self._harvest_stop = False
+        self._harvest_flush = False  # drain() bypasses the cadence
         self._harvest_thread: threading.Thread | None = None
         if harvest_async:
             self._harvest_thread = threading.Thread(
@@ -202,26 +203,33 @@ class DetectorPipeline:
         while self._pending:
             self.pump()
         if self.harvest_async:
-            while True:
-                with self._inflight_lock:
-                    empty = not self._inflight
-                if empty and self._harvest_idle.is_set():
-                    break
-                if (
-                    self._harvest_thread is None
-                    or not self._harvest_thread.is_alive()
-                ):
-                    # Dead harvester (should be impossible — the loop
-                    # swallows processing errors — but never spin
-                    # against it): fall back to synchronous harvest.
-                    while self._harvest_one(keep=0):
-                        pass
-                    break
-                self._harvest_wake.set()
-                time.sleep(0.005)
+            self._harvest_flush = True
+            try:
+                self._drain_async()
+            finally:
+                self._harvest_flush = False
         else:
             while self._harvest_one(keep=0):
                 pass
+
+    def _drain_async(self) -> None:
+        while True:
+            with self._inflight_lock:
+                empty = not self._inflight
+            if empty and self._harvest_idle.is_set():
+                break
+            if (
+                self._harvest_thread is None
+                or not self._harvest_thread.is_alive()
+            ):
+                # Dead harvester (should be impossible — the loop
+                # swallows processing errors — but never spin against
+                # it): fall back to synchronous harvest.
+                while self._harvest_one(keep=0):
+                    pass
+                break
+            self._harvest_wake.set()
+            time.sleep(0.005)
 
     def close(self) -> None:
         """Stop the background harvester (if any) after a final drain."""
@@ -243,6 +251,17 @@ class DetectorPipeline:
         while True:
             self._harvest_wake.wait(timeout=0.05)
             self._harvest_wake.clear()
+            # The interval knob composes with async mode: between due
+            # times the harvester idles (stale reports keep being
+            # dropped at append time), so a tunnel isn't saturated with
+            # back-to-back readbacks the interval was set to avoid.
+            # drain()/close() bypass the cadence via _harvest_stop.
+            if (
+                not self._harvest_stop
+                and not self._harvest_flush
+                and time.monotonic() - self._last_harvest < self.harvest_interval_s
+            ):
+                continue
             with self._inflight_lock:
                 if not self._inflight:
                     if self._harvest_stop:
@@ -253,6 +272,7 @@ class DetectorPipeline:
                     self.stats.reports_skipped += 1
                 item = self._inflight.pop()
                 self._harvest_idle.clear()
+            self._last_harvest = time.monotonic()
             try:
                 self._process_report(item)
             except Exception:  # noqa: BLE001 — a raising on_report must

@@ -263,6 +263,8 @@ class ShopGateway:
             return 200, "application/json", b"{}"
 
         if route.startswith("/ofrep/v1/evaluate/flags/"):
+            if method != "POST":  # OFREP evaluation is POST-only
+                return 405, "application/json", b'{"error":"method not allowed"}'
             # OFREP surface: flagd serves OFREP over HTTP (:8016 in the
             # reference, consumed by the Python load generator via the
             # OpenFeature OFREP provider, locustfile.py:72-74). Shape
