@@ -62,11 +62,12 @@ def make_batch_pool(config, batch_size, n_pool, rng):
 
 
 def main():
-    # 8192 is the sweet spot on v5e: throughput scales ~linearly with
-    # batch (2048→10.9M, 4096→49.7M, 8192→88.8M spans/s) until the
-    # fused kernel's scoped-VMEM stack overflows at 16384 (16.04M >
-    # 16M limit). Overridable for sweeps.
-    batch_size = int(os.environ.get("BENCH_BATCH", 8192))
+    # Throughput scales ~linearly with batch (2048→10.9M, 8192→86M,
+    # 32768→359M, 65536→713M spans/s on v5e-1) — the fused kernel's
+    # batch-grid tiling (ops/fused.py) keeps VMEM bounded at any B.
+    # 65536 is the practical peak (131072 trips a residual scoped-VMEM
+    # edge). Overridable for sweeps.
+    batch_size = int(os.environ.get("BENCH_BATCH", 65536))
     config = DetectorConfig()
     step = jax.jit(partial(detector_step, config), donate_argnums=0)
     rng = np.random.default_rng(0)

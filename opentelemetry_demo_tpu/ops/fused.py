@@ -65,58 +65,75 @@ def _cell_chunk(total_cells: int, batch: int) -> int:
 
 
 def _delta_kernel(
-    flat_ref,  # int32[B, 1] — svc*R + bucket (rank 0 ⇒ no-op)
-    rank_ref,  # int32[B, 1] — HLL rank, 0 for masked lanes
-    cidx_ref,  # int32[B, D] — CMS row indices
-    weight_ref,  # int32[B, 1] — CMS increment (0 for masked lanes)
-    svc_ref,  # int32[B, 1] — local service id, >=S for masked lanes
-    feats_ref,  # float32[4, B] — premasked [1, loglat, loglat², err]
-    hll_ref,  # out int32[SR/C, C]
-    cms_ref,  # out int32[D, W]
-    stats_ref,  # out float32[4, S]
+    flat_ref,  # int32[TB, 1] — svc*R + bucket (rank 0 ⇒ no-op)
+    rank_ref,  # int32[TB, 1] — HLL rank, 0 for masked lanes
+    cidx_ref,  # int32[TB, D] — CMS row indices
+    weight_ref,  # int32[TB, 1] — CMS increment (0 for masked lanes)
+    svc_ref,  # int32[TB, 1] — local service id, >=S for masked lanes
+    feats_ref,  # float32[4, TB] — premasked [1, loglat, loglat², err]
+    hll_ref,  # out int32[SR/C, C] — same block every grid step
+    cms_ref,  # out int32[D, W] — same block every grid step
+    stats_ref,  # out float32[4, S] — same block every grid step
 ):
+    """One grid step absorbs one batch tile into the delta.
+
+    The grid runs sequentially over batch tiles (TPU grids iterate in
+    order), each step revisiting the SAME output block: the first step
+    initialises, later steps max/sum-accumulate. This keeps only a
+    [TB, chunk] compare intermediate in VMEM regardless of total B —
+    the scoped-VMEM ceiling that capped the single-block kernel at
+    B=16384 no longer binds."""
     b = flat_ref.shape[0]
     n_hll, c_hll = hll_ref.shape
     d, w = cms_ref.shape
     s = stats_ref.shape[1]
-    flat = flat_ref[:]  # [B, 1]
+    first = pl.program_id(0) == 0
+    flat = flat_ref[:]  # [TB, 1]
     rank = rank_ref[:]
 
     # HLL delta: per cell tile, max rank over the batch where the flat
     # (service, bucket) id hits the lane's cell id.
     def hll_body(i, _):
         cell = i * c_hll + jax.lax.broadcasted_iota(jnp.int32, (1, c_hll), 1)
-        contrib = jnp.where(flat == cell, rank, 0)  # [B, C]
-        hll_ref[pl.ds(i, 1), :] = jnp.max(contrib, axis=0, keepdims=True)
+        contrib = jnp.where(flat == cell, rank, 0)  # [TB, C]
+        tile_max = jnp.max(contrib, axis=0, keepdims=True)
+        prev = jnp.where(first, 0, hll_ref[pl.ds(i, 1), :])
+        hll_ref[pl.ds(i, 1), :] = jnp.maximum(prev, tile_max)
         return 0
 
     jax.lax.fori_loop(0, n_hll, hll_body, 0)
 
     # CMS delta: per row and cell tile, sum weights over the batch where
     # the row hash hits the lane's counter id.
-    weight = weight_ref[:]  # [B, 1] int32
-    c_cms = _cell_chunk(w, b)
+    weight = weight_ref[:]  # [TB, 1] int32
+    # 2*b: the grid pipeline double-buffers blocks, so budget the
+    # [TB, chunk] intermediates as if two tiles were resident.
+    c_cms = _cell_chunk(w, 2 * b)
     for di in range(d):  # depth is small and static — unrolled
-        col = cidx_ref[:, pl.ds(di, 1)]  # [B, 1]
+        col = cidx_ref[:, pl.ds(di, 1)]  # [TB, 1]
 
         def cms_body(i, _, col=col, di=di):
             cell = i * c_cms + jax.lax.broadcasted_iota(
                 jnp.int32, (1, c_cms), 1
             )
-            contrib = jnp.where(col == cell, weight, 0)  # [B, C]
-            cms_ref[pl.ds(di, 1), pl.ds(i * c_cms, c_cms)] = jnp.sum(
-                contrib, axis=0, keepdims=True
+            contrib = jnp.where(col == cell, weight, 0)  # [TB, C]
+            tile_sum = jnp.sum(contrib, axis=0, keepdims=True)
+            prev = jnp.where(
+                first, 0, cms_ref[pl.ds(di, 1), pl.ds(i * c_cms, c_cms)]
             )
+            cms_ref[pl.ds(di, 1), pl.ds(i * c_cms, c_cms)] = prev + tile_sum
             return 0
 
         jax.lax.fori_loop(0, w // c_cms, cms_body, 0)
 
     # Segment stats: one-hot matmul on the MXU.
     cols = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
-    onehot = (cols == svc_ref[:]).astype(jnp.float32)  # [B, S]
-    stats_ref[:] = jnp.dot(
+    onehot = (cols == svc_ref[:]).astype(jnp.float32)  # [TB, S]
+    tile_stats = jnp.dot(
         feats_ref[:], onehot, preferred_element_type=jnp.float32
     )
+    prev = jnp.where(first, 0.0, stats_ref[:])
+    stats_ref[:] = prev + tile_stats
 
 
 def _delta_pallas(
@@ -132,10 +149,30 @@ def _delta_pallas(
     cms_depth: int,
     cms_width: int,
     interpret: bool = False,
+    batch_tile: int | None = None,
 ) -> SketchDelta:
     b = flat.shape[0]
+    # Tile the batch axis so VMEM holds one tile, not the whole batch;
+    # the grid accumulates tiles into one delta (see _delta_kernel).
+    # 4096 keeps the [TB, chunk] compare intermediates comfortably under
+    # the 16M scoped-VMEM limit at any total B (8192 tiles sat at
+    # 16.04M — over by 40K — once the grid's double buffering counted).
+    target = min(b, batch_tile or 4096)
+    # Pick the LARGEST divisor tile ≤ target (fewest grid steps), not a
+    # power-of-two shrink: every grid step re-sweeps all sketch cell
+    # tiles, so a degenerate tile (e.g. 16 for b=6000) would be a
+    # silent orders-of-magnitude cliff. Refuse instead of degrading.
+    nb = -(-b // target)  # ceil
+    while nb <= b and b % nb:
+        nb += 1
+    tb = b // nb
+    if tb < min(target, 256):
+        raise ValueError(
+            f"batch size {b} has no usable tile divisor near {target}; "
+            "use a multiple of 4096 (or ≤ 4096) for the pallas impl"
+        )
     sr = num_services * hll_regs
-    c_hll = _cell_chunk(sr, b)
+    c_hll = _cell_chunk(sr, 2 * tb)  # 2*: grid double-buffering headroom
     # Under shard_map the per-shard delta varies across every mesh axis
     # any input varies across (batch-sharded lanes, sketch-localised
     # ids); pallas_call can't infer that, so propagate the union.
@@ -147,12 +184,34 @@ def _delta_pallas(
         jax.ShapeDtypeStruct((cms_depth, cms_width), jnp.int32, vma=vma),
         jax.ShapeDtypeStruct((4, num_services), jnp.float32, vma=vma),
     )
-    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    d = cidx_t.shape[1]
+
+    def col_tile(i):  # [B, k] inputs: tile the batch (row) axis
+        return (i, 0)
+
+    def feats_tile(i):  # [4, B] input: tile the lane (col) axis
+        return (0, i)
+
+    def whole(i):  # outputs: same full block every grid step
+        return (0, 0)
+
     hll_d, cms_d, stats = pl.pallas_call(
         _delta_kernel,
+        grid=(nb,),
         out_shape=out_shape,
-        in_specs=[vmem] * 6,
-        out_specs=(vmem, vmem, vmem),
+        in_specs=[
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, d), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, tb), feats_tile, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((sr // c_hll, c_hll), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((cms_depth, cms_width), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, num_services), whole, memory_space=pltpu.VMEM),
+        ),
         interpret=interpret,
     )(
         flat.reshape(b, 1),
@@ -180,6 +239,7 @@ def sketch_batch_delta(
     hll_p: int = hll.HLL_P,
     cms_width: int = cms.CMS_WIDTH,
     impl: str = "xla",  # "xla" | "pallas" | "interpret"
+    batch_tile: int | None = None,  # pallas batch-grid tile (default 4096)
 ) -> SketchDelta:
     """Reduce one span batch to its mergeable sketch delta.
 
@@ -237,6 +297,7 @@ def sketch_batch_delta(
         cms_depth=d,
         cms_width=cms_width,
         interpret=(impl == "interpret"),
+        batch_tile=batch_tile,
     )
 
 

@@ -255,7 +255,8 @@ class DetectorPipeline:
             # times the harvester idles (stale reports keep being
             # dropped at append time), so a tunnel isn't saturated with
             # back-to-back readbacks the interval was set to avoid.
-            # drain()/close() bypass the cadence via _harvest_stop.
+            # drain() bypasses the cadence via _harvest_flush; close()
+            # via _harvest_stop.
             if (
                 not self._harvest_stop
                 and not self._harvest_flush

@@ -71,6 +71,20 @@ class TestSketchBatchDelta:
         got = fused.sketch_batch_delta(*batch.values(), impl="interpret", **kw)
         _assert_delta_equal(ref, got)
 
+    @pytest.mark.parametrize("batch_tile", [64, 128, 256])
+    def test_batch_grid_tiling_matches_single_block(self, rng, batch_tile):
+        """The batch-grid accumulation path (B > tile → multi-step grid
+        revisiting the same output block) is bit-identical to the XLA
+        reference and to the single-block kernel."""
+        b, s, p, d, w = 512, 16, 8, 4, 1024
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        ref = fused.sketch_batch_delta(*batch.values(), impl="xla", **kw)
+        tiled = fused.sketch_batch_delta(
+            *batch.values(), impl="interpret", batch_tile=batch_tile, **kw
+        )
+        _assert_delta_equal(ref, tiled)
+
     def test_all_invalid_lanes_produce_empty_delta(self, rng):
         kw = dict(num_services=8, hll_p=8, cms_width=512)
         batch = _batch(rng, 64, 8, 4, 512)
