@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-load test tracetest bench gen-k8s gen-proto build-native check clean
+.PHONY: start start-load test tracetest bench gen-k8s gen-proto gen-dashboards build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -35,6 +35,9 @@ check:          ## fast static sanity (no network, no device)
 
 gen-proto:      ## regenerate protobuf stubs (build artifact)
 	bash scripts/gen_proto.sh
+
+gen-dashboards: ## regenerate deploy/grafana/*.json from telemetry.dashboards
+	$(PY) -c "from opentelemetry_demo_tpu.telemetry.dashboards import write_grafana_dashboards as w; print('\n'.join(w('deploy/grafana')))"
 
 clean:
 	$(MAKE) -C opentelemetry_demo_tpu/native clean 2>/dev/null || true

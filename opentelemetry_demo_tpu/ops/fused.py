@@ -167,9 +167,14 @@ def _delta_pallas(
         nb += 1
     tb = b // nb
     if tb < min(target, 256):
+        hint = (
+            f"pick a batch_tile that divides {b}"
+            if batch_tile
+            else "use a batch size that is a multiple of 4096 (or ≤ 4096)"
+        )
         raise ValueError(
-            f"batch size {b} has no usable tile divisor near {target}; "
-            "use a multiple of 4096 (or ≤ 4096) for the pallas impl"
+            f"batch size {b} has no usable tile divisor near {target} "
+            f"for the pallas impl; {hint}"
         )
     sr = num_services * hll_regs
     c_hll = _cell_chunk(sr, 2 * tb)  # 2*: grid double-buffering headroom

@@ -62,6 +62,7 @@ class Shop:
         # subscribed exporters (the anomaly-detector seam).
         self.collector = Collector(clock=lambda: self._t)
         self.collector.add_scrape_target("shop", self.metrics)
+        self.collector.attach_hostmetrics()
         rng = np.random.default_rng(self.config.seed)
         env = ServiceEnv(
             tracer=self.tracer,

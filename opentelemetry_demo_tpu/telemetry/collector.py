@@ -117,9 +117,18 @@ class Collector:
 
     # -- receivers ----------------------------------------------------
 
-    def add_scrape_target(self, job: str, registry: MetricRegistry) -> None:
+    def add_scrape_target(self, job: str, registry: MetricRegistry, before=None) -> None:
         """Register a service registry for the 5 s scrape cycle."""
-        self.scraper.add_target(job, registry)
+        self.scraper.add_target(job, registry, before)
+
+    def attach_hostmetrics(self, receiver=None):
+        """Enable the hostmetrics receiver on the scrape cadence
+        (otelcol-config.yml:24-81 scrapers → metrics pipeline)."""
+        from .hostmetrics import HostMetricsReceiver
+
+        receiver = receiver or HostMetricsReceiver()
+        self.add_scrape_target("hostmetrics", receiver.registry, before=receiver.scrape)
+        return receiver
 
     def receive_spans(self, records: list[SpanRecord]) -> None:
         """OTLP trace receiver → memory_limiter → transform → batch."""

@@ -165,6 +165,79 @@ def evaluate(dashboard: Dashboard, collector: Collector, at: float) -> dict:
     return {p.title: evaluate_panel(p, collector, at) for p in dashboard.panels}
 
 
+def to_grafana_json(dashboard: Dashboard) -> dict:
+    """Export a dashboard as a real Grafana dashboard model.
+
+    Bridges the in-proc definitions to the reference's deployment shape
+    (provisioned JSON files under
+    /root/reference/src/grafana/provisioning/dashboards/demo/): each
+    Query becomes the equivalent PromQL expression against the same
+    metric names, so the file drops into a Grafana+Prometheus stack
+    (deploy/ integration) unchanged.
+    """
+    panels = []
+    for i, panel in enumerate(dashboard.panels):
+        q = panel.query
+        w = int(q.window_s)
+        if q.kind == "rate":
+            by = f" by ({', '.join(q.by)})" if q.by else ""
+            sel = _promql_selector(q.metric, q.matchers)
+            expr = f"sum{by} (rate({sel}[{w}s]))"
+        elif q.kind == "quantile":
+            by_labels = ("le",) + tuple(q.by)
+            sel = _promql_selector(q.metric, q.matchers)
+            expr = (
+                f"histogram_quantile({q.q}, sum by ({', '.join(by_labels)}) "
+                f"(rate({sel}[{w}s])))"
+            )
+        elif q.kind == "instant":
+            expr = _promql_selector(q.metric, q.matchers)
+        else:  # traces/logs/exemplars panels target other datasources
+            expr = ""
+        panels.append({
+            "id": i + 1,
+            "title": panel.title,
+            "type": "timeseries" if expr else "table",
+            "gridPos": {"h": 8, "w": 12, "x": 12 * (i % 2), "y": 8 * (i // 2)},
+            "fieldConfig": {"defaults": {"unit": panel.unit or "none"}},
+            "targets": (
+                [{"expr": expr, "refId": "A", "exemplar": q.kind == "quantile"}]
+                if expr else []
+            ),
+        })
+    return {
+        "uid": dashboard.uid,
+        "title": dashboard.title,
+        "schemaVersion": 39,
+        "tags": ["opentelemetry-demo-tpu"],
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": panels,
+    }
+
+
+def _promql_selector(metric: str, matchers: dict) -> str:
+    if not matchers:
+        return metric
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(matchers.items()))
+    return metric + "{" + inner + "}"
+
+
+def write_grafana_dashboards(outdir: str) -> list[str]:
+    """Write all provisioned dashboards as Grafana JSON (make gen-dashboards)."""
+    import json
+    import os
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for board in provisioned_dashboards():
+        path = os.path.join(outdir, f"{board.uid}-dashboard.json")
+        with open(path, "w") as f:
+            json.dump(to_grafana_json(board), f, indent=2)
+            f.write("\n")
+        paths.append(path)
+    return paths
+
+
 def render_text(dashboard: Dashboard, collector: Collector, at: float) -> str:
     """Plain-text dashboard render (the ops-console view)."""
     lines = [f"== {dashboard.title} ({dashboard.uid}) @ t={at:.1f}s =="]

@@ -243,17 +243,22 @@ class Scraper:
     def __init__(self, tsdb: MetricTSDB, interval_s: float = 5.0):
         self.tsdb = tsdb
         self.interval_s = interval_s
-        self._targets: list[tuple[str, object]] = []
+        self._targets: list[tuple[str, object, object]] = []
         self._last_scrape: float | None = None
 
-    def add_target(self, job: str, registry) -> None:
-        self._targets.append((job, registry))
+    def add_target(self, job: str, registry, before=None) -> None:
+        """Register a registry; ``before()`` (if given) runs at each
+        scrape first — the hook pull-collectors like the hostmetrics
+        receiver use to refresh their gauges on the scrape cadence."""
+        self._targets.append((job, registry, before))
 
     def maybe_scrape(self, now: float) -> bool:
         if self._last_scrape is not None and now - self._last_scrape < self.interval_s:
             return False
         self._last_scrape = now
-        for job, registry in self._targets:
+        for job, registry, before in self._targets:
+            if before is not None:
+                before()
             counters, gauges = registry.snapshot()
             for (name, label_key), value in counters.items():
                 labels = dict(label_key)

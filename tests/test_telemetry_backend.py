@@ -303,6 +303,45 @@ def test_provisioned_dashboards_evaluate(busy_shop):
     assert "p95 latency by service" in text and "frontend" in text
 
 
+def test_hostmetrics_flow_into_shop_tsdb(busy_shop):
+    """The hostmetrics receiver is wired into the shop's scrape cycle
+    (its `before` hook refreshes /proc gauges each scrape)."""
+    import os
+
+    if not os.path.exists("/proc/meminfo"):
+        pytest.skip("no /proc on this platform (receiver degrades to no-op)")
+    rows = busy_shop.collector.tsdb.instant(
+        "system_memory_utilization", at=busy_shop.now
+    )
+    assert rows
+    labels, v = rows[0]
+    assert labels["job"] == "hostmetrics" and 0.0 <= v <= 1.0
+
+
+def test_grafana_json_export(tmp_path):
+    import json
+
+    paths = dashboards.write_grafana_dashboards(str(tmp_path))
+    assert len(paths) == 5
+    by_uid = {}
+    for p in paths:
+        doc = json.load(open(p))
+        by_uid[doc["uid"]] = doc
+        assert doc["panels"], p
+    # spanmetrics p95 panel renders the reference's query shape.
+    span = by_uid["spanmetrics"]
+    exprs = [t["expr"] for panel in span["panels"] for t in panel["targets"]]
+    assert any(
+        e.startswith("histogram_quantile(0.95,")
+        and "traces_span_metrics_duration_milliseconds_bucket" in e
+        for e in exprs
+    )
+    # rate panels carry matchers as PromQL selectors.
+    demo = by_uid["demo"]
+    all_exprs = [t["expr"] for p in demo["panels"] for t in p["targets"]]
+    assert any('status_code="STATUS_CODE_ERROR"' in e for e in all_exprs)
+
+
 def test_shop_metrics_scraped_into_tsdb(busy_shop):
     """Service registries (app_* custom metrics, SURVEY.md §5) land in
     the TSDB via the 5 s scrape cycle like any Prometheus target."""
