@@ -1,19 +1,24 @@
 """Benchmark: sketch-update throughput of the flagship detector step.
 
-Measures sustained spans/sec through the full single-chip detector update
-(HLL + CMS + EWMA heads + heavy-hitter query + window rotation) on
-device-resident batches — the BASELINE north-star metric
+Measures sustained spans/sec through the full single-chip detector
+update (HLL + CMS + EWMA heads + heavy-hitter query + window rotation)
+on device-resident batches — the BASELINE north-star metric
 ("≥200,000 spans/sec sketch updates on a single v5e-1").
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "spans/sec", "vs_baseline": N}
 
-Methodology: a pool of pre-tensorized batches lives on device (host
-ingest is benchmarked separately; the north star isolates sketch-update
-throughput), the state buffer is donated every step, window-rotation
-masks cycle at the cadence a real 200k spans/s stream would see, and
-nothing syncs to host inside the timed loop. Reported number is
-spans/sec over the whole timed region including rotations.
+Methodology — honest under remote/tunneled devices:
+``jax.block_until_ready`` can return before device compute completes on
+tunneled PJRT topologies (measured here: a matmul chain with a 28 ms
+FLOP floor "completing" in 0.1 ms), so any fetch-free timed loop
+measures dispatch rate, not throughput. This bench instead times two
+state-chained regions of k1 and k2 steps, each terminated by a real
+device→host scalar fetch (the chain's final ``step_idx``), and reports
+the SLOPE (t2-t1)/(k2-k1) as per-step cost — fixed costs (fetch RTT,
+loop overhead) cancel, device compute cannot be hidden. The state is
+donated every step and batches live on device; window-rotation masks
+cycle at the cadence a real stream at the baseline rate would see.
 """
 
 from __future__ import annotations
@@ -62,33 +67,23 @@ def make_batch_pool(config, batch_size, n_pool, rng):
 
 
 def main():
-    # Throughput scales ~linearly with batch (2048→10.9M, 8192→86M,
-    # 32768→359M, 65536→713M spans/s on v5e-1) — the fused kernel's
-    # batch-grid tiling (ops/fused.py) keeps VMEM bounded at any B.
-    # 65536 is the practical peak (131072 trips a residual scoped-VMEM
-    # edge). Overridable for sweeps.
-    batch_size = int(os.environ.get("BENCH_BATCH", 65536))
+    # 512k: the XLA scatter path (auto-selected for large batches)
+    # saturates ~15.9M spans/s from B≈128k on v5e-1; 512k keeps the
+    # timed regions long relative to any fixed overheads.
+    batch_size = int(os.environ.get("BENCH_BATCH", 524288))
     config = DetectorConfig()
     step = jax.jit(partial(detector_step, config), donate_argnums=0)
     rng = np.random.default_rng(0)
 
-    n_pool = 8
+    n_pool = 4
     pool = make_batch_pool(config, batch_size, n_pool, rng)
-    # dt stays a Python-derived constant end to end: fetching even one
-    # device scalar to host (e.g. float(dt)) degrades axon tunnel
-    # dispatch ~20x for the rest of the process with no recovery
-    # (measured directly: 68us/step before a single float(dt), then
-    # 1.3-3ms/step on every later fetch-free loop), so the timed loop
-    # and everything before it must be fetch-free.
     dt_host = batch_size / BASELINE_SPANS_PER_SEC
     dt = jnp.float32(dt_host)
 
-    # Rotation cadence as seen by a stream at the baseline rate: the 1s
-    # window rotates every ~1s/dt steps, the 10s/60s windows at 1/10 and
-    # 1/60 of that.
+    # Rotation cadence as seen by a stream at the baseline rate.
     steps_per_sec = max(int(1.0 / dt_host), 1)
     masks = []
-    for i in range(steps_per_sec * 60):
+    for i in range(max(steps_per_sec * 60, 240)):
         masks.append(
             (i % steps_per_sec == 0,
              i % (steps_per_sec * 10) == 0,
@@ -98,26 +93,43 @@ def main():
     mask_seq = [uniq[m] for m in masks]
 
     state = detector_init(config)
-    # Warmup / compile.
+    # Warmup / compile, then a real fetch so the whole run measures in
+    # the same (synchronized) tunnel regime.
     state, report = step(state, *pool[0], dt, mask_seq[1])
-    jax.block_until_ready(state)
+    _ = int(np.asarray(state.step_idx))
 
-    # Calibrate to a ~4s timed region.
-    t0 = time.perf_counter()
-    probe = 50
-    for i in range(probe):
-        state, report = step(state, *pool[i % n_pool], dt, mask_seq[i % len(mask_seq)])
-    jax.block_until_ready(state)
-    per_step = (time.perf_counter() - t0) / probe
-    iters = max(int(4.0 / per_step), 200)
+    def region(k: int, state):
+        t0 = time.perf_counter()
+        for i in range(k):
+            state, _report = step(
+                state, *pool[i % n_pool], dt, mask_seq[i % len(mask_seq)]
+            )
+        _ = int(np.asarray(state.step_idx))  # fetch forces the chain
+        return time.perf_counter() - t0, state
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state, report = step(state, *pool[i % n_pool], dt, mask_seq[i % len(mask_seq)])
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
+    # Calibrate k from a probe SLOPE (two probe lengths) so the fixed
+    # fetch RTT — which dominates short regions on tunneled topologies —
+    # doesn't inflate the estimate and undersize the timed regions.
+    ta, state = region(4, state)
+    tb, state = region(12, state)
+    per_step_est = max((tb - ta) / 8, 1e-5)
+    k1 = max(int(2.0 / per_step_est), 8)
+    k2 = 3 * k1
 
-    spans_per_sec = batch_size * iters / elapsed
+    per_step = 0.0
+    for _attempt in range(3):
+        t1, state = region(k1, state)
+        t2, state = region(k2, state)
+        per_step = (t2 - t1) / (k2 - k1)
+        if per_step > 0:
+            break
+    if per_step <= 0:
+        raise RuntimeError(
+            f"non-positive slope ({per_step!r}) after 3 attempts — "
+            "timing noise exceeded the signal; refusing to report"
+        )
+
+    spans_per_sec = batch_size / per_step
     print(
         json.dumps(
             {

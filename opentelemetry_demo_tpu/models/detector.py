@@ -319,7 +319,7 @@ def detector_step(
         num_services=s_axis,
         hll_p=config.hll_p,
         cms_width=config.cms_width,
-        impl=fused.resolve_impl(config.sketch_impl),
+        impl=fused.resolve_impl(config.sketch_impl, batch=int(svc.shape[0])),
     )
     hll_delta = comm.pmax_batch(delta.hll)
     cms_delta = comm.psum_batch(delta.cms)
