@@ -110,22 +110,28 @@ def main():
     # Calibrate k from a probe SLOPE (two probe lengths) so the fixed
     # fetch RTT — which dominates short regions on tunneled topologies —
     # doesn't inflate the estimate and undersize the timed regions.
+    # k1 is bounded ([8, 2000]) so a probe spike can neither hang the
+    # bench for hours nor shrink the regions to pure RTT jitter.
     ta, state = region(4, state)
     tb, state = region(12, state)
     per_step_est = max((tb - ta) / 8, 1e-5)
-    k1 = max(int(2.0 / per_step_est), 8)
-    k2 = 3 * k1
+    k1 = min(max(int(2.0 / per_step_est), 8), 2000)
 
+    # Accept a measurement only when the inter-region signal dwarfs
+    # RTT jitter (≥0.5 s of extra device work); otherwise grow the
+    # regions and retry.
     per_step = 0.0
-    for _attempt in range(3):
+    for _attempt in range(4):
+        k2 = 3 * k1
         t1, state = region(k1, state)
         t2, state = region(k2, state)
         per_step = (t2 - t1) / (k2 - k1)
-        if per_step > 0:
+        if per_step > 0 and (t2 - t1) >= 0.5:
             break
+        k1 = min(k1 * 4, 20_000)
     if per_step <= 0:
         raise RuntimeError(
-            f"non-positive slope ({per_step!r}) after 3 attempts — "
+            f"non-positive slope ({per_step!r}) after retries — "
             "timing noise exceeded the signal; refusing to report"
         )
 

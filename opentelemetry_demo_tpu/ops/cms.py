@@ -86,6 +86,34 @@ def cms_update(
     return flat.reshape(table.shape)
 
 
+def cms_update_hist(
+    table: jnp.ndarray,
+    idx: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Scatter-free unit-weight batch count: sort + searchsorted.
+
+    Semantically identical to :func:`cms_update` with ``weight=None``.
+    TPU scatters serialize on duplicate indices, and a CMS batch is
+    nothing but duplicates (B ≫ W); a histogram computed as
+    ``diff(searchsorted(sort(ids), bin_edges))`` avoids scatters
+    entirely — measured ~2× faster at B=512k, D=4, W=8192 on v5e-1
+    (7.3 ms vs 14.2 ms), which matters because the CMS update dominates
+    the large-batch detector step. 2-D tables only (the delta path);
+    invalid lanes sort past the last edge and fall out of the counts.
+    """
+    d, w = table.shape
+    row_offset = jnp.arange(d, dtype=jnp.int32)[:, None] * w
+    flat_idx = idx + row_offset
+    if valid is not None:
+        flat_idx = jnp.where(valid[None, :], flat_idx, d * w)
+    s = jnp.sort(flat_idx.reshape(-1))
+    edges = jnp.arange(d * w + 1, dtype=flat_idx.dtype)
+    cuts = jnp.searchsorted(s, edges)
+    counts = (cuts[1:] - cuts[:-1]).astype(table.dtype)
+    return table + counts.reshape(d, w)
+
+
 def cms_query(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Point-query counts for a batch: ``min`` over the D rows.
 

@@ -85,6 +85,19 @@ class TestSketchBatchDelta:
         )
         _assert_delta_equal(ref, tiled)
 
+    def test_resolve_impl_batch_crossover(self, monkeypatch):
+        """Auto-selection routes small batches to the dense kernel and
+        large ones to the scatter path (measured crossover ~4096)."""
+        monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
+        assert fused.resolve_impl(None, batch=2048) == "pallas"
+        assert fused.resolve_impl(None, batch=4096) == "pallas"
+        assert fused.resolve_impl(None, batch=4097) == "xla"
+        assert fused.resolve_impl(None) == "pallas"  # no batch hint
+        # Explicit requests are never overridden by the batch hint.
+        assert fused.resolve_impl("pallas", batch=524288) == "pallas"
+        monkeypatch.setattr(fused.jax, "default_backend", lambda: "cpu")
+        assert fused.resolve_impl(None, batch=64) == "xla"
+
     def test_all_invalid_lanes_produce_empty_delta(self, rng):
         kw = dict(num_services=8, hll_p=8, cms_width=512)
         batch = _batch(rng, 64, 8, 4, 512)

@@ -157,6 +157,23 @@ class TestCMS:
             np.asarray(cms_merge(t_a, t_b)), np.asarray(t_all)
         )
 
+    def test_hist_update_matches_scatter_update(self, rng):
+        """cms_update_hist (sort/searchsorted, scatter-free) is exactly
+        cms_update with unit weights, masked lanes included."""
+        from opentelemetry_demo_tpu.ops.cms import cms_update_hist
+
+        h64, hi, lo = _hashes(rng, 6000)
+        idx = cms_indices(hi, lo, DEPTH, WIDTH)
+        valid = jnp.asarray(rng.integers(0, 2, size=6000).astype(bool))
+        want = cms_update(cms_init(DEPTH, WIDTH), idx, valid=valid)
+        got = cms_update_hist(cms_init(DEPTH, WIDTH), idx, valid=valid)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # And without a mask.
+        np.testing.assert_array_equal(
+            np.asarray(cms_update_hist(cms_init(DEPTH, WIDTH), idx)),
+            np.asarray(cms_update(cms_init(DEPTH, WIDTH), idx)),
+        )
+
     def test_weights_and_mask(self, rng):
         h64, hi, lo = _hashes(rng, 100)
         idx = cms_indices(hi, lo, DEPTH, WIDTH)
