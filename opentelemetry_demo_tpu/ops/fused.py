@@ -27,8 +27,11 @@ Pallas path is property-tested against it (interpret mode on CPU, native
 on TPU). Honest fetch-synchronized timing on v5e-1 (S=32, p=12, 4×8192
 CMS): the dense kernel wins the small-batch regime (~2.6M spans/s
 through the full detector step at B=2048, vs ~1.5M for the scatter
-path) because its cost is one cell sweep per batch tile; XLA's native
-O(1)-per-span scatters win large batches (~20M spans/s from B≈128k).
+path) because its cost is one cell sweep per batch tile; the XLA path
+wins large batches (~20M spans/s from B≈128k) with O(1)-per-span work —
+a scatter-max for HLL and the scatter-free sort+searchsorted histogram
+for the CMS count (``cms.cms_update_hist``; TPU scatters serialize on
+duplicate indices, and a CMS batch is nothing but duplicates).
 ``resolve_impl`` auto-selects by batch size. The kernel's further wins
 are determinism (fixed VPU/MXU schedule, no batch-order dependence) and
 keeping the whole delta VMEM-resident.
@@ -323,10 +326,11 @@ def resolve_impl(requested: str | None, batch: int | None = None) -> str:
     compare-reduction kernel's cost per batch tile is a full sweep of
     all sketch cells, so its per-span cost is ~O(cells / tile): it wins
     in the small-batch low-latency regime (measured ~2.6M spans/s at
-    B=2048 vs ~1.5M for the scatter path on v5e-1, honest
-    fetch-synchronized timing) but loses at large batches where XLA's
-    native O(1)-per-span scatters saturate ~20M spans/s (B ≥ 128k).
-    CPU interpret mode is for tests, not production CPU runs.
+    B=2048 vs the xla path on v5e-1, honest fetch-synchronized timing)
+    but loses at large batches where the xla path's O(1)-per-span work
+    (HLL scatter-max + scatter-free CMS histogram) saturates ~20M
+    spans/s (B ≥ 128k). CPU interpret mode is for tests, not production
+    CPU runs.
     """
     if requested is None:
         if jax.default_backend() != "tpu":

@@ -37,6 +37,13 @@ class InMemoryCartStore:
     def empty(self, user_id: str) -> None:
         self._data.pop(user_id, None)
 
+    def stats(self) -> tuple[int, int]:
+        """(key count, total items) — the server-stats surface the
+        redis-receiver analogue scrapes (telemetry.receivers)."""
+        return len(self._data), sum(
+            sum(cart.values()) for cart in self._data.values()
+        )
+
 
 class FailingCartStore(InMemoryCartStore):
     """The cartFailure stand-in: every write raises."""
@@ -56,6 +63,11 @@ class CartService(ServiceBase):
         super().__init__(env)
         self._store = InMemoryCartStore()
         self._bad_store = FailingCartStore()
+
+    @property
+    def store(self) -> InMemoryCartStore:
+        """The real (healthy) backing store — the stats-scrape surface."""
+        return self._store
 
     def _active_store(self, ctx: TraceContext):
         if bool(self.flag(FLAG_CART_FAILURE, False, ctx)):

@@ -106,6 +106,10 @@ class ProductCatalog(ServiceBase):
         q = query.lower()
         return [p for p in self._products if q in p["name"].lower()]
 
+    def list_ids(self) -> list[str]:
+        """Product ids without a span — internal/probe surface."""
+        return [p["id"] for p in self._products]
+
     def price_of(self, product_id: str) -> Money:
         for p in self._products:
             if p["id"] == product_id:

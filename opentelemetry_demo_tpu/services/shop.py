@@ -63,6 +63,9 @@ class Shop:
         self.collector = Collector(clock=lambda: self._t)
         self.collector.add_scrape_target("shop", self.metrics)
         self.collector.attach_hostmetrics()
+        # Receiver family parity (otelcol-config.yml:15-23): cart-store
+        # stats (redis receiver analogue) + httpcheck wired after the
+        # services exist (see below).
         rng = np.random.default_rng(self.config.seed)
         env = ServiceEnv(
             tracer=self.tracer,
@@ -95,6 +98,35 @@ class Shop:
         self.accounting = AccountingService(env, self.bus)
         self.fraud = FraudDetectionService(env, self.bus)
         self.loadgen = LoadGenerator(self.frontend, rng, users=self.config.users)
+
+        # Pull receivers on the scrape cadence (SURVEY.md §5 Profiling):
+        # cart-store stats = the redis receiver; an in-proc httpcheck
+        # probe = the frontend-proxy health check.
+        from ..telemetry.receivers import HttpCheckReceiver, StoreStatsReceiver
+
+        store_stats = StoreStatsReceiver(self.cart.store)
+        self.collector.add_scrape_target(
+            "valkey-cart", store_stats.registry, before=store_stats.scrape
+        )
+        httpcheck = HttpCheckReceiver()
+
+        def probe_frontend() -> int:
+            # Liveness probe with NO telemetry/rng side effects (probe
+            # spans would pollute the deterministic traffic stream the
+            # detector tests rely on): verify the catalog's data path
+            # serves — goes red if the product table is gone/corrupt,
+            # like the reference's /health probes (liveness, not deep
+            # app-fault health).
+            try:
+                self.catalog.price_of(self.catalog.list_ids()[0])
+                return 200
+            except Exception:
+                return 500
+
+        httpcheck.add_target("frontend-proxy", probe_frontend)
+        self.collector.add_scrape_target(
+            "httpcheck", httpcheck.registry, before=httpcheck.scrape
+        )
 
     # -- flag control (flagd-ui analogue) ------------------------------
 

@@ -1,0 +1,83 @@
+"""Pull receivers: httpcheck + store-stats (the redis receiver analogue).
+
+The reference collector scrapes two more receiver families beyond
+hostmetrics (/root/reference/src/otel-collector/otelcol-config.yml):
+``httpcheck`` probing the frontend-proxy (:15-17) and ``redis`` reading
+the cart store's server stats (:20-23). Same capabilities here as
+scrape-cadence pull receivers on a :class:`~.metrics.MetricRegistry`
+(register via ``Collector.add_scrape_target(..., before=recv.scrape)``).
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from .metrics import MetricRegistry
+
+
+class HttpCheckReceiver:
+    """Probes HTTP endpoints; emits httpcheck.* metrics.
+
+    ``targets`` maps a name to either a URL (real HTTP GET, used when
+    the gateway serves on a socket) or a zero-arg callable returning an
+    HTTP status int (in-proc probing on the virtual clock).
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None, timeout_s: float = 5.0):
+        self.registry = registry or MetricRegistry()
+        self.timeout_s = timeout_s
+        self._targets: dict[str, str | Callable[[], int]] = {}
+
+    def add_target(self, name: str, target: str | Callable[[], int]) -> None:
+        self._targets[name] = target
+
+    def _probe(self, target) -> tuple[int, float]:
+        t0 = time.monotonic()
+        if callable(target):
+            status = int(target())
+        else:
+            try:
+                with urllib.request.urlopen(target, timeout=self.timeout_s) as r:
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            except Exception:
+                status = 0  # unreachable
+        return status, (time.monotonic() - t0) * 1000.0
+
+    def scrape(self) -> None:
+        for name, target in self._targets.items():
+            status, ms = self._probe(target)
+            ok = 1.0 if 200 <= status < 400 else 0.0
+            # Status code is a VALUE, not a label: gauges keyed by a
+            # changing code would leave the stale series (old code, old
+            # up/down value) exported forever beside the new one.
+            self.registry.gauge_set("httpcheck_status", ok, endpoint=name)
+            self.registry.gauge_set(
+                "httpcheck_http_status_code", float(status), endpoint=name
+            )
+            self.registry.gauge_set("httpcheck_duration_ms", ms, endpoint=name)
+            if not ok:
+                self.registry.counter_add("httpcheck_error_total", 1.0, endpoint=name)
+
+
+class StoreStatsReceiver:
+    """Cart-store stats: the redis receiver analogue.
+
+    The reference scrapes Valkey server stats (keys, memory, ops) from
+    the cart store. Here the store is in-proc, so the receiver reads it
+    directly: key count (users with carts), total items, and cumulative
+    op counters if the store exposes them.
+    """
+
+    def __init__(self, store, registry: MetricRegistry | None = None):
+        self.store = store
+        self.registry = registry or MetricRegistry()
+
+    def scrape(self) -> None:
+        keys, items = self.store.stats()
+        self.registry.gauge_set("store_db_keys", float(keys))
+        self.registry.gauge_set("store_items_total", float(items))

@@ -318,6 +318,39 @@ def test_hostmetrics_flow_into_shop_tsdb(busy_shop):
     assert labels["job"] == "hostmetrics" and 0.0 <= v <= 1.0
 
 
+def test_receiver_family_metrics_in_tsdb(busy_shop):
+    """httpcheck + store-stats receivers (otelcol-config.yml:15-23
+    analogues) land in the TSDB on the scrape cadence."""
+    tsdb = busy_shop.collector.tsdb
+    at = busy_shop.now
+    up = tsdb.instant("httpcheck_status", at=at)
+    assert up and all(v == 1.0 for _, v in up)
+    keys = tsdb.instant("store_db_keys", at=at)
+    assert keys and keys[0][0]["job"] == "valkey-cart"
+
+
+def test_httpcheck_receiver_real_http():
+    from opentelemetry_demo_tpu.services.gateway import ShopGateway
+    from opentelemetry_demo_tpu.services.shop import Shop as _Shop
+    from opentelemetry_demo_tpu.telemetry.receivers import HttpCheckReceiver
+
+    shop = _Shop(ShopConfig(users=0, seed=1))
+    gw = ShopGateway(shop, host="127.0.0.1", port=0)
+    gw.start()
+    try:
+        recv = HttpCheckReceiver()
+        recv.add_target("edge", f"http://127.0.0.1:{gw.port}/health")
+        recv.add_target("missing", f"http://127.0.0.1:{gw.port}/no-such")
+        recv.scrape()
+        _, gauges = recv.registry.snapshot()
+        status = {dict(k)["endpoint"]: v for (n, k), v in gauges.items()
+                  if n == "httpcheck_status"}
+        assert status["edge"] == 1.0
+        assert status["missing"] == 0.0
+    finally:
+        gw.stop()
+
+
 def test_grafana_json_export(tmp_path):
     import json
 
