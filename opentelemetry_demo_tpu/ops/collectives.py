@@ -16,9 +16,14 @@ from jax import lax
 
 
 class Comm(NamedTuple):
-    """Axis names; ``None`` means that axis is not sharded."""
+    """Axis names; ``None`` means that axis is not sharded.
 
-    batch_axis: str | None = None
+    ``batch_axis`` may be a TUPLE of names on hybrid multi-host meshes
+    (``("dcn", "batch")``) — ``lax.psum``/``pmax`` reduce over all of
+    them at once, merging deltas across hosts and chips in one
+    collective."""
+
+    batch_axis: str | tuple[str, ...] | None = None
     sketch_axis: str | None = None
 
     def psum_batch(self, x: jnp.ndarray) -> jnp.ndarray:

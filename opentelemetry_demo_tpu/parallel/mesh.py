@@ -31,3 +31,61 @@ def make_mesh(
         )
     arr = np.asarray(devs[:use]).reshape(n_batch, n_sketch)
     return Mesh(arr, axis_names=("batch", "sketch"))
+
+
+def make_hybrid_mesh(
+    n_dcn: int | None = None,
+    n_batch: int | None = None,
+    n_sketch: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """3-axis ``("dcn", "batch", "sketch")`` mesh for multi-host scale.
+
+    The reference scales across hosts with Kafka consumer groups + k8s
+    replicas (SURVEY.md §2.3); here the cross-host analogue is an outer
+    ``dcn`` mesh axis: span batches shard over (dcn × batch) — each
+    host's runtime feeds its own slice — and the tiny sketch deltas
+    reduce over BOTH axes, so only KB-scale monoid merges cross the
+    data-center network while the wide batch data stays host-local
+    (ICI inside a pod, DCN between pods — the scaling-book layout).
+
+    On a real multi-host run, ``n_dcn`` defaults to
+    ``jax.process_count()`` and devices are grouped so the dcn axis
+    aligns with process boundaries (collectives inside ``batch``/
+    ``sketch`` then ride ICI only). Works identically on a virtual
+    single-host mesh for tests/dry runs.
+    """
+    devs = devices if devices is not None else jax.devices()
+    n_proc = jax.process_count()
+    if n_dcn is None:
+        n_dcn = max(n_proc, 1)
+    if n_batch is None:
+        n_batch = max(len(devs) // (n_dcn * n_sketch), 1)
+    use = n_dcn * n_batch * n_sketch
+    if use > len(devs):
+        raise ValueError(
+            f"hybrid mesh ({n_dcn} dcn × {n_batch} batch × {n_sketch} "
+            f"sketch) needs {use} devices, only {len(devs)} available"
+        )
+    if n_proc > 1:
+        # Real multi-host: the ICI/DCN promise only holds when the dcn
+        # axis IS the process axis and every process contributes its
+        # whole local block. Enforce it, and build via mesh_utils so
+        # device order matches the hardware topology.
+        per_proc = len(devs) // n_proc
+        if n_dcn != n_proc or n_batch * n_sketch != per_proc or use != len(devs):
+            raise ValueError(
+                f"multi-host hybrid mesh must use n_dcn == process_count "
+                f"({n_proc}) and batch×sketch == devices/process "
+                f"({per_proc}); got {n_dcn}×{n_batch}×{n_sketch}"
+            )
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, n_batch, n_sketch),
+            dcn_mesh_shape=(n_dcn, 1, 1),
+            devices=devs,
+        )
+        return Mesh(arr, axis_names=("dcn", "batch", "sketch"))
+    arr = np.asarray(devs[:use]).reshape(n_dcn, n_batch, n_sketch)
+    return Mesh(arr, axis_names=("dcn", "batch", "sketch"))

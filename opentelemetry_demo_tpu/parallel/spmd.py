@@ -86,7 +86,9 @@ def make_sharded_step(
     Returns ``(step_fn, state)``; ``step_fn(state, *batch_arrays, dt,
     rotate)`` matches the single-chip step's signature and semantics.
     Constraints: ``num_services`` and ``cms_depth`` must divide by the
-    sketch-axis size, the batch size by the batch-axis size.
+    sketch-axis size, and the batch size by the product of ALL
+    batch-sharding axes — ``mesh.shape["batch"]`` on a 2-D mesh,
+    ``mesh.shape["dcn"] * mesh.shape["batch"]`` on a hybrid mesh.
     """
     n_sketch = mesh.shape["sketch"]
     if config.num_services % n_sketch:
@@ -94,11 +96,19 @@ def make_sharded_step(
     if config.cms_depth % n_sketch:
         raise ValueError("cms_depth must divide by the sketch axis")
 
-    comm = Comm(batch_axis="batch", sketch_axis="sketch")
+    # Multi-host (hybrid) meshes carry an outer "dcn" axis: the span
+    # batch shards over (dcn × batch) and delta merges psum/pmax over
+    # both — lax collectives take axis-name tuples, so the same step
+    # serves 2-D single-pod and 3-D cross-pod meshes.
+    batch_axes: str | tuple = "batch"
+    if "dcn" in mesh.axis_names:
+        batch_axes = ("dcn", "batch")
+
+    comm = Comm(batch_axis=batch_axes, sketch_axis="sketch")
     local = partial(detector_step, config, comm=comm)
 
     state_specs = sharded_state_specs(config)
-    b = P("batch")
+    b = P(batch_axes)
     in_specs = (
         state_specs,
         b, b, b, b, b, b, b, b,  # svc, lat, err, t_hi, t_lo, a_hi, a_lo, valid
