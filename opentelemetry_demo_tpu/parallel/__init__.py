@@ -19,12 +19,13 @@ All collectives ride ICI inside a pod; the ``ring`` module provides the
 
 from ..ops.collectives import Comm, NO_COMM
 from .spmd import make_sharded_step, sharded_state_specs
-from .mesh import make_mesh
+from .mesh import make_hybrid_mesh, make_mesh
 from .ring import ring_merge_max, ring_merge_sum
 
 __all__ = [
     "Comm",
     "NO_COMM",
+    "make_hybrid_mesh",
     "make_mesh",
     "make_sharded_step",
     "sharded_state_specs",

@@ -17,6 +17,7 @@ from .tracestore import TraceStore
 from .tsdb import MetricTSDB, Scraper
 from .logstore import LogDoc, LogStore
 from .hostmetrics import HostMetricsReceiver
+from .receivers import HttpCheckReceiver, StoreStatsReceiver
 from . import dashboards
 
 __all__ = [
@@ -34,5 +35,7 @@ __all__ = [
     "LogDoc",
     "LogStore",
     "HostMetricsReceiver",
+    "HttpCheckReceiver",
+    "StoreStatsReceiver",
     "dashboards",
 ]

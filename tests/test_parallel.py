@@ -96,7 +96,7 @@ def test_hybrid_dcn_mesh_matches_single_chip(rng):
     8-device mesh is bit-exact on integer banks vs the single-chip step
     — the cross-pod scaling story (only KB-scale delta merges cross the
     dcn axis)."""
-    from opentelemetry_demo_tpu.parallel.mesh import make_hybrid_mesh
+    from opentelemetry_demo_tpu.parallel import make_hybrid_mesh
 
     config = DetectorConfig(num_services=8, cms_depth=4)
     mesh = make_hybrid_mesh(n_dcn=2, n_batch=2, n_sketch=2)
