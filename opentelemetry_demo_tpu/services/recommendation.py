@@ -34,9 +34,12 @@ class RecommendationService(ServiceBase):
         leak = bool(self.flag(FLAG_RECO_CACHE, False, ctx))
         extra_us = 0.0
         if leak:
-            # Each hit grows the "cache"; latency grows with it.
+            # Each hit grows the "cache"; latency grows with it. The
+            # reference's leak re-caches the whole catalog per request
+            # (recommendation_server.py:79-93), so growth is steep:
+            # a few dozen hits already multiply the base latency.
             self._cache_entries += 1
-            extra_us = min(self._cache_entries * 15.0, 50_000.0)
+            extra_us = min(self._cache_entries * 150.0, 50_000.0)
         else:
             self._cache_entries = 0
         products = self.catalog.list_products(ctx)

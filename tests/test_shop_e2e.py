@@ -128,6 +128,45 @@ class TestFaultScenarios:
         )
         assert flagged & {"frontend", "product-catalog", "currency"}, flagged
 
+    def test_recommendation_cache_leak_flags_recommendation(self):
+        """recommendationCacheFailure grows a leaked 'cache' so each hit
+        gets slower (reference recommendation_server.py:79-93) — a slow
+        latency ramp the z/CUSUM heads must catch."""
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "recommendationCacheFailure", True, "recommendation", "lat",
+            fault_s=180.0,
+        )
+        assert "recommendation" in flagged, flagged
+
+    def test_payment_unreachable_flags_money_path(self):
+        """paymentUnreachable fails every charge hard (reference
+        main.go:475-479 reroutes to a bad address)."""
+        shop, det, pipe, events, on_spans = make_rig(seed=7)
+        shop.run(150.0, on_spans)
+        n0 = len(events)
+
+        def charged_total():
+            counters, _ = shop.metrics.snapshot()
+            return sum(v for (n, _k), v in counters.items()
+                       if n == "app_payment_transactions_total")
+
+        before = charged_total()
+        shop.set_flag("paymentUnreachable", True)
+        shop.run(180.0, on_spans)
+        pipe.drain()
+        flagged = {s for _, f, _ in events[n0:] for s in f}
+        assert flagged & {"payment", "checkout", "frontend"}, flagged
+        # Every checkout during the fault failed: no new transactions.
+        assert charged_total() == before
+
+    def test_ad_manual_gc_flags_ad(self):
+        """adManualGc triggers full collections that stall ad responses
+        (reference GarbageCollectionTrigger.java)."""
+        shop, pipe, events, n0, flagged = self._run_fault(
+            "adManualGc", True, "ad", "lat", fault_s=120.0
+        )
+        assert "ad" in flagged, flagged
+
     def test_kafka_queue_problems_floods_consumers(self):
         shop, pipe, events, n0, flagged = self._run_fault(
             "kafkaQueueProblems", 40, "fraud-detection", "lat/rate",
