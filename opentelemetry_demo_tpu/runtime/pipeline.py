@@ -245,9 +245,11 @@ class DetectorPipeline:
     def _harvest_loop(self) -> None:
         """Background harvester: blocking readback off the pump thread.
 
-        Always takes the NEWEST in-flight report (older ones are
-        superseded — device state already includes them; CUSUM keeps
-        persistent anomalies sticky across skipped readbacks)."""
+        On the cadence path, takes the NEWEST in-flight report (older
+        ones are superseded — device state already includes them; CUSUM
+        keeps persistent anomalies sticky across skipped readbacks).
+        Under drain() (``_harvest_flush``), processes every remaining
+        report oldest-first — end-of-stream must not lose finals."""
         while True:
             self._harvest_wake.wait(timeout=0.05)
             self._harvest_wake.clear()
@@ -268,10 +270,16 @@ class DetectorPipeline:
                     if self._harvest_stop:
                         return
                     continue
-                while len(self._inflight) > 1:
-                    self._inflight.popleft()
-                    self.stats.reports_skipped += 1
-                item = self._inflight.pop()
+                # Cadence path: keep only the newest (older reports are
+                # superseded — device state already includes them). The
+                # drain path must NOT skip: end-of-stream harvests every
+                # remaining report oldest-first, matching sync-mode
+                # drain semantics.
+                if not self._harvest_flush:
+                    while len(self._inflight) > 1:
+                        self._inflight.popleft()
+                        self.stats.reports_skipped += 1
+                item = self._inflight.popleft()
                 self._harvest_idle.clear()
             self._last_harvest = time.monotonic()
             try:

@@ -249,7 +249,12 @@ class ShopGateway:
                 )
 
         if route.startswith("/otlp-http/"):
-            # Browser-telemetry seam; no shop lock needed (pure decode).
+            # Browser-telemetry seam. The decode is pure, but the fan-out
+            # mutates the same Collector state and detector pipeline that
+            # every other route touches under the lock — concurrent OTLP
+            # POSTs (ThreadingHTTPServer) would otherwise race the
+            # collector's flush-list swap and the pipeline's donated
+            # device buffers.
             if "json" in req_ctype:
                 records = otlp.decode_export_request_json(body)
             else:
@@ -257,9 +262,10 @@ class ShopGateway:
             if records:
                 # Same fan-out as server-side spans: detector feed AND
                 # the telemetry backend (trace store / spanmetrics).
-                if self.on_spans is not None:
-                    self.on_spans(time.monotonic() - self._t0, records)
-                self.shop.collector.receive_spans(records)
+                with self._lock:
+                    if self.on_spans is not None:
+                        self.on_spans(time.monotonic() - self._t0, records)
+                    self.shop.collector.receive_spans(records)
             return 200, "application/json", b"{}"
 
         if route.startswith("/ofrep/v1/evaluate/flags/"):

@@ -10,6 +10,7 @@ scrape-cadence pull receivers on a :class:`~.metrics.MetricRegistry`
 
 from __future__ import annotations
 
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -24,12 +25,22 @@ class HttpCheckReceiver:
     ``targets`` maps a name to either a URL (real HTTP GET, used when
     the gateway serves on a socket) or a zero-arg callable returning an
     HTTP status int (in-proc probing on the virtual clock).
+
+    URL targets are probed on a background thread and ``scrape()``
+    publishes the last completed result: the scraper runs inside
+    ``Shop.pump`` while the gateway holds its request lock, so a
+    blocking GET against an unreachable target would stall every locked
+    HTTP route for up to ``timeout_s`` per cycle. Callable targets stay
+    synchronous (in-proc, no network).
     """
 
     def __init__(self, registry: MetricRegistry | None = None, timeout_s: float = 5.0):
         self.registry = registry or MetricRegistry()
         self.timeout_s = timeout_s
         self._targets: dict[str, str | Callable[[], int]] = {}
+        self._url_lock = threading.Lock()
+        self._url_results: dict[str, tuple[int, float]] = {}
+        self._url_inflight: set[str] = set()
 
     def add_target(self, name: str, target: str | Callable[[], int]) -> None:
         self._targets[name] = target
@@ -48,9 +59,39 @@ class HttpCheckReceiver:
                 status = 0  # unreachable
         return status, (time.monotonic() - t0) * 1000.0
 
+    def _probe_url_async(self, name: str, target: str) -> None:
+        def run():
+            result = self._probe(target)
+            with self._url_lock:
+                self._url_results[name] = result
+                self._url_inflight.discard(name)
+
+        threading.Thread(
+            target=run, name=f"httpcheck-{name}", daemon=True
+        ).start()
+
     def scrape(self) -> None:
         for name, target in self._targets.items():
-            status, ms = self._probe(target)
+            if callable(target):
+                status, ms = self._probe(target)
+            else:
+                with self._url_lock:
+                    last = self._url_results.get(name)
+                    kick = name not in self._url_inflight
+                    if kick:
+                        self._url_inflight.add(name)
+                if kick:
+                    try:
+                        self._probe_url_async(name, target)
+                    except Exception:
+                        # A failed thread start must not wedge the
+                        # target in the inflight set forever.
+                        with self._url_lock:
+                            self._url_inflight.discard(name)
+                        raise
+                if last is None:
+                    continue  # first probe still in flight
+                status, ms = last
             ok = 1.0 if 200 <= status < 400 else 0.0
             # Status code is a VALUE, not a label: gauges keyed by a
             # changing code would leave the stale series (old code, old

@@ -8,6 +8,8 @@ metrics and logs in the backend stores.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import pytest
 
@@ -338,13 +340,20 @@ def test_httpcheck_receiver_real_http():
     gw = ShopGateway(shop, host="127.0.0.1", port=0)
     gw.start()
     try:
-        recv = HttpCheckReceiver()
+        recv = HttpCheckReceiver(timeout_s=2.0)
         recv.add_target("edge", f"http://127.0.0.1:{gw.port}/health")
         recv.add_target("missing", f"http://127.0.0.1:{gw.port}/no-such")
-        recv.scrape()
-        _, gauges = recv.registry.snapshot()
-        status = {dict(k)["endpoint"]: v for (n, k), v in gauges.items()
-                  if n == "httpcheck_status"}
+        # URL targets probe on a background thread (a blocking GET would
+        # stall the gateway lock): the first scrape kicks the probes,
+        # later scrapes publish the last completed result.
+        status = {}
+        deadline = time.monotonic() + 5.0
+        while len(status) < 2 and time.monotonic() < deadline:
+            recv.scrape()
+            _, gauges = recv.registry.snapshot()
+            status = {dict(k)["endpoint"]: v for (n, k), v in gauges.items()
+                      if n == "httpcheck_status"}
+            time.sleep(0.02)
         assert status["edge"] == 1.0
         assert status["missing"] == 0.0
     finally:
