@@ -15,6 +15,14 @@ from .detector import (
     detector_init,
     detector_step,
 )
+from .metrics_head import (
+    MetricsHead,
+    MetricsHeadConfig,
+    MetricsHeadReport,
+    MetricsHeadState,
+    metrics_head_init,
+    metrics_head_step,
+)
 from .windows import WindowClock
 
 __all__ = [
@@ -24,5 +32,11 @@ __all__ = [
     "DetectorState",
     "detector_init",
     "detector_step",
+    "MetricsHead",
+    "MetricsHeadConfig",
+    "MetricsHeadReport",
+    "MetricsHeadState",
+    "metrics_head_init",
+    "metrics_head_step",
     "WindowClock",
 ]

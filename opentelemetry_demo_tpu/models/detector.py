@@ -428,10 +428,15 @@ def detector_step(
     counts = comm.pmin_sketch(
         jax.vmap(cms.cms_query, in_axes=(0, None))(cms_bank[:, 0], cidx)
     ).astype(jnp.float32)  # [W#, B]
-    col = jax.lax.broadcasted_iota(jnp.int32, (svc.shape[0], s_axis), 1)
-    onehot = (col == svc[:, None]).astype(jnp.float32) * valid_f[:, None]  # [B,S]
+    # Per-service max via scatter-max: a dense [W#, B, S] one-hot product
+    # would materialise ~200 MB at B=512k — the scatter keeps the
+    # intermediate at the output's size. Lanes with svc == s_axis
+    # (out-of-slice) land in the sacrificial last column; invalid lanes
+    # contribute 0, the identity for non-negative counts.
     per_svc_max = comm.pmax_batch(
-        jnp.max(counts[:, :, None] * onehot[None, :, :], axis=1)
+        jnp.zeros((counts.shape[0], s_axis + 1), jnp.float32)
+        .at[:, svc]
+        .max(counts * valid_f[None, :])[:, :s_axis]
     )  # [W#, S]
     hh_ratio = (per_svc_max / jnp.maximum(span_total[:, 0], 1.0)[:, None]).T
 

@@ -1,0 +1,132 @@
+"""Metrics detection head: EWMA z-scores over per-service metric rates.
+
+The span detector (models.detector) watches the trace stream; this head
+watches the OTLP *metrics* stream the collector exports beside it
+(/root/reference/src/otel-collector/otelcol-config.yml:124-126) — counter
+rates (requests, errors, queue depth deltas) and gauge levels per
+service. Same design idiom as the span heads: one donated pytree, one
+jitted straight-line step, static ``[S, M, T]`` shapes, masked updates
+for unobserved cells — so the same program serves every scrape cadence.
+
+The observation model is simpler than the span path's (points arrive at
+scrape cadence, already aggregated), so the state is just debiased EWMA
+mean/variance per (service, metric) at T timescales, with a relative +
+absolute variance floor: counter rates are bursty and a freshly-warm
+cell must not alarm on scrape jitter.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MetricsHeadConfig(NamedTuple):
+    """Static shapes/thresholds (closed over at jit time)."""
+
+    num_services: int = 32
+    num_metrics: int = 32  # interned metric-name slots (beyond: dropped)
+    taus_s: tuple[float, ...] = (10.0, 60.0, 300.0)  # scrape-cadence scales
+    z_threshold: float = 6.0
+    warmup_obs: float = 8.0  # observations before a cell may alarm
+    rel_floor: float = 0.10  # σ floor as a fraction of the mean
+    abs_floor: float = 1.0  # absolute σ² floor (rate units²)
+
+    @property
+    def num_taus(self) -> int:
+        return len(self.taus_s)
+
+
+class MetricsHeadState(NamedTuple):
+    mean: jnp.ndarray  # float32[S, M, T]
+    var: jnp.ndarray  # float32[S, M, T]
+    obs: jnp.ndarray  # float32[S, M] — observations seen per cell
+    step_idx: jnp.ndarray  # int32[]
+
+
+class MetricsHeadReport(NamedTuple):
+    z: jnp.ndarray  # float32[S, M, T]
+    cell_flags: jnp.ndarray  # bool[S, M] — any timescale over threshold
+    flags: jnp.ndarray  # bool[S] — any metric over threshold
+
+
+def metrics_head_init(config: MetricsHeadConfig) -> MetricsHeadState:
+    s, m, t = config.num_services, config.num_metrics, config.num_taus
+    return MetricsHeadState(
+        mean=jnp.zeros((s, m, t), jnp.float32),
+        var=jnp.zeros((s, m, t), jnp.float32),
+        obs=jnp.zeros((s, m), jnp.float32),
+        step_idx=jnp.zeros((), jnp.int32),
+    )
+
+
+def metrics_head_step(
+    config: MetricsHeadConfig,
+    state: MetricsHeadState,
+    x: jnp.ndarray,  # float32[S, M] — rate/level observations
+    observed: jnp.ndarray,  # bool[S, M] — which cells saw data
+    dt: jnp.ndarray,  # float32[] — seconds since previous step
+) -> tuple[MetricsHeadState, MetricsHeadReport]:
+    """One EWMA z step; jit with ``donate_argnums=1``.
+
+    z is computed against the *prior* state, then the state absorbs the
+    observation (West's update), mirroring ops.ewma.ewma_update — which
+    isn't reused directly because the variance floor here is
+    level-relative, not constant.
+    """
+    x = x.astype(jnp.float32)[:, :, None]  # [S, M, 1]
+    obs3 = observed.astype(jnp.bool_)[:, :, None]  # [S, M, 1]
+    taus = jnp.asarray(config.taus_s, jnp.float32)  # [T]
+    # Debiased smoothing (the span heads' trick): until a cell has seen
+    # ~1/α observations, use the running-average weight instead.
+    alpha = jnp.maximum(
+        1.0 - jnp.exp(-jnp.maximum(dt, 1e-3) / taus),  # [T]
+        1.0 / (state.obs[:, :, None] + 1.0),  # [S, M, 1]
+    )  # [S, M, T]
+
+    delta = x - state.mean
+    floor2 = (config.rel_floor * state.mean) ** 2 + config.abs_floor
+    z = delta / jnp.sqrt(state.var + floor2)
+    warm = (state.obs < config.warmup_obs)[:, :, None]
+    z = jnp.where(obs3 & ~warm, z, 0.0)
+
+    new_mean = jnp.where(obs3, state.mean + alpha * delta, state.mean)
+    new_var = jnp.where(
+        obs3,
+        (1.0 - alpha) * (state.var + alpha * delta * delta),
+        state.var,
+    )
+    obs = state.obs + observed.astype(jnp.float32)
+
+    cell_flags = jnp.any(jnp.abs(z) > config.z_threshold, axis=2)  # [S, M]
+    flags = jnp.any(cell_flags, axis=1)  # [S]
+    new_state = MetricsHeadState(
+        mean=new_mean, var=new_var, obs=obs, step_idx=state.step_idx + 1
+    )
+    return new_state, MetricsHeadReport(z=z, cell_flags=cell_flags, flags=flags)
+
+
+class MetricsHead:
+    """Host-side driver: owns state + the compiled step."""
+
+    def __init__(self, config: MetricsHeadConfig | None = None):
+        self.config = config or MetricsHeadConfig()
+        self.state = metrics_head_init(self.config)
+        self._step = jax.jit(
+            partial(metrics_head_step, self.config), donate_argnums=0
+        )
+
+    def observe(
+        self, x: np.ndarray, observed: np.ndarray, dt: float
+    ) -> MetricsHeadReport:
+        self.state, report = self._step(
+            self.state,
+            jnp.asarray(x, jnp.float32),
+            jnp.asarray(observed, bool),
+            jnp.float32(dt),
+        )
+        return report

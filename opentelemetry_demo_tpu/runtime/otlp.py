@@ -154,14 +154,21 @@ def decode_export_request_columnar(payload: bytes):
 
 
 class OtlpHttpReceiver:
-    """Threaded OTLP/HTTP trace receiver feeding a callback.
+    """Threaded OTLP/HTTP receiver feeding callbacks, one per signal.
 
-    ``on_records`` is called from the server thread with each request's
-    decoded SpanRecords; the callback enqueues into the pipeline (which
-    owns batching/tensorization on its own thread). When ``on_columnar``
-    is provided and the native decoder is available, protobuf bodies
-    skip Python record objects entirely: C++ wire decode → columnar
-    arrays → ``on_columnar`` (the pipeline's fast path).
+    ``POST /v1/traces`` (and any unrecognised path, for compatibility)
+    decodes spans: ``on_records`` is called from the server thread with
+    each request's SpanRecords; the callback enqueues into the pipeline
+    (which owns batching/tensorization on its own thread). When
+    ``on_columnar`` is provided and the native decoder is available,
+    protobuf bodies skip Python record objects entirely: C++ wire decode
+    → columnar arrays → ``on_columnar`` (the pipeline's fast path).
+
+    ``POST /v1/metrics`` decodes OTLP metrics/v1 (runtime.otlp_metrics)
+    into ``on_metric_records`` — the collector's metrics-pipeline leg
+    (otelcol-config.yml:124-126). Absent the callback, metric exports
+    are acknowledged and dropped (an ingest-side null sink, matching a
+    collector with no metrics pipeline configured).
     """
 
     def __init__(
@@ -170,6 +177,7 @@ class OtlpHttpReceiver:
         host: str = "0.0.0.0",
         port: int = 4318,
         on_columnar: Callable | None = None,
+        on_metric_records: Callable | None = None,
     ):
         receiver = self
 
@@ -177,9 +185,23 @@ class OtlpHttpReceiver:
             def do_POST(self):  # noqa: N802 (http.server API)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                is_json = "json" in (self.headers.get("Content-Type") or "")
+                path = self.path.split("?", 1)[0]
                 columnar = None
+                metric_records = None
                 try:
-                    if "json" in (self.headers.get("Content-Type") or ""):
+                    if path.endswith("/v1/metrics"):
+                        from . import otlp_metrics
+
+                        if is_json:
+                            metric_records = (
+                                otlp_metrics.decode_metrics_request_json(body)
+                            )
+                        else:
+                            metric_records = (
+                                otlp_metrics.decode_metrics_request(body)
+                            )
+                    elif is_json:
                         records = decode_export_request_json(body)
                     elif receiver.on_columnar is not None:
                         columnar = decode_export_request_columnar(body)
@@ -199,20 +221,24 @@ class OtlpHttpReceiver:
                     self.send_response(400)
                     self.end_headers()
                     return
-                if columnar is not None:
+                if metric_records is not None:
+                    if receiver.on_metric_records is not None:
+                        receiver.on_metric_records(metric_records)
+                elif columnar is not None:
                     receiver.on_columnar(columnar)
                 else:
                     receiver.on_records(records)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-protobuf")
                 self.end_headers()
-                self.wfile.write(b"")  # empty ExportTraceServiceResponse
+                self.wfile.write(b"")  # empty Export*ServiceResponse
 
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
 
         self.on_records = on_records
         self.on_columnar = on_columnar
+        self.on_metric_records = on_metric_records
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="otlp-receiver", daemon=True

@@ -1,0 +1,406 @@
+"""OTLP/HTTP metrics ingestion + export: the collector's second leg.
+
+The reference collector runs a full metrics pipeline beside traces
+(/root/reference/src/otel-collector/otelcol-config.yml:124-126, receivers
+:4-23) and every SDK exports OTLP metrics into it. The detector sidecar
+therefore consumes BOTH streams: ``POST /v1/traces`` (runtime.otlp) and
+``POST /v1/metrics`` (this module), turning metric points into per-service
+rate/level observations for the metrics detection head
+(models.metrics_head).
+
+Field numbers follow the public OTLP protocol (opentelemetry-proto
+metrics/v1): ExportMetricsServiceRequest{resource_metrics=1},
+ResourceMetrics{resource=1, scope_metrics=2}, Resource{attributes=1},
+ScopeMetrics{metrics=2}, Metric{name=1, unit=3, gauge=5, sum=7,
+histogram=9}, Gauge{data_points=1}, Sum{data_points=1,
+aggregation_temporality=2, is_monotonic=3}, Histogram{data_points=1,
+aggregation_temporality=2}, NumberDataPoint{start_time_unix_nano=2,
+time_unix_nano=3, as_double=4, as_int=6},
+HistogramDataPoint{start_time_unix_nano=2, time_unix_nano=3, count=4,
+sum=5, bucket_counts=6, explicit_bounds=7}.
+
+The module also *encodes* ``ExportMetricsServiceRequest`` from a
+:class:`~..telemetry.metrics.MetricRegistry` snapshot — that is the
+collector-side ``otlphttp`` metrics exporter (otelcol-config.yml:124-126
+wires `otlphttp/prometheus`; here the registry IS the metric source), so
+the sidecar's wire e2e is collector registry → protobuf → HTTP →
+receiver → detector head.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Iterable, NamedTuple
+
+from . import wire
+
+# AggregationTemporality enum (metrics/v1).
+TEMPORALITY_UNSPECIFIED = 0
+TEMPORALITY_DELTA = 1
+TEMPORALITY_CUMULATIVE = 2
+
+
+class MetricRecord(NamedTuple):
+    """One ingested metric data point, projected to the detector's needs.
+
+    ``kind`` ∈ {"gauge", "sum"}; histogram points are projected to two
+    sum records (``{name}_count``, ``{name}_sum``) matching the
+    Prometheus naming the rest of the stack uses.
+    """
+
+    service: str
+    name: str
+    value: float
+    kind: str = "sum"
+    monotonic: bool = True
+    temporality: int = TEMPORALITY_CUMULATIVE
+    time_unix_nano: int = 0
+
+
+def _u64_to_double(raw: int) -> float:
+    return struct.unpack("<d", raw.to_bytes(8, "little"))[0]
+
+
+def _u64_to_i64(raw: int) -> int:
+    return raw - (1 << 64) if raw >= (1 << 63) else raw
+
+
+def _number_point_value(buf: bytes) -> tuple[float | None, int]:
+    """NumberDataPoint → (value, time_unix_nano); value None if absent."""
+    dp = wire.scan_fields(buf)
+    t = int(wire.first(dp, 3, 0) or 0)
+    raw_d = wire.first(dp, 4)
+    if raw_d is not None:
+        return _u64_to_double(int(raw_d)), t
+    raw_i = wire.first(dp, 6)
+    if raw_i is not None:
+        return float(_u64_to_i64(int(raw_i))), t
+    return None, t
+
+
+def _service_of_resource(rm: dict) -> str:
+    res_buf = wire.first(rm, 1)
+    if res_buf:
+        res = wire.scan_fields(res_buf)
+        for kv_buf in res.get(1, []):
+            kv = wire.scan_fields(kv_buf)
+            if wire.first(kv, 1) == b"service.name":
+                val_buf = wire.first(kv, 2)
+                if isinstance(val_buf, bytes):
+                    sv = wire.first(wire.scan_fields(val_buf), 1)
+                    if isinstance(sv, bytes):
+                        return sv.decode("utf-8", "replace")
+    return "unknown"
+
+
+def decode_metrics_request(payload: bytes) -> list[MetricRecord]:
+    """ExportMetricsServiceRequest protobuf → MetricRecords."""
+    records: list[MetricRecord] = []
+    req = wire.scan_fields(payload)
+    for rm_buf in req.get(1, []):
+        rm = wire.scan_fields(rm_buf)
+        service = _service_of_resource(rm)
+        for sm_buf in rm.get(2, []):
+            sm = wire.scan_fields(sm_buf)
+            for m_buf in sm.get(2, []):
+                _decode_metric(m_buf, service, records)
+    return records
+
+
+def _decode_metric(m_buf: bytes, service: str, out: list[MetricRecord]) -> None:
+    m = wire.scan_fields(m_buf)
+    name_raw = wire.first(m, 1, b"")
+    name = name_raw.decode("utf-8", "replace") if isinstance(name_raw, bytes) else ""
+    gauge_buf = wire.first(m, 5)
+    sum_buf = wire.first(m, 7)
+    hist_buf = wire.first(m, 9)
+    if gauge_buf:
+        g = wire.scan_fields(gauge_buf)
+        for dp_buf in g.get(1, []):
+            val, t = _number_point_value(dp_buf)
+            if val is not None:
+                out.append(MetricRecord(service, name, val, kind="gauge",
+                                        monotonic=False,
+                                        temporality=TEMPORALITY_UNSPECIFIED,
+                                        time_unix_nano=t))
+    elif sum_buf:
+        s = wire.scan_fields(sum_buf)
+        temporality = int(wire.first(s, 2, 0) or 0)
+        monotonic = bool(wire.first(s, 3, 0) or 0)
+        for dp_buf in s.get(1, []):
+            val, t = _number_point_value(dp_buf)
+            if val is not None:
+                out.append(MetricRecord(service, name, val, kind="sum",
+                                        monotonic=monotonic,
+                                        temporality=temporality,
+                                        time_unix_nano=t))
+    elif hist_buf:
+        h = wire.scan_fields(hist_buf)
+        temporality = int(wire.first(h, 2, 0) or 0)
+        for dp_buf in h.get(1, []):
+            dp = wire.scan_fields(dp_buf)
+            t = int(wire.first(dp, 3, 0) or 0)
+            count = wire.first(dp, 4)
+            total = wire.first(dp, 5)
+            if count is not None:
+                out.append(MetricRecord(service, name + "_count", float(int(count)),
+                                        kind="sum", monotonic=True,
+                                        temporality=temporality,
+                                        time_unix_nano=t))
+            if total is not None:
+                out.append(MetricRecord(service, name + "_sum",
+                                        _u64_to_double(int(total)),
+                                        kind="sum", monotonic=True,
+                                        temporality=temporality,
+                                        time_unix_nano=t))
+
+
+def decode_metrics_request_json(payload: bytes) -> list[MetricRecord]:
+    """JSON-encoded OTLP metrics (the collector's otlphttp json mode)."""
+    doc = json.loads(payload)
+    records: list[MetricRecord] = []
+    temp_enum = {
+        "AGGREGATION_TEMPORALITY_DELTA": TEMPORALITY_DELTA,
+        "AGGREGATION_TEMPORALITY_CUMULATIVE": TEMPORALITY_CUMULATIVE,
+    }
+
+    def point_value(dp: dict) -> float | None:
+        if "asDouble" in dp:
+            return float(dp["asDouble"])
+        if "asInt" in dp:
+            return float(int(dp["asInt"]))
+        return None
+
+    for rm in doc.get("resourceMetrics", []):
+        service = "unknown"
+        for attr in rm.get("resource", {}).get("attributes", []):
+            if attr.get("key") == "service.name":
+                service = attr.get("value", {}).get("stringValue", service)
+        for sm in rm.get("scopeMetrics", []):
+            for m in sm.get("metrics", []):
+                name = m.get("name", "")
+                if "gauge" in m:
+                    for dp in m["gauge"].get("dataPoints", []):
+                        val = point_value(dp)
+                        if val is not None:
+                            records.append(MetricRecord(
+                                service, name, val, kind="gauge",
+                                monotonic=False,
+                                temporality=TEMPORALITY_UNSPECIFIED,
+                                time_unix_nano=int(dp.get("timeUnixNano", 0))))
+                elif "sum" in m:
+                    s = m["sum"]
+                    raw_t = s.get("aggregationTemporality", 0)
+                    temporality = (
+                        int(raw_t) if isinstance(raw_t, int)
+                        else temp_enum.get(raw_t, 0)
+                    )
+                    for dp in s.get("dataPoints", []):
+                        val = point_value(dp)
+                        if val is not None:
+                            records.append(MetricRecord(
+                                service, name, val, kind="sum",
+                                monotonic=bool(s.get("isMonotonic", False)),
+                                temporality=temporality,
+                                time_unix_nano=int(dp.get("timeUnixNano", 0))))
+                elif "histogram" in m:
+                    h = m["histogram"]
+                    raw_t = h.get("aggregationTemporality", 0)
+                    temporality = (
+                        int(raw_t) if isinstance(raw_t, int)
+                        else temp_enum.get(raw_t, 0)
+                    )
+                    for dp in h.get("dataPoints", []):
+                        t = int(dp.get("timeUnixNano", 0))
+                        if "count" in dp:
+                            records.append(MetricRecord(
+                                service, name + "_count",
+                                float(int(dp["count"])), kind="sum",
+                                monotonic=True, temporality=temporality,
+                                time_unix_nano=t))
+                        if "sum" in dp:
+                            records.append(MetricRecord(
+                                service, name + "_sum", float(dp["sum"]),
+                                kind="sum", monotonic=True,
+                                temporality=temporality, time_unix_nano=t))
+    return records
+
+
+# --- encoding: registry snapshot → ExportMetricsServiceRequest ---------
+
+
+def _encode_string_attr(field_no: int, key: str, value: str) -> bytes:
+    any_value = wire.encode_len(1, value.encode())
+    kv = wire.encode_len(1, key.encode()) + wire.encode_len(2, any_value)
+    return wire.encode_len(field_no, kv)
+
+
+def _encode_number_point(value: float, t_ns: int, start_ns: int = 0) -> bytes:
+    dp = b""
+    if start_ns:
+        dp += wire.encode_fixed64(2, start_ns)
+    dp += wire.encode_fixed64(3, t_ns)
+    dp += wire.encode_double(4, float(value))
+    return dp
+
+
+def encode_metrics_request(
+    service_metrics: Iterable[tuple[str, Iterable[tuple[str, float, bool]]]],
+    t_ns: int,
+    start_ns: int = 0,
+) -> bytes:
+    """Build an ExportMetricsServiceRequest.
+
+    ``service_metrics`` yields ``(service_name, [(metric_name, value,
+    is_counter), ...])``; counters encode as cumulative monotonic Sums,
+    the rest as Gauges. One resource per service, one scope per
+    resource — the shape every OTLP SDK produces.
+    """
+    rms = b""
+    for service, metrics in service_metrics:
+        resource = _encode_string_attr(1, "service.name", service)
+        ms = b""
+        for name, value, is_counter in metrics:
+            point = wire.encode_len(1, _encode_number_point(value, t_ns, start_ns))
+            if is_counter:
+                body = (
+                    point
+                    + wire.encode_int(2, TEMPORALITY_CUMULATIVE)
+                    + wire.encode_int(3, 1)  # is_monotonic
+                )
+                metric = wire.encode_len(1, name.encode()) + wire.encode_len(7, body)
+            else:
+                metric = wire.encode_len(1, name.encode()) + wire.encode_len(
+                    5, point
+                )
+            ms += wire.encode_len(2, metric)
+        rm = wire.encode_len(1, resource)
+        if ms:
+            # One ScopeMetrics submessage whose repeated `metrics`
+            # fields are ``ms``.
+            rm += wire.encode_len(2, ms)
+        rms += wire.encode_len(1, rm)
+    return rms
+
+
+def registry_to_request(
+    jobs: Iterable[tuple[str, "object"]], t_ns: int, start_ns: int = 0
+) -> bytes:
+    """Encode (job, MetricRegistry) pairs — label sets fold by summing.
+
+    Per-label-set series of one counter collapse into one per-service
+    total (counter rates are what the detection head consumes; label
+    cardinality stays host-side in the TSDB). Gauges fold by max — for
+    up/status gauges a max is the natural disjunction.
+    """
+    payload = []
+    for job, registry in jobs:
+        counters, gauges = registry.snapshot()
+        folded: dict[str, float] = {}
+        for (name, _labels), value in counters.items():
+            folded[name] = folded.get(name, 0.0) + value
+        rows = [(name, value, True) for name, value in sorted(folded.items())]
+        gfold: dict[str, float] = {}
+        for (name, _labels), value in gauges.items():
+            gfold[name] = max(gfold.get(name, float("-inf")), value)
+        rows += [(name, value, False) for name, value in sorted(gfold.items())]
+        payload.append((job, rows))
+    return encode_metrics_request(payload, t_ns, start_ns)
+
+
+class OtlpHttpMetricsExporter:
+    """POSTs registry snapshots to an OTLP/HTTP ``/v1/metrics`` endpoint.
+
+    Subscribe on ``Collector.metrics_exporters``: called after each
+    scrape cycle with the scraped (job, registry) pairs, it serialises
+    one ExportMetricsServiceRequest and enqueues it for a background
+    sender thread — ``Collector.pump`` often runs under the gateway's
+    request lock, so the network POST must never block the caller (the
+    reference collector's sending_queue decouples the same way). The
+    bounded queue drops OLDEST on overflow: snapshots are cumulative, so
+    a later export supersedes a lost one. Failures count, not raise.
+    """
+
+    def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 16):
+        import collections
+
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/metrics"):
+            self.endpoint += "/v1/metrics"
+        self.timeout_s = timeout_s
+        self.sent = 0
+        self.errors = 0
+        self.dropped = 0
+        self._queue: "collections.deque[bytes]" = collections.deque()
+        self._queue_max = queue_max
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def __call__(self, now: float, jobs: list) -> None:
+        body = registry_to_request(jobs, t_ns=int(now * 1e9))
+        with self._lock:
+            self._queue.append(body)
+            while len(self._queue) > self._queue_max:
+                self._queue.popleft()
+                self.dropped += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._send_loop, name="otlp-metrics-export", daemon=True
+                )
+                self._thread.start()
+        self._wake.set()
+
+    def _send_loop(self) -> None:
+        import urllib.request
+
+        while True:
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        self._idle.set()
+                        if self._stop:
+                            return
+                        break
+                    self._idle.clear()
+                    body = self._queue.popleft()
+                req = urllib.request.Request(
+                    self.endpoint,
+                    data=body,
+                    headers={"Content-Type": "application/x-protobuf"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=self.timeout_s):
+                        self.sent += 1
+                except Exception:
+                    self.errors += 1
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until the queue is empty (tests / shutdown)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                empty = not self._queue
+            if empty and self._idle.is_set():
+                return True
+            self._wake.set()
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout=self.timeout_s + 1.0)

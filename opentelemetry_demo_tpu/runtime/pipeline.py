@@ -200,17 +200,20 @@ class DetectorPipeline:
 
     def drain(self) -> None:
         """Harvest all in-flight reports (end of stream / shutdown)."""
-        while self._pending:
-            self.pump()
-        if self.harvest_async:
-            self._harvest_flush = True
-            try:
+        # Raise the flush flag BEFORE pumping the backlog: the async
+        # harvester must not cadence-skip reports dispatched during the
+        # drain itself.
+        self._harvest_flush = True
+        try:
+            while self._pending:
+                self.pump()
+            if self.harvest_async:
                 self._drain_async()
-            finally:
-                self._harvest_flush = False
-        else:
-            while self._harvest_one(keep=0):
-                pass
+            else:
+                while self._harvest_one(keep=0):
+                    pass
+        finally:
+            self._harvest_flush = False
 
     def _drain_async(self) -> None:
         while True:

@@ -110,6 +110,12 @@ class Collector:
         self.exemplars: dict[tuple[str, str], deque[Exemplar]] = {}
         # Extra trace-batch subscribers — the anomaly-detector seam.
         self.trace_exporters: list[Callable[[float, list[SpanRecord]], None]] = []
+        # Metrics-pipeline subscribers, invoked after each scrape cycle
+        # with the scraped (job, registry) pairs — the otlphttp metrics
+        # exporter seam (otelcol-config.yml:124-126): the anomaly
+        # sidecar's /v1/metrics leg subscribes here, in-proc or over
+        # HTTP via runtime.otlp_metrics.OtlpHttpMetricsExporter.
+        self.metrics_exporters: list[Callable[[float, list], None]] = []
         self._pending_spans: list[SpanRecord] = []
         self._last_batch_flush: float | None = None
         self._last_self_report: float | None = None
@@ -199,7 +205,10 @@ class Collector:
             or now - self._last_batch_flush >= self.config.batch_timeout_s
         ):
             self._flush_spans(now)
-        self.scraper.maybe_scrape(now)
+        if self.scraper.maybe_scrape(now) and self.metrics_exporters:
+            jobs = self.scraper.targets()
+            for exporter in self.metrics_exporters:
+                exporter(now, jobs)
 
     def _flush_spans(self, now: float) -> None:
         batch, self._pending_spans = self._pending_spans, []

@@ -164,6 +164,45 @@ ANOMALY_CARDINALITY = "app_anomaly_distinct_traces"
 ANOMALY_HEAVY_HITTER = "app_anomaly_heavy_hitter_ratio"
 ANOMALY_SPANS_TOTAL = "app_anomaly_spans_processed_total"
 ANOMALY_LAG_P99 = "app_anomaly_detection_lag_p99_ms"
+# The metrics-ingestion leg (OTLP /v1/metrics → metrics head).
+ANOMALY_METRIC_Z = "app_anomaly_metric_z_score"
+ANOMALY_METRIC_FLAG_TOTAL = "app_anomaly_metric_flags_total"
+ANOMALY_METRIC_POINTS_TOTAL = "app_anomaly_metric_points_processed_total"
+
+
+def export_metrics_report(
+    registry: MetricRegistry,
+    service_names: list[str],
+    metric_names: list[str],
+    report,
+    flagged: list[str],
+    seen: set | None = None,
+) -> None:
+    """Publish one MetricsHeadReport into the registry (host-side).
+
+    ``seen`` (caller-owned, persisted across reports) tracks which
+    (service, metric) series were ever exported: quiet cells never mint
+    a series, but a series that HAS been minted keeps updating — its z
+    masks to 0 when the stream stops, and freezing the last anomalous
+    value on the Prometheus surface would show a permanent incident.
+    """
+    import numpy as np
+
+    z = np.asarray(report.z)  # [S, M, T]
+    cell = np.asarray(report.cell_flags)  # [S, M]
+    for i, sname in enumerate(service_names[: z.shape[0]]):
+        zi = np.abs(z[i]).max(axis=1)  # [M]
+        for j, mname in enumerate(metric_names[: z.shape[1]]):
+            key = (sname, mname)
+            minted = seen is not None and key in seen
+            if zi[j] > 0.0 or cell[i, j] or minted:
+                registry.gauge_set(
+                    ANOMALY_METRIC_Z, float(zi[j]), service=sname, metric=mname
+                )
+                if seen is not None:
+                    seen.add(key)
+    for name in flagged:
+        registry.counter_add(ANOMALY_METRIC_FLAG_TOTAL, 1.0, service=name)
 
 
 def export_report(

