@@ -16,6 +16,8 @@ with half a config is worse than one that refuses to boot.
 Env contract (all optional, sensible defaults):
 
 - ``ANOMALY_OTLP_PORT``      OTLP/HTTP listen port (default 4318)
+- ``ANOMALY_OTLP_GRPC_PORT`` OTLP/gRPC listen port (default 4317, the
+                             collector's primary ingress; -1 disables)
 - ``ANOMALY_METRICS_PORT``   Prometheus listen port (default 9464)
 - ``ANOMALY_BATCH``          device batch size (default 2048)
 - ``ANOMALY_HARVEST_INTERVAL``  report readback cadence seconds (default 0
@@ -40,6 +42,7 @@ from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
 from . import checkpoint
+from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
 
@@ -85,9 +88,15 @@ class DetectorDaemon:
             flags = FlagEvaluator()
 
         config = config or DetectorConfig()
+        restored_offsets: dict = {}
         if self.ckpt_path and checkpoint.exists(self.ckpt_path):
             self.detector, meta = checkpoint.load(self.ckpt_path, config)
             restored_names = meta.get("service_names", [])
+            # JSON round-trips partition keys as strings; offsets are
+            # keyed by int partition everywhere else.
+            restored_offsets = {
+                int(p): int(o) for p, o in meta.get("offsets", {}).items()
+            }
         else:
             self.detector = AnomalyDetector(config)
             restored_names = []
@@ -114,11 +123,40 @@ class DetectorDaemon:
         for name in restored_names:  # re-intern in checkpoint order
             self.pipeline.tensorizer.service_id(name)
 
+        # The OTLP metrics leg: /v1/metrics → feed → metrics head. The
+        # feed keeps its OWN service table: results join on service NAME
+        # at the export surface, and sharing the span tensorizer's table
+        # would let metric-only scrape jobs (kafka, node exporters, …)
+        # exhaust the span detector's service slots.
+        from ..models.metrics_head import MetricsHeadConfig
+
+        self.metrics_feed = MetricsFeed(
+            MetricsHeadConfig(num_services=config.num_services),
+            on_report=self._on_metrics_report,
+        )
+        self._metric_series_seen: set[tuple[str, str]] = set()
         self.receiver = OtlpHttpReceiver(
             self.pipeline.submit,
             port=self.otlp_port,
             on_columnar=self.pipeline.submit_columnar,
+            on_metric_records=self.metrics_feed.submit,
         )
+        # OTLP/gRPC :4317 — the reference collector's primary ingress
+        # (otelcol-config.yml:5-8); every SDK defaults to gRPC export.
+        self.grpc_receiver = None
+        grpc_port = _env_int("ANOMALY_OTLP_GRPC_PORT", 4317)
+        if grpc_port >= 0:
+            try:
+                from .otlp_grpc import OtlpGrpcReceiver
+
+                self.grpc_receiver = OtlpGrpcReceiver(
+                    self.pipeline.submit,
+                    port=grpc_port,
+                    on_columnar=self.pipeline.submit_columnar,
+                    on_metric_records=self.metrics_feed.submit,
+                )
+            except ImportError:  # grpcio absent: HTTP leg still serves
+                self.grpc_receiver = None
         self.exporter = tele_metrics.PrometheusExporter(
             self.registry, port=self.metrics_port
         )
@@ -128,7 +166,12 @@ class DetectorDaemon:
             from .kafka_orders import OrdersSource  # gated import
 
             self._orders = OrdersSource(kafka_addr)
-        self._offsets: dict = {}
+            if restored_offsets:
+                # The snapshot's offsets win over broker-committed ones:
+                # sketch state corresponds to THEM (checkpoint.py module
+                # docstring — replay past the snapshot double-counts).
+                self._orders.seek(restored_offsets)
+        self._offsets: dict = dict(restored_offsets)
         self._stop = threading.Event()
         self._last_ckpt = time.monotonic()
 
@@ -146,10 +189,29 @@ class DetectorDaemon:
         )
         self._spans_seen = self.pipeline.stats.spans
 
+    def _on_metrics_report(self, t_batch, report) -> None:
+        names = self.metrics_feed.service_names
+        flagged = self.metrics_feed.flagged_services(report, names)
+        tele_metrics.export_metrics_report(
+            self.registry,
+            names,
+            self.metrics_feed.metric_slot_names(),
+            report,
+            flagged,
+            seen=self._metric_series_seen,
+        )
+        self.registry.counter_add(
+            tele_metrics.ANOMALY_METRIC_POINTS_TOTAL,
+            float(self.metrics_feed.points_total - getattr(self, "_points_seen", 0)),
+        )
+        self._points_seen = self.metrics_feed.points_total
+
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
         self.receiver.start()
+        if self.grpc_receiver is not None:
+            self.grpc_receiver.start()
         self.exporter.start()
 
     def step(self, t_now: float | None = None) -> None:
@@ -159,6 +221,7 @@ class DetectorDaemon:
                 self._offsets.update(offsets)
                 self.pipeline.submit([record])
         self.pipeline.pump(t_now)
+        self.metrics_feed.pump(time.monotonic() if t_now is None else t_now)
         if (
             self.ckpt_path
             and time.monotonic() - self._last_ckpt >= self.ckpt_interval_s
@@ -188,6 +251,10 @@ class DetectorDaemon:
 
     def shutdown(self) -> None:
         self.receiver.stop()
+        if self.grpc_receiver is not None:
+            self.grpc_receiver.stop()
+        if self._orders is not None:
+            self._orders.close()
         self.pipeline.close()  # drain + stop the harvester thread if any
         if self.ckpt_path:
             self._checkpoint()

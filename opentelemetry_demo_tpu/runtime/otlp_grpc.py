@@ -1,0 +1,134 @@
+"""OTLP/gRPC receiver (:4317): the collector's primary telemetry ingress.
+
+The reference collector listens for OTLP gRPC first and HTTP second
+(/root/reference/src/otel-collector/otelcol-config.yml:5-8), and every
+reference SDK defaults to gRPC export — so the sidecar speaks it too.
+The transport is grpcio with *generic* raw-bytes handlers: no generated
+stubs, no proto runtime on the hot path — request bytes go straight into
+the same hand-rolled wire decoders the HTTP receiver uses
+(runtime.otlp / runtime.otlp_metrics), and the response is the empty
+Export*ServiceResponse (zero bytes is a valid empty proto3 message).
+
+Service/method names are the public OTLP protocol's:
+``opentelemetry.proto.collector.{trace,metrics}.v1``. Any OTLP gRPC
+exporter (otel-go/java/python SDKs, another collector's ``otlp``
+exporter) interoperates unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from . import otlp, otlp_metrics
+from .tensorize import SpanRecord
+
+TRACE_EXPORT = "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+METRICS_EXPORT = (
+    "/opentelemetry.proto.collector.metrics.v1.MetricsService/Export"
+)
+
+
+class OtlpGrpcReceiver:
+    """gRPC twin of :class:`~.otlp.OtlpHttpReceiver` — same callbacks.
+
+    ``on_records`` receives decoded SpanRecords per Export call;
+    ``on_columnar`` (with the native decoder available) takes the C++
+    columnar fast path; ``on_metric_records`` receives MetricRecords
+    from the MetricsService. Malformed payloads answer
+    ``INVALID_ARGUMENT`` (the client's fault); callback failures
+    propagate as ``INTERNAL`` — server bugs must surface.
+    """
+
+    def __init__(
+        self,
+        on_records: Callable[[list[SpanRecord]], None],
+        host: str = "0.0.0.0",
+        port: int = 4317,
+        on_columnar: Callable | None = None,
+        on_metric_records: Callable | None = None,
+        max_workers: int = 4,
+    ):
+        import grpc
+        from concurrent import futures
+
+        self.on_records = on_records
+        self.on_columnar = on_columnar
+        self.on_metric_records = on_metric_records
+        receiver = self
+
+        def export_traces(request: bytes, context) -> bytes:
+            columnar = None
+            try:
+                if receiver.on_columnar is not None:
+                    columnar = otlp.decode_export_request_columnar(request)
+                if columnar is None:
+                    records = otlp.decode_export_request(request)
+            except Exception:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
+                )
+            if columnar is not None:
+                receiver.on_columnar(columnar)
+            else:
+                receiver.on_records(records)
+            return b""  # empty ExportTraceServiceResponse
+
+        def export_metrics(request: bytes, context) -> bytes:
+            try:
+                records = otlp_metrics.decode_metrics_request(request)
+            except Exception:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
+                )
+            if receiver.on_metric_records is not None:
+                receiver.on_metric_records(records)
+            return b""  # empty ExportMetricsServiceResponse
+
+        handlers = {
+            TRACE_EXPORT: export_traces,
+            METRICS_EXPORT: export_metrics,
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                fn = handlers.get(details.method)
+                if fn is None:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    fn, request_deserializer=None, response_serializer=None
+                )
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="otlp-grpc"
+            )
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            # grpc reports bind failure by returning port 0 instead of
+            # raising; a daemon that silently boots with a dead primary
+            # ingress is worse than one that refuses to boot.
+            raise OSError(f"OTLP/gRPC receiver failed to bind {host}:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+
+def export_client(target: str):
+    """(traces_fn, metrics_fn) raw-bytes unary callables for tests and
+    the collector's gRPC exporter — each takes a serialized request and
+    returns the (empty) response bytes."""
+    import grpc
+
+    channel = grpc.insecure_channel(target)
+    traces = channel.unary_unary(
+        TRACE_EXPORT, request_serializer=None, response_deserializer=None
+    )
+    metrics = channel.unary_unary(
+        METRICS_EXPORT, request_serializer=None, response_deserializer=None
+    )
+    return traces, metrics

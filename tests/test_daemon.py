@@ -53,6 +53,7 @@ def env(tmp_path, monkeypatch):
     flag_path = tmp_path / "flags.json"
     flag_path.write_text(json.dumps(flags))
     monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+    monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "0")
     monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
     monkeypatch.setenv("ANOMALY_BATCH", "256")
     monkeypatch.setenv("FLAGD_FILE", str(flag_path))
@@ -127,3 +128,50 @@ def test_daemon_end_to_end(env):
     finally:
         daemon2.exporter.stop()
         daemon2.receiver.stop()
+
+
+def test_daemon_metrics_leg_flags_surge(env):
+    """The /v1/metrics ingestion leg end to end: a counter-rate surge
+    (the kafkaQueueProblems/flood failure shape on the metric stream)
+    raises a metric-driven flag, visible on the Prometheus surface."""
+    from opentelemetry_demo_tpu.runtime.otlp_metrics import (
+        encode_metrics_request,
+    )
+
+    daemon = DetectorDaemon(DetectorConfig(num_services=8, hll_p=8, cms_width=512))
+    daemon.start()
+    rng = np.random.default_rng(5)
+    try:
+        def post_counter(total, t):
+            body = encode_metrics_request(
+                [("kafka", [("queue_depth_total", total, True)])],
+                t_ns=int(t * 1e9),
+            )
+            conn = http.client.HTTPConnection("127.0.0.1", daemon.receiver.port)
+            conn.request(
+                "POST",
+                "/v1/metrics",
+                body=body,
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+
+        total = 0.0
+        t = 0.0
+        for i in range(60):
+            t += 5.0
+            rate = 30.0 * (1.0 + 0.05 * rng.standard_normal())
+            if i >= 50:
+                rate = 300.0  # the queue-problems surge
+            total += rate * 5.0
+            assert post_counter(total, t) == 200
+            daemon.step(t)
+        text = _scrape(daemon.exporter.port)
+        assert tele_metrics.ANOMALY_METRIC_Z in text
+        assert 'metric="queue_depth_total"' in text
+        assert tele_metrics.ANOMALY_METRIC_FLAG_TOTAL in text
+        assert 'app_anomaly_metric_flags_total{service="kafka"}' in text
+    finally:
+        daemon.shutdown()

@@ -1,0 +1,128 @@
+"""OTLP/gRPC receiver: the collector's primary ingress (:4317 analogue).
+
+Real gRPC over a real socket (grpcio), raw-bytes generic handlers in
+front of the hand-rolled wire decoders — the interop contract any OTLP
+SDK exporter relies on (otelcol-config.yml:5-8).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from opentelemetry_demo_tpu.runtime import wire  # noqa: E402
+from opentelemetry_demo_tpu.runtime.otlp_grpc import (  # noqa: E402
+    OtlpGrpcReceiver,
+    export_client,
+)
+from opentelemetry_demo_tpu.runtime.otlp_metrics import (  # noqa: E402
+    encode_metrics_request,
+)
+
+
+def _span_payload(service: str, n: int, rng, lat_ns: int = 10**6) -> bytes:
+    def kv(k, v):
+        return wire.encode_len(1, k.encode()) + wire.encode_len(
+            2, wire.encode_len(1, v.encode())
+        )
+
+    spans = b""
+    for _ in range(n):
+        start = 10**18
+        spans += wire.encode_len(
+            2,
+            wire.encode_len(1, bytes(rng.integers(0, 256, 16, dtype=np.uint8)))
+            + wire.encode_fixed64(7, start)
+            + wire.encode_fixed64(8, start + lat_ns),
+        )
+    rs = wire.encode_len(
+        1, wire.encode_len(1, kv("service.name", service))
+    ) + wire.encode_len(2, spans)
+    return wire.encode_len(1, rs)
+
+
+@pytest.fixture
+def receiver():
+    spans, metrics = [], []
+    recv = OtlpGrpcReceiver(
+        spans.extend,
+        host="127.0.0.1",
+        port=0,
+        on_metric_records=metrics.extend,
+    )
+    recv.start()
+    yield recv, spans, metrics
+    recv.stop()
+
+
+def test_trace_export_round_trip(receiver):
+    recv, spans, _ = receiver
+    rng = np.random.default_rng(0)
+    traces, _metrics = export_client(f"127.0.0.1:{recv.port}")
+    resp = traces(_span_payload("checkout", 7, rng), timeout=5)
+    assert resp == b""
+    assert len(spans) == 7
+    assert spans[0].service == "checkout"
+    assert spans[0].duration_us == pytest.approx(1000.0)
+
+
+def test_metrics_export_round_trip(receiver):
+    recv, _, metrics = receiver
+    _traces, metrics_fn = export_client(f"127.0.0.1:{recv.port}")
+    body = encode_metrics_request(
+        [("cart", [("gets_total", 12.0, True)])], t_ns=5
+    )
+    assert metrics_fn(body, timeout=5) == b""
+    assert len(metrics) == 1
+    assert metrics[0].service == "cart"
+    assert metrics[0].value == 12.0
+
+
+def test_malformed_payload_is_invalid_argument(receiver):
+    recv, spans, _ = receiver
+    traces, _ = export_client(f"127.0.0.1:{recv.port}")
+    with pytest.raises(grpc.RpcError) as exc:
+        traces(b"\xff\xff\xff\xff", timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert not spans
+
+
+def test_unknown_method_unimplemented(receiver):
+    recv, *_ = receiver
+    channel = grpc.insecure_channel(f"127.0.0.1:{recv.port}")
+    bogus = channel.unary_unary(
+        "/opentelemetry.proto.collector.logs.v1.LogsService/Export",
+        request_serializer=None,
+        response_deserializer=None,
+    )
+    with pytest.raises(grpc.RpcError) as exc:
+        bogus(b"", timeout=5)
+    assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_daemon_serves_grpc(tmp_path, monkeypatch):
+    """The sidecar boots both ingresses; gRPC spans reach the pipeline."""
+    from opentelemetry_demo_tpu.models import DetectorConfig
+    from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+    monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+    monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "0")
+    monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+    monkeypatch.setenv("ANOMALY_BATCH", "64")
+    monkeypatch.delenv("KAFKA_ADDR", raising=False)
+    monkeypatch.delenv("ANOMALY_CHECKPOINT", raising=False)
+    monkeypatch.delenv("FLAGD_FILE", raising=False)
+    daemon = DetectorDaemon(DetectorConfig(num_services=8, hll_p=8, cms_width=512))
+    daemon.start()
+    try:
+        assert daemon.grpc_receiver is not None
+        rng = np.random.default_rng(1)
+        traces, _ = export_client(f"127.0.0.1:{daemon.grpc_receiver.port}")
+        traces(_span_payload("payment", 64, rng), timeout=5)
+        daemon.step(0.05)
+        daemon.pipeline.drain()
+        assert daemon.pipeline.stats.spans >= 64
+    finally:
+        daemon.shutdown()
