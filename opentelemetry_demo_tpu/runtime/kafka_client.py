@@ -1,0 +1,301 @@
+"""Minimal Kafka client: simple consumer + producer over the wire subset.
+
+The consumer follows the classic "simple consumer with group offset
+storage" pattern: manual partition assignment from Metadata, positions
+restored via OffsetFetch (falling back to earliest), Fetch polls, and
+OffsetCommit with generation -1 / empty member id — real Kafka protocol
+semantics that skip the group-membership state machine (JoinGroup/
+SyncGroup/Heartbeat), which only matters for multi-instance rebalancing;
+the sidecar scales by partition assignment, not rebalance (SURVEY.md
+§2.3 consumer groups → sharded ingestion).
+
+Matches the contract of the reference consumers: poll loop
+(src/fraud-detection/.../main.kt:54-69), committed offsets as the resume
+point (src/accounting/Consumer.cs:77-80).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import NamedTuple
+
+from . import kafka_wire as kw
+
+
+class FetchedMessage(NamedTuple):
+    partition: int
+    offset: int
+    key: bytes | None
+    value: bytes | None
+
+
+class KafkaConnection:
+    """One broker connection: framed request/response with correlation."""
+
+    def __init__(self, host: str, port: int, client_id: str = "otel-demo-tpu",
+                 timeout_s: float = 5.0):
+        self.client_id = client_id
+        self._corr = itertools.count(1)
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+
+    def request(self, api_key: int, api_version: int, body: bytes) -> kw.Reader:
+        corr = next(self._corr)
+        frame = kw.encode_request(api_key, api_version, corr, self.client_id, body)
+        with self._lock:
+            self._sock.sendall(frame)
+            resp = kw.read_frame(self._sock)
+        if resp is None:
+            raise kw.KafkaWireError("broker closed connection")
+        r = kw.Reader(resp)
+        got = r.int32()
+        if got != corr:
+            raise kw.KafkaWireError(f"correlation mismatch {got} != {corr}")
+        return r
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _parse_bootstrap(bootstrap: str) -> tuple[str, int]:
+    host, _, port = bootstrap.partition(":")
+    return host or "127.0.0.1", int(port or 9092)
+
+
+class KafkaProducer:
+    """Produce v0 with broker-assigned offsets (acks=1 semantics)."""
+
+    def __init__(self, bootstrap: str):
+        self._conn = KafkaConnection(*_parse_bootstrap(bootstrap))
+
+    def send(self, topic: str, value: bytes, key: bytes | None = None,
+             partition: int = 0) -> int:
+        """Returns the broker-assigned base offset."""
+        mset = kw.encode_message_set([(key, value)])
+        body = (
+            kw.enc_int16(1)  # required_acks
+            + kw.enc_int32(1000)  # timeout
+            + kw.enc_array(
+                [(topic, [(partition, mset)])],
+                lambda t: kw.enc_string(t[0])
+                + kw.enc_array(
+                    t[1],
+                    lambda p: kw.enc_int32(p[0]) + kw.enc_int32(len(p[1])) + p[1],
+                ),
+            )
+        )
+        r = self._conn.request(kw.PRODUCE, 0, body)
+
+        def read_partition():
+            return r.int32(), r.int16(), r.int64()
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        _name, parts = topics[0]
+        partition_, error, base_offset = parts[0]
+        if error != kw.NO_ERROR:
+            raise kw.KafkaWireError(f"produce error {error} on partition {partition_}")
+        return base_offset
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class KafkaConsumer:
+    """Simple consumer with consumer-group offset storage."""
+
+    def __init__(
+        self,
+        bootstrap: str,
+        group_id: str,
+        topic: str,
+        max_bytes: int = 1 << 20,
+        auto_commit: bool = True,
+    ):
+        self.group_id = group_id
+        self.topic = topic
+        self.max_bytes = max_bytes
+        self.auto_commit = auto_commit
+        self._conn = KafkaConnection(*_parse_bootstrap(bootstrap))
+        self._partitions = self._fetch_partitions()
+        # Restore committed positions; fall back to earliest.
+        committed = self.committed()
+        self._positions = {
+            p: committed.get(p, -1) if committed.get(p, -1) >= 0 else 0
+            for p in self._partitions
+        }
+
+    # -- metadata / offsets --------------------------------------------
+
+    def _fetch_partitions(self) -> list[int]:
+        body = kw.enc_array([self.topic], kw.enc_string)
+        r = self._conn.request(kw.METADATA, 0, body)
+        r.array(lambda: (r.int32(), r.string(), r.int32()))  # brokers
+
+        def read_partition():
+            r.int16()  # error
+            partition = r.int32()
+            r.int32()  # leader
+            r.array(r.int32)
+            r.array(r.int32)
+            return partition
+
+        topics = r.array(lambda: (r.int16(), r.string(), r.array(read_partition)))
+        for _err, name, parts in topics:
+            if name == self.topic:
+                return sorted(parts)
+        return [0]
+
+    def committed(self) -> dict[int, int]:
+        """Consumer-group committed offsets (next-to-read), -1 = none."""
+        body = kw.enc_string(self.group_id) + kw.enc_array(
+            [(self.topic, self._partitions if hasattr(self, "_partitions") else [0])],
+            lambda t: kw.enc_string(t[0]) + kw.enc_array(t[1], kw.enc_int32),
+        )
+        r = self._conn.request(kw.OFFSET_FETCH, 1, body)
+
+        def read_partition():
+            partition = r.int32()
+            offset = r.int64()
+            r.string()  # metadata
+            r.int16()  # error
+            return partition, offset
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        out: dict[int, int] = {}
+        for _name, parts in topics:
+            out.update(dict(parts))
+        return out
+
+    def commit(self, offsets: dict[int, int] | None = None) -> None:
+        """Commit next-to-read offsets (defaults to current positions)."""
+        offsets = offsets if offsets is not None else dict(self._positions)
+        body = (
+            kw.enc_string(self.group_id)
+            + kw.enc_int32(-1)  # generation: simple consumer
+            + kw.enc_string("")  # member id
+            + kw.enc_int64(-1)  # retention: broker default
+            + kw.enc_array(
+                [(self.topic, sorted(offsets.items()))],
+                lambda t: kw.enc_string(t[0])
+                + kw.enc_array(
+                    t[1],
+                    lambda p: kw.enc_int32(p[0])
+                    + kw.enc_int64(p[1])
+                    + kw.enc_string(""),
+                ),
+            )
+        )
+        r = self._conn.request(kw.OFFSET_COMMIT, 2, body)
+        topics = r.array(
+            lambda: (r.string(), r.array(lambda: (r.int32(), r.int16())))
+        )
+        for _name, parts in topics:
+            for partition, error in parts:
+                if error != kw.NO_ERROR:
+                    raise kw.KafkaWireError(
+                        f"offset commit error {error} on partition {partition}"
+                    )
+
+    @property
+    def positions(self) -> dict[int, int]:
+        return dict(self._positions)
+
+    def seek(self, partition: int, offset: int) -> None:
+        """Set the next-to-read position; a partition the boot-time
+        metadata didn't list is added to the fetch set rather than
+        silently dropped (stale metadata must not cause replay)."""
+        if partition not in self._positions:
+            self._partitions = sorted(set(self._partitions) | {partition})
+        self._positions[partition] = offset
+
+    def _reset_offset(self, partition: int) -> None:
+        """OFFSET_OUT_OF_RANGE recovery: reset to earliest (the
+        ``auto.offset.reset=earliest`` rule the reference consumers
+        configure) via ListOffsets."""
+        body = (
+            kw.enc_int32(-1)
+            + kw.enc_array(
+                [(self.topic, [(partition, -2, 1)])],  # ts -2 = earliest
+                lambda t: kw.enc_string(t[0])
+                + kw.enc_array(
+                    t[1],
+                    lambda p: kw.enc_int32(p[0])
+                    + kw.enc_int64(p[1])
+                    + kw.enc_int32(p[2]),
+                ),
+            )
+        )
+        r = self._conn.request(kw.LIST_OFFSETS, 0, body)
+
+        def read_partition():
+            part = r.int32()
+            err = r.int16()
+            offsets = r.array(r.int64)
+            return part, err, offsets
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        for _name, parts in topics:
+            for part, err, offsets in parts:
+                if part == partition and err == kw.NO_ERROR and offsets:
+                    self._positions[partition] = offsets[0]
+
+    # -- poll -----------------------------------------------------------
+
+    def poll(self, max_wait_ms: int = 100) -> list[FetchedMessage]:
+        body = (
+            kw.enc_int32(-1)  # replica_id
+            + kw.enc_int32(max_wait_ms)
+            + kw.enc_int32(1)  # min_bytes
+            + kw.enc_array(
+                [(self.topic, [(p, self._positions[p], self.max_bytes)
+                               for p in self._partitions])],
+                lambda t: kw.enc_string(t[0])
+                + kw.enc_array(
+                    t[1],
+                    lambda p: kw.enc_int32(p[0])
+                    + kw.enc_int64(p[1])
+                    + kw.enc_int32(p[2]),
+                ),
+            )
+        )
+        r = self._conn.request(kw.FETCH, 0, body)
+
+        def read_partition():
+            partition = r.int32()
+            error = r.int16()
+            hw = r.int64()
+            size = r.int32()
+            mset = r.buf[r.pos : r.pos + size]
+            r.pos += size
+            return partition, error, hw, mset
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        out: list[FetchedMessage] = []
+        for _name, parts in topics:
+            for partition, error, _hw, mset in parts:
+                if error == kw.OFFSET_OUT_OF_RANGE:
+                    # Retention deleted our position (or a checkpoint
+                    # predates the log start): reset to earliest rather
+                    # than wedging on retries forever.
+                    self._reset_offset(partition)
+                    continue
+                if error != kw.NO_ERROR:
+                    continue  # transient: position holds, retry later
+                for msg in kw.decode_message_set(mset):
+                    if msg.offset < self._positions[partition]:
+                        continue  # broker re-sent below our position
+                    out.append(
+                        FetchedMessage(partition, msg.offset, msg.key, msg.value)
+                    )
+                    self._positions[partition] = msg.offset + 1
+        if out and self.auto_commit:
+            self.commit()
+        return out
+
+    def close(self) -> None:
+        self._conn.close()

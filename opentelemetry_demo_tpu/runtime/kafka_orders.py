@@ -15,13 +15,13 @@ orders), with item count/value as the monitored attribute (heavy-hitter
 signal = one product dominating order flow — the business-level anomaly
 the reference's accounting/fraud pair exists to catch).
 
-The consumer itself is dependency-gated: with ``confluent_kafka`` or
-``kafka-python`` absent (this image ships neither), :class:`OrdersSource`
-raises at construction with a clear message, and tests/sims feed decoded
-bytes straight through :func:`decode_order` / :func:`order_to_record`.
-Consumer-group offsets are surfaced on every poll so ``checkpoint`` can
-key sketch snapshots to them (exactly-once-ish resume; SURVEY.md §5
-"Checkpoint / resume").
+The consumer transport prefers ``confluent_kafka`` when installed and
+otherwise uses the framework's own wire client
+(``runtime.kafka_client`` — real Kafka protocol over a real socket; the
+in-repo broker ``runtime.kafka_broker`` stands in for the compose
+topology's broker in tests). Consumer-group offsets are surfaced on
+every poll so ``checkpoint`` can key sketch snapshots to them
+(exactly-once-ish resume; SURVEY.md §5 "Checkpoint / resume").
 """
 
 from __future__ import annotations
@@ -144,40 +144,151 @@ def encode_order(order: Order) -> bytes:
 
 
 class OrdersSource:
-    """Kafka consumer for topic ``orders`` (dependency-gated).
+    """Kafka consumer for topic ``orders``.
 
     Mirrors the reference consumer contract: own group id, auto-commit
     offsets (/root/reference/src/accounting/Consumer.cs:77-80), value =
     OrderResult bytes. Yields ``(offset_by_partition, SpanRecord)``.
+
+    Transport: ``confluent_kafka`` when installed (production images
+    that ship it), else the built-in wire client
+    (:class:`~.kafka_client.KafkaConsumer`) — real Kafka protocol over a
+    real socket either way, so the leg never silently degrades to
+    in-proc simulation.
     """
 
     TOPIC = "orders"
+    RECONNECT_BACKOFF_S = 1.0
 
     def __init__(self, bootstrap: str, group_id: str = "anomaly-detector"):
+        self._bootstrap = bootstrap
+        self._group_id = group_id
+        self._pending_seek: dict[int, int] = {}
+        self._wire = None
+        self._next_connect = 0.0  # wire-transport reconnect backoff
         try:
             from confluent_kafka import Consumer  # type: ignore
-        except ImportError as e:  # pragma: no cover - gated dependency
-            raise ImportError(
-                "confluent_kafka is not available in this image; use "
-                "runtime.replay.FileSource or the in-proc services bus "
-                "for ingestion, or install a Kafka client in deployment."
-            ) from e
-        self._consumer = Consumer(
-            {
-                "bootstrap.servers": bootstrap,
-                "group.id": group_id,
-                "auto.offset.reset": "earliest",
-                "enable.auto.commit": True,
-            }
-        )
-        self._consumer.subscribe([self.TOPIC])
+
+            self._consumer = Consumer(
+                {
+                    "bootstrap.servers": bootstrap,
+                    "group.id": group_id,
+                    "auto.offset.reset": "earliest",
+                    "enable.auto.commit": True,
+                }
+            )
+            self._consumer.subscribe([self.TOPIC])
+        except ImportError:
+            # Built-in wire transport, connected lazily on first poll:
+            # the compose topology starts services in parallel, so a
+            # broker that isn't up yet must mean "retry", not a boot
+            # crash (confluent buffers the same way internally). A
+            # malformed address is NOT transient — validate it now, so
+            # a config error refuses to boot (mustMapEnv discipline)
+            # instead of retrying silently forever.
+            from .kafka_client import _parse_bootstrap
+
+            _parse_bootstrap(bootstrap)
+            self._consumer = None
+            self._ensure_wire(raise_on_fail=False)
+
+    def _ensure_wire(self, raise_on_fail: bool = False):
+        import time as _time
+
+        if self._wire is not None:
+            return self._wire
+        now = _time.monotonic()
+        if now < self._next_connect:
+            return None
+        self._next_connect = now + self.RECONNECT_BACKOFF_S
+        try:
+            from .kafka_client import KafkaConsumer
+
+            self._wire = KafkaConsumer(self._bootstrap, self._group_id, self.TOPIC)
+            self._last_connect_error = None
+        except Exception as e:
+            if raise_on_fail:
+                raise
+            # Log once per distinct failure — a silent forever-retry
+            # would hide a permanently unreachable broker.
+            msg = f"{type(e).__name__}: {e}"
+            if msg != getattr(self, "_last_connect_error", None):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Kafka connect to %s failed (%s); retrying every %.0fs",
+                    self._bootstrap, msg, self.RECONNECT_BACKOFF_S,
+                )
+                self._last_connect_error = msg
+            return None
+        if self._pending_seek:
+            for partition, offset in self._pending_seek.items():
+                self._wire.seek(partition, offset)
+        return self._wire
+
+    def _drop_wire(self) -> None:
+        if self._wire is not None:
+            # Remember positions so a reconnect resumes where we were
+            # even if the last auto-commit didn't land.
+            self._pending_seek.update(self._wire.positions)
+            try:
+                self._wire.close()
+            finally:
+                self._wire = None
+
+    def seek(self, offsets: dict[int, int]) -> None:
+        """Seek to checkpointed next-to-read offsets (resume): sketch
+        state corresponds to the checkpoint's offsets, which win over
+        broker-committed ones. Applied now if connected, and re-applied
+        on every (re)connect."""
+        offsets = {int(p): int(o) for p, o in offsets.items()}
+        self._pending_seek.update(offsets)
+        if self._wire is not None:
+            for partition, offset in offsets.items():
+                self._wire.seek(partition, offset)
+        elif self._consumer is not None:  # pragma: no cover - confluent
+            from confluent_kafka import TopicPartition  # type: ignore
+
+            self._consumer.assign(
+                [
+                    TopicPartition(self.TOPIC, p, o)
+                    for p, o in offsets.items()
+                ]
+            )
 
     def poll(self, timeout_s: float = 0.1) -> Iterator[tuple[dict, SpanRecord]]:
-        msg = self._consumer.poll(timeout_s)
+        # Next-offset semantics (Kafka committed-offset convention): a
+        # checkpoint taken after a message seeks *past* it on resume,
+        # so nothing is double-counted into the CMS.
+        if self._consumer is None:
+            wire = self._ensure_wire()
+            if wire is None:
+                return  # broker unreachable: retry next poll
+            try:
+                msgs = wire.poll(max_wait_ms=int(timeout_s * 1000))
+            except Exception:
+                # Transient transport failure (broker restart, half-open
+                # socket): drop the connection and reconnect with
+                # backoff instead of killing the daemon loop.
+                self._drop_wire()
+                return
+            for msg in msgs:
+                if msg.value is None:
+                    continue
+                yield (
+                    {msg.partition: msg.offset + 1},
+                    order_to_record(decode_order(msg.value)),
+                )
+            return
+        msg = self._consumer.poll(timeout_s)  # pragma: no cover - confluent
         if msg is None or msg.error():
             return
-        # Next-offset semantics (Kafka committed-offset convention): a
-        # checkpoint taken after this message seeks *past* it on resume,
-        # so nothing is double-counted into the CMS.
         offsets = {msg.partition(): msg.offset() + 1}
         yield offsets, order_to_record(decode_order(msg.value()))
+
+    def close(self) -> None:
+        if self._wire is not None:
+            self._wire.close()
+            self._wire = None
+        elif self._consumer is not None:  # pragma: no cover
+            self._consumer.close()

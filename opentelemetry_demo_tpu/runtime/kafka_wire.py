@@ -1,0 +1,253 @@
+"""Kafka wire protocol: the minimal subset the orders leg speaks.
+
+The reference's async tier is a real Kafka broker
+(/root/reference/docker-compose.yml kafka service) with consumers
+polling over TCP (src/fraud-detection/.../main.kt:54-69,
+src/accounting/Consumer.cs:77-80). This image ships no Kafka client
+library, so — in the same from-scratch spirit as ``runtime.wire`` for
+protobuf — this module implements the Kafka protocol primitives
+directly: size-prefixed request/response framing, the primitive codecs,
+and the v0 MessageSet record format (magic 0, zlib CRC32).
+
+Versions are pinned to the legacy (non-flexible) protocol era —
+Produce v0, Fetch v0, ListOffsets v0, Metadata v0, FindCoordinator v0,
+OffsetCommit v2, OffsetFetch v1 — which IS real Kafka wire format
+(every broker accepted it for a decade); the point is consuming ordered
+bytes over a real socket with consumer-group offset storage, not
+re-implementing KIP-482 tagged fields. The in-repo broker
+(``kafka_broker``) speaks the same subset, so client and broker are
+interoperable test doubles for the compose topology's real broker.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import NamedTuple
+
+# API keys (the public protocol's).
+PRODUCE = 0
+FETCH = 1
+LIST_OFFSETS = 2
+METADATA = 3
+OFFSET_COMMIT = 8
+OFFSET_FETCH = 9
+FIND_COORDINATOR = 10
+
+# Error codes.
+NO_ERROR = 0
+OFFSET_OUT_OF_RANGE = 1
+UNKNOWN_TOPIC_OR_PARTITION = 3
+UNSUPPORTED_VERSION = 35
+
+
+class KafkaWireError(ValueError):
+    """Malformed Kafka wire data."""
+
+
+# --- primitive codecs --------------------------------------------------
+
+
+class Reader:
+    """Sequential reader over one request/response body."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise KafkaWireError("truncated message")
+        out = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def int8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def int16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def int32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def int64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> str | None:
+        n = self.int16()
+        if n == -1:
+            return None
+        return self._take(n).decode("utf-8")
+
+    def bytes_(self) -> bytes | None:
+        n = self.int32()
+        if n == -1:
+            return None
+        return self._take(n)
+
+    def array(self, fn):
+        n = self.int32()
+        if n < 0:
+            return []
+        return [fn() for _ in range(n)]
+
+    def remaining(self) -> bytes:
+        return self.buf[self.pos :]
+
+
+def enc_int8(v: int) -> bytes:
+    return struct.pack(">b", v)
+
+
+def enc_int16(v: int) -> bytes:
+    return struct.pack(">h", v)
+
+
+def enc_int32(v: int) -> bytes:
+    return struct.pack(">i", v)
+
+
+def enc_int64(v: int) -> bytes:
+    return struct.pack(">q", v)
+
+
+def enc_string(v: str | None) -> bytes:
+    if v is None:
+        return enc_int16(-1)
+    raw = v.encode("utf-8")
+    return enc_int16(len(raw)) + raw
+
+
+def enc_bytes(v: bytes | None) -> bytes:
+    if v is None:
+        return enc_int32(-1)
+    return enc_int32(len(v)) + v
+
+
+def enc_array(items, fn) -> bytes:
+    return enc_int32(len(items)) + b"".join(fn(x) for x in items)
+
+
+# --- request/response framing -----------------------------------------
+
+
+def encode_request(
+    api_key: int,
+    api_version: int,
+    correlation_id: int,
+    client_id: str,
+    body: bytes,
+) -> bytes:
+    """Size-prefixed request with the v1 (non-flexible) header."""
+    payload = (
+        enc_int16(api_key)
+        + enc_int16(api_version)
+        + enc_int32(correlation_id)
+        + enc_string(client_id)
+        + body
+    )
+    return enc_int32(len(payload)) + payload
+
+
+class RequestHeader(NamedTuple):
+    api_key: int
+    api_version: int
+    correlation_id: int
+    client_id: str | None
+
+
+def decode_request_header(reader: Reader) -> RequestHeader:
+    return RequestHeader(
+        api_key=reader.int16(),
+        api_version=reader.int16(),
+        correlation_id=reader.int32(),
+        client_id=reader.string(),
+    )
+
+
+def encode_response(correlation_id: int, body: bytes) -> bytes:
+    payload = enc_int32(correlation_id) + body
+    return enc_int32(len(payload)) + payload
+
+
+def read_frame(sock) -> bytes | None:
+    """One size-prefixed frame off a socket; None on clean EOF."""
+    header = _read_exact(sock, 4)
+    if header is None:
+        return None
+    (size,) = struct.unpack(">i", header)
+    if size < 0 or size > 64 * 1024 * 1024:
+        raise KafkaWireError(f"implausible frame size {size}")
+    frame = _read_exact(sock, size)
+    if frame is None:
+        raise KafkaWireError("truncated frame")
+    return frame
+
+
+def _read_exact(sock, n: int) -> bytes | None:
+    """Exactly n bytes; None on EOF at a frame boundary, error mid-frame."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            if got == 0:
+                return None
+            raise KafkaWireError("connection closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+# --- MessageSet v0 (magic 0) ------------------------------------------
+
+
+class KafkaMessage(NamedTuple):
+    offset: int
+    key: bytes | None
+    value: bytes | None
+
+
+def encode_message(key: bytes | None, value: bytes | None) -> bytes:
+    """One magic-0 message body (without the offset/size envelope)."""
+    rest = enc_int8(0) + enc_int8(0) + enc_bytes(key) + enc_bytes(value)
+    crc = zlib.crc32(rest) & 0xFFFFFFFF
+    return struct.pack(">I", crc) + rest
+
+
+def encode_message_set(messages, base_offset: int = 0) -> bytes:
+    """[(key, value), ...] → on-wire MessageSet with assigned offsets."""
+    out = b""
+    for i, (key, value) in enumerate(messages):
+        msg = encode_message(key, value)
+        out += enc_int64(base_offset + i) + enc_int32(len(msg)) + msg
+    return out
+
+
+def decode_message_set(buf: bytes) -> list[KafkaMessage]:
+    """On-wire MessageSet → messages; a trailing partial message (the
+    protocol allows brokers to cut one at the fetch byte limit) is
+    dropped, matching every real client's behavior."""
+    out: list[KafkaMessage] = []
+    pos = 0
+    n = len(buf)
+    while pos + 12 <= n:
+        offset, size = struct.unpack(">qi", buf[pos : pos + 12])
+        if pos + 12 + size > n:
+            break  # partial trailing message
+        body = buf[pos + 12 : pos + 12 + size]
+        pos += 12 + size
+        crc_stored = struct.unpack(">I", body[:4])[0]
+        rest = body[4:]
+        if zlib.crc32(rest) & 0xFFFFFFFF != crc_stored:
+            raise KafkaWireError(f"bad message CRC at offset {offset}")
+        r = Reader(rest)
+        magic = r.int8()
+        if magic != 0:
+            raise KafkaWireError(f"unsupported message magic {magic}")
+        r.int8()  # attributes (no compression in this subset)
+        key = r.bytes_()
+        value = r.bytes_()
+        out.append(KafkaMessage(offset=offset, key=key, value=value))
+    return out
