@@ -1,0 +1,175 @@
+// Native shipping kernel: quote money math + tracking-id generation.
+//
+// The reference keeps shipping native (its shipping service is Rust —
+// /root/reference/src/shipping/src/shipping_service/quote.rs:15-46
+// builds a Quote from the quote service's float; tracking.rs:8-10 mints
+// tracking ids); this framework keeps the same polyglot contract:
+// services/shipping.py is the facade, the arithmetic lives here, and a
+// pure-Python fallback keeps the capability dependency-free.
+//
+// Semantics pinned to services/shipping.py + services/money.py by
+// tests/test_native_shipping.py:
+//   - quote total = round(per_item * count, 2) — Python round():
+//     ties-to-even at 2 decimal places, via scaling to cents;
+//   - Money split = Money.from_float: units = trunc, nanos =
+//     round((value-units)*1e9) with carry normalisation;
+//   - tracking id = RFC 4122 UUID v5 (SHA-1, URL namespace) over the
+//     trace-id hex string — byte-identical to Python's
+//     uuid.uuid5(uuid.NAMESPACE_URL, name).
+//
+// Build: g++ -O3 -shared -fPIC (no dependencies); loaded via ctypes by
+// runtime/native.py.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr int64_t kNanosPerUnit = 1000000000;
+
+// ---- minimal SHA-1 (RFC 3174) for UUID v5 ---------------------------
+
+struct Sha1 {
+  uint32_t h[5] = {0x67452301u, 0xEFCDAB89u, 0x98BADCFEu, 0x10325476u,
+                   0xC3D2E1F0u};
+  uint8_t block[64];
+  uint64_t total = 0;
+  size_t fill = 0;
+
+  static uint32_t rol(uint32_t v, int s) { return (v << s) | (v >> (32 - s)); }
+
+  void process(const uint8_t* p) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; ++i) {
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    }
+    for (int i = 16; i < 80; ++i) {
+      w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int i = 0; i < 80; ++i) {
+      uint32_t f, k;
+      if (i < 20) {
+        f = (b & c) | (~b & d);
+        k = 0x5A827999u;
+      } else if (i < 40) {
+        f = b ^ c ^ d;
+        k = 0x6ED9EBA1u;
+      } else if (i < 60) {
+        f = (b & c) | (b & d) | (c & d);
+        k = 0x8F1BBCDCu;
+      } else {
+        f = b ^ c ^ d;
+        k = 0xCA62C1D6u;
+      }
+      uint32_t t = rol(a, 5) + f + e + k + w[i];
+      e = d;
+      d = c;
+      c = rol(b, 30);
+      b = a;
+      a = t;
+    }
+    h[0] += a;
+    h[1] += b;
+    h[2] += c;
+    h[3] += d;
+    h[4] += e;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    while (len) {
+      size_t take = 64 - fill < len ? 64 - fill : len;
+      std::memcpy(block + fill, data, take);
+      fill += take;
+      data += take;
+      len -= take;
+      if (fill == 64) {
+        process(block);
+        fill = 0;
+      }
+    }
+  }
+
+  void digest(uint8_t out[20]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (fill != 56) update(&zero, 1);
+    uint8_t len_be[8];
+    for (int i = 0; i < 8; ++i) len_be[i] = uint8_t(bits >> (56 - 8 * i));
+    update(len_be, 8);
+    for (int i = 0; i < 5; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+// RFC 4122 URL namespace: 6ba7b811-9dad-11d1-80b4-00c04fd430c8.
+constexpr uint8_t kUrlNamespace[16] = {0x6b, 0xa7, 0xb8, 0x11, 0x9d, 0xad,
+                                       0x11, 0xd1, 0x80, 0xb4, 0x00, 0xc0,
+                                       0x4f, 0xd4, 0x30, 0xc8};
+
+void split(int64_t total_nanos, int64_t* out_units, int32_t* out_nanos) {
+  int64_t a = total_nanos < 0 ? -total_nanos : total_nanos;
+  int64_t u = a / kNanosPerUnit;
+  int64_t n = a % kNanosPerUnit;
+  if (total_nanos < 0) {
+    u = -u;
+    n = -n;
+  }
+  *out_units = u;
+  *out_nanos = int32_t(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Quote total for `count` items at `per_item` cost: round(per_item *
+// count, 2) (llrint under round-to-nearest-even == Python round()'s
+// 2-dp behaviour via cent scaling), split Money.from_float-style.
+// Returns 0, or -1 for invalid count, or -3 when the product leaves
+// the safely representable domain.
+int otd_quote_money(double per_item, int32_t count, int64_t* out_units,
+                    int32_t* out_nanos) {
+  if (count < 0) return -1;
+  double total = per_item * double(count);
+  // The nanos domain is int64: |total| * 1e9 must stay below ~9.22e18,
+  // so the guard is on 9.0e9 units (cents * 1e7 is the overflow site).
+  if (!(total >= -9.0e9 && total <= 9.0e9)) return -3;
+  double cents = total * 100.0;
+  int64_t c = llrint(cents);  // ties-to-even, like Python round(x, 2)
+  split(c * (kNanosPerUnit / 100), out_units, out_nanos);
+  return 0;
+}
+
+// RFC 4122 UUID v5 over the URL namespace — byte-identical to Python's
+// uuid.uuid5(uuid.NAMESPACE_URL, name). Writes the canonical 36-char
+// form (no NUL) into out36. Returns 0.
+int otd_tracking_id(const uint8_t* name, int32_t name_len, char* out36) {
+  Sha1 sha;
+  sha.update(kUrlNamespace, sizeof(kUrlNamespace));
+  sha.update(name, size_t(name_len));
+  uint8_t d[20];
+  sha.digest(d);
+  d[6] = uint8_t((d[6] & 0x0F) | 0x50);  // version 5
+  d[8] = uint8_t((d[8] & 0x3F) | 0x80);  // RFC 4122 variant
+  static const char* hex = "0123456789abcdef";
+  int pos = 0;
+  for (int i = 0; i < 16; ++i) {
+    if (i == 4 || i == 6 || i == 8 || i == 10) out36[pos++] = '-';
+    out36[pos++] = hex[d[i] >> 4];
+    out36[pos++] = hex[d[i] & 0x0F];
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -149,7 +149,23 @@ def _configure_currency(lib: ctypes.CDLL) -> None:
     ]
 
 
-_CONFIGURE = {"ingest": _configure_ingest, "currency": _configure_currency}
+def _configure_shipping(lib: ctypes.CDLL) -> None:
+    lib.otd_quote_money.restype = ctypes.c_int
+    lib.otd_quote_money.argtypes = [
+        ctypes.c_double, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.otd_tracking_id.restype = ctypes.c_int
+    lib.otd_tracking_id.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p
+    ]
+
+
+_CONFIGURE = {
+    "ingest": _configure_ingest,
+    "currency": _configure_currency,
+    "shipping": _configure_shipping,
+}
 
 
 def _load() -> ctypes.CDLL | None:
@@ -211,6 +227,32 @@ def money_sum(
         u1, n1, u2, n2, ctypes.byref(ou), ctypes.byref(on)
     )
     return code, ou.value, on.value
+
+
+def shipping_available() -> bool:
+    return _lib_for("shipping") is not None
+
+
+def quote_money(per_item: float, count: int) -> tuple[int, int, int]:
+    """(code, units, nanos): code 0 ok, -1 bad count, -3 overflow.
+
+    Quote total = round(per_item * count, 2), split from_float-style —
+    the native half of services.shipping (see native/shipping.cc)."""
+    lib = _lib_for("shipping")
+    assert lib is not None
+    ou = ctypes.c_int64(0)
+    on = ctypes.c_int32(0)
+    code = lib.otd_quote_money(per_item, count, ctypes.byref(ou), ctypes.byref(on))
+    return code, ou.value, on.value
+
+
+def tracking_id(name: bytes) -> str:
+    """UUID v5 (URL namespace) over ``name`` — uuid.uuid5 parity."""
+    lib = _lib_for("shipping")
+    assert lib is not None
+    out = ctypes.create_string_buffer(36)
+    lib.otd_tracking_id(name, len(name), out)
+    return out.raw.decode("ascii")
 
 
 def crc32(data: bytes) -> int:

@@ -7,6 +7,13 @@ tracking.rs issues tracking ids) and the PHP quote's per-item random
 cost (/root/reference/src/quote/app/routes.php:16-74). Here shipping is
 one hop (quote is a separate service object, same call structure), with
 the quote cost = per-item uniform cost — the same observable shape.
+
+Like the reference — whose shipping is its second NATIVE service — the
+arithmetic lives in a native C++ kernel (native/shipping.cc via
+runtime.native): quote money math (2-dp rounding + units/nanos split)
+and tracking-id generation (RFC 4122 UUID v5). The pure-Python fallback
+keeps the capability dependency-free; parity is pinned by
+tests/test_native_shipping.py.
 """
 
 from __future__ import annotations
@@ -15,7 +22,27 @@ import uuid
 
 from .base import ServiceBase
 from .money import Money
+from ..runtime import native
 from ..telemetry.tracer import TraceContext
+
+
+def quote_money(per_item: float, item_count: int) -> Money:
+    """round(per_item × count, 2) as USD Money — native kernel when
+    available, Python arithmetic otherwise (identical results)."""
+    if native.shipping_available():
+        code, units, nanos = native.quote_money(per_item, item_count)
+        if code == 0:
+            return Money("USD", units, nanos)
+    return Money.from_float("USD", round(per_item * item_count, 2))
+
+
+def tracking_id(trace_id: bytes) -> str:
+    """Deterministic tracking id: UUID v5 (URL namespace) of the trace
+    id hex — native SHA-1 kernel when available (uuid.uuid5 parity)."""
+    name = trace_id.hex().encode()
+    if native.shipping_available():
+        return native.tracking_id(name)
+    return str(uuid.uuid5(uuid.NAMESPACE_URL, name.decode()))
 
 
 class QuoteService(ServiceBase):
@@ -29,7 +56,7 @@ class QuoteService(ServiceBase):
         if item_count <= 0:
             return Money("USD", 0, 0)
         per_item = float(self.env.rng.uniform(8.0, 12.5))
-        return Money.from_float("USD", round(per_item * item_count, 2))
+        return quote_money(per_item, item_count)
 
 
 class ShippingService(ServiceBase):
@@ -47,4 +74,4 @@ class ShippingService(ServiceBase):
 
     def ship_order(self, ctx: TraceContext) -> str:
         self.span("ship-order", ctx)
-        return str(uuid.uuid5(uuid.NAMESPACE_URL, ctx.trace_id.hex()))
+        return tracking_id(ctx.trace_id)

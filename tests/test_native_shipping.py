@@ -1,0 +1,78 @@
+"""Native C++ shipping kernel ⇄ Python parity (like test_native_currency).
+
+The reference's shipping service is native (Rust, quote.rs/tracking.rs);
+ours keeps the arithmetic in native/shipping.cc behind services/shipping
+with a pure-Python fallback. These tests pin the two paths to identical
+results.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime import native
+from opentelemetry_demo_tpu.services.money import Money
+from opentelemetry_demo_tpu.services.shipping import quote_money, tracking_id
+
+pytestmark = pytest.mark.skipif(
+    not native.shipping_available(),
+    reason=f"native shipping unavailable: {native._errors.get('shipping')}",
+)
+
+
+def _python_quote(per_item: float, count: int) -> Money:
+    return Money.from_float("USD", round(per_item * count, 2))
+
+
+def test_quote_money_matches_python():
+    rng = np.random.default_rng(42)
+    for _ in range(500):
+        per_item = float(rng.uniform(8.0, 12.5))
+        count = int(rng.integers(0, 50))
+        code, units, nanos = native.quote_money(per_item, count)
+        assert code == 0
+        expected = _python_quote(per_item, count)
+        assert (units, nanos) == (expected.units, expected.nanos), (
+            per_item,
+            count,
+        )
+
+
+def test_quote_money_exact_cents():
+    code, units, nanos = native.quote_money(10.0, 3)
+    assert (code, units, nanos) == (0, 30, 0)
+    code, units, nanos = native.quote_money(8.99, 2)
+    assert (code, units, nanos) == (0, 17, 980_000_000)
+
+
+def test_quote_money_rejects_negative_count():
+    code, _, _ = native.quote_money(10.0, -1)
+    assert code == -1
+
+
+def test_tracking_id_is_uuid5_parity():
+    rng = np.random.default_rng(7)
+    for _ in range(100):
+        trace = bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+        name = trace.hex()
+        assert native.tracking_id(name.encode()) == str(
+            uuid.uuid5(uuid.NAMESPACE_URL, name)
+        )
+
+
+def test_tracking_id_various_lengths():
+    for name in (b"", b"a", b"x" * 55, b"y" * 56, b"z" * 200):
+        assert native.tracking_id(name) == str(
+            uuid.uuid5(uuid.NAMESPACE_URL, name.decode())
+        )
+
+
+def test_facade_uses_native_and_matches():
+    m = quote_money(9.75, 4)
+    assert m == _python_quote(9.75, 4)
+    tid = tracking_id(b"\x01" * 16)
+    assert tid == str(uuid.uuid5(uuid.NAMESPACE_URL, ("01" * 16)))
+    assert uuid.UUID(tid).version == 5
