@@ -156,3 +156,201 @@ class HttpLoadGenerator:
         self.start()
         time.sleep(seconds)
         self.stop()
+
+
+def browser_traffic_enabled() -> bool:
+    """The reference's gate, same env var (locustfile.py:180-181)."""
+    import os
+
+    return os.environ.get("LOCUST_BROWSER_TRAFFIC_ENABLED", "").lower() in (
+        "true", "yes", "on",
+    )
+
+
+class BrowserLoadGenerator:
+    """WebsiteBrowserUser analogue: drives the RENDERED storefront.
+
+    The reference's browser users (locustfile.py:184-211, Playwright,
+    gated by ``LOCUST_BROWSER_TRAFFIC_ENABLED``) differ from its HTTP
+    users in three observable ways, all reproduced here without a real
+    browser engine:
+
+    - they load *pages* and then their referenced resources (images),
+      carrying the session cookie a browser would;
+    - they interact — change currency on the cart page, click a product,
+      submit the add-to-cart form, follow the 303 redirect;
+    - they emit *browser-side* spans (documentLoad + resource fetches,
+      service ``frontend-web``) through the gateway's ``/otlp-http``
+      seam, with ``synthetic_request=true`` baggage injected into every
+      request (the add_baggage_header route hook).
+    """
+
+    SERVICE = "frontend-web"
+
+    def __init__(
+        self,
+        base_url: str,
+        users: int = 1,
+        wait_range_s: tuple[float, float] = (1.0, 3.0),
+        seed: int = 0,
+        timeout_s: float = 10.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.users = users
+        self.wait_range_s = wait_range_s
+        self.timeout_s = timeout_s
+        self._seed = seed
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._count_lock = threading.Lock()
+        self.pages_loaded = 0
+        self.images_loaded = 0
+        self.spans_exported = 0
+        self.errors = 0
+
+    # -- a minimal browser ---------------------------------------------
+
+    def _fetch(self, path: str, cookies: dict[str, str],
+               form: dict[str, str] | None = None) -> tuple[int, str, float]:
+        """One navigation: returns (status, html, duration_s); follows
+        one 303 (the add-to-cart redirect) like a browser would."""
+        headers = {
+            "baggage": "synthetic_request=true",
+            "Cookie": "; ".join(f"{k}={v}" for k, v in cookies.items()),
+        }
+        data = None
+        if form is not None:
+            from urllib.parse import urlencode
+
+            data = urlencode(form).encode()
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers,
+            method="POST" if form is not None else "GET",
+        )
+        t0 = time.time()
+        try:
+            # A browser follows the 303 itself; urllib turns the POST
+            # into a GET on redirect, which is exactly the behavior.
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                for header, value in resp.headers.items():
+                    if header.lower() == "set-cookie":
+                        name, _, rest = value.partition("=")
+                        cookies[name.strip()] = rest.split(";", 1)[0]
+                html = resp.read().decode("utf-8", "replace")
+                return resp.status, html, time.time() - t0
+        except Exception:
+            with self._count_lock:
+                self.errors += 1
+            return 0, "", time.time() - t0
+
+    def _load_page(self, path: str, cookies: dict[str, str],
+                   form: dict[str, str] | None = None) -> str:
+        """Navigate, then fetch every referenced image; export the
+        documentLoad + resource spans the browser SDK would."""
+        import re
+
+        t_start = time.time()
+        status, html, dur = self._fetch(path, cookies, form)
+        spans = [("documentLoad " + path, t_start, dur, status == 0)]
+        for src in re.findall(r'src="(/images/[^"]+)"', html):
+            t_img = time.time()
+            img_status, _, img_dur = self._fetch(src, cookies)
+            spans.append(("resourceFetch " + src, t_img, img_dur, img_status == 0))
+            with self._count_lock:
+                self.images_loaded += 1
+        with self._count_lock:
+            self.pages_loaded += 1
+        self._export_spans(spans, cookies)
+        return html
+
+    def _export_spans(self, spans, cookies: dict[str, str]) -> None:
+        """Browser-side OTLP/JSON export through the /otlp-http seam."""
+        session = cookies.get("shop_session", "")
+        doc = {
+            "resourceSpans": [{
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": self.SERVICE}},
+                ]},
+                "scopeSpans": [{"spans": [
+                    {
+                        "traceId": uuid.uuid4().hex,
+                        "name": name,
+                        "startTimeUnixNano": str(int(t0 * 1e9)),
+                        "endTimeUnixNano": str(int((t0 + dur) * 1e9)),
+                        "status": {"code": 2 if failed else 0},
+                        "attributes": [
+                            {"key": "session.id",
+                             "value": {"stringValue": session}},
+                        ],
+                    }
+                    for name, t0, dur, failed in spans
+                ]}],
+            }]
+        }
+        req = urllib.request.Request(
+            self.base_url + "/otlp-http/v1/traces",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+            with self._count_lock:
+                self.spans_exported += len(spans)
+        except Exception:
+            with self._count_lock:
+                self.errors += 1
+
+    # -- the two reference browser tasks --------------------------------
+
+    def open_cart_page_and_change_currency(self, cookies) -> None:
+        self._load_page("/cart", cookies)
+        self._load_page("/cart?currency=CHF", cookies)
+
+    def add_product_to_cart(self, rng, cookies) -> None:
+        import re
+
+        html = self._load_page("/", cookies)
+        links = re.findall(r'href="/product/([^"]+)"', html)
+        if not links:
+            return
+        pid = links[int(rng.integers(len(links)))]
+        self._load_page(f"/product/{pid}", cookies)
+        # Submitting the add-to-cart form 303s to /cart; _fetch follows.
+        self._load_page("/cart/add", cookies,
+                        form={"productId": pid, "quantity": "1"})
+
+    def _user_loop(self, user_idx: int) -> None:
+        rng = np.random.default_rng(self._seed + 1000 + user_idx)
+        cookies: dict[str, str] = {}
+        lo, hi = self.wait_range_s
+        while not self._stop.is_set():
+            if int(rng.integers(2)):
+                self.add_product_to_cart(rng, cookies)
+            else:
+                self.open_cart_page_and_change_currency(cookies)
+            self._stop.wait(float(rng.uniform(lo, hi)))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        for i in range(self.users):
+            t = threading.Thread(
+                target=self._user_loop, args=(i,),
+                name=f"browser-loadgen-{i}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.stop()

@@ -247,6 +247,44 @@ def test_cart_latency_histogram_exported(rig):
     assert "app_cart_get_cart_latency_ms_count" in text
 
 
+def test_browser_loadgen_drives_storefront(rig, monkeypatch):
+    """WebsiteBrowserUser analogue (locustfile.py:184-211): rendered
+    pages + image resources + browser-side spans through /otlp-http,
+    env-gated like the reference."""
+    from opentelemetry_demo_tpu.services.http_load import (
+        BrowserLoadGenerator,
+        browser_traffic_enabled,
+    )
+
+    monkeypatch.delenv("LOCUST_BROWSER_TRAFFIC_ENABLED", raising=False)
+    assert not browser_traffic_enabled()
+    monkeypatch.setenv("LOCUST_BROWSER_TRAFFIC_ENABLED", "true")
+    assert browser_traffic_enabled()
+
+    shop, gw, sink = rig
+    lg = BrowserLoadGenerator(
+        f"http://127.0.0.1:{gw.port}", users=2,
+        wait_range_s=(0.01, 0.05), seed=3,
+    )
+    lg.run_for(2.5)
+    assert lg.pages_loaded >= 4
+    assert lg.images_loaded >= 1  # storefront img tags were fetched
+    assert lg.spans_exported >= lg.pages_loaded  # browser-side telemetry
+    assert lg.errors == 0
+    with gw._lock:
+        gw._pump_locked()
+    services = {s.service for s in sink}
+    # Server-side spans from the rendered pages AND the browser's own
+    # service through the /otlp-http seam.
+    assert "frontend-web" in services
+    assert {"frontend-proxy", "frontend"} <= services
+    names = {s.name for s in sink if s.service == "frontend-web"}
+    assert any(n and n.startswith("documentLoad") for n in names)
+    assert any(n and n.startswith("resourceFetch /images/") for n in names)
+    # The add-to-cart click-through reached the cart service.
+    assert any(s.service == "cart" for s in sink)
+
+
 def test_http_loadgen_drives_traffic(rig):
     shop, gw, sink = rig
     lg = HttpLoadGenerator(
