@@ -16,6 +16,11 @@ with half a config is worse than one that refuses to boot.
 Env contract (all optional, sensible defaults):
 
 - ``ANOMALY_OTLP_PORT``      OTLP/HTTP listen port (default 4318)
+- ``ANOMALY_NUM_SERVICES`` / ``ANOMALY_CMS_WIDTH`` / ``ANOMALY_HLL_P`` /
+  ``ANOMALY_WARMUP_BATCHES`` / ``ANOMALY_Z_WARMUP_BATCHES``
+                             detector geometry/warmup overrides (defaults
+                             from models.DetectorConfig; geometry shrinks
+                             compile time on small deployments)
 - ``ANOMALY_OTLP_GRPC_PORT`` OTLP/gRPC listen port (default 4317, the
                              collector's primary ingress; -1 disables)
 - ``ANOMALY_METRICS_PORT``   Prometheus listen port (default 9464)
@@ -87,7 +92,19 @@ class DetectorDaemon:
         else:
             flags = FlagEvaluator()
 
-        config = config or DetectorConfig()
+        if config is None:
+            base = DetectorConfig()
+            config = base._replace(
+                num_services=_env_int("ANOMALY_NUM_SERVICES", base.num_services),
+                cms_width=_env_int("ANOMALY_CMS_WIDTH", base.cms_width),
+                hll_p=_env_int("ANOMALY_HLL_P", base.hll_p),
+                warmup_batches=_env_float(
+                    "ANOMALY_WARMUP_BATCHES", base.warmup_batches
+                ),
+                z_warmup_batches=_env_float(
+                    "ANOMALY_Z_WARMUP_BATCHES", base.z_warmup_batches
+                ),
+            )
         restored_offsets: dict = {}
         if self.ckpt_path and checkpoint.exists(self.ckpt_path):
             self.detector, meta = checkpoint.load(self.ckpt_path, config)
@@ -216,6 +233,21 @@ class DetectorDaemon:
 
     def step(self, t_now: float | None = None) -> None:
         """One pump + housekeeping tick (public for tests/sims)."""
+        # Self-telemetry on a 1 s cadence (the collector's own otelcol_*
+        # habit): ingest/batch/backlog visibility even before the first
+        # detector report, and the first handle on a wedged pipeline.
+        now_mono = time.monotonic()
+        if now_mono - getattr(self, "_last_self_report", 0.0) >= 1.0:
+            self._last_self_report = now_mono
+            self.registry.gauge_set(
+                "app_anomaly_pending_rows", float(self.pipeline._pending_rows)
+            )
+            self.registry.gauge_set(
+                "app_anomaly_batches_dispatched", float(self.pipeline.stats.batches)
+            )
+            self.registry.gauge_set(
+                "app_anomaly_spans_ingested", float(self.pipeline.stats.spans)
+            )
         if self._orders is not None:
             for offsets, record in self._orders.poll(0.0):
                 self._offsets.update(offsets)
@@ -237,9 +269,14 @@ class DetectorDaemon:
         )
         self._last_ckpt = time.monotonic()
 
-    def run(self) -> None:
-        """Blocking serve loop; returns after :meth:`stop`."""
+    def run(self, on_ready=None) -> None:
+        """Blocking serve loop; returns after :meth:`stop`.
+
+        ``on_ready(daemon)`` fires once after the listeners start —
+        the hook for announcing resolved ports."""
         self.start()
+        if on_ready is not None:
+            on_ready(self)
         try:
             while not self._stop.wait(self.pump_interval_s):
                 self.step()
@@ -262,12 +299,27 @@ class DetectorDaemon:
 
 
 def main() -> None:
-    daemon = DetectorDaemon()
+    import faulthandler
     import signal
 
+    # SIGUSR1 dumps all stacks — the debugging handle for a wedged
+    # daemon (kill -USR1 <pid>), matching Go services' SIGQUIT habit.
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    daemon = DetectorDaemon()
     signal.signal(signal.SIGTERM, lambda *_: daemon.stop())
     signal.signal(signal.SIGINT, lambda *_: daemon.stop())
-    daemon.run()
+
+    def announce(d: DetectorDaemon) -> None:
+        # Announce resolved ports (env may request ephemeral :0) so
+        # operators and cross-process harnesses can discover them.
+        grpc_port = d.grpc_receiver.port if d.grpc_receiver else -1
+        print(
+            f"anomaly-detector: otlp-http :{d.receiver.port} "
+            f"otlp-grpc :{grpc_port} metrics :{d.exporter.port}",
+            flush=True,
+        )
+
+    daemon.run(on_ready=announce)
 
 
 if __name__ == "__main__":

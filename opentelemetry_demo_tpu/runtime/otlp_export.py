@@ -1,0 +1,197 @@
+"""OTLP/HTTP export: spans (and the shared background poster).
+
+The compose topology runs the shop and the anomaly detector as separate
+processes wired by the collector's ``otlphttp`` exporters
+(/root/reference/docker-compose.yml:226-256 fraud-detection pattern;
+otelcol-config.yml:85-92 exporter blocks). This module is the shop-side
+half of that seam: encode SpanRecords into ExportTraceServiceRequest
+protobuf and POST them to the sidecar's ``/v1/traces`` — from a
+background thread, because exporters get invoked under the gateway's
+request lock and the network must never stall it (the same rule as
+``otlp_metrics.OtlpHttpMetricsExporter``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import urllib.request
+
+from . import wire
+from .tensorize import SpanRecord
+
+
+class BackgroundPoster:
+    """Bounded queue + one sender thread; drop-OLDEST on overflow.
+
+    Drop-oldest matches exporter semantics for both signals: metric
+    snapshots are cumulative (a later export supersedes a lost one) and
+    span batches are telemetry, where freshness beats completeness when
+    the sink cannot keep up (the reference collector's sending_queue
+    drops the same way).
+    """
+
+    def __init__(self, endpoint: str, content_type: str,
+                 timeout_s: float = 2.0, queue_max: int = 16):
+        self.endpoint = endpoint
+        self.content_type = content_type
+        self.timeout_s = timeout_s
+        self.sent = 0
+        self.errors = 0
+        self.dropped = 0
+        self._queue: "collections.deque[bytes]" = collections.deque()
+        self._queue_max = queue_max
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def submit(self, body: bytes) -> None:
+        with self._lock:
+            self._queue.append(body)
+            while len(self._queue) > self._queue_max:
+                self._queue.popleft()
+                self.dropped += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._send_loop, name="otlp-export", daemon=True
+                )
+                self._thread.start()
+        self._wake.set()
+
+    def _send_loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        self._idle.set()
+                        if self._stop:
+                            return
+                        break
+                    self._idle.clear()
+                    body = self._queue.popleft()
+                req = urllib.request.Request(
+                    self.endpoint,
+                    data=body,
+                    headers={"Content-Type": self.content_type},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=self.timeout_s):
+                        self.sent += 1
+                except Exception:
+                    self.errors += 1
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                empty = not self._queue
+            if empty and self._idle.is_set():
+                return True
+            self._wake.set()
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout=self.timeout_s + 1.0)
+
+
+def _norm_trace_id(trace_id: bytes | int) -> bytes:
+    if isinstance(trace_id, int):
+        return trace_id.to_bytes(16, "big", signed=False)
+    raw = bytes(trace_id)
+    return (raw + b"\0" * 16)[:16]
+
+
+def _kv_str(key: str, value: str) -> bytes:
+    any_value = wire.encode_len(1, value.encode())
+    return wire.encode_len(1, key.encode()) + wire.encode_len(2, any_value)
+
+
+def encode_export_request(
+    records: list[SpanRecord], t_ns: int | None = None
+) -> bytes:
+    """SpanRecords → ExportTraceServiceRequest protobuf.
+
+    The inverse of ``otlp.decode_export_request`` over the fields this
+    framework carries (service → resource attr, duration → start/end,
+    error → status code 2, monitored attr → ``app.product.id``) —
+    round-trip pinned by tests. One resource block per service, spans in
+    input order within each.
+    """
+    if t_ns is None:
+        t_ns = int(time.time() * 1e9)
+    by_service: dict[str, list[SpanRecord]] = {}
+    for rec in records:
+        by_service.setdefault(rec.service, []).append(rec)
+    out = b""
+    for service, recs in by_service.items():
+        resource = wire.encode_len(1, _kv_str("service.name", service))
+        spans = b""
+        for rec in recs:
+            end = t_ns
+            start = end - int(max(rec.duration_us, 0.0) * 1000.0)
+            span = (
+                wire.encode_len(1, _norm_trace_id(rec.trace_id))
+                + wire.encode_len(5, (rec.name or "span").encode())
+                + wire.encode_fixed64(7, start)
+                + wire.encode_fixed64(8, end)
+            )
+            if rec.attr:
+                span += wire.encode_len(9, _kv_str("app.product.id", rec.attr))
+            if rec.is_error:
+                span += wire.encode_len(15, wire.encode_int(3, 2))  # ERROR
+            spans += wire.encode_len(2, span)
+        # One ScopeSpans submessage whose repeated `spans` fields are
+        # ``spans`` (field 2 of ScopeSpans == field 2 of ResourceSpans'
+        # entry — wrap ONCE).
+        rs = wire.encode_len(1, resource) + wire.encode_len(2, spans)
+        out += wire.encode_len(1, rs)
+    return out
+
+
+class OtlpHttpSpanExporter:
+    """Subscribe on ``Collector.trace_exporters`` (or a gateway's
+    ``on_spans``): ships each span batch to an OTLP/HTTP ``/v1/traces``
+    endpoint from the background sender."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 64):
+        endpoint = endpoint.rstrip("/")
+        if not endpoint.endswith("/v1/traces"):
+            endpoint += "/v1/traces"
+        self._poster = BackgroundPoster(
+            endpoint, "application/x-protobuf", timeout_s, queue_max
+        )
+
+    def __call__(self, now: float, records: list[SpanRecord]) -> None:
+        if records:
+            self._poster.submit(encode_export_request(records))
+
+    @property
+    def sent(self) -> int:
+        return self._poster.sent
+
+    @property
+    def errors(self) -> int:
+        return self._poster.errors
+
+    @property
+    def dropped(self) -> int:
+        return self._poster.dropped
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        return self._poster.flush(timeout_s)
+
+    def close(self) -> None:
+        self._poster.close()

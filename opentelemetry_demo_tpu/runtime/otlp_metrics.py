@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import json
 import struct
-import threading
 from typing import Iterable, NamedTuple
 
 from . import wire
@@ -315,92 +314,41 @@ class OtlpHttpMetricsExporter:
 
     Subscribe on ``Collector.metrics_exporters``: called after each
     scrape cycle with the scraped (job, registry) pairs, it serialises
-    one ExportMetricsServiceRequest and enqueues it for a background
-    sender thread — ``Collector.pump`` often runs under the gateway's
-    request lock, so the network POST must never block the caller (the
-    reference collector's sending_queue decouples the same way). The
-    bounded queue drops OLDEST on overflow: snapshots are cumulative, so
-    a later export supersedes a lost one. Failures count, not raise.
+    one ExportMetricsServiceRequest and enqueues it on the shared
+    background poster — ``Collector.pump`` often runs under the
+    gateway's request lock, so the network POST must never block the
+    caller (see ``otlp_export.BackgroundPoster`` for the queue/drop
+    semantics). Failures count, not raise.
     """
 
     def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 16):
-        import collections
+        from .otlp_export import BackgroundPoster
 
-        self.endpoint = endpoint.rstrip("/")
-        if not self.endpoint.endswith("/v1/metrics"):
-            self.endpoint += "/v1/metrics"
-        self.timeout_s = timeout_s
-        self.sent = 0
-        self.errors = 0
-        self.dropped = 0
-        self._queue: "collections.deque[bytes]" = collections.deque()
-        self._queue_max = queue_max
-        self._lock = threading.Lock()
-        self._wake = threading.Event()
-        self._idle = threading.Event()
-        self._idle.set()
-        self._stop = False
-        self._thread: threading.Thread | None = None
+        endpoint = endpoint.rstrip("/")
+        if not endpoint.endswith("/v1/metrics"):
+            endpoint += "/v1/metrics"
+        self._poster = BackgroundPoster(
+            endpoint, "application/x-protobuf", timeout_s, queue_max
+        )
 
     def __call__(self, now: float, jobs: list) -> None:
-        body = registry_to_request(jobs, t_ns=int(now * 1e9))
-        with self._lock:
-            self._queue.append(body)
-            while len(self._queue) > self._queue_max:
-                self._queue.popleft()
-                self.dropped += 1
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._send_loop, name="otlp-metrics-export", daemon=True
-                )
-                self._thread.start()
-        self._wake.set()
+        self._poster.submit(registry_to_request(jobs, t_ns=int(now * 1e9)))
 
-    def _send_loop(self) -> None:
-        import urllib.request
+    @property
+    def sent(self) -> int:
+        return self._poster.sent
 
-        while True:
-            self._wake.wait(timeout=0.2)
-            self._wake.clear()
-            while True:
-                with self._lock:
-                    if not self._queue:
-                        self._idle.set()
-                        if self._stop:
-                            return
-                        break
-                    self._idle.clear()
-                    body = self._queue.popleft()
-                req = urllib.request.Request(
-                    self.endpoint,
-                    data=body,
-                    headers={"Content-Type": "application/x-protobuf"},
-                    method="POST",
-                )
-                try:
-                    with urllib.request.urlopen(req, timeout=self.timeout_s):
-                        self.sent += 1
-                except Exception:
-                    self.errors += 1
+    @property
+    def errors(self) -> int:
+        return self._poster.errors
+
+    @property
+    def dropped(self) -> int:
+        return self._poster.dropped
 
     def flush(self, timeout_s: float = 5.0) -> bool:
         """Block until the queue is empty (tests / shutdown)."""
-        import time
-
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            with self._lock:
-                empty = not self._queue
-            if empty and self._idle.is_set():
-                return True
-            self._wake.set()
-            time.sleep(0.005)
-        return False
+        return self._poster.flush(timeout_s)
 
     def close(self) -> None:
-        with self._lock:
-            self._stop = True
-            thread = self._thread
-        self._wake.set()
-        if thread is not None:
-            thread.join(timeout=self.timeout_s + 1.0)
+        self._poster.close()

@@ -164,6 +164,7 @@ ANOMALY_CARDINALITY = "app_anomaly_distinct_traces"
 ANOMALY_HEAVY_HITTER = "app_anomaly_heavy_hitter_ratio"
 ANOMALY_SPANS_TOTAL = "app_anomaly_spans_processed_total"
 ANOMALY_LAG_P99 = "app_anomaly_detection_lag_p99_ms"
+ANOMALY_CUSUM = "app_anomaly_cusum"
 # The metrics-ingestion leg (OTLP /v1/metrics → metrics head).
 ANOMALY_METRIC_Z = "app_anomaly_metric_z_score"
 ANOMALY_METRIC_FLAG_TOTAL = "app_anomaly_metric_flags_total"
@@ -220,6 +221,7 @@ def export_report(
     card_z = np.asarray(report.card_z)
     card = np.asarray(report.card_est)
     hh = np.asarray(report.hh_ratio)
+    cusum = np.asarray(report.cusum)
     # The intern table can outgrow the sketch's service axis (overflow
     # names share the last id but keep their own table entries), so cap
     # at the report's actual row count.
@@ -234,5 +236,9 @@ def export_report(
                            service=name, signal="cardinality")
         registry.gauge_set(ANOMALY_CARDINALITY, float(card[i].max()), service=name)
         registry.gauge_set(ANOMALY_HEAVY_HITTER, float(hh[i].max()), service=name)
+        for j, signal in enumerate(("latency_up", "error_up", "rate_down")):
+            registry.gauge_set(
+                ANOMALY_CUSUM, float(cusum[i, j]), service=name, signal=signal
+            )
     for name in flagged:
         registry.counter_add(ANOMALY_FLAG_TOTAL, 1.0, service=name)

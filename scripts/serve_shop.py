@@ -27,7 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
 from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
 from opentelemetry_demo_tpu.services.gateway import ShopGateway
-from opentelemetry_demo_tpu.services.http_load import HttpLoadGenerator
+from opentelemetry_demo_tpu.services.http_load import (
+    BrowserLoadGenerator,
+    HttpLoadGenerator,
+    browser_traffic_enabled,
+)
 from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig
 from opentelemetry_demo_tpu.telemetry.metrics import export_report
 from opentelemetry_demo_tpu.utils.flag_ui import FlagEditorUI
@@ -35,23 +39,45 @@ from opentelemetry_demo_tpu.utils.flag_ui import FlagEditorUI
 
 def serve(args) -> None:
     shop = Shop(ShopConfig(users=0, seed=args.seed))
-    detector = AnomalyDetector(DetectorConfig(num_services=32))
 
-    def on_report(t, report, flagged):
-        export_report(
-            shop.metrics,
-            pipeline.tensorizer.service_names,
-            report,
-            flagged,
+    pipeline = None
+    span_exporter = None
+    metrics_exporter = None
+    if args.otlp_endpoint:
+        # Compose topology: the detector runs in its OWN process (the
+        # anomaly-detector container); this process exports spans and
+        # scraped metrics to it over OTLP/HTTP, the otelcol exporter
+        # pattern (otelcol-config.yml:85-92, docker-compose.yml:226-256).
+        from opentelemetry_demo_tpu.runtime.otlp_export import (
+            OtlpHttpSpanExporter,
+        )
+        from opentelemetry_demo_tpu.runtime.otlp_metrics import (
+            OtlpHttpMetricsExporter,
         )
 
-    pipeline = DetectorPipeline(
-        detector, flags=shop.flags, on_report=on_report, batch_size=args.batch
-    )
+        span_exporter = OtlpHttpSpanExporter(args.otlp_endpoint)
+        metrics_exporter = OtlpHttpMetricsExporter(args.otlp_endpoint)
+        shop.collector.metrics_exporters.append(metrics_exporter)
+        on_spans = span_exporter
+    else:
+        # Single-process mode: in-proc detector pipeline.
+        detector = AnomalyDetector(DetectorConfig(num_services=32))
 
-    def on_spans(t, spans):
-        pipeline.submit(spans)
-        pipeline.pump(t)
+        def on_report(t, report, flagged):
+            export_report(
+                shop.metrics,
+                pipeline.tensorizer.service_names,
+                report,
+                flagged,
+            )
+
+        pipeline = DetectorPipeline(
+            detector, flags=shop.flags, on_report=on_report, batch_size=args.batch
+        )
+
+        def on_spans(t, spans):
+            pipeline.submit(spans)
+            pipeline.pump(t)
 
     gw = ShopGateway(shop, host=args.host, port=args.port, on_spans=on_spans)
     gw.feature_ui = FlagEditorUI(shop.flags)
@@ -66,26 +92,50 @@ def serve(args) -> None:
         )
         load.start()
         print(f"in-proc load: {args.users} users", flush=True)
+    browser_load = None
+    if browser_traffic_enabled():
+        # The reference gates Playwright browser users the same way
+        # (locustfile.py:180-211, LOCUST_BROWSER_TRAFFIC_ENABLED).
+        browser_load = BrowserLoadGenerator(
+            f"http://127.0.0.1:{gw.port}", users=1, seed=args.seed
+        )
+        browser_load.start()
+        print("in-proc browser load: 1 user", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
-    if load is not None:
-        load.stop()
+    for lg in (load, browser_load):
+        if lg is not None:
+            lg.stop()
     gw.stop()
-    pipeline.drain()
+    if pipeline is not None:
+        pipeline.drain()
+    for exporter in (span_exporter, metrics_exporter):
+        if exporter is not None:
+            exporter.flush()
+            exporter.close()
 
 
 def load_only(args) -> None:
     load = HttpLoadGenerator(args.target, users=args.users, seed=args.seed)
     load.start()
     print(f"load: {args.users} users → {args.target}", flush=True)
+    browser_load = None
+    if browser_traffic_enabled():
+        browser_load = BrowserLoadGenerator(
+            args.target, users=1, seed=args.seed
+        )
+        browser_load.start()
+        print("browser load: 1 user", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     load.stop()
+    if browser_load is not None:
+        browser_load.stop()
 
 
 def main() -> None:
@@ -97,6 +147,12 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=512)
     parser.add_argument("--load-only", action="store_true")
     parser.add_argument("--target", default="http://127.0.0.1:8080")
+    parser.add_argument(
+        "--otlp-endpoint",
+        default=os.getenv("OTEL_EXPORTER_OTLP_ENDPOINT", ""),
+        help="export spans+metrics to an external anomaly-detector "
+        "daemon over OTLP/HTTP instead of running one in-process",
+    )
     args = parser.parse_args()
     if args.load_only:
         load_only(args)

@@ -1,0 +1,84 @@
+"""Span export codec: SpanRecords → OTLP protobuf → both decoders.
+
+The shop-side half of the cross-process seam (runtime.otlp_export);
+nesting bugs here silently turn every exported batch into one garbage
+record, so the round trip is pinned through the Python decoder AND the
+native columnar decoder.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+
+import pytest
+
+from opentelemetry_demo_tpu.runtime import native
+from opentelemetry_demo_tpu.runtime.otlp import (
+    OtlpHttpReceiver,
+    decode_export_request,
+    decode_export_request_columnar,
+)
+from opentelemetry_demo_tpu.runtime.otlp_export import (
+    OtlpHttpSpanExporter,
+    encode_export_request,
+)
+from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
+
+RECORDS = [
+    SpanRecord("payment", 1500.0, b"\x01" * 16, True, "X1", "Charge"),
+    SpanRecord("payment", 900.0, b"\x02" * 16, False, None, "ok"),
+    SpanRecord("cart", 50.5, 7, False, None, None),
+]
+
+
+def test_roundtrip_through_python_decoder():
+    out = decode_export_request(encode_export_request(RECORDS, t_ns=10**18))
+    assert [(r.service, round(r.duration_us, 1), r.is_error, r.attr) for r in out] == [
+        ("payment", 1500.0, True, "X1"),
+        ("payment", 900.0, False, None),
+        ("cart", 50.5, False, None),
+    ]
+    assert out[0].name == "Charge"
+    assert out[0].trace_id[:4] == b"\x01\x01\x01\x01"
+
+
+@pytest.mark.skipif(not native.available(), reason="native ingest unavailable")
+def test_roundtrip_through_native_columnar_decoder():
+    cols = decode_export_request_columnar(
+        encode_export_request(RECORDS, t_ns=10**18)
+    )
+    assert cols.services == ["payment", "cart"]
+    assert cols.is_error.tolist() == [1, 0, 0]
+    assert cols.duration_us.round(1).tolist() == [1500.0, 900.0, 50.5]
+
+
+def test_exporter_ships_to_receiver():
+    got: list[SpanRecord] = []
+    done = threading.Event()
+
+    def on_records(records):
+        got.extend(records)
+        done.set()
+
+    recv = OtlpHttpReceiver(on_records, host="127.0.0.1", port=0)
+    recv.start()
+    try:
+        exporter = OtlpHttpSpanExporter(f"http://127.0.0.1:{recv.port}")
+        exporter(0.0, RECORDS)
+        assert exporter.flush(5.0)
+        assert done.wait(5.0)
+        assert exporter.sent == 1 and exporter.errors == 0
+        assert [r.service for r in got] == ["payment", "payment", "cart"]
+        assert got[0].is_error
+        exporter.close()
+    finally:
+        recv.stop()
+
+
+def test_exporter_down_sink_counts_not_raises():
+    exporter = OtlpHttpSpanExporter("http://127.0.0.1:9", timeout_s=0.3)
+    exporter(0.0, RECORDS)  # discard port: connection refused
+    exporter.flush(5.0)
+    assert exporter.errors == 1 and exporter.sent == 0
+    exporter.close()
