@@ -86,6 +86,14 @@ def first(fields: dict[int, list], field_no: int, default=None):
     return vals[0] if vals else default
 
 
+def to_int64(value: int) -> int:
+    """Sign-extend a decoded varint: proto3 int32/int64 encode negatives
+    as 64-bit two's complement, which :func:`read_varint` returns as the
+    raw unsigned value. The decode-side counterpart of
+    :func:`encode_varint`'s negative handling."""
+    return value - (1 << 64) if value >= (1 << 63) else value
+
+
 # --- encoding helpers (tests + loopback fixtures) ---------------------
 
 

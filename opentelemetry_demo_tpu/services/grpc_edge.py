@@ -1,0 +1,284 @@
+"""gRPC edge: the reference's 9-service gRPC surface over the shop.
+
+The reference's business services ARE gRPC servers (pb/demo.proto
+services; e.g. checkout serves CheckoutService, cart CartService). This
+framework's services are in-proc objects behind the HTTP gateway; the
+gRPC edge exposes the same wire surface — method paths
+``/oteldemo.<Service>/<Method>`` with the reference's field numbers
+(proto/demo.proto) — so a client built against the reference's stubs
+talks to this shop unchanged.
+
+Transport is grpcio generic raw-bytes handlers (the ``otlp_grpc``
+pattern): requests decode by field number through the wire scanner,
+responses encode with the wire helpers — no generated stubs anywhere in
+the runtime. Interop with REAL protoc stubs is pinned by
+tests/test_grpc_edge.py.
+
+Every call runs under one lock: the shop object graph is single-writer
+by design (the HTTP gateway serializes the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..runtime import wire
+from ..telemetry.tracer import TraceContext
+from .base import ServiceError
+from .money import Money
+
+PKG = "oteldemo"
+
+
+# -- message codecs (field numbers = proto/demo.proto) ------------------
+
+
+def _enc_money(m: Money) -> bytes:
+    out = wire.encode_len(1, m.currency.encode())
+    if m.units:
+        out += wire.encode_int(2, m.units)
+    if m.nanos:
+        out += wire.encode_int(3, m.nanos)
+    return out
+
+
+def _dec_money(buf: bytes) -> Money:
+    f = wire.scan_fields(buf)
+    code = wire.first(f, 1, b"USD")
+    # int64/int32 varints need sign extension — a negative Money (a
+    # refund) arrives as 64-bit two's complement on the wire.
+    return Money(
+        code.decode() if isinstance(code, bytes) else "USD",
+        wire.to_int64(int(wire.first(f, 2, 0) or 0)),
+        wire.to_int64(int(wire.first(f, 3, 0) or 0)),
+    )
+
+
+def _dec_str(fields: dict, n: int, default: str = "") -> str:
+    raw = wire.first(fields, n, None)
+    return raw.decode("utf-8", "replace") if isinstance(raw, bytes) else default
+
+
+def _enc_cart_item(product_id: str, qty: int) -> bytes:
+    return wire.encode_len(1, product_id.encode()) + wire.encode_int(2, qty)
+
+
+def _enc_product(p: dict) -> bytes:
+    out = wire.encode_len(1, p["id"].encode())
+    out += wire.encode_len(2, p.get("name", "").encode())
+    if p.get("description"):
+        out += wire.encode_len(3, p["description"].encode())
+    out += wire.encode_len(4, f"/images/{p['id']}.svg".encode())
+    out += wire.encode_len(5, _enc_money(Money.from_float("USD", p["priceUsd"])))
+    for cat in p.get("categories", []):
+        out += wire.encode_len(6, cat.encode())
+    return out
+
+
+class GrpcShopEdge:
+    """Serves the oteldemo gRPC surface; delegates into a Shop."""
+
+    def __init__(self, shop, host: str = "0.0.0.0", port: int = 0,
+                 lock: threading.Lock | None = None, max_workers: int = 4):
+        import grpc
+        from concurrent import futures
+
+        self.shop = shop
+        self._lock = lock or threading.Lock()
+        edge = self
+
+        handlers = {
+            f"/{PKG}.CartService/AddItem": self._add_item,
+            f"/{PKG}.CartService/GetCart": self._get_cart,
+            f"/{PKG}.CartService/EmptyCart": self._empty_cart,
+            f"/{PKG}.RecommendationService/ListRecommendations":
+                self._list_recommendations,
+            f"/{PKG}.ProductCatalogService/ListProducts": self._list_products,
+            f"/{PKG}.ProductCatalogService/GetProduct": self._get_product,
+            f"/{PKG}.ProductCatalogService/SearchProducts": self._search_products,
+            f"/{PKG}.ShippingService/GetQuote": self._get_quote,
+            f"/{PKG}.ShippingService/ShipOrder": self._ship_order,
+            f"/{PKG}.CurrencyService/GetSupportedCurrencies":
+                self._supported_currencies,
+            f"/{PKG}.CurrencyService/Convert": self._convert,
+            f"/{PKG}.PaymentService/Charge": self._charge,
+            f"/{PKG}.EmailService/SendOrderConfirmation": self._send_confirmation,
+            f"/{PKG}.CheckoutService/PlaceOrder": self._place_order,
+            f"/{PKG}.AdService/GetAds": self._get_ads,
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                fn = handlers.get(details.method)
+                if fn is None:
+                    return None
+
+                def call(request: bytes, context) -> bytes:
+                    ctx = TraceContext.new({})
+                    try:
+                        with edge._lock:
+                            return fn(ctx, request)
+                    except ServiceError as e:
+                        context.abort(grpc.StatusCode.INTERNAL, str(e))
+                    except (wire.WireError, ValueError) as e:
+                        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    call, request_deserializer=None, response_serializer=None
+                )
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="grpc-edge"
+            )
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise OSError(f"gRPC edge failed to bind {host}:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+    # -- cart ----------------------------------------------------------
+
+    def _add_item(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        user_id = _dec_str(f, 1)
+        item = wire.scan_fields(wire.first(f, 2, b"") or b"")
+        self.shop.cart.add_item(
+            ctx, user_id, _dec_str(item, 1), int(wire.first(item, 2, 1) or 1)
+        )
+        return b""
+
+    def _get_cart(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        user_id = _dec_str(f, 1)
+        items = self.shop.cart.get_cart(ctx, user_id)
+        out = wire.encode_len(1, user_id.encode())
+        for pid, qty in items.items():
+            out += wire.encode_len(2, _enc_cart_item(pid, qty))
+        return out
+
+    def _empty_cart(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        self.shop.cart.empty_cart(ctx, _dec_str(f, 1))
+        return b""
+
+    # -- recommendation / catalog --------------------------------------
+
+    def _list_recommendations(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        exclude = [b.decode("utf-8", "replace") for b in f.get(2, [])]
+        recs = self.shop.recommendation.list_recommendations(ctx, exclude)
+        return b"".join(wire.encode_len(1, r.encode()) for r in recs)
+
+    def _list_products(self, ctx, request: bytes) -> bytes:
+        products = self.shop.catalog.list_products(ctx)
+        return b"".join(
+            wire.encode_len(1, _enc_product(p)) for p in products
+        )
+
+    def _get_product(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        return _enc_product(self.shop.catalog.get_product(ctx, _dec_str(f, 1)))
+
+    def _search_products(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        hits = self.shop.catalog.search_products(ctx, _dec_str(f, 1))
+        return b"".join(wire.encode_len(1, _enc_product(p)) for p in hits)
+
+    # -- shipping ------------------------------------------------------
+
+    @staticmethod
+    def _item_count(f: dict) -> int:
+        count = 0
+        for item_buf in f.get(2, []):
+            item = wire.scan_fields(item_buf)
+            count += int(wire.first(item, 2, 1) or 1)
+        return count
+
+    def _get_quote(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        cost = self.shop.shipping.get_quote(ctx, self._item_count(f))
+        return wire.encode_len(1, _enc_money(cost))
+
+    def _ship_order(self, ctx, request: bytes) -> bytes:
+        tracking = self.shop.shipping.ship_order(ctx)
+        return wire.encode_len(1, tracking.encode())
+
+    # -- currency / payment --------------------------------------------
+
+    def _supported_currencies(self, ctx, request: bytes) -> bytes:
+        codes = self.shop.currency.supported_currencies(ctx)
+        return b"".join(wire.encode_len(1, c.encode()) for c in codes)
+
+    def _convert(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        money = _dec_money(wire.first(f, 1, b"") or b"")
+        converted = self.shop.currency.convert(ctx, money, _dec_str(f, 2))
+        return _enc_money(converted)
+
+    def _charge(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        amount = _dec_money(wire.first(f, 1, b"") or b"")
+        card = wire.scan_fields(wire.first(f, 2, b"") or b"")
+        txid = self.shop.payment.charge(
+            ctx,
+            amount,
+            _dec_str(card, 1),
+            int(wire.first(card, 3, 2030) or 2030),
+            int(wire.first(card, 4, 1) or 1),
+        )
+        return wire.encode_len(1, txid.encode())
+
+    # -- email / checkout / ad -----------------------------------------
+
+    def _send_confirmation(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        order = wire.scan_fields(wire.first(f, 2, b"") or b"")
+        self.shop.email.send_order_confirmation(
+            ctx, _dec_str(f, 1), _dec_str(order, 1)
+        )
+        return b""
+
+    def _place_order(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        card = wire.scan_fields(wire.first(f, 6, b"") or b"")
+        kwargs = {}
+        if _dec_str(card, 1):
+            kwargs = {
+                "card_number": _dec_str(card, 1),
+                "expiry_year": int(wire.first(card, 3, 2030) or 2030),
+                "expiry_month": int(wire.first(card, 4, 1) or 1),
+            }
+        placed = self.shop.checkout.place_order(
+            ctx,
+            _dec_str(f, 1),
+            _dec_str(f, 2, "USD"),
+            _dec_str(f, 5),
+            **kwargs,
+        )
+        order = (
+            wire.encode_len(1, placed.order_id.encode())
+            + wire.encode_len(2, placed.tracking_id.encode())
+            + wire.encode_len(3, _enc_money(placed.total))
+        )
+        for pid in placed.items:
+            order += wire.encode_len(
+                5, wire.encode_len(1, _enc_cart_item(pid, 1))
+            )
+        return wire.encode_len(1, order)
+
+    def _get_ads(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        keys = [b.decode("utf-8", "replace") for b in f.get(1, [])]
+        ads = self.shop.ad.get_ads(ctx, keys)
+        out = b""
+        for ad_text in ads:
+            ad = wire.encode_len(1, b"/") + wire.encode_len(2, ad_text.encode())
+            out += wire.encode_len(1, ad)
+        return out

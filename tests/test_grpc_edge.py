@@ -1,0 +1,203 @@
+"""gRPC edge ⇄ protoc-stub interop: the 9-service wire surface.
+
+Clients here are built from REAL protoc-generated stubs of
+proto/demo.proto (the reference's field numbers), talking to the edge's
+hand-rolled wire handlers over a real gRPC socket — the proof that a
+client of the reference's services talks to this shop unchanged
+(VERDICT r1 "Next #10").
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from opentelemetry_demo_tpu.services.grpc_edge import GrpcShopEdge  # noqa: E402
+from opentelemetry_demo_tpu.services.shop import Shop, ShopConfig  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None
+    or importlib.util.find_spec("google.protobuf") is None,
+    reason="protoc / protobuf runtime unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path_factory.mktemp("proto_gen_edge")
+    subprocess.run(
+        ["protoc", "--python_out", str(out), "proto/demo.proto"],
+        check=True,
+        cwd=repo_root,
+    )
+    sys.path.insert(0, str(out / "proto"))
+    try:
+        import demo_pb2  # noqa: F401
+
+        yield demo_pb2
+    finally:
+        sys.path.remove(str(out / "proto"))
+        sys.modules.pop("demo_pb2", None)
+
+
+@pytest.fixture(scope="module")
+def edge():
+    shop = Shop(ShopConfig(users=0, seed=11))
+    e = GrpcShopEdge(shop, host="127.0.0.1", port=0)
+    e.start()
+    yield e
+    e.stop()
+
+
+def _stub(edge, pb2, service: str, method: str, req_cls, resp_cls):
+    channel = grpc.insecure_channel(f"127.0.0.1:{edge.port}")
+    return channel.unary_unary(
+        f"/oteldemo.{service}/{method}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+def test_catalog_surface(edge, pb2):
+    list_products = _stub(edge, pb2, "ProductCatalogService", "ListProducts",
+                          pb2.Empty, pb2.ListProductsResponse)
+    resp = list_products(pb2.Empty(), timeout=5)
+    assert len(resp.products) >= 5
+    first = resp.products[0]
+    assert first.id and first.name
+    assert first.price_usd.currency_code == "USD"
+    assert first.price_usd.units > 0
+
+    get_product = _stub(edge, pb2, "ProductCatalogService", "GetProduct",
+                        pb2.GetProductRequest, pb2.Product)
+    p = get_product(pb2.GetProductRequest(id=first.id), timeout=5)
+    assert p.id == first.id and p.picture.endswith(".svg")
+
+    search = _stub(edge, pb2, "ProductCatalogService", "SearchProducts",
+                   pb2.SearchProductsRequest, pb2.SearchProductsResponse)
+    hits = search(pb2.SearchProductsRequest(query="telescope"), timeout=5)
+    assert hits.results
+
+
+def test_cart_round_trip(edge, pb2):
+    add = _stub(edge, pb2, "CartService", "AddItem",
+                pb2.AddItemRequest, pb2.Empty)
+    get = _stub(edge, pb2, "CartService", "GetCart",
+                pb2.GetCartRequest, pb2.Cart)
+    empty = _stub(edge, pb2, "CartService", "EmptyCart",
+                  pb2.EmptyCartRequest, pb2.Empty)
+    add(pb2.AddItemRequest(
+        user_id="u1",
+        item=pb2.CartItem(product_id="TEL-DOB-10", quantity=2)), timeout=5)
+    cart = get(pb2.GetCartRequest(user_id="u1"), timeout=5)
+    assert cart.user_id == "u1"
+    assert [(i.product_id, i.quantity) for i in cart.items] == [("TEL-DOB-10", 2)]
+    empty(pb2.EmptyCartRequest(user_id="u1"), timeout=5)
+    assert not get(pb2.GetCartRequest(user_id="u1"), timeout=5).items
+
+
+def test_currency_convert(edge, pb2):
+    convert = _stub(edge, pb2, "CurrencyService", "Convert",
+                    pb2.CurrencyConversionRequest, pb2.Money)
+    out = convert(pb2.CurrencyConversionRequest(
+        **{"from": pb2.Money(currency_code="USD", units=10)},
+        to_code="EUR"), timeout=5)
+    assert out.currency_code == "EUR"
+    assert 0 < out.units + out.nanos / 1e9 < 10.5
+
+    supported = _stub(edge, pb2, "CurrencyService", "GetSupportedCurrencies",
+                      pb2.Empty, pb2.GetSupportedCurrenciesResponse)
+    codes = supported(pb2.Empty(), timeout=5).currency_codes
+    assert "USD" in codes and "EUR" in codes
+
+
+def test_currency_convert_negative_money(edge, pb2):
+    # A refund: negative int64 units ride the wire as 64-bit two's
+    # complement — the decode must sign-extend, not conjure 1.8e19.
+    convert = _stub(edge, pb2, "CurrencyService", "Convert",
+                    pb2.CurrencyConversionRequest, pb2.Money)
+    out = convert(pb2.CurrencyConversionRequest(
+        **{"from": pb2.Money(currency_code="USD", units=-2,
+                             nanos=-500_000_000)},
+        to_code="USD"), timeout=5)
+    assert out.units == -2 and out.nanos == -500_000_000
+
+
+def test_shipping_and_payment(edge, pb2):
+    quote = _stub(edge, pb2, "ShippingService", "GetQuote",
+                  pb2.GetQuoteRequest, pb2.GetQuoteResponse)
+    q = quote(pb2.GetQuoteRequest(items=[
+        pb2.CartItem(product_id="X", quantity=2),
+        pb2.CartItem(product_id="Y", quantity=1)]), timeout=5)
+    assert q.cost_usd.units > 0
+
+    ship = _stub(edge, pb2, "ShippingService", "ShipOrder",
+                 pb2.ShipOrderRequest, pb2.ShipOrderResponse)
+    assert len(ship(pb2.ShipOrderRequest(), timeout=5).tracking_id) == 36
+
+    charge = _stub(edge, pb2, "PaymentService", "Charge",
+                   pb2.ChargeRequest, pb2.ChargeResponse)
+    resp = charge(pb2.ChargeRequest(
+        amount=pb2.Money(currency_code="USD", units=30),
+        credit_card=pb2.CreditCardInfo(
+            credit_card_number="4432801561520454",
+            credit_card_expiration_year=2030,
+            credit_card_expiration_month=1)), timeout=5)
+    assert resp.transaction_id
+
+
+def test_place_order_full_path(edge, pb2):
+    add = _stub(edge, pb2, "CartService", "AddItem",
+                pb2.AddItemRequest, pb2.Empty)
+    add(pb2.AddItemRequest(
+        user_id="buyer",
+        item=pb2.CartItem(product_id="EYE-PLO-25", quantity=1)), timeout=5)
+    place = _stub(edge, pb2, "CheckoutService", "PlaceOrder",
+                  pb2.PlaceOrderRequest, pb2.PlaceOrderResponse)
+    resp = place(pb2.PlaceOrderRequest(
+        user_id="buyer", user_currency="USD", email="b@example.com",
+        credit_card=pb2.CreditCardInfo(
+            credit_card_number="4432801561520454",
+            credit_card_expiration_year=2030,
+            credit_card_expiration_month=1)), timeout=5)
+    assert resp.order.order_id
+    assert len(resp.order.shipping_tracking_id) == 36
+    assert [i.item.product_id for i in resp.order.items] == ["EYE-PLO-25"]
+
+
+def test_recommendations_and_ads(edge, pb2):
+    recs = _stub(edge, pb2, "RecommendationService", "ListRecommendations",
+                 pb2.ListRecommendationsRequest, pb2.ListRecommendationsResponse)
+    out = recs(pb2.ListRecommendationsRequest(
+        user_id="u", product_ids=["TEL-DOB-10"]), timeout=5)
+    assert out.product_ids and "TEL-DOB-10" not in out.product_ids
+
+    ads = _stub(edge, pb2, "AdService", "GetAds",
+                pb2.AdRequest, pb2.AdResponse)
+    resp = ads(pb2.AdRequest(context_keys=["telescopes"]), timeout=5)
+    assert resp.ads and all(a.text for a in resp.ads)
+
+
+def test_email_confirmation(edge, pb2):
+    send = _stub(edge, pb2, "EmailService", "SendOrderConfirmation",
+                 pb2.SendOrderConfirmationRequest, pb2.Empty)
+    send(pb2.SendOrderConfirmationRequest(
+        email="a@b.c", order=pb2.OrderResult(order_id="o-1")), timeout=5)
+
+
+def test_service_error_is_internal_status(edge, pb2):
+    place = _stub(edge, pb2, "CheckoutService", "PlaceOrder",
+                  pb2.PlaceOrderRequest, pb2.PlaceOrderResponse)
+    with pytest.raises(grpc.RpcError) as exc:  # empty cart
+        place(pb2.PlaceOrderRequest(
+            user_id="nobody", user_currency="USD", email="x@y.z"), timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INTERNAL
