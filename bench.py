@@ -74,16 +74,22 @@ def make_batch_pool(config, batch_size, n_pool, rng):
     return pool
 
 
-def main():
-    # 512k: the XLA path (auto-selected for large batches; CMS counting
-    # via the scatter-free sort+searchsorted histogram) saturates ~20M
-    # spans/s from B≈128k on v5e-1; 512k keeps the timed regions long
-    # relative to any fixed overheads.
-    batch_size = int(os.environ.get("BENCH_BATCH", 524288))
-    config = DetectorConfig()
-    step = jax.jit(partial(detector_step, config), donate_argnums=0)
-    rng = np.random.default_rng(0)
+def measure_throughput(
+    config: DetectorConfig,
+    batch_size: int,
+    rng,
+    min_signal_s: float = 0.5,
+    target_region_s: float = 2.0,
+) -> float:
+    """Slope-timed spans/sec through the full detector step.
 
+    Times two state-chained regions of k1/k2 steps, each terminated by a
+    real device→host scalar fetch, and reports (t2-t1)/(k2-k1) — fixed
+    costs (fetch RTT, loop overhead) cancel, device compute cannot be
+    hidden (the only honest timing on tunneled PJRT topologies, where
+    block_until_ready can return early).
+    """
+    step = jax.jit(partial(detector_step, config), donate_argnums=0)
     n_pool = 4
     pool = make_batch_pool(config, batch_size, n_pool, rng)
     dt_host = batch_size / BASELINE_SPANS_PER_SEC
@@ -104,7 +110,7 @@ def main():
     state = detector_init(config)
     # Warmup / compile, then a real fetch so the whole run measures in
     # the same (synchronized) tunnel regime.
-    state, report = step(state, *pool[0], dt, mask_seq[1])
+    state, _report = step(state, *pool[0], dt, mask_seq[1])
     _ = int(np.asarray(state.step_idx))
 
     def region(k: int, state):
@@ -124,11 +130,10 @@ def main():
     ta, state = region(4, state)
     tb, state = region(12, state)
     per_step_est = max((tb - ta) / 8, 1e-5)
-    k1 = min(max(int(2.0 / per_step_est), 8), 2000)
+    k1 = min(max(int(target_region_s / per_step_est), 8), 2000)
 
     # Accept a measurement only when the inter-region signal dwarfs
-    # RTT jitter (≥0.5 s of extra device work); otherwise grow the
-    # regions and retry.
+    # RTT jitter; otherwise grow the regions and retry.
     per_step = 0.0
     signal = 0.0
     for _attempt in range(4):
@@ -137,20 +142,63 @@ def main():
         t2, state = region(k2, state)
         per_step = (t2 - t1) / (k2 - k1)
         signal = t2 - t1
-        if per_step > 0 and signal >= 0.5:
+        if per_step > 0 and signal >= min_signal_s:
             break
         k1 = min(k1 * 4, 20_000)
-    if per_step <= 0 or signal < 0.5:
+    if per_step <= 0 or signal < min_signal_s:
         raise RuntimeError(
             f"slope {per_step!r} with only {signal:.3f}s of inter-region "
             "signal after retries — timing noise exceeded the signal; "
             "refusing to report"
         )
+    return batch_size / per_step
 
-    spans_per_sec = batch_size / per_step
+
+def measure_impl_matrix(rng) -> dict[str, float]:
+    """impl × batch-size crossover matrix (BASELINE config #4 audit).
+
+    The dense Pallas kernel's per-span cost is a fixed sweep of all
+    sketch cell tiles per batch tile — flat in B — so it owns the
+    small-batch low-latency regime; the XLA path's O(1)-per-span
+    scatter-free formulation wins throughput at large B. The matrix in
+    the artifact makes the auto-select crossover auditable instead of
+    asserted. Looser signal floor (0.3 s) than the headline number —
+    these are regime comparisons, not the record.
+    """
+    if jax.default_backend() != "tpu":
+        return {}
+    out: dict[str, float] = {}
+    # Three regimes, both impls: 6 compiles ≈ the bulk of the cost.
+    for impl in ("pallas", "xla"):
+        for batch in (2048, 65536, 524288):
+            config = DetectorConfig(sketch_impl=impl)
+            try:
+                rate = measure_throughput(
+                    config, batch, rng, min_signal_s=0.3, target_region_s=0.8
+                )
+            except (RuntimeError, ValueError):
+                out[f"{impl}@{batch}"] = float("nan")
+                continue
+            out[f"{impl}@{batch}"] = round(rate, 1)
+    return out
+
+
+def main():
+    # 512k: the XLA path (auto-selected for large batches; CMS counting
+    # via the scatter-free sort+searchsorted histogram) saturates ~20M
+    # spans/s from B≈128k on v5e-1; 512k keeps the timed regions long
+    # relative to any fixed overheads.
+    batch_size = int(os.environ.get("BENCH_BATCH", 524288))
+    rng = np.random.default_rng(0)
+    spans_per_sec = measure_throughput(DetectorConfig(), batch_size, rng)
+
+    # ---- impl × batch crossover (config #4 audit) --------------------
+    matrix = {}
+    if os.environ.get("BENCH_MATRIX", "1") != "0":
+        matrix = measure_impl_matrix(rng)
 
     # ---- north star #2: detection lag through the real pipeline ------
-    fetch_rtt_ms = measure_fetch_rtt(state)
+    fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
 
     print(
@@ -167,6 +215,7 @@ def main():
                 "lag_rate_spans_per_sec": lag["rate"],
                 "lag_batches": lag["batches"],
                 "fetch_rtt_ms": fetch_rtt_ms,
+                "sketch_impl_matrix": matrix,
                 "lag_note": (
                     "p99 is submit-to-harvest through the real pipeline "
                     "(every harvest pays one device-to-host fetch); on a "
@@ -178,7 +227,7 @@ def main():
     )
 
 
-def measure_fetch_rtt(state) -> float:
+def measure_fetch_rtt() -> float:
     """Median ms of a 1-scalar device→host fetch (the harvest's floor).
 
     block_until_ready can return early on tunneled PJRT topologies, so
@@ -188,10 +237,11 @@ def measure_fetch_rtt(state) -> float:
     the first conversion, so re-fetching the same array times a dict
     lookup, not the wire).
     """
+    base = jnp.zeros((), jnp.int32)
     bump = jax.jit(lambda s, i: s + i)
     samples = []
     for i in range(7):
-        fresh = bump(state.step_idx, i)
+        fresh = bump(base, i)
         t0 = time.perf_counter()
         _ = int(np.asarray(fresh))
         samples.append((time.perf_counter() - t0) * 1000.0)
@@ -199,57 +249,16 @@ def measure_fetch_rtt(state) -> float:
     return round(samples[len(samples) // 2], 3)
 
 
-def measure_lag(rng, rate: float | None = None, seconds: float | None = None):
-    """p99 submit→harvest lag via the real DetectorPipeline (the
-    scripts/bench_lag.py methodology, embedded so the driver artifact
-    carries the number)."""
-    from opentelemetry_demo_tpu.models import AnomalyDetector
-    from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
-    from opentelemetry_demo_tpu.runtime.tensorize import SpanColumns
+def measure_lag(rng):
+    """p99 submit→harvest lag via the shared methodology
+    (runtime.lagbench — also the scripts/bench_lag.py engine)."""
+    del rng  # lagbench owns its seeding
+    from opentelemetry_demo_tpu.runtime.lagbench import measure_lag as run
 
-    rate = float(os.environ.get("BENCH_LAG_RATE", rate or 2_000.0))
-    seconds = float(os.environ.get("BENCH_LAG_SECONDS", seconds or 6.0))
-    batch = 256
-    detector = AnomalyDetector(DetectorConfig())
-    pipe = DetectorPipeline(detector, batch_size=batch)
-
-    def make_columns(rows: int) -> SpanColumns:
-        return SpanColumns(
-            svc=rng.integers(0, 20, size=rows).astype(np.int32),
-            lat_us=rng.gamma(4.0, 250.0, size=rows).astype(np.float32),
-            is_error=(rng.random(rows) < 0.02).astype(np.float32),
-            trace_key=rng.integers(0, 2**63, size=rows, dtype=np.uint64),
-            attr_crc=rng.zipf(1.5, size=rows).astype(np.uint64),
-        )
-
-    chunks = [make_columns(batch) for _ in range(16)]
-    interval = batch / rate
-
-    # Warmup compiles the pipeline's step; scrub it from the stats.
-    pipe.submit_columns(chunks[0])
-    pipe.pump(time.monotonic())
-    pipe.drain()
-    pipe.stats.lag_ms.clear()
-    base_batches = pipe.stats.batches
-
-    end = time.monotonic() + seconds
-    next_at = time.monotonic()
-    i = 0
-    while time.monotonic() < end:
-        now = time.monotonic()
-        if now < next_at:
-            time.sleep(min(next_at - now, interval))
-            continue
-        next_at += interval
-        pipe.submit_columns(chunks[i % len(chunks)])
-        pipe.pump(time.monotonic())
-        i += 1
-    pipe.drain()
-    return {
-        "p99_ms": round(pipe.stats.lag_p99_ms(), 3),
-        "rate": rate,
-        "batches": pipe.stats.batches - base_batches,
-    }
+    return run(
+        rate=float(os.environ.get("BENCH_LAG_RATE", 2_000.0)),
+        seconds=float(os.environ.get("BENCH_LAG_SECONDS", 6.0)),
+    )
 
 
 if __name__ == "__main__":

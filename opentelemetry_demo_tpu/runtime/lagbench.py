@@ -1,0 +1,95 @@
+"""Detection-lag measurement: one methodology, two entry points.
+
+The p99 submit→harvest lag through the REAL DetectorPipeline at a paced
+span rate — the second BASELINE north star ("<100 ms p99 detection lag
+under the default Locust load profile"). Both ``bench.py`` (the driver
+artifact) and ``scripts/bench_lag.py`` (the standalone CLI) call this,
+so the reported numbers can never silently diverge.
+
+Timing integrity: every harvest ends in a real device→host fetch (the
+packed report), so the lag samples are fetch-terminated — the only
+honest synchronization on tunneled PJRT topologies where
+``block_until_ready`` can return early.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..models import AnomalyDetector, DetectorConfig
+from .pipeline import DetectorPipeline
+from .tensorize import SpanColumns
+
+BASELINE_LAG_MS = 100.0
+
+
+def make_columns(rng, rows: int) -> SpanColumns:
+    return SpanColumns(
+        svc=rng.integers(0, 20, size=rows).astype(np.int32),
+        lat_us=rng.gamma(4.0, 250.0, size=rows).astype(np.float32),
+        is_error=(rng.random(rows) < 0.02).astype(np.float32),
+        trace_key=rng.integers(0, 2**63, size=rows, dtype=np.uint64),
+        attr_crc=rng.zipf(1.5, size=rows).astype(np.uint64),
+    )
+
+
+def measure_lag(
+    rate: float = 2_000.0,
+    seconds: float = 6.0,
+    batch: int = 256,
+    harvest_interval_s: float = 0.0,
+    harvest_async: bool = False,
+    seed: int = 0,
+    config: DetectorConfig | None = None,
+) -> dict:
+    """Drive the pipeline at ``rate`` spans/s; return lag statistics.
+
+    The default rate models the north star's own config — the default
+    Locust profile is 5 users with 1-10 s waits (~10²-10³ spans/s), not
+    the 200k/s throughput stress config (pass ``rate=200_000`` +
+    ``harvest_async=True`` for that regime).
+    """
+    detector = AnomalyDetector(config or DetectorConfig())
+    pipe = DetectorPipeline(
+        detector,
+        batch_size=batch,
+        harvest_interval_s=harvest_interval_s,
+        harvest_async=harvest_async,
+    )
+    rng = np.random.default_rng(seed)
+    # Pre-build chunks so generation cost stays off the timed path.
+    chunks = [make_columns(rng, batch) for _ in range(16)]
+    interval = batch / rate
+
+    # Warmup compiles the step; scrub it from every reported stat.
+    pipe.submit_columns(chunks[0])
+    pipe.pump(time.monotonic())
+    pipe.drain()
+    pipe.stats.lag_ms.clear()
+    base_batches = pipe.stats.batches
+    base_spans = pipe.stats.spans
+    base_skipped = pipe.stats.reports_skipped
+
+    end = time.monotonic() + seconds
+    next_at = time.monotonic()
+    i = 0
+    while time.monotonic() < end:
+        now = time.monotonic()
+        if now < next_at:
+            time.sleep(min(next_at - now, interval))
+            continue
+        next_at += interval
+        pipe.submit_columns(chunks[i % len(chunks)])
+        pipe.pump(time.monotonic())
+        i += 1
+    pipe.close()
+
+    return {
+        "p99_ms": round(pipe.stats.lag_p99_ms(), 3),
+        "rate": rate,
+        "batches": pipe.stats.batches - base_batches,
+        "spans": pipe.stats.spans - base_spans,
+        "reports_skipped": pipe.stats.reports_skipped - base_skipped,
+    }

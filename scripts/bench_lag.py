@@ -1,13 +1,11 @@
-"""Detection-lag benchmark: the second north-star metric.
+"""Detection-lag benchmark CLI: the second north-star metric.
 
-BASELINE north star: <100 ms p99 detection lag under the default Locust
-profile (SURVEY.md §6) — the time from a span batch's submission to its
-report being harvested on host. This drives the REAL DetectorPipeline
-(async single-in-flight dispatch, donated state) at a configurable
-span rate on whatever device jax finds, and prints one JSON line:
+Thin argument front-end over the shared methodology in
+``opentelemetry_demo_tpu.runtime.lagbench`` (also what ``bench.py``
+embeds in the driver artifact). Prints one JSON line:
 
     {"metric": "detection_lag_p99", "value": N, "unit": "ms",
-     "vs_baseline": <100ms-baseline ratio>}
+     "vs_baseline": <100ms-baseline ratio>, ...}
 
 Usage: python scripts/bench_lag.py [--rate 200000] [--seconds 8]
 """
@@ -16,41 +14,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-import time
-from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
-from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
-from opentelemetry_demo_tpu.runtime.tensorize import SpanColumns
-
-BASELINE_LAG_MS = 100.0
-
-
-def make_columns(rng, rows: int) -> SpanColumns:
-    return SpanColumns(
-        svc=rng.integers(0, 20, size=rows).astype(np.int32),
-        lat_us=rng.gamma(4.0, 250.0, size=rows).astype(np.float32),
-        is_error=(rng.random(rows) < 0.02).astype(np.float32),
-        trace_key=rng.integers(0, 2**63, size=rows, dtype=np.uint64),
-        attr_crc=rng.zipf(1.5, size=rows).astype(np.uint64),
-    )
+from opentelemetry_demo_tpu.runtime.lagbench import (  # noqa: E402
+    BASELINE_LAG_MS,
+    measure_lag,
+)
 
 
 def main() -> None:
-    parser = argparse.ArgumentParser()
-    # Defaults model the north star's own config: "<100 ms p99 detection
-    # lag, default Locust profile" — the default profile is 5 users with
-    # 1-10 s waits (~10^2-10^3 spans/s), NOT the 200k/s throughput
-    # config. Pass --rate 200000 --harvest-async to measure the stress
-    # config (there, on a tunneled session, dispatch sustains the full
-    # rate and lag is readback-cadence-bound).
+    parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--rate", type=float, default=2_000.0,
-                        help="spans/sec to sustain")
+                        help="spans/sec to sustain (default models the "
+                        "default Locust profile; 200000 = stress config)")
     parser.add_argument("--seconds", type=float, default=8.0)
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--harvest-interval", type=float, default=0.0,
@@ -59,53 +38,22 @@ def main() -> None:
                         help="fetch reports on a background thread")
     args = parser.parse_args()
 
-    detector = AnomalyDetector(DetectorConfig())
-    pipe = DetectorPipeline(
-        detector, batch_size=args.batch,
+    stats = measure_lag(
+        rate=args.rate,
+        seconds=args.seconds,
+        batch=args.batch,
         harvest_interval_s=args.harvest_interval,
         harvest_async=args.harvest_async,
     )
-    rng = np.random.default_rng(0)
-
-    # Pre-build chunks so generation cost stays off the timed path.
-    chunk_rows = args.batch
-    chunks = [make_columns(rng, chunk_rows) for _ in range(16)]
-    interval = chunk_rows / args.rate
-
-    # Warmup: compile the step before the paced loop; scrub it from
-    # every reported stat (not just the lag samples).
-    pipe.submit_columns(chunks[0])
-    pipe.pump(time.monotonic())
-    pipe.drain()
-    pipe.stats.lag_ms.clear()
-    base_batches = pipe.stats.batches
-    base_spans = pipe.stats.spans
-    base_skipped = pipe.stats.reports_skipped
-
-    end = time.monotonic() + args.seconds
-    next_at = time.monotonic()
-    i = 0
-    while time.monotonic() < end:
-        now = time.monotonic()
-        if now < next_at:
-            time.sleep(min(next_at - now, interval))
-            continue
-        next_at += interval
-        pipe.submit_columns(chunks[i % len(chunks)])
-        pipe.pump(time.monotonic())
-        i += 1
-    pipe.drain()
-
-    p99 = pipe.stats.lag_p99_ms()
     print(json.dumps({
         "metric": "detection_lag_p99",
-        "value": round(p99, 3),
+        "value": stats["p99_ms"],
         "unit": "ms",
-        "vs_baseline": round(BASELINE_LAG_MS / max(p99, 1e-9), 3),
-        "rate_spans_per_sec": args.rate,
-        "batches": pipe.stats.batches - base_batches,
-        "spans": pipe.stats.spans - base_spans,
-        "reports_skipped": pipe.stats.reports_skipped - base_skipped,
+        "vs_baseline": round(BASELINE_LAG_MS / max(stats["p99_ms"], 1e-9), 3),
+        "rate_spans_per_sec": stats["rate"],
+        "batches": stats["batches"],
+        "spans": stats["spans"],
+        "reports_skipped": stats["reports_skipped"],
     }))
 
 
