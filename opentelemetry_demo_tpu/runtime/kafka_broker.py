@@ -60,10 +60,19 @@ class KafkaBroker:
 
     def stop(self) -> None:
         self._stop = True
+        # close() alone does NOT wake a thread blocked in accept() — the
+        # kernel socket survives the fd close while the syscall holds it
+        # and keeps accepting (the port then never frees). shutdown()
+        # interrupts the accept deterministically.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        self._acceptor.join(timeout=2.0)
         # Close accepted connections too: a conn thread blocked in recv
         # would otherwise hold the port against a broker restart.
         with self._lock:

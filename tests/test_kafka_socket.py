@@ -174,9 +174,20 @@ def test_orders_source_resumes_from_checkpoint_offsets(broker):
 def test_orders_source_survives_broker_restart():
     """Transient broker loss must mean 'retry', not a daemon crash —
     the confluent transport buffers the same way internally."""
+    import random
     import time
 
-    b1 = KafkaBroker()
+    # A fixed port BELOW the ephemeral range (32768+): an ephemeral
+    # broker port, once released, can be recycled as some other test
+    # connection's local port and block the restart rebind.
+    b1 = None
+    for _ in range(20):
+        try:
+            b1 = KafkaBroker(port=random.randint(20000, 30000))
+            break
+        except OSError:
+            continue
+    assert b1 is not None, "no low port available"
     b1.start()
     _publish_orders(b1, 2)
     source = OrdersSource(_addr(b1))
@@ -187,7 +198,16 @@ def test_orders_source_survives_broker_restart():
     assert list(source.poll(0.05)) == []
     assert list(source.poll(0.05)) == []
 
-    b2 = KafkaBroker(port=port)
+    # Rebinding the same port can race lingering sockets under a busy
+    # suite; retry briefly like a restarting container would.
+    for attempt in range(20):
+        try:
+            b2 = KafkaBroker(port=port)
+            break
+        except OSError:
+            time.sleep(0.25)
+    else:
+        pytest.fail(f"port {port} never became rebindable")
     b2.start()
     try:
         _publish_orders(b2, 1, start=100)
