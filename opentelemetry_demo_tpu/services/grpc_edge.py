@@ -105,6 +105,11 @@ class GrpcShopEdge:
             f"/{PKG}.EmailService/SendOrderConfirmation": self._send_confirmation,
             f"/{PKG}.CheckoutService/PlaceOrder": self._place_order,
             f"/{PKG}.AdService/GetAds": self._get_ads,
+            f"/{PKG}.FeatureFlagService/GetFlag": self._get_flag,
+            f"/{PKG}.FeatureFlagService/CreateFlag": self._create_flag,
+            f"/{PKG}.FeatureFlagService/UpdateFlag": self._update_flag,
+            f"/{PKG}.FeatureFlagService/ListFlags": self._list_flags,
+            f"/{PKG}.FeatureFlagService/DeleteFlag": self._delete_flag,
         }
 
         class Handler(grpc.GenericRpcHandler):
@@ -282,3 +287,104 @@ class GrpcShopEdge:
             ad = wire.encode_len(1, b"/") + wire.encode_len(2, ad_text.encode())
             out += wire.encode_len(1, ad)
         return out
+
+    # -- feature flags (the flagd-analogue store over gRPC) ------------
+    #
+    # The wire Flag{name, description, enabled} projects onto the flagd
+    # document: enabled = state ENABLED with a truthy defaultVariant;
+    # Create/Update write boolean on/off flags (richer variants stay
+    # editable through the flag-editor UI, which shares the store).
+
+    def _flags_copy(self) -> dict:
+        """Copy-for-write of the flag doc (flags map + each spec dict);
+        reads go straight to the live doc — the edge lock serialises
+        all mutation."""
+        live = self.shop.flags._doc.get("flags", {})
+        return {"flags": {k: dict(v) for k, v in live.items()}}
+
+    def _enc_flag(self, name: str, spec: dict) -> bytes:
+        enabled = (
+            spec.get("state", "ENABLED") == "ENABLED"
+            and bool(spec.get("variants", {}).get(spec.get("defaultVariant")))
+        )
+        out = wire.encode_len(1, name.encode())
+        desc = spec.get("description", "")
+        if desc:
+            out += wire.encode_len(2, desc.encode())
+        if enabled:
+            out += wire.encode_int(3, 1)
+        return out
+
+    def _get_flag(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        name = _dec_str(f, 1)
+        spec = self.shop.flags._doc.get("flags", {}).get(name)
+        if spec is None:
+            raise ValueError(f"no such flag {name!r}")
+        return wire.encode_len(1, self._enc_flag(name, spec))
+
+    def _create_flag(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        name = _dec_str(f, 1)
+        enabled = bool(wire.first(f, 3, 0) or 0)
+        doc = self._flags_copy()
+        doc["flags"][name] = {
+            "state": "ENABLED",
+            "description": _dec_str(f, 2),
+            "variants": {"on": True, "off": False},
+            "defaultVariant": "on" if enabled else "off",
+        }
+        self.shop.flags.replace(doc)
+        return wire.encode_len(
+            1, self._enc_flag(name, doc["flags"][name])
+        )
+
+    def _update_flag(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        name = _dec_str(f, 1)
+        enabled = bool(wire.first(f, 2, 0) or 0)
+        doc = self._flags_copy()
+        spec = doc["flags"].get(name)
+        if spec is None:
+            raise ValueError(f"no such flag {name!r}")
+        if not enabled:
+            # Prefer flipping to a falsy variant (the flag then
+            # evaluates False for every caller); a variants map with no
+            # falsy member (percentage flags) disables via state, and a
+            # DISABLED flag evaluates to the caller's default.
+            variants = spec.get("variants", {})
+            off = next((k for k, v in variants.items() if not v), None)
+            if off is not None:
+                spec["state"] = "ENABLED"
+                spec["defaultVariant"] = off
+            else:
+                spec["state"] = "DISABLED"
+        else:
+            spec["state"] = "ENABLED"
+            variants = dict(spec.get("variants", {}))
+            if not variants.get(spec.get("defaultVariant")):
+                on = next(
+                    (k for k, v in variants.items() if v), None
+                )
+                if on is None:
+                    variants["on"] = True
+                    spec["variants"] = variants
+                    on = "on"
+                spec["defaultVariant"] = on
+        self.shop.flags.replace(doc)
+        return b""
+
+    def _list_flags(self, ctx, request: bytes) -> bytes:
+        live = self.shop.flags._doc.get("flags", {})
+        return b"".join(
+            wire.encode_len(1, self._enc_flag(name, spec))
+            for name, spec in sorted(live.items())
+        )
+
+    def _delete_flag(self, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        name = _dec_str(f, 1)
+        doc = self._flags_copy()
+        doc["flags"].pop(name, None)
+        self.shop.flags.replace(doc)
+        return b""

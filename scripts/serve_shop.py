@@ -85,6 +85,19 @@ def serve(args) -> None:
     print(f"shop gateway on http://{args.host}:{gw.port}  "
           f"(flag editor at /feature, metrics at /metrics)", flush=True)
 
+    grpc_edge = None
+    if args.grpc_port >= 0:
+        # The reference's business services ARE gRPC servers; the edge
+        # serves their whole oteldemo surface beside the HTTP gateway,
+        # sharing the gateway's lock (one single-writer shop graph).
+        from opentelemetry_demo_tpu.services.grpc_edge import GrpcShopEdge
+
+        grpc_edge = GrpcShopEdge(
+            shop, host=args.host, port=args.grpc_port, lock=gw._lock
+        )
+        grpc_edge.start()
+        print(f"gRPC edge on {args.host}:{grpc_edge.port}", flush=True)
+
     load = None
     if args.users > 0:
         load = HttpLoadGenerator(
@@ -109,6 +122,8 @@ def serve(args) -> None:
     for lg in (load, browser_load):
         if lg is not None:
             lg.stop()
+    if grpc_edge is not None:
+        grpc_edge.stop()
     gw.stop()
     if pipeline is not None:
         pipeline.drain()
@@ -147,6 +162,12 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=512)
     parser.add_argument("--load-only", action="store_true")
     parser.add_argument("--target", default="http://127.0.0.1:8080")
+    parser.add_argument(
+        "--grpc-port", type=int,
+        default=int(os.getenv("SHOP_GRPC_PORT", "-1")),
+        help="serve the oteldemo gRPC surface on this port "
+        "(0 = ephemeral, -1 = disabled)",
+    )
     parser.add_argument(
         "--otlp-endpoint",
         default=os.getenv("OTEL_EXPORTER_OTLP_ENDPOINT", ""),

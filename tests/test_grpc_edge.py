@@ -194,6 +194,56 @@ def test_email_confirmation(edge, pb2):
         email="a@b.c", order=pb2.OrderResult(order_id="o-1")), timeout=5)
 
 
+def test_feature_flag_service(edge, pb2):
+    create = _stub(edge, pb2, "FeatureFlagService", "CreateFlag",
+                   pb2.CreateFlagRequest, pb2.CreateFlagResponse)
+    get = _stub(edge, pb2, "FeatureFlagService", "GetFlag",
+                pb2.GetFlagRequest, pb2.GetFlagResponse)
+    update = _stub(edge, pb2, "FeatureFlagService", "UpdateFlag",
+                   pb2.UpdateFlagRequest, pb2.UpdateFlagResponse)
+    list_flags = _stub(edge, pb2, "FeatureFlagService", "ListFlags",
+                       pb2.ListFlagsRequest, pb2.ListFlagsResponse)
+    delete = _stub(edge, pb2, "FeatureFlagService", "DeleteFlag",
+                   pb2.DeleteFlagRequest, pb2.DeleteFlagResponse)
+
+    resp = create(pb2.CreateFlagRequest(
+        name="adFailure", description="break ads", enabled=True), timeout=5)
+    assert resp.flag.name == "adFailure" and resp.flag.enabled
+
+    # The gRPC write landed in the SAME store the services evaluate.
+    assert edge.shop.flags.evaluate("adFailure", False) is True
+
+    update(pb2.UpdateFlagRequest(name="adFailure", enabled=False), timeout=5)
+    assert not get(pb2.GetFlagRequest(name="adFailure"), timeout=5).flag.enabled
+    assert edge.shop.flags.evaluate("adFailure", True) is False
+
+    names = [fl.name for fl in list_flags(pb2.ListFlagsRequest(), timeout=5).flag]
+    assert "adFailure" in names
+    delete(pb2.DeleteFlagRequest(name="adFailure"), timeout=5)
+    names = [fl.name for fl in list_flags(pb2.ListFlagsRequest(), timeout=5).flag]
+    assert "adFailure" not in names
+
+    with pytest.raises(grpc.RpcError) as exc:
+        get(pb2.GetFlagRequest(name="nope"), timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    # A percentage flag with no falsy variant must still disable (via
+    # state), and re-enabling restores a truthy default.
+    edge.shop.flags.replace({"flags": {"paymentFailure": {
+        "state": "ENABLED",
+        "variants": {"50%": 0.5, "100%": 1.0},
+        "defaultVariant": "100%",
+    }}})
+    update(pb2.UpdateFlagRequest(name="paymentFailure", enabled=False),
+           timeout=5)
+    assert edge.shop.flags.evaluate("paymentFailure", 0.0) == 0.0
+    assert not get(pb2.GetFlagRequest(name="paymentFailure"),
+                   timeout=5).flag.enabled
+    update(pb2.UpdateFlagRequest(name="paymentFailure", enabled=True),
+           timeout=5)
+    assert edge.shop.flags.evaluate("paymentFailure", 0.0) == 1.0
+
+
 def test_service_error_is_internal_status(edge, pb2):
     place = _stub(edge, pb2, "CheckoutService", "PlaceOrder",
                   pb2.PlaceOrderRequest, pb2.PlaceOrderResponse)
