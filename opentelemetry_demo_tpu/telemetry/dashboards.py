@@ -116,6 +116,15 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Anomaly flags",
                       Query("rate", "app_anomaly_flags_total",
                             by=("service",)), "flags/s"),
+                Panel("CUSUM accumulators",
+                      Query("instant", "app_anomaly_cusum",
+                            by=("service", "signal"))),
+                Panel("Metric-stream |z| by service/metric",
+                      Query("instant", "app_anomaly_metric_z_score",
+                            by=("service", "metric"))),
+                Panel("Metric-stream flags",
+                      Query("rate", "app_anomaly_metric_flags_total",
+                            by=("service",)), "flags/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],
