@@ -155,8 +155,6 @@ class DetectorPipeline:
         # Assemble up to one batch of rows from the columnar queue;
         # an oversized head chunk is split and its tail re-queued.
         with self._pending_lock:
-            if not self._pending:
-                return
             budget = self.tensorizer.batch_size
             parts: list[SpanColumns] = []
             while self._pending and budget:
@@ -169,6 +167,18 @@ class DetectorPipeline:
                     parts.append(head)
                     budget -= head.rows
             self._pending_rows -= sum(p.rows for p in parts)
+        if not parts:
+            # Nothing to dispatch — but an idle pump must still fetch
+            # due in-flight reports (outside the pending lock: the
+            # fetch blocks for an RTT and submitters must not): a
+            # report that only ever harvests on the NEXT batch's pump
+            # carries one extra batch interval of detection lag.
+            if not self.harvest_async:
+                now = time.monotonic()
+                if now - self._last_harvest >= self.harvest_interval_s:
+                    if self._harvest_one(keep=0):
+                        self._last_harvest = time.monotonic()
+            return
         cols = SpanColumns.concat(parts)
         batch = self.tensorizer.pack_columns(cols)
         # Packed dispatch: the report comes back as ONE device vector so
@@ -195,7 +205,14 @@ class DetectorPipeline:
         else:
             now = time.monotonic()
             if now - self._last_harvest >= self.harvest_interval_s:
-                if self._harvest_one(keep=1):
+                # Adaptive overlap: with more batches queued, leave the
+                # newest dispatch in flight (device compute overlaps the
+                # fetch — the throughput regime); with the queue drained,
+                # fetch everything now (the low-rate regime, where a
+                # kept report would wait a whole batch interval).
+                with self._pending_lock:
+                    keep = 1 if self._pending else 0
+                if self._harvest_one(keep=keep):
                     self._last_harvest = time.monotonic()
 
     def drain(self) -> None:
