@@ -428,16 +428,46 @@ def detector_step(
     counts = comm.pmin_sketch(
         jax.vmap(cms.cms_query, in_axes=(0, None))(cms_bank[:, 0], cidx)
     ).astype(jnp.float32)  # [W#, B]
-    # Per-service max via scatter-max: a dense [W#, B, S] one-hot product
-    # would materialise ~200 MB at B=512k — the scatter keeps the
-    # intermediate at the output's size. Lanes with svc == s_axis
-    # (out-of-slice) land in the sacrificial last column; invalid lanes
-    # contribute 0, the identity for non-negative counts.
-    per_svc_max = comm.pmax_batch(
-        jnp.zeros((counts.shape[0], s_axis + 1), jnp.float32)
-        .at[:, svc]
-        .max(counts * valid_f[None, :])[:, :s_axis]
-    )  # [W#, S]
+    # Per-service max, chunked over the batch: a single dense
+    # [W#, B, S] one-hot product would materialise ~200 MB of HBM at
+    # B=512k, and a scatter-max serializes on duplicate service ids
+    # (a span batch is nothing but duplicates). The scan sweeps the
+    # batch in fixed chunks — each step's [W#, chunk, S] intermediate
+    # is a few MB of dense VPU work — and max-accumulates.
+    nw = counts.shape[0]
+    b_total = svc.shape[0]
+    chunk = min(b_total, 8192)
+    masked = counts * valid_f[None, :]
+    hh_svc = svc
+    pad = (-b_total) % chunk  # static
+    if pad:
+        # Pad to a chunk multiple: padding lanes carry svc == s_axis
+        # (all-zero one-hot row) and zero counts — max identities.
+        masked = jnp.pad(masked, ((0, 0), (0, pad)))
+        hh_svc = jnp.pad(hh_svc, (0, pad), constant_values=s_axis)
+    if chunk == b_total + pad:
+        col = jax.lax.broadcasted_iota(jnp.int32, (chunk, s_axis), 1)
+        onehot = (col == hh_svc[:, None]).astype(jnp.float32)
+        local_max = jnp.max(masked[:, :, None] * onehot[None, :, :], axis=1)
+    else:
+        n_chunks = (b_total + pad) // chunk
+
+        def hh_chunk(acc, xs):
+            cnt_c, svc_c = xs  # [W#, chunk], [chunk]
+            col = jax.lax.broadcasted_iota(jnp.int32, (chunk, s_axis), 1)
+            onehot = (col == svc_c[:, None]).astype(jnp.float32)
+            m = jnp.max(cnt_c[:, :, None] * onehot[None, :, :], axis=1)
+            return jnp.maximum(acc, m), None
+
+        local_max, _ = jax.lax.scan(
+            hh_chunk,
+            jnp.zeros((nw, s_axis), jnp.float32),
+            (
+                masked.reshape(nw, n_chunks, chunk).transpose(1, 0, 2),
+                hh_svc.reshape(n_chunks, chunk),
+            ),
+        )
+    per_svc_max = comm.pmax_batch(local_max)  # [W#, S]
     hh_ratio = (per_svc_max / jnp.maximum(span_total[:, 0], 1.0)[:, None]).T
 
     # ---- CUSUM layer: sustained small shifts --------------------------
