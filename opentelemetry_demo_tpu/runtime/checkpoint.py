@@ -38,6 +38,7 @@ def save(
     detector: AnomalyDetector,
     offsets: dict[str, Any] | None = None,
     service_names: list[str] | None = None,
+    metrics_feed=None,
 ) -> None:
     state_np = {
         k: np.asarray(v) for k, v in detector.state._asdict().items()
@@ -51,6 +52,16 @@ def save(
         "config": list(detector.config._replace(sketch_impl=None)),
         "clock_t_prev": detector.clock._t_prev,
     }
+    if metrics_feed is not None:
+        # The metrics-leg head warms in minutes, but a restart must not
+        # forget which rate is "normal" — snapshot its EWMA state and
+        # both intern tables beside the sketch state.
+        head = metrics_feed.head
+        for name, arr in head.state._asdict().items():
+            state_np[f"metrics_{name}"] = np.asarray(arr)
+        meta["metrics_config"] = list(head.config)
+        meta["metrics_service_names"] = metrics_feed.service_names
+        meta["metrics_metric_names"] = metrics_feed.metric_names
     # Metadata rides inside the npz (as a unicode scalar) so snapshot
     # and offsets commit in ONE os.replace — a crash can only ever leave
     # the previous complete (state, offsets) pair, never a mixed one.
@@ -75,7 +86,17 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
                 "__meta__); it was written by an incompatible version"
             )
         meta = json.loads(str(data["__meta__"][()]))
-        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+        arrays = {
+            k: data[k]
+            for k in data.files
+            if k != "__meta__" and not k.startswith("metrics_")
+        }
+        metrics_arrays = {
+            k[len("metrics_"):]: data[k]
+            for k in data.files
+            if k.startswith("metrics_")
+        }
+    meta["_metrics_arrays"] = metrics_arrays
     saved_cfg = DetectorConfig(
         *[tuple(v) if isinstance(v, list) else v for v in meta["config"]]
     )
@@ -98,3 +119,31 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
 
 def exists(path: str) -> bool:
     return os.path.exists(path + ".npz")
+
+
+def restore_metrics_feed(meta: dict, feed) -> bool:
+    """Hydrate a MetricsFeed from checkpoint meta (load() output).
+
+    Returns False (feed untouched) when the snapshot has no metrics leg
+    or its geometry doesn't match the feed's — a geometry change means
+    the cells don't line up and warm state would be attributed to the
+    wrong (service, metric)."""
+    arrays = meta.get("_metrics_arrays") or {}
+    if not arrays or meta.get("metrics_config") is None:
+        return False
+    from ..models.metrics_head import MetricsHeadConfig, MetricsHeadState
+
+    saved_cfg = MetricsHeadConfig(
+        *[tuple(v) if isinstance(v, list) else v
+          for v in meta["metrics_config"]]
+    )
+    if list(saved_cfg) != list(feed.config):
+        return False
+    feed.head.state = MetricsHeadState(
+        **{k: jax.device_put(v) for k, v in arrays.items()}
+    )
+    for name in meta.get("metrics_service_names", []):
+        feed._intern_service(name)
+    for name in meta.get("metrics_metric_names", []):
+        feed.metric_id(name)
+    return True

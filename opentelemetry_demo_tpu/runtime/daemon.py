@@ -106,6 +106,7 @@ class DetectorDaemon:
                 ),
             )
         restored_offsets: dict = {}
+        meta: dict | None = None
         if self.ckpt_path and checkpoint.exists(self.ckpt_path):
             self.detector, meta = checkpoint.load(self.ckpt_path, config)
             restored_names = meta.get("service_names", [])
@@ -151,6 +152,8 @@ class DetectorDaemon:
             MetricsHeadConfig(num_services=config.num_services),
             on_report=self._on_metrics_report,
         )
+        if meta is not None:
+            checkpoint.restore_metrics_feed(meta, self.metrics_feed)
         self._metric_series_seen: set[tuple[str, str]] = set()
         self.receiver = OtlpHttpReceiver(
             self.pipeline.submit,
@@ -266,6 +269,7 @@ class DetectorDaemon:
             self.detector,
             offsets=dict(self._offsets),
             service_names=self.pipeline.tensorizer.service_names,
+            metrics_feed=self.metrics_feed,
         )
         self._last_ckpt = time.monotonic()
 

@@ -175,3 +175,14 @@ def test_daemon_metrics_leg_flags_surge(env):
         assert 'app_anomaly_metric_flags_total{service="kafka"}' in text
     finally:
         daemon.shutdown()
+
+    # Reboot: the metrics head's warm state and intern tables come back
+    # (a restart must not forget which rate is "normal").
+    daemon2 = DetectorDaemon(DetectorConfig(num_services=8, hll_p=8, cms_width=512))
+    try:
+        assert daemon2.metrics_feed.service_names == ["kafka"]
+        assert daemon2.metrics_feed.metric_names == ["queue_depth_total"]
+        obs = np.asarray(daemon2.metrics_feed.head.state.obs)
+        assert obs[0, 0] > 30  # warm, not reset
+    finally:
+        daemon2.shutdown()
