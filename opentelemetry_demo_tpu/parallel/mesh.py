@@ -85,6 +85,11 @@ def make_hybrid_mesh(
             mesh_shape=(1, n_batch, n_sketch),
             dcn_mesh_shape=(n_dcn, 1, 1),
             devices=devs,
+            # The dcn axis IS the process axis in this design (the
+            # enforcement above) — group granules by process, which
+            # also holds on single-slice multi-host and multi-process
+            # CPU topologies where slice_index carries no signal.
+            process_is_granule=True,
         )
         return Mesh(arr, axis_names=("dcn", "batch", "sketch"))
     arr = np.asarray(devs[:use]).reshape(n_dcn, n_batch, n_sketch)
