@@ -33,13 +33,18 @@ class BackgroundPoster:
     """
 
     def __init__(self, endpoint: str, content_type: str,
-                 timeout_s: float = 2.0, queue_max: int = 16):
+                 timeout_s: float = 2.0, queue_max: int = 16,
+                 send=None):
+        """``send(body)`` overrides the default HTTP POST (e.g. a gRPC
+        unary call); it runs on the sender thread and signals failure by
+        raising."""
         self.endpoint = endpoint
         self.content_type = content_type
         self.timeout_s = timeout_s
         self.sent = 0
         self.errors = 0
         self.dropped = 0
+        self._send = send or self._http_send
         self._queue: "collections.deque[bytes]" = collections.deque()
         self._queue_max = queue_max
         self._lock = threading.Lock()
@@ -48,6 +53,16 @@ class BackgroundPoster:
         self._idle.set()
         self._stop = False
         self._thread: threading.Thread | None = None
+
+    def _http_send(self, body: bytes) -> None:
+        req = urllib.request.Request(
+            self.endpoint,
+            data=body,
+            headers={"Content-Type": self.content_type},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s):
+            pass
 
     def submit(self, body: bytes) -> None:
         with self._lock:
@@ -75,15 +90,9 @@ class BackgroundPoster:
                         break
                     self._idle.clear()
                     body = self._queue.popleft()
-                req = urllib.request.Request(
-                    self.endpoint,
-                    data=body,
-                    headers={"Content-Type": self.content_type},
-                    method="POST",
-                )
                 try:
-                    with urllib.request.urlopen(req, timeout=self.timeout_s):
-                        self.sent += 1
+                    self._send(body)
+                    self.sent += 1
                 except Exception:
                     self.errors += 1
 
@@ -105,6 +114,9 @@ class BackgroundPoster:
         self._wake.set()
         if thread is not None:
             thread.join(timeout=self.timeout_s + 1.0)
+        closer = getattr(self._send, "close", None)
+        if closer is not None:
+            closer()
 
 
 def _norm_trace_id(trace_id: bytes | int) -> bytes:
@@ -161,22 +173,59 @@ def encode_export_request(
     return out
 
 
-class OtlpHttpSpanExporter:
-    """Subscribe on ``Collector.trace_exporters`` (or a gateway's
-    ``on_spans``): ships each span batch to an OTLP/HTTP ``/v1/traces``
-    endpoint from the background sender."""
+class grpc_send:
+    """A ``send`` hook for :class:`BackgroundPoster` that ships bodies
+    over OTLP/gRPC (the collector exporter default) instead of HTTP.
+    ``signal`` ∈ {"traces", "metrics"}. Lazily opens the channel on the
+    sender thread's first call; :meth:`close` (invoked by the poster's
+    ``close``) shuts the channel down — grpcio channels are not
+    reliably collected by GC and would leak sockets/poller threads."""
 
-    def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 64):
-        endpoint = endpoint.rstrip("/")
-        if not endpoint.endswith("/v1/traces"):
-            endpoint += "/v1/traces"
-        self._poster = BackgroundPoster(
-            endpoint, "application/x-protobuf", timeout_s, queue_max
-        )
+    def __init__(self, target: str, signal: str, timeout_s: float = 2.0):
+        self._target = target
+        self._signal = signal
+        self._timeout_s = timeout_s
+        self._channel = None
+        self._fn = None
 
-    def __call__(self, now: float, records: list[SpanRecord]) -> None:
-        if records:
-            self._poster.submit(encode_export_request(records))
+    def __call__(self, body: bytes) -> None:
+        if self._fn is None:
+            import grpc
+
+            from .otlp_grpc import METRICS_EXPORT, TRACE_EXPORT
+
+            self._channel = grpc.insecure_channel(self._target)
+            path = TRACE_EXPORT if self._signal == "traces" else METRICS_EXPORT
+            self._fn = self._channel.unary_unary(
+                path, request_serializer=None, response_deserializer=None
+            )
+        self._fn(body, timeout=self._timeout_s)
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._fn = None
+
+
+def split_endpoint(endpoint: str) -> tuple[str, str]:
+    """("grpc"|"http", target) from an exporter endpoint string.
+
+    ``grpc://host:4317`` selects the gRPC transport; anything else is
+    OTLP/HTTP (scheme defaulted to http:// when absent)."""
+    if endpoint.startswith("grpc://"):
+        # A gRPC channel target is host:port — tolerate the trailing
+        # slash endpoint env vars commonly carry.
+        return "grpc", endpoint[len("grpc://"):].rstrip("/")
+    if "://" not in endpoint:
+        endpoint = "http://" + endpoint
+    return "http", endpoint
+
+
+class _ExporterBase:
+    """Counters/flush/close surface shared by the concrete exporters."""
+
+    _poster: BackgroundPoster
 
     @property
     def sent(self) -> int:
@@ -195,3 +244,29 @@ class OtlpHttpSpanExporter:
 
     def close(self) -> None:
         self._poster.close()
+
+
+class OtlpHttpSpanExporter(_ExporterBase):
+    """Subscribe on ``Collector.trace_exporters`` (or a gateway's
+    ``on_spans``): ships each span batch to an OTLP ``/v1/traces``
+    endpoint from the background sender. ``grpc://host:port`` endpoints
+    ship over OTLP/gRPC instead (same callable surface)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 64):
+        scheme, target = split_endpoint(endpoint)
+        if scheme == "grpc":
+            self._poster = BackgroundPoster(
+                target, "application/grpc", timeout_s, queue_max,
+                send=grpc_send(target, "traces", timeout_s),
+            )
+        else:
+            target = target.rstrip("/")
+            if not target.endswith("/v1/traces"):
+                target += "/v1/traces"
+            self._poster = BackgroundPoster(
+                target, "application/x-protobuf", timeout_s, queue_max
+            )
+
+    def __call__(self, now: float, records: list[SpanRecord]) -> None:
+        if records:
+            self._poster.submit(encode_export_request(records))

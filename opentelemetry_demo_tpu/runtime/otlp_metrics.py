@@ -34,6 +34,7 @@ import struct
 from typing import Iterable, NamedTuple
 
 from . import wire
+from .otlp_export import _ExporterBase
 
 # AggregationTemporality enum (metrics/v1).
 TEMPORALITY_UNSPECIFIED = 0
@@ -309,7 +310,7 @@ def registry_to_request(
     return encode_metrics_request(payload, t_ns, start_ns)
 
 
-class OtlpHttpMetricsExporter:
+class OtlpHttpMetricsExporter(_ExporterBase):
     """POSTs registry snapshots to an OTLP/HTTP ``/v1/metrics`` endpoint.
 
     Subscribe on ``Collector.metrics_exporters``: called after each
@@ -322,33 +323,22 @@ class OtlpHttpMetricsExporter:
     """
 
     def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 16):
-        from .otlp_export import BackgroundPoster
+        from .otlp_export import BackgroundPoster, grpc_send, split_endpoint
 
-        endpoint = endpoint.rstrip("/")
-        if not endpoint.endswith("/v1/metrics"):
-            endpoint += "/v1/metrics"
-        self._poster = BackgroundPoster(
-            endpoint, "application/x-protobuf", timeout_s, queue_max
-        )
+        scheme, target = split_endpoint(endpoint)
+        if scheme == "grpc":
+            # OTLP/gRPC (the collector exporter default); same sender.
+            self._poster = BackgroundPoster(
+                target, "application/grpc", timeout_s, queue_max,
+                send=grpc_send(target, "metrics", timeout_s),
+            )
+        else:
+            target = target.rstrip("/")
+            if not target.endswith("/v1/metrics"):
+                target += "/v1/metrics"
+            self._poster = BackgroundPoster(
+                target, "application/x-protobuf", timeout_s, queue_max
+            )
 
     def __call__(self, now: float, jobs: list) -> None:
         self._poster.submit(registry_to_request(jobs, t_ns=int(now * 1e9)))
-
-    @property
-    def sent(self) -> int:
-        return self._poster.sent
-
-    @property
-    def errors(self) -> int:
-        return self._poster.errors
-
-    @property
-    def dropped(self) -> int:
-        return self._poster.dropped
-
-    def flush(self, timeout_s: float = 5.0) -> bool:
-        """Block until the queue is empty (tests / shutdown)."""
-        return self._poster.flush(timeout_s)
-
-    def close(self) -> None:
-        self._poster.close()

@@ -172,7 +172,9 @@ def main() -> None:
         "--otlp-endpoint",
         default=os.getenv("OTEL_EXPORTER_OTLP_ENDPOINT", ""),
         help="export spans+metrics to an external anomaly-detector "
-        "daemon over OTLP/HTTP instead of running one in-process",
+        "daemon instead of running one in-process; http(s)://host:4318 "
+        "for OTLP/HTTP, grpc://host:4317 for OTLP/gRPC (the collector "
+        "exporter default)",
     )
     args = parser.parse_args()
     if args.load_only:

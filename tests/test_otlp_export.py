@@ -82,3 +82,40 @@ def test_exporter_down_sink_counts_not_raises():
     exporter.flush(5.0)
     assert exporter.errors == 1 and exporter.sent == 0
     exporter.close()
+
+
+def test_grpc_endpoint_ships_both_signals():
+    """grpc:// endpoints ride OTLP/gRPC — the collector exporter
+    default — through the same background sender surface."""
+    grpc = pytest.importorskip("grpc")
+    del grpc
+    from opentelemetry_demo_tpu.runtime.otlp_grpc import OtlpGrpcReceiver
+    from opentelemetry_demo_tpu.runtime.otlp_metrics import (
+        OtlpHttpMetricsExporter,
+    )
+    from opentelemetry_demo_tpu.telemetry.metrics import MetricRegistry
+
+    spans, metrics = [], []
+    recv = OtlpGrpcReceiver(
+        spans.extend, host="127.0.0.1", port=0,
+        on_metric_records=metrics.extend,
+    )
+    recv.start()
+    try:
+        span_exp = OtlpHttpSpanExporter(f"grpc://127.0.0.1:{recv.port}")
+        span_exp(0.0, RECORDS)
+        assert span_exp.flush(5.0)
+        assert span_exp.sent == 1 and span_exp.errors == 0
+        assert [r.service for r in spans] == ["payment", "payment", "cart"]
+        span_exp.close()
+
+        reg = MetricRegistry()
+        reg.counter_add("orders_total", 9.0)
+        met_exp = OtlpHttpMetricsExporter(f"grpc://127.0.0.1:{recv.port}")
+        met_exp(1.0, [("checkout", reg)])
+        assert met_exp.flush(5.0)
+        assert met_exp.sent == 1 and met_exp.errors == 0
+        assert metrics and metrics[0].name == "orders_total"
+        met_exp.close()
+    finally:
+        recv.stop()
