@@ -119,7 +119,15 @@ class GrpcShopEdge:
                     return None
 
                 def call(request: bytes, context) -> bytes:
-                    ctx = TraceContext.new({})
+                    # W3C context rides gRPC metadata (every reference
+                    # SDK propagates traceparent/baggage this way);
+                    # from_headers handles absence (fresh trace id) and
+                    # parses baggage either way.
+                    meta = {
+                        k: v for k, v in (context.invocation_metadata() or [])
+                        if isinstance(v, str)
+                    }
+                    ctx = TraceContext.from_headers(meta)
                     try:
                         with edge._lock:
                             return fn(ctx, request)

@@ -16,7 +16,13 @@ selectors + assertions) against the real HTTP edge:
 - **Trigger**: one HTTP request to a :class:`~.services.gateway.ShopGateway`
   (plus optional ``setup`` requests, e.g. filling a cart before
   checkout), with a fresh generated trace id in the ``traceparent``
-  header — the Tracetest trigger span analogue.
+  header — the Tracetest trigger span analogue. ``type: grpc`` triggers
+  drive the :class:`~.services.grpc_edge.GrpcShopEdge` instead, exactly
+  like the reference's gRPC triggers (``tracetest.yaml`` ``trigger.grpc``
+  blocks): the method path names the oteldemo RPC, the request is the
+  message as YAML, and protoc-generated stubs (compiled on demand, the
+  same build-artifact policy as tests/test_proto_contract.py) do the
+  JSON↔protobuf mapping via descriptor reflection.
 - **Selector**: ``{service: ..., name: ...}`` picks spans of the
   triggered trace (name = substring match, like tracetest's
   ``span[name=...]`` selectors on our reduced span model).
@@ -132,18 +138,108 @@ def _check_assertion(spec: dict, spans: list[SpanRecord], response) -> tuple[boo
     return op(actual, expect), f"{metric} = {actual!r} (want {op_name} {expect!r})"
 
 
+class _GrpcStubs:
+    """protoc-compiled demo.proto stubs + descriptor-driven codecs.
+
+    Lazily compiled once per runner (stubs are build artifacts, not
+    sources — the gen_proto.sh policy); YAML request dicts map to
+    protobuf via json_format, responses map back for json_path
+    assertions, mirroring Tracetest's reflection-based gRPC trigger.
+    """
+
+    def __init__(self):
+        import subprocess
+        import sys
+        import tempfile
+
+        repo_root = Path(__file__).resolve().parent.parent
+        # Held on the instance so the stubs dir lives exactly as long
+        # as the runner and is removed on GC/interpreter exit.
+        self._tmp = tempfile.TemporaryDirectory(prefix="tracetest_pb_")
+        subprocess.run(
+            ["protoc", "--python_out", self._tmp.name, "proto/demo.proto"],
+            check=True,
+            cwd=repo_root,
+        )
+        sys.path.insert(0, str(Path(self._tmp.name) / "proto"))
+        try:
+            import demo_pb2  # noqa: F401
+
+            self.pb2 = demo_pb2
+        finally:
+            sys.path.remove(str(Path(self._tmp.name) / "proto"))
+
+    def method(self, full_method: str):
+        """"oteldemo.Service/Method" → (path, req_cls, resp_cls)."""
+        from google.protobuf import message_factory
+
+        service_path, method_name = full_method.split("/", 1)
+        _pkg, service_name = service_path.rsplit(".", 1)
+        svc_desc = self.pb2.DESCRIPTOR.services_by_name[service_name]
+        m = svc_desc.FindMethodByName(method_name)
+        return (
+            f"/{service_path}/{method_name}",
+            message_factory.GetMessageClass(m.input_type),
+            message_factory.GetMessageClass(m.output_type),
+        )
+
+
 class TraceTestClient:
     """Triggers spec'd requests against a gateway and collects the trace.
 
     ``span_log`` must be the (shared) list every gateway ``on_spans``
     flush appends to; the client filters it by the trigger's trace id.
+    ``grpc_target`` (host:port of a GrpcShopEdge over the SAME shop)
+    enables ``type: grpc`` triggers.
     """
 
-    def __init__(self, base_url: str, span_log: list, pump, lock: threading.Lock):
+    def __init__(self, base_url: str, span_log: list, pump, lock: threading.Lock,
+                 grpc_target: str | None = None):
         self.base_url = base_url.rstrip("/")
         self.span_log = span_log
         self.pump = pump  # flushes pending shop spans into span_log
         self.lock = lock
+        self.grpc_target = grpc_target
+        self._stubs: _GrpcStubs | None = None
+        self._channel = None
+        self._grpc_init_lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def _grpc_call(self, grpc_spec: dict, trace_id: str):
+        import grpc
+        from google.protobuf import json_format
+
+        # Parallel suites share this client: one protoc compile, one
+        # channel.
+        with self._grpc_init_lock:
+            if self._stubs is None:
+                self._stubs = _GrpcStubs()
+            if self._channel is None:
+                if self.grpc_target is None:
+                    raise RuntimeError("suite uses a grpc trigger but the "
+                                       "rig has no gRPC edge")
+                self._channel = grpc.insecure_channel(self.grpc_target)
+        path, req_cls, resp_cls = self._stubs.method(grpc_spec["method"])
+        request = json_format.ParseDict(
+            grpc_spec.get("request", {}), req_cls()
+        )
+        fn = self._channel.unary_unary(
+            path,
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+        metadata = tuple(
+            TraceContext(trace_id=bytes.fromhex(trace_id)).to_headers().items()
+        )
+        try:
+            resp = fn(request, timeout=30, metadata=metadata)
+        except grpc.RpcError as e:
+            return int(e.code().value[0]), None
+        return 0, json_format.MessageToDict(resp)  # grpc OK
 
     def _request(self, http_spec: dict, trace_id: str):
         body = http_spec.get("body")
@@ -174,13 +270,24 @@ class TraceTestClient:
     def run_test(self, doc: dict) -> TestResult:
         spec = doc.get("spec", doc)
         result = TestResult(test_id=spec.get("id", "?"), name=spec.get("name", "?"))
-        trigger = spec["trigger"]["http"]
         trace_id = uuid.uuid4().hex
+        kind = spec["trigger"].get("type", "http")
 
-        # Setup requests ride the same trace (cart fill before checkout).
-        for setup in trigger.get("setup", []):
-            self._request(setup, trace_id)
-        status, response = self._request(trigger, trace_id)
+        if kind == "grpc":
+            trigger = spec["trigger"]["grpc"]
+            for setup in trigger.get("setup", []):
+                self._grpc_call(setup, trace_id)
+            status, response = self._grpc_call(trigger, trace_id)
+            want_status = trigger.get("expect_status", 0)  # grpc OK
+            status_detail = f"grpc status {status} (want {want_status})"
+        else:
+            trigger = spec["trigger"]["http"]
+            # Setup requests ride the same trace (cart fill first).
+            for setup in trigger.get("setup", []):
+                self._request(setup, trace_id)
+            status, response = self._request(trigger, trace_id)
+            want_status = trigger.get("expect_status", 200)
+            status_detail = f"HTTP {status} (want {want_status})"
         self.pump()
         with self.lock:
             spans = [
@@ -188,10 +295,9 @@ class TraceTestClient:
                 if isinstance(s.trace_id, bytes) and s.trace_id.hex() == trace_id
             ]
 
-        want_status = trigger.get("expect_status", 200)
         result.checks.append(CheckResult(
             result.test_id, "trigger status",
-            status == want_status, f"HTTP {status} (want {want_status})",
+            status == want_status, status_detail,
         ))
         for check in spec.get("specs", []):
             selected = _select(spans, check.get("selector", {}))
@@ -257,7 +363,11 @@ def run_suites(
 
 
 def make_rig(seed: int = 0):
-    """Boot a Shop + gateway + span log; returns (gateway, client, stop)."""
+    """Boot a Shop + gateway (+ gRPC edge) + span log.
+
+    Returns (gateway, client, stop); the edge serves the same shop under
+    the gateway's lock so HTTP and gRPC triggers hit one object graph.
+    """
     from .services import Shop, ShopConfig, ShopGateway
     from .utils.flag_ui import FlagEditorUI
 
@@ -273,14 +383,37 @@ def make_rig(seed: int = 0):
     gw.feature_ui = FlagEditorUI(shop.flags)
     gw.start()
 
+    grpc_target = None
+    edge = None
+    try:
+        from .services.grpc_edge import GrpcShopEdge
+
+        edge = GrpcShopEdge(shop, host="127.0.0.1", port=0, lock=gw._lock)
+        edge.start()
+        grpc_target = f"127.0.0.1:{edge.port}"
+    except ImportError:  # grpcio absent: HTTP triggers only
+        pass
+    except Exception:
+        # Edge bind/boot failure must not leak a serving gateway.
+        gw.stop()
+        raise
+
     def pump():
         with gw._lock:
             gw._pump_locked()
 
     client = TraceTestClient(
-        f"http://127.0.0.1:{gw.port}", span_log, pump, lock
+        f"http://127.0.0.1:{gw.port}", span_log, pump, lock,
+        grpc_target=grpc_target,
     )
-    return gw, client, gw.stop
+
+    def stop():
+        client.close()
+        if edge is not None:
+            edge.stop()
+        gw.stop()
+
+    return gw, client, stop
 
 
 def main(argv: list[str] | None = None) -> int:
