@@ -450,12 +450,47 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
   return n_rec;
 }
 
+// USD-normalization table for the order value lane, installed from
+// Python (currency_data.EUR_RATES) via otd_set_order_rates. Codes are
+// fixed 8-byte NUL-padded entries; unknown codes pass through at 1.0
+// (kafka_orders.to_usd_factor contract).
+static struct OrderRate {
+  char code[8];
+  double factor;
+} g_order_rates[64];
+static int g_n_order_rates = 0;
+
+void otd_set_order_rates(const char* codes, const double* factors, int n) {
+  if (n > 64) n = 64;
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < 8; ++j) g_order_rates[i].code[j] = codes[i * 8 + j];
+    g_order_rates[i].factor = factors[i];
+  }
+  g_n_order_rates = n;
+}
+
+static double order_rate_lookup(const uint8_t* p, size_t len) {
+  if (len == 0 || len > 8) return 1.0;
+  for (int i = 0; i < g_n_order_rates; ++i) {
+    const char* c = g_order_rates[i].code;
+    size_t clen = 0;
+    while (clen < 8 && c[clen]) ++clen;
+    if (clen != len) continue;
+    bool eq = true;
+    for (size_t j = 0; j < len; ++j)
+      if ((uint8_t)c[j] != p[j]) { eq = false; break; }
+    if (eq) return g_order_rates[i].factor;
+  }
+  return 1.0;
+}
+
 // Decode a batch of OrderResult payloads (one Kafka message each) into
 // the detector's order-record columns: order-id key (first 8 bytes of
-// the id string), shipping cost in currency units (the value lane), and
-// the CRC of the first *non-empty* product id (heavy-hitter attribute —
-// kafka_orders.decode_order skips falsy ids). Mirrors decode_order +
-// order_to_record, including error verdicts.
+// the id string), shipping cost USD-normalized via the installed rate
+// table (the value lane), and the CRC of the first *non-empty* product
+// id (heavy-hitter attribute — kafka_orders.decode_order skips falsy
+// ids). Mirrors decode_order + order_to_record, including error
+// verdicts.
 int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
                       int n,                                     //
                       float* value_units, uint64_t* order_key,   //
@@ -464,7 +499,7 @@ int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
     Slice top{bufs[i], lens[i]};
     Field f;
     bool descend;
-    Str order_id, tracking, first_product;
+    Str order_id, tracking, first_product, currency;
     bool money_claimed = false;
     uint64_t units = 0, nanos = 0;
     bool units_claimed = false, nanos_claimed = false;
@@ -485,7 +520,9 @@ int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
           Field mf;
           while (!m.done()) {
             if (!next_field(m, mf)) return -1;
-            if (mf.no == 2) {
+            if (mf.no == 1) {  // currency_code (bytes-first)
+              if (!bytes_first(mf, currency)) return -1;
+            } else if (mf.no == 2) {
               if (!numeric_first(mf, units_claimed, units)) return -1;
             } else if (mf.no == 3) {
               if (!numeric_first(mf, nanos_claimed, nanos)) return -1;
@@ -530,8 +567,11 @@ int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
     }
     // Parity with wire.py: varints decode unsigned, and _money_units
     // floats the raw value (negative money is producer error; both
-    // sides treat it identically).
-    value_units[i] = float(double(units) + double(nanos) * 1e-9);
+    // sides treat it identically). USD normalization matches
+    // order_to_record: float32(float64 value × float64 factor).
+    double factor = currency.set ? order_rate_lookup(currency.p, currency.n)
+                                 : order_rate_lookup((const uint8_t*)"USD", 3);
+    value_units[i] = float((double(units) + double(nanos) * 1e-9) * factor);
     order_key[i] =
         order_id.set && order_id.n ? key8(order_id.p, order_id.n) : 0;
     attr_crc[i] =

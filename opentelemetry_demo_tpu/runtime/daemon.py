@@ -254,7 +254,8 @@ class DetectorDaemon:
         if self._orders is not None:
             for offsets, record in self._orders.poll(0.0):
                 self._offsets.update(offsets)
-                self.pipeline.submit([record])
+                if record is not None:  # tombstone / skipped poison pill
+                    self.pipeline.submit([record])
         self.pipeline.pump(t_now)
         self.metrics_feed.pump(time.monotonic() if t_now is None else t_now)
         if (

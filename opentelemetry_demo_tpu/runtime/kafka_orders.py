@@ -31,6 +31,7 @@ from typing import Iterator, NamedTuple, Sequence
 import numpy as np
 
 from . import native, wire
+from ..currency_data import to_usd_factor
 from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
 ORDERS_SERVICE = "checkout-orders"
@@ -43,16 +44,21 @@ class Order(NamedTuple):
     item_count: int
     product_ids: tuple[str, ...]
     total_quantity: int
+    currency: str = "USD"  # shipping_cost Money currency on the wire
 
 
-def _money_units(buf: bytes | None) -> float:
+def _money_units(buf: bytes | None) -> tuple[float, str]:
     if not buf:
-        return 0.0
+        return 0.0, "USD"
     f = wire.scan_fields(buf)
+    code = wire.first(f, 1, b"USD")
     units = wire.first(f, 2, 0)
     nanos = wire.first(f, 3, 0)
     # zigzag not used (int64/int32 plain varints in the schema)
-    return float(units) + float(nanos) * 1e-9
+    return (
+        float(units) + float(nanos) * 1e-9,
+        code.decode("utf-8", "replace") if isinstance(code, bytes) else "USD",
+    )
 
 
 def decode_order(payload: bytes) -> Order:
@@ -60,7 +66,7 @@ def decode_order(payload: bytes) -> Order:
     f = wire.scan_fields(payload)
     order_id = (wire.first(f, 1, b"") or b"").decode("utf-8", "replace")
     tracking = (wire.first(f, 2, b"") or b"").decode("utf-8", "replace")
-    shipping = _money_units(wire.first(f, 3))
+    shipping, currency = _money_units(wire.first(f, 3))
     products: list[str] = []
     qty = 0
     for item_buf in f.get(5, []):
@@ -72,7 +78,10 @@ def decode_order(payload: bytes) -> Order:
             if pid:
                 products.append(pid.decode("utf-8", "replace"))
             qty += int(wire.first(cart_f, 2, 0) or 0)
-    return Order(order_id, tracking, shipping, len(products), tuple(products), qty)
+    return Order(
+        order_id, tracking, shipping, len(products), tuple(products), qty,
+        currency,
+    )
 
 
 def order_to_record(order: Order, duration_us: float = 0.0) -> SpanRecord:
@@ -81,11 +90,15 @@ def order_to_record(order: Order, duration_us: float = 0.0) -> SpanRecord:
     Trace-id analogue = order id (distinct-order cardinality); monitored
     attribute = the order's first product id (heavy-hitter per service
     'checkout-orders'); latency lane carries order value so the EWMA head
-    doubles as an order-value anomaly tracker.
+    doubles as an order-value anomaly tracker. The value is normalized
+    to USD (the wire carries the user currency, reference parity with
+    main.go's localized shipping cost) so a burst of JPY checkouts is
+    not a ~150x false value anomaly.
     """
+    value = order.shipping_cost_units * to_usd_factor(order.currency)
     return SpanRecord(
         service="checkout-orders",
-        duration_us=duration_us if duration_us else order.shipping_cost_units,
+        duration_us=duration_us if duration_us else value,
         trace_id=order.order_id.encode() or b"\0",
         is_error=False,
         attr=order.product_ids[0] if order.product_ids else "",
@@ -117,29 +130,81 @@ def decode_orders_columnar(
     return tensorizer.columns_from_records(records)
 
 
-def encode_order(order: Order) -> bytes:
-    """Wire-compatible OrderResult encoder (simulator + tests).
+MoneyTuple = tuple  # (currency: str, units: int, nanos: int)
 
-    Lets the in-proc shop (``services.checkout``) publish byte-identical
-    payloads to what the reference's Go producer emits, so the decode
-    path is exercised end-to-end without a broker.
+
+def encode_money(currency: str, units: int, nanos: int) -> bytes:
+    """Money submessage; zero units/nanos omitted (proto3 defaults)."""
+    out = wire.encode_len(1, currency.encode())
+    if units:
+        out += wire.encode_int(2, units)
+    if nanos:
+        out += wire.encode_int(3, nanos)
+    return out
+
+
+def encode_order_result(
+    order_id: str,
+    tracking_id: str,
+    shipping: MoneyTuple,
+    lines: Sequence[tuple[str, int, MoneyTuple | None]],
+) -> bytes:
+    """The ONE wire-compatible OrderResult encoder.
+
+    Both transports that emit OrderResult — checkout's Kafka publish and
+    the gRPC edge's PlaceOrder response — go through here, so they can
+    never disagree about quantities or costs on the same proto message.
+    ``lines`` = (product_id, quantity, (currency, units, nanos) | None).
     """
-    items = b""
-    for pid in order.product_ids:
-        cart = wire.encode_len(1, pid.encode()) + wire.encode_int(
-            2, max(order.total_quantity // max(order.item_count, 1), 1)
-        )
-        items += wire.encode_len(5, wire.encode_len(1, cart))
-    money = wire.encode_len(1, b"USD") + wire.encode_int(
-        2, int(order.shipping_cost_units)
-    ) + wire.encode_int(
-        3, int((order.shipping_cost_units - int(order.shipping_cost_units)) * 1e9)
+    out = (
+        wire.encode_len(1, order_id.encode())
+        + wire.encode_len(2, tracking_id.encode())
+        + wire.encode_len(3, encode_money(*shipping))
     )
-    return (
-        wire.encode_len(1, order.order_id.encode())
-        + wire.encode_len(2, order.tracking_id.encode())
-        + wire.encode_len(3, money)
-        + items
+    for pid, qty, cost in lines:
+        cart = wire.encode_len(1, pid.encode()) + wire.encode_int(2, qty)
+        item = wire.encode_len(1, cart)
+        if cost is not None:
+            item += wire.encode_len(2, encode_money(*cost))
+        out += wire.encode_len(5, item)
+    return out
+
+
+def encode_placed_order(placed) -> bytes:
+    """OrderResult bytes from a ``services.checkout.PlacedOrder``.
+
+    Duck-typed (``.shipping``/``.items`` with Money-shaped members) so
+    the runtime layer needs no services import. This is the ONE
+    marshalling of PlacedOrder onto the wire — checkout's Kafka publish
+    and the gRPC edge's PlaceOrder response both call it, so neither
+    call site can drift back to e.g. encoding the grand total as
+    shipping_cost.
+    """
+    return encode_order_result(
+        placed.order_id,
+        placed.tracking_id,
+        (placed.shipping.currency, placed.shipping.units,
+         placed.shipping.nanos),
+        [
+            (line.product_id, line.quantity,
+             (line.cost.currency, line.cost.units, line.cost.nanos))
+            for line in placed.items
+        ],
+    )
+
+
+def encode_order(order: Order) -> bytes:
+    """OrderResult from the compact :class:`Order` shape (simulator +
+    tests — real producers carry exact lines via
+    :func:`encode_order_result`; this synthesizes uniform quantities)."""
+    units = int(order.shipping_cost_units)
+    nanos = int((order.shipping_cost_units - units) * 1e9)
+    qty = max(order.total_quantity // max(order.item_count, 1), 1)
+    return encode_order_result(
+        order.order_id,
+        order.tracking_id,
+        (order.currency, units, nanos),
+        [(pid, qty, None) for pid in order.product_ids],
     )
 
 
@@ -164,6 +229,7 @@ class OrdersSource:
         self._bootstrap = bootstrap
         self._group_id = group_id
         self._pending_seek: dict[int, int] = {}
+        self.decode_failures = 0  # poison pills skipped (not crashed on)
         self._wire = None
         self._next_connect = 0.0  # wire-transport reconnect backoff
         try:
@@ -256,10 +322,18 @@ class OrdersSource:
                 ]
             )
 
-    def poll(self, timeout_s: float = 0.1) -> Iterator[tuple[dict, SpanRecord]]:
-        # Next-offset semantics (Kafka committed-offset convention): a
-        # checkpoint taken after a message seeks *past* it on resume,
-        # so nothing is double-counted into the CMS.
+    def poll(
+        self, timeout_s: float = 0.1
+    ) -> Iterator[tuple[dict, SpanRecord | None]]:
+        """Yield ``(offsets, record)``; ``record`` is None for a skipped
+        message (tombstone or undecodable poison pill) whose offset must
+        STILL advance — otherwise a pill at a partition tail is never
+        committed past and replays (and re-logs) on every restart.
+
+        Next-offset semantics (Kafka committed-offset convention): a
+        checkpoint taken after a message seeks *past* it on resume, so
+        nothing is double-counted into the CMS.
+        """
         if self._consumer is None:
             wire = self._ensure_wire()
             if wire is None:
@@ -273,18 +347,48 @@ class OrdersSource:
                 self._drop_wire()
                 return
             for msg in msgs:
-                if msg.value is None:
-                    continue
-                yield (
-                    {msg.partition: msg.offset + 1},
-                    order_to_record(decode_order(msg.value)),
+                record = (
+                    None if msg.value is None
+                    else self._decode(msg.value, msg.partition, msg.offset)
                 )
+                yield {msg.partition: msg.offset + 1}, record
             return
         msg = self._consumer.poll(timeout_s)  # pragma: no cover - confluent
         if msg is None or msg.error():
             return
-        offsets = {msg.partition(): msg.offset() + 1}
-        yield offsets, order_to_record(decode_order(msg.value()))
+        record = (
+            None if msg.value() is None
+            else self._decode(msg.value(), msg.partition(), msg.offset())
+        )
+        yield {msg.partition(): msg.offset() + 1}, record
+
+    def _decode(self, value: bytes, partition: int, offset: int):
+        """Decode one message, treating a malformed payload as a skip.
+
+        A bad producer payload must not be a poison pill: the transport
+        try in :meth:`poll` guards the socket, not the decode, and
+        auto-commit means a crash here would skip the message *silently*
+        after restart — crash plus data loss. Instead: log, count,
+        continue (the reference consumers do the same — a deser error in
+        the Kotlin consumer logs and polls on, main.kt:64).
+        """
+        try:
+            return order_to_record(decode_order(value))
+        except Exception as e:
+            # Deliberately broad: a wrong-schema payload that parses as
+            # valid wire format surfaces as TypeError/AttributeError
+            # (scan_fields returns an int where bytes were expected),
+            # not WireError — and ANY decode failure is the same poison
+            # pill from the consumer's point of view.
+            self.decode_failures += 1
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "orders[%s@%s]: undecodable payload skipped (%s: %s); "
+                "%d total", partition, offset, type(e).__name__, e,
+                self.decode_failures,
+            )
+            return None
 
     def close(self) -> None:
         if self._wire is not None:

@@ -134,6 +134,25 @@ def _configure_ingest(lib: ctypes.CDLL) -> None:
     ]
     lib.otd_crc32.restype = ctypes.c_uint32
     lib.otd_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    # Install the USD-normalization table for the order value lane once
+    # per load — the same factors kafka_orders.order_to_record applies
+    # on the Python path (currency_data is a leaf module; no cycle).
+    lib.otd_set_order_rates.restype = None
+    lib.otd_set_order_rates.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int
+    ]
+    from ..currency_data import EUR_RATES, to_usd_factor
+
+    # The C side clamps at 64 entries SILENTLY — growing EUR_RATES past
+    # that would diverge native (factor 1.0) from Python (real factor).
+    assert len(EUR_RATES) <= 64, "EUR_RATES exceeds native rate-table cap"
+    codes = b"".join(
+        code.encode().ljust(8, b"\0")[:8] for code in EUR_RATES
+    )
+    factors = (ctypes.c_double * len(EUR_RATES))(
+        *(to_usd_factor(code) for code in EUR_RATES)
+    )
+    lib.otd_set_order_rates(codes, factors, len(EUR_RATES))
 
 
 def _configure_currency(lib: ctypes.CDLL) -> None:

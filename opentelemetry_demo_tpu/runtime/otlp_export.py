@@ -66,6 +66,13 @@ class BackgroundPoster:
 
     def submit(self, body: bytes) -> None:
         with self._lock:
+            if self._stop:
+                # After close() the sender thread has exited (or is
+                # exiting); enqueueing would black-hole the body while
+                # the counters report healthy. Count it as dropped so a
+                # misused exporter is visible in its own stats.
+                self.dropped += 1
+                return
             self._queue.append(body)
             while len(self._queue) > self._queue_max:
                 self._queue.popleft()

@@ -27,18 +27,28 @@ from .email import EmailService
 from .money import Money
 from .payment import PaymentService
 from .shipping import ShippingService
-from ..runtime.kafka_orders import Order, encode_order
+from ..runtime.kafka_orders import encode_placed_order
 from ..telemetry.tracer import TraceContext
 
 FLAG_KAFKA_PROBLEMS = "kafkaQueueProblems"
 ORDERS_TOPIC = "orders"
 
 
+class OrderLine(NamedTuple):
+    """One cart line as it appears in OrderResult.items
+    (proto OrderItem: CartItem + per-line cost in the user currency)."""
+
+    product_id: str
+    quantity: int
+    cost: Money
+
+
 class PlacedOrder(NamedTuple):
     order_id: str
     tracking_id: str
     total: Money
-    items: tuple[str, ...]
+    shipping: Money  # the shipping quote, converted to the user currency
+    items: tuple[OrderLine, ...]
 
 
 class CheckoutService(ServiceBase):
@@ -81,15 +91,18 @@ class CheckoutService(ServiceBase):
                 raise ServiceError(self.name, "empty cart")
 
             total = Money(user_currency, 0, 0)
-            product_ids = []
+            lines: list[OrderLine] = []
             for product_id, qty in items.items():
                 self.catalog.get_product(ctx, product_id)
                 usd = self.catalog.price_of(product_id).multiply(qty)
-                total = total.add(self.currency.convert(ctx, usd, user_currency))
-                product_ids.append(product_id)
+                line_cost = self.currency.convert(ctx, usd, user_currency)
+                total = total.add(line_cost)
+                lines.append(OrderLine(product_id, qty, line_cost))
+            product_ids = [line.product_id for line in lines]
 
             ship_usd = self.shipping.get_quote(ctx, sum(items.values()))
-            total = total.add(self.currency.convert(ctx, ship_usd, user_currency))
+            ship_cost = self.currency.convert(ctx, ship_usd, user_currency)
+            total = total.add(ship_cost)
 
             self.payment.charge(ctx, total, card_number, expiry_year, expiry_month)
             tracking_id = self.shipping.ship_order(ctx)
@@ -98,35 +111,33 @@ class CheckoutService(ServiceBase):
             order_id = str(uuid.uuid5(uuid.NAMESPACE_DNS, ctx.trace_id.hex()))
             self.email.send_order_confirmation(ctx, email, order_id)
 
-            order = Order(
-                order_id=order_id,
-                tracking_id=tracking_id,
-                shipping_cost_units=ship_usd.to_float(),
-                item_count=len(product_ids),
-                product_ids=tuple(product_ids),
-                total_quantity=sum(items.values()),
+            placed = PlacedOrder(
+                order_id, tracking_id, total, ship_cost, tuple(lines)
             )
-            self._publish(ctx, order)
+            self._publish(ctx, placed)
             self.span("PlaceOrder", ctx, attr=product_ids[0] if product_ids else None)
             self.log(
                 "INFO", "order placed", ctx,
                 order_id=order_id, items=len(product_ids),
                 total=f"{total.currency} {total.to_float():.2f}",
             )
-            return PlacedOrder(order_id, tracking_id, total, tuple(product_ids))
+            return placed
         except ServiceError as err:
             self.span("PlaceOrder", ctx, scale=1.5, error=True)
             self.log("ERROR", f"order failed: {err}", ctx, user=user_id)
             raise
 
-    def _publish(self, ctx: TraceContext, order: Order) -> None:
-        """Async post-processing boundary (main.go:549-614)."""
+    def _publish(self, ctx: TraceContext, placed: PlacedOrder) -> None:
+        """Async post-processing boundary (main.go:549-614). The Kafka
+        payload goes through the same OrderResult encoder as the gRPC
+        PlaceOrder response — real quantities and per-line costs, never
+        a diverging second encoding of the same proto message."""
         topic = self.bus.topic(ORDERS_TOPIC)
-        value = encode_order(order)
+        value = encode_placed_order(placed)
         headers = ctx.to_headers()  # context over the async boundary
-        topic.produce(order.order_id.encode(), value, headers)
+        topic.produce(placed.order_id.encode(), value, headers)
         self.span("orders publish", ctx, scale=0.3)
         # kafkaQueueProblems: flood the topic so consumers lag.
         flood = int(self.flag(FLAG_KAFKA_PROBLEMS, 0, ctx))
         for _ in range(max(flood, 0)):
-            topic.produce(order.order_id.encode(), value, headers)
+            topic.produce(placed.order_id.encode(), value, headers)

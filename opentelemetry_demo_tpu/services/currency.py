@@ -18,43 +18,9 @@ from __future__ import annotations
 
 from .base import ServiceBase, ServiceError
 from .money import NANOS_PER_UNIT, Money, MoneyError
+from ..currency_data import EUR_RATES  # noqa: F401 — canonical location
 from ..runtime import native
 from ..telemetry.tracer import TraceContext
-
-# EUR = 1.0; own values (shape of the reference's table, not its data).
-EUR_RATES = {
-    "EUR": 1.0,
-    "USD": 1.09,
-    "JPY": 171.5,
-    "GBP": 0.853,
-    "TRY": 35.1,
-    "CAD": 1.47,
-    "AUD": 1.65,
-    "CHF": 0.955,
-    "CNY": 7.83,
-    "SEK": 11.4,
-    "NZD": 1.78,
-    "MXN": 18.6,
-    "SGD": 1.46,
-    "HKD": 8.52,
-    "NOK": 11.7,
-    "KRW": 1486.0,
-    "INR": 91.2,
-    "BRL": 6.05,
-    "ZAR": 19.9,
-    "DKK": 7.46,
-    "PLN": 4.31,
-    "THB": 38.2,
-    "ILS": 4.02,
-    "CZK": 25.2,
-    "ISK": 150.9,
-    "RON": 4.97,
-    "HUF": 392.0,
-    "PHP": 63.6,
-    "MYR": 4.86,
-    "BGN": 1.96,
-    "IDR": 17650.0,
-}
 
 
 class CurrencyService(ServiceBase):

@@ -407,8 +407,18 @@ class ShopGateway:
             return (*ok, json.dumps({
                 "orderId": order.order_id,
                 "shippingTrackingId": order.tracking_id,
+                "shippingCost": _money_json(order.shipping),
                 "total": _money_json(order.total),
-                "items": list(order.items),
+                "items": [
+                    {
+                        "item": {
+                            "productId": line.product_id,
+                            "quantity": line.quantity,
+                        },
+                        "cost": _money_json(line.cost),
+                    }
+                    for line in order.items
+                ],
             }).encode())
 
         return 404, "application/json", b'{"error":"no route"}'

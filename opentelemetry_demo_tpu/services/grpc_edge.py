@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 
 from ..runtime import wire
+from ..runtime.kafka_orders import encode_placed_order
 from ..telemetry.tracer import TraceContext
 from .base import ServiceError
 from .money import Money
@@ -275,16 +276,10 @@ class GrpcShopEdge:
             _dec_str(f, 5),
             **kwargs,
         )
-        order = (
-            wire.encode_len(1, placed.order_id.encode())
-            + wire.encode_len(2, placed.tracking_id.encode())
-            + wire.encode_len(3, _enc_money(placed.total))
-        )
-        for pid in placed.items:
-            order += wire.encode_len(
-                5, wire.encode_len(1, _enc_cart_item(pid, 1))
-            )
-        return wire.encode_len(1, order)
+        # OrderResult field 3 is shipping_cost (proto/demo.proto:202),
+        # NOT the grand total — marshalled by the SAME helper checkout's
+        # Kafka publish uses, so the two transports cannot diverge.
+        return wire.encode_len(1, encode_placed_order(placed))
 
     def _get_ads(self, ctx, request: bytes) -> bytes:
         f = wire.scan_fields(request)

@@ -71,16 +71,26 @@ class InProcTransport:
         order = self.frontend.api_checkout(ctx, user_id, currency, email)
         # Same wire shape as the gateway's /api/checkout response, so
         # the two transports stay interchangeable.
-        total = order.total
+        def money(m):
+            return {
+                "currencyCode": m.currency, "units": m.units, "nanos": m.nanos,
+            }
+
         return {
             "orderId": order.order_id,
             "shippingTrackingId": order.tracking_id,
-            "total": {
-                "currencyCode": total.currency,
-                "units": total.units,
-                "nanos": total.nanos,
-            },
-            "items": list(order.items),
+            "shippingCost": money(order.shipping),
+            "total": money(order.total),
+            "items": [
+                {
+                    "item": {
+                        "productId": line.product_id,
+                        "quantity": line.quantity,
+                    },
+                    "cost": money(line.cost),
+                }
+                for line in order.items
+            ],
         }
 
 

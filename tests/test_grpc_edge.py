@@ -160,7 +160,7 @@ def test_place_order_full_path(edge, pb2):
                 pb2.AddItemRequest, pb2.Empty)
     add(pb2.AddItemRequest(
         user_id="buyer",
-        item=pb2.CartItem(product_id="EYE-PLO-25", quantity=1)), timeout=5)
+        item=pb2.CartItem(product_id="EYE-PLO-25", quantity=2)), timeout=5)
     place = _stub(edge, pb2, "CheckoutService", "PlaceOrder",
                   pb2.PlaceOrderRequest, pb2.PlaceOrderResponse)
     resp = place(pb2.PlaceOrderRequest(
@@ -171,7 +171,16 @@ def test_place_order_full_path(edge, pb2):
             credit_card_expiration_month=1)), timeout=5)
     assert resp.order.order_id
     assert len(resp.order.shipping_tracking_id) == 36
-    assert [i.item.product_id for i in resp.order.items] == ["EYE-PLO-25"]
+    # Contract semantics (proto/demo.proto:199-205): field 3 is the
+    # SHIPPING cost, items carry real cart quantities + per-line cost.
+    assert [(i.item.product_id, i.item.quantity)
+            for i in resp.order.items] == [("EYE-PLO-25", 2)]
+    line = resp.order.items[0]
+    price = edge.shop.catalog.price_of("EYE-PLO-25").to_float()
+    line_cost = line.cost.units + line.cost.nanos / 1e9
+    assert line_cost == pytest.approx(2 * price, abs=0.01)
+    ship = resp.order.shipping_cost.units + resp.order.shipping_cost.nanos / 1e9
+    assert 0 < ship < line_cost  # the quote, NOT the grand total
 
 
 def test_recommendations_and_ads(edge, pb2):

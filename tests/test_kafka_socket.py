@@ -171,6 +171,30 @@ def test_orders_source_resumes_from_checkpoint_offsets(broker):
     s2.close()
 
 
+def test_orders_source_skips_poison_pill(broker):
+    """A malformed payload is a skip (logged + counted), not a daemon
+    crash — and with auto-commit it must not become silent data loss for
+    the GOOD messages around it."""
+    producer = KafkaProducer(_addr(broker))
+    producer.send("orders", encode_order(
+        Order("ord-ok-1", "t", 1.0, 1, ("P",), 1)))
+    producer.send("orders", b"\xff\xff\xff\xff")  # truncated varint
+    producer.send("orders", encode_order(
+        Order("ord-ok-2", "t", 1.0, 1, ("P",), 1)))
+
+    source = OrdersSource(_addr(broker))
+    got = list(source.poll(0.05))
+    # The pill yields a None record WITH its offset advance, so even a
+    # pill at the partition tail gets committed past instead of
+    # replaying (and re-logging) on every restart.
+    assert [rec.trace_id if rec else None for _off, rec in got] == [
+        b"ord-ok-1", None, b"ord-ok-2",
+    ]
+    assert [off for off, _rec in got] == [{0: 1}, {0: 2}, {0: 3}]
+    assert source.decode_failures == 1
+    source.close()
+
+
 def test_orders_source_survives_broker_restart():
     """Transient broker loss must mean 'retry', not a daemon crash —
     the confluent transport buffers the same way internally."""

@@ -13,11 +13,20 @@ Kafka consumer groups scaled across hosts (SURVEY.md §2.3).
 from __future__ import annotations
 
 import os
-import random
+import socket
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    """Reserve a free port via bind(0) instead of guessing from a shared
+    range: a random 20000-29999 pick can collide with the kafka tests'
+    broker ports or unrelated ephemeral sockets under parallel runs."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 _CHILD = r'''
 import sys
@@ -102,7 +111,7 @@ def test_two_process_hybrid_mesh_bitexact():
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    port = str(random.randint(20000, 29999))
+    port = str(_free_port())
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _CHILD, str(i), port],

@@ -232,6 +232,11 @@ class TestOrdersParity:
             Order("ord-1", "trk", 3.5, 2, ("P-A", "P-B"), 3),
             Order("", "", 0.0, 0, (), 0),
             Order("ord-with-long-id-123456", "t", 19.99, 1, ("P-Z",), 1),
+            # Non-USD wires: the value lane must USD-normalize
+            # identically on both decode paths.
+            Order("ord-jpy", "t", 1500.0, 1, ("P-J",), 1, currency="JPY"),
+            Order("ord-eur", "t", 9.5, 1, ("P-E",), 1, currency="EUR"),
+            Order("ord-xxx", "t", 7.0, 1, ("P-X",), 1, currency="XXX"),
         ]
         return [encode_order(o) for o in orders]
 
@@ -251,6 +256,30 @@ class TestOrdersParity:
     def test_empty_batch(self):
         got = decode_orders_columnar([], SpanTensorizer())
         assert got.rows == 0
+
+    def test_value_lane_usd_normalized(self):
+        # A JPY shipping cost must not land ~150x a USD one in the
+        # detector's order-value lane (currency-dependent units would
+        # make non-USD traffic bursts fire false value anomalies).
+        from opentelemetry_demo_tpu.currency_data import to_usd_factor
+
+        jpy = encode_order(
+            Order("o-j", "t", 1500.0, 1, ("P",), 1, currency="JPY")
+        )
+        usd = encode_order(Order("o-u", "t", 1500.0, 1, ("P",), 1))
+        rec_jpy = order_to_record(decode_order(jpy))
+        rec_usd = order_to_record(decode_order(usd))
+        assert rec_usd.duration_us == pytest.approx(1500.0)
+        assert rec_jpy.duration_us == pytest.approx(
+            1500.0 * to_usd_factor("JPY")
+        )
+        assert rec_jpy.duration_us < 20.0  # ~9.5 USD, not 1500
+        got = decode_orders_columnar([jpy, usd], SpanTensorizer())
+        np.testing.assert_allclose(
+            got.lat_us[:2],
+            [rec_jpy.duration_us, rec_usd.duration_us],
+            rtol=1e-6,
+        )
 
     def test_empty_product_id_skipped(self):
         # decode_order skips falsy product ids; the first NON-empty one

@@ -84,6 +84,18 @@ def test_exporter_down_sink_counts_not_raises():
     exporter.close()
 
 
+def test_submit_after_close_counts_dropped():
+    """A closed exporter must not black-hole: the sender thread is gone,
+    so anything submitted afterwards is counted dropped immediately
+    instead of queueing forever behind healthy-looking counters."""
+    exporter = OtlpHttpSpanExporter("http://127.0.0.1:9", timeout_s=0.3)
+    exporter.close()
+    exporter(0.0, RECORDS)
+    assert exporter.dropped == 1
+    assert exporter.sent == 0 and exporter.errors == 0
+    assert exporter.flush(0.5)  # nothing queued
+
+
 def test_grpc_endpoint_ships_both_signals():
     """grpc:// endpoints ride OTLP/gRPC — the collector exporter
     default — through the same background sender surface."""
