@@ -195,16 +195,20 @@ bool bytes_first(const Field& f, Str& out) {
   return false;
 }
 
-// numeric-first: numeric claims with the value; empty LEN is falsy and
-// claims with the default; nonempty LEN errors (int(bytes)).
-bool numeric_first(const Field& f, bool& claimed, uint64_t& out) {
+// numeric-first: numeric claims with the value; nonempty LEN errors
+// (int(bytes) of non-digits raises). Empty LEN depends on the Python
+// call-site shape: `int(first(...) or 0)` treats b"" as falsy → default
+// (empty_len_ok), while bare `float(first(...))` raises on b"" —
+// callers pass empty_len_ok=false to model the latter.
+bool numeric_first(const Field& f, bool& claimed, uint64_t& out,
+                   bool empty_len_ok = true) {
   if (claimed) return true;
   if (numeric(f)) {
     claimed = true;
     out = f.num;
     return true;
   }
-  if (f.wt == kLen && f.len == 0) {
+  if (empty_len_ok && f.wt == kLen && f.len == 0) {
     claimed = true;
     return true;
   }
@@ -520,12 +524,23 @@ int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
           Field mf;
           while (!m.done()) {
             if (!next_field(m, mf)) return -1;
-            if (mf.no == 1) {  // currency_code (bytes-first)
-              if (!bytes_first(mf, currency)) return -1;
+            if (mf.no == 1) {
+              // currency_code: bytes-first, EXCEPT Python's
+              // isinstance(code, bytes) guard (_money_units) maps a
+              // numeric value to the USD default instead of raising —
+              // so a nonzero varint claims-with-default here, unlike
+              // every other bytes field in this decoder.
+              if (!bytes_first(mf, currency)) {
+                if (!numeric(mf)) return -1;
+                currency.set = true;  // claimed, empty → USD factor
+              }
             } else if (mf.no == 2) {
-              if (!numeric_first(mf, units_claimed, units)) return -1;
+              // float(first(...)) raises on b"" — no empty-LEN default.
+              if (!numeric_first(mf, units_claimed, units, false))
+                return -1;
             } else if (mf.no == 3) {
-              if (!numeric_first(mf, nanos_claimed, nanos)) return -1;
+              if (!numeric_first(mf, nanos_claimed, nanos, false))
+                return -1;
             }
           }
           break;

@@ -37,10 +37,11 @@ def make_columns(rng, rows: int) -> SpanColumns:
 
 def measure_lag(
     rate: float = 2_000.0,
-    seconds: float = 6.0,
+    seconds: float = 12.0,
     batch: int = 256,
     harvest_interval_s: float = 0.0,
     harvest_async: bool = False,
+    rtt_probe: bool = True,
     seed: int = 0,
     config: DetectorConfig | None = None,
 ) -> dict:
@@ -50,6 +51,14 @@ def measure_lag(
     Locust profile is 5 users with 1-10 s waits (~10²-10³ spans/s), not
     the 200k/s throughput stress config (pass ``rate=200_000`` +
     ``harvest_async=True`` for that regime).
+
+    With ``rtt_probe`` (default), every harvest launches one timed
+    1-scalar fetch CONCURRENT with its report fetch (same tunnel moment,
+    same congestion), and the result carries ``p99_net_ms`` = p99 of
+    elementwise lag−RTT — what a locally attached chip (no tunnel round
+    trip per readback) would show — beside the gross number, plus the
+    RTT distribution itself so the gross p99 can be judged against the
+    topology's own floor and jitter.
     """
     detector = AnomalyDetector(config or DetectorConfig())
     pipe = DetectorPipeline(
@@ -57,6 +66,7 @@ def measure_lag(
         batch_size=batch,
         harvest_interval_s=harvest_interval_s,
         harvest_async=harvest_async,
+        rtt_probe=rtt_probe,
     )
     rng = np.random.default_rng(seed)
     # Pre-build chunks so generation cost stays off the timed path.
@@ -68,6 +78,7 @@ def measure_lag(
     pipe.pump(time.monotonic())
     pipe.drain()
     pipe.stats.lag_ms.clear()
+    pipe.stats.rtt_ms.clear()
     base_batches = pipe.stats.batches
     base_spans = pipe.stats.spans
     base_skipped = pipe.stats.reports_skipped
@@ -86,10 +97,22 @@ def measure_lag(
         i += 1
     pipe.close()
 
-    return {
+    out = {
         "p99_ms": round(pipe.stats.lag_p99_ms(), 3),
         "rate": rate,
         "batches": pipe.stats.batches - base_batches,
         "spans": pipe.stats.spans - base_spans,
         "reports_skipped": pipe.stats.reports_skipped - base_skipped,
     }
+    net = pipe.stats.lag_net_samples()
+    rtt = np.asarray(pipe.stats.rtt_ms, dtype=np.float64)
+    rtt = rtt[~np.isnan(rtt)]  # timed-out probes append NaN sentinels
+    if net.size and rtt.size:
+        out.update(
+            p99_net_ms=round(float(np.percentile(net, 99)), 3),
+            p50_net_ms=round(float(np.percentile(net, 50)), 3),
+            rtt_p50_ms=round(float(np.percentile(rtt, 50)), 3),
+            rtt_p99_ms=round(float(np.percentile(rtt, 99)), 3),
+            rtt_pairs=int(net.size),
+        )
+    return out

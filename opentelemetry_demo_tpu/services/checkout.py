@@ -51,6 +51,38 @@ class PlacedOrder(NamedTuple):
     items: tuple[OrderLine, ...]
 
 
+def money_json(m: Money) -> dict:
+    """Money → the proto-JSON shape the reference APIs use."""
+    return {"currencyCode": m.currency, "units": m.units, "nanos": m.nanos}
+
+
+def placed_order_json(order: PlacedOrder) -> dict:
+    """PlacedOrder → the /api/checkout response shape.
+
+    The ONE serializer for every transport that returns an order to a
+    client (gateway HTTP route, in-proc mobile transport), mirroring the
+    reference's proto-JSON of OrderResult
+    (/root/reference/pb/demo.proto:207-214) — a field added here reaches
+    all transports at once instead of desynchronizing hand-kept copies.
+    """
+    return {
+        "orderId": order.order_id,
+        "shippingTrackingId": order.tracking_id,
+        "shippingCost": money_json(order.shipping),
+        "total": money_json(order.total),
+        "items": [
+            {
+                "item": {
+                    "productId": line.product_id,
+                    "quantity": line.quantity,
+                },
+                "cost": money_json(line.cost),
+            }
+            for line in order.items
+        ],
+    }
+
+
 class CheckoutService(ServiceBase):
     name = "checkout"
     base_latency_us = 1000.0

@@ -43,6 +43,7 @@ from urllib.parse import parse_qs, urlparse
 from xml.sax.saxutils import escape as _xml_escape
 
 from .base import ServiceError
+from .checkout import money_json as _money_json, placed_order_json
 from .frontend import FLAG_IMAGE_SLOW_LOAD
 from .shop import Shop
 from .webui import WebStorefront
@@ -50,11 +51,6 @@ from ..runtime import otlp
 from ..telemetry.tracer import TraceContext
 
 MAX_FAULT_DELAY_S = 10.0  # cap on header-triggered fault delays
-
-
-def _money_json(m) -> dict:
-    """Money → the proto-JSON shape the reference APIs use."""
-    return {"currencyCode": m.currency, "units": m.units, "nanos": m.nanos}
 
 
 def _product_image_svg(product_id: str) -> bytes:
@@ -404,21 +400,6 @@ class ShopGateway:
                 doc.get("currencyCode", "USD"),
                 doc.get("email", "someone@example.com"),
             )
-            return (*ok, json.dumps({
-                "orderId": order.order_id,
-                "shippingTrackingId": order.tracking_id,
-                "shippingCost": _money_json(order.shipping),
-                "total": _money_json(order.total),
-                "items": [
-                    {
-                        "item": {
-                            "productId": line.product_id,
-                            "quantity": line.quantity,
-                        },
-                        "cost": _money_json(line.cost),
-                    }
-                    for line in order.items
-                ],
-            }).encode())
+            return (*ok, json.dumps(placed_order_json(order)).encode())
 
         return 404, "application/json", b'{"error":"no route"}'

@@ -24,6 +24,7 @@ import json
 import urllib.request
 import uuid
 
+from .checkout import placed_order_json
 from .frontend import Frontend
 from ..telemetry.tracer import TraceContext, Tracer
 
@@ -69,29 +70,9 @@ class InProcTransport:
 
     def checkout(self, ctx, user_id, currency, email):
         order = self.frontend.api_checkout(ctx, user_id, currency, email)
-        # Same wire shape as the gateway's /api/checkout response, so
-        # the two transports stay interchangeable.
-        def money(m):
-            return {
-                "currencyCode": m.currency, "units": m.units, "nanos": m.nanos,
-            }
-
-        return {
-            "orderId": order.order_id,
-            "shippingTrackingId": order.tracking_id,
-            "shippingCost": money(order.shipping),
-            "total": money(order.total),
-            "items": [
-                {
-                    "item": {
-                        "productId": line.product_id,
-                        "quantity": line.quantity,
-                    },
-                    "cost": money(line.cost),
-                }
-                for line in order.items
-            ],
-        }
+        # One serializer with the gateway's /api/checkout route, so the
+        # two transports cannot desynchronize.
+        return placed_order_json(order)
 
 
 class HttpTransport:

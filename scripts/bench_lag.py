@@ -45,7 +45,7 @@ def main() -> None:
         harvest_interval_s=args.harvest_interval,
         harvest_async=args.harvest_async,
     )
-    print(json.dumps({
+    out = {
         "metric": "detection_lag_p99",
         "value": stats["p99_ms"],
         "unit": "ms",
@@ -54,7 +54,14 @@ def main() -> None:
         "batches": stats["batches"],
         "spans": stats["spans"],
         "reports_skipped": stats["reports_skipped"],
-    }))
+    }
+    # Paired-probe fields (see lagbench): net = lag − concurrent RTT,
+    # the locally-attached-chip number on tunneled topologies.
+    for key in ("p99_net_ms", "p50_net_ms", "rtt_p50_ms", "rtt_p99_ms",
+                "rtt_pairs"):
+        if key in stats:
+            out[key] = stats[key]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

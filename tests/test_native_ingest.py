@@ -281,6 +281,37 @@ class TestOrdersParity:
             rtol=1e-6,
         )
 
+    def test_numeric_currency_code_falls_back_to_usd_both_ways(self):
+        # Money.currency_code encoded as a VARINT (malformed producer):
+        # Python's isinstance(code, bytes) guard maps it to "USD"
+        # (_money_units) rather than raising — the native decoder must
+        # take the same lenient branch instead of failing the batch.
+        money = wire.encode_int(1, 5) + wire.encode_int(2, 3)
+        payload = wire.encode_len(1, b"ord-n") + wire.encode_len(3, money)
+        order = decode_order(payload)
+        assert order.currency == "USD"
+        assert order.shipping_cost_units == pytest.approx(3.0)
+        rec = order_to_record(decode_order(payload))
+        got = decode_orders_columnar([payload], SpanTensorizer())
+        assert got.rows == 1
+        np.testing.assert_allclose(
+            got.lat_us[:1], [rec.duration_us], rtol=1e-6
+        )
+
+    def test_empty_money_units_bytes_raise_both_ways(self):
+        # Money.units as an EMPTY length-delimited field: float(b"")
+        # raises on the Python path, so the native path must error too
+        # (error verdicts are part of the parity contract).
+        money = wire.encode_len(1, b"USD") + wire.encode_len(2, b"")
+        payload = wire.encode_len(1, b"ord-e") + wire.encode_len(3, money)
+        with pytest.raises(Exception):
+            decode_order(payload)
+        from opentelemetry_demo_tpu.runtime import native
+
+        if native.available():
+            with pytest.raises(ValueError):
+                native.decode_orders([payload])
+
     def test_empty_product_id_skipped(self):
         # decode_order skips falsy product ids; the first NON-empty one
         # is the heavy-hitter attribute.

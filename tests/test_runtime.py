@@ -407,6 +407,24 @@ class TestPipeline:
         assert pipe.stats.harvest_errors >= 1
         assert len(calls) >= 2  # harvester kept delivering after the error
 
+    def test_pipeline_paired_rtt_probe(self, rng):
+        """rtt_probe pairs one concurrent 1-scalar fetch with every
+        harvested report: samples align 1:1 with lag samples and the
+        net (lag−RTT) series is finite."""
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        pipe = DetectorPipeline(det, batch_size=256, rtt_probe=True)
+        for k in range(5):
+            pipe.submit(self._records(rng, 100))
+            pipe.pump(1000.0 + k / 4)
+        pipe.drain()
+        assert len(pipe.stats.rtt_ms) == len(pipe.stats.lag_ms) == 5
+        net = pipe.stats.lag_net_samples()
+        assert net.size == 5 and np.isfinite(net).all()
+        # On a local backend the probe RTT is microseconds, so net stays
+        # within the same order as the gross lag (sanity, not a perf
+        # assertion).
+        assert (net <= np.asarray(pipe.stats.lag_ms)).all()
+
     def test_pipeline_disabled_by_flag(self, rng):
         det = AnomalyDetector(DetectorConfig(num_services=8))
         ev = FlagEvaluator(
