@@ -19,13 +19,21 @@ from __future__ import annotations
 
 import socket
 import threading
+from typing import NamedTuple
 
 from . import kafka_wire as kw
 
 
+class StoredMessage(NamedTuple):
+    key: bytes | None
+    value: bytes | None
+    headers: tuple  # ((str, bytes|None), ...) — v2 record headers
+    timestamp_ms: int
+
+
 class _PartitionLog:
     def __init__(self):
-        self.messages: list[tuple[bytes | None, bytes | None]] = []
+        self.messages: list[StoredMessage] = []
 
     @property
     def high_watermark(self) -> int:
@@ -94,12 +102,12 @@ class KafkaBroker:
             )
 
     def append(self, topic: str, value: bytes, key: bytes | None = None,
-               partition: int = 0) -> int:
+               partition: int = 0, headers=()) -> int:
         """Direct append (producer-side shortcut for sims); returns offset."""
         self.ensure_topic(topic)
         with self._lock:
             log = self._topics[topic][partition]
-            log.messages.append((key, value))
+            log.messages.append(StoredMessage(key, value, tuple(headers), 0))
             return log.high_watermark - 1
 
     def committed(self, group: str, topic: str, partition: int = 0) -> int:
@@ -148,22 +156,24 @@ class KafkaBroker:
 
     def _dispatch(self, header: kw.RequestHeader, r: kw.Reader) -> bytes:
         handlers = {
-            kw.PRODUCE: (0, self._produce_v0),
-            kw.FETCH: (0, self._fetch_v0),
-            kw.LIST_OFFSETS: (0, self._list_offsets_v0),
-            kw.METADATA: (0, self._metadata_v0),
-            kw.FIND_COORDINATOR: (0, self._find_coordinator_v0),
-            kw.OFFSET_COMMIT: (2, self._offset_commit_v2),
-            kw.OFFSET_FETCH: (1, self._offset_fetch_v1),
+            (kw.PRODUCE, 0): self._produce_v0,
+            (kw.PRODUCE, 3): self._produce_v3,
+            (kw.FETCH, 0): self._fetch_v0,
+            (kw.FETCH, 4): self._fetch_v4,
+            (kw.LIST_OFFSETS, 0): self._list_offsets_v0,
+            (kw.METADATA, 0): self._metadata_v0,
+            (kw.FIND_COORDINATOR, 0): self._find_coordinator_v0,
+            (kw.OFFSET_COMMIT, 2): self._offset_commit_v2,
+            (kw.OFFSET_FETCH, 1): self._offset_fetch_v1,
         }
-        entry = handlers.get(header.api_key)
-        if entry is None or header.api_version != entry[0]:
+        handler = handlers.get((header.api_key, header.api_version))
+        if handler is None:
             # Protocol-correct refusal (error body shapes vary per API,
             # so close after a header-only error frame).
             raise kw.KafkaWireError(
                 f"unsupported api {header.api_key} v{header.api_version}"
             )
-        return entry[1](r)
+        return handler(r)
 
     def _metadata_v0(self, r: kw.Reader) -> bytes:
         topics = r.array(r.string)
@@ -231,7 +241,9 @@ class KafkaBroker:
                     log = self._topics[name][partition]
                     base = log.high_watermark
                     for msg in kw.decode_message_set(mset):
-                        log.messages.append((msg.key, msg.value))
+                        log.messages.append(
+                            StoredMessage(msg.key, msg.value, (), 0)
+                        )
                     resp_parts.append((partition, kw.NO_ERROR, base))
                 resp_topics.append((name, resp_parts))
         return kw.enc_array(
@@ -242,6 +254,58 @@ class KafkaBroker:
                 lambda p: kw.enc_int32(p[0]) + kw.enc_int16(p[1]) + kw.enc_int64(p[2]),
             ),
         )
+
+    def _produce_v3(self, r: kw.Reader) -> bytes:
+        """Produce v3: transactional_id + v2 RecordBatch payloads (the
+        modern minimum — Kafka ≥3.0 accepts nothing older). Headers
+        survive into the log."""
+        r.string()  # transactional_id (nullable; transactions unsupported)
+        r.int16()  # required_acks
+        r.int32()  # timeout
+
+        def read_partition():
+            partition = r.int32()
+            size = r.int32()
+            batches = r.buf[r.pos : r.pos + size]
+            r.pos += size
+            return partition, batches
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        resp_topics = []
+        with self._lock:
+            for name, parts in topics:
+                self._topics.setdefault(
+                    name, [_PartitionLog() for _ in range(self.num_partitions)]
+                )
+                resp_parts = []
+                for partition, batches in parts:
+                    if partition >= len(self._topics[name]):
+                        resp_parts.append(
+                            (partition, kw.UNKNOWN_TOPIC_OR_PARTITION, -1)
+                        )
+                        continue
+                    log = self._topics[name][partition]
+                    base = log.high_watermark
+                    for rec in kw.decode_record_batches(batches):
+                        log.messages.append(
+                            StoredMessage(
+                                rec.key, rec.value, rec.headers,
+                                rec.timestamp_ms,
+                            )
+                        )
+                    resp_parts.append((partition, kw.NO_ERROR, base))
+                resp_topics.append((name, resp_parts))
+        # v3 partition response carries log_append_time (-1: CREATE_TIME
+        # logs); throttle_time_ms trails the response.
+        return kw.enc_array(
+            resp_topics,
+            lambda t: kw.enc_string(t[0])
+            + kw.enc_array(
+                t[1],
+                lambda p: kw.enc_int32(p[0]) + kw.enc_int16(p[1])
+                + kw.enc_int64(p[2]) + kw.enc_int64(-1),
+            ),
+        ) + kw.enc_int32(0)
 
     def _fetch_v0(self, r: kw.Reader) -> bytes:
         r.int32()  # replica_id
@@ -276,8 +340,12 @@ class KafkaBroker:
                     mset = b""
                     pos = offset
                     while pos < hw and len(mset) < max_bytes:
-                        key, value = log.messages[pos]
-                        mset += kw.encode_message_set([(key, value)], base_offset=pos)
+                        msg = log.messages[pos]
+                        # v0 fetch serves magic-0 messages: headers have
+                        # no slot in that format and are dropped.
+                        mset += kw.encode_message_set(
+                            [(msg.key, msg.value)], base_offset=pos
+                        )
                         pos += 1
                     resp_parts.append((partition, kw.NO_ERROR, hw, mset))
                 resp_topics.append((name, resp_parts))
@@ -292,6 +360,69 @@ class KafkaBroker:
                 + kw.enc_int32(len(p[3]))
                 + p[3],
             ),
+        )
+
+    def _fetch_v4(self, r: kw.Reader) -> bytes:
+        """Fetch v4: isolation level + v2 RecordBatch record sets (the
+        modern minimum), headers intact."""
+        r.int32()  # replica_id
+        r.int32()  # max_wait_ms (no long-poll in the test double)
+        r.int32()  # min_bytes
+        r.int32()  # max_bytes (whole response; per-partition cap below)
+        r.int8()  # isolation_level (no transactions: read_uncommitted)
+
+        def read_partition():
+            return r.int32(), r.int64(), r.int32()
+
+        topics = r.array(lambda: (r.string(), r.array(read_partition)))
+        resp_topics = []
+        with self._lock:
+            for name, parts in topics:
+                logs = self._topics.get(name)
+                resp_parts = []
+                for partition, offset, max_bytes in parts:
+                    if logs is None or partition >= len(logs):
+                        resp_parts.append(
+                            (partition, kw.UNKNOWN_TOPIC_OR_PARTITION, -1, b"")
+                        )
+                        continue
+                    log = logs[partition]
+                    hw = log.high_watermark
+                    if offset > hw or offset < 0:
+                        resp_parts.append(
+                            (partition, kw.OFFSET_OUT_OF_RANGE, hw, b"")
+                        )
+                        continue
+                    # One batch per stored message keeps the cut-at-
+                    # byte-limit semantics identical to the v0 path.
+                    batches = b""
+                    pos = offset
+                    while pos < hw and len(batches) < max_bytes:
+                        msg = log.messages[pos]
+                        batches += kw.encode_record_batch(
+                            [(msg.key, msg.value, msg.headers)],
+                            base_offset=pos,
+                            base_timestamp_ms=msg.timestamp_ms,
+                        )
+                        pos += 1
+                    resp_parts.append((partition, kw.NO_ERROR, hw, batches))
+                resp_topics.append((name, resp_parts))
+
+        def enc_partition(p):
+            partition, error, hw, batches = p
+            return (
+                kw.enc_int32(partition)
+                + kw.enc_int16(error)
+                + kw.enc_int64(hw)
+                + kw.enc_int64(hw)  # last_stable_offset (no txns)
+                + kw.enc_int32(0)  # aborted_transactions: none
+                + kw.enc_int32(len(batches))
+                + batches
+            )
+
+        return kw.enc_int32(0) + kw.enc_array(  # throttle_time_ms first
+            resp_topics,
+            lambda t: kw.enc_string(t[0]) + kw.enc_array(t[1], enc_partition),
         )
 
     def _list_offsets_v0(self, r: kw.Reader) -> bytes:

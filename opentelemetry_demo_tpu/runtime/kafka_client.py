@@ -19,6 +19,7 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+import time
 from typing import NamedTuple
 
 from . import kafka_wire as kw
@@ -29,6 +30,7 @@ class FetchedMessage(NamedTuple):
     offset: int
     key: bytes | None
     value: bytes | None
+    headers: tuple = ()  # ((str, bytes|None), ...) — v2 record headers
 
 
 class KafkaConnection:
@@ -68,20 +70,29 @@ def _parse_bootstrap(bootstrap: str) -> tuple[str, int]:
 
 
 class KafkaProducer:
-    """Produce v0 with broker-assigned offsets (acks=1 semantics)."""
+    """Produce v3 (v2 RecordBatch + headers) with broker-assigned
+    offsets (acks=1 semantics) — the modern protocol minimum, so the
+    same client speaks to the in-repo broker and a real Kafka ≥3.0."""
 
     def __init__(self, bootstrap: str):
         self._conn = KafkaConnection(*_parse_bootstrap(bootstrap))
 
     def send(self, topic: str, value: bytes, key: bytes | None = None,
-             partition: int = 0) -> int:
-        """Returns the broker-assigned base offset."""
-        mset = kw.encode_message_set([(key, value)])
+             partition: int = 0, headers=()) -> int:
+        """Returns the broker-assigned base offset. ``headers``:
+        iterable of (str, bytes|None) pairs or a {str: bytes} mapping —
+        trace context crosses the async boundary here, the reference's
+        producer-header injection (main.go:631-637)."""
+        batch = kw.encode_record_batch(
+            [(key, value, headers)],
+            base_timestamp_ms=int(time.time() * 1000),
+        )
         body = (
-            kw.enc_int16(1)  # required_acks
+            kw.enc_string(None)  # transactional_id
+            + kw.enc_int16(1)  # required_acks
             + kw.enc_int32(1000)  # timeout
             + kw.enc_array(
-                [(topic, [(partition, mset)])],
+                [(topic, [(partition, batch)])],
                 lambda t: kw.enc_string(t[0])
                 + kw.enc_array(
                     t[1],
@@ -89,10 +100,14 @@ class KafkaProducer:
                 ),
             )
         )
-        r = self._conn.request(kw.PRODUCE, 0, body)
+        r = self._conn.request(kw.PRODUCE, 3, body)
 
         def read_partition():
-            return r.int32(), r.int16(), r.int64()
+            partition_ = r.int32()
+            error = r.int16()
+            base_offset = r.int64()
+            r.int64()  # log_append_time
+            return partition_, error, base_offset
 
         topics = r.array(lambda: (r.string(), r.array(read_partition)))
         _name, parts = topics[0]
@@ -247,10 +262,14 @@ class KafkaConsumer:
     # -- poll -----------------------------------------------------------
 
     def poll(self, max_wait_ms: int = 100) -> list[FetchedMessage]:
+        """Fetch v4 (v2 RecordBatch + headers) — the modern protocol
+        minimum, same rationale as the producer's v3."""
         body = (
             kw.enc_int32(-1)  # replica_id
             + kw.enc_int32(max_wait_ms)
             + kw.enc_int32(1)  # min_bytes
+            + kw.enc_int32(self.max_bytes)  # whole-response cap
+            + kw.enc_int8(0)  # isolation_level: read_uncommitted
             + kw.enc_array(
                 [(self.topic, [(p, self._positions[p], self.max_bytes)
                                for p in self._partitions])],
@@ -263,21 +282,24 @@ class KafkaConsumer:
                 ),
             )
         )
-        r = self._conn.request(kw.FETCH, 0, body)
+        r = self._conn.request(kw.FETCH, 4, body)
+        r.int32()  # throttle_time_ms
 
         def read_partition():
             partition = r.int32()
             error = r.int16()
             hw = r.int64()
+            r.int64()  # last_stable_offset
+            r.array(lambda: (r.int64(), r.int64()))  # aborted_transactions
             size = r.int32()
-            mset = r.buf[r.pos : r.pos + size]
+            batches = r.buf[r.pos : r.pos + size]
             r.pos += size
-            return partition, error, hw, mset
+            return partition, error, hw, batches
 
         topics = r.array(lambda: (r.string(), r.array(read_partition)))
         out: list[FetchedMessage] = []
         for _name, parts in topics:
-            for partition, error, _hw, mset in parts:
+            for partition, error, _hw, batches in parts:
                 if error == kw.OFFSET_OUT_OF_RANGE:
                     # Retention deleted our position (or a checkpoint
                     # predates the log start): reset to earliest rather
@@ -286,13 +308,16 @@ class KafkaConsumer:
                     continue
                 if error != kw.NO_ERROR:
                     continue  # transient: position holds, retry later
-                for msg in kw.decode_message_set(mset):
-                    if msg.offset < self._positions[partition]:
-                        continue  # broker re-sent below our position
+                for rec in kw.decode_record_batches(batches):
+                    if rec.offset < self._positions[partition]:
+                        continue  # batch starts below our position
                     out.append(
-                        FetchedMessage(partition, msg.offset, msg.key, msg.value)
+                        FetchedMessage(
+                            partition, rec.offset, rec.key, rec.value,
+                            rec.headers,
+                        )
                     )
-                    self._positions[partition] = msg.offset + 1
+                    self._positions[partition] = rec.offset + 1
         if out and self.auto_commit:
             self.commit()
         return out

@@ -9,14 +9,20 @@ protobuf — this module implements the Kafka protocol primitives
 directly: size-prefixed request/response framing, the primitive codecs,
 and the v0 MessageSet record format (magic 0, zlib CRC32).
 
-Versions are pinned to the legacy (non-flexible) protocol era —
-Produce v0, Fetch v0, ListOffsets v0, Metadata v0, FindCoordinator v0,
-OffsetCommit v2, OffsetFetch v1 — which IS real Kafka wire format
-(every broker accepted it for a decade); the point is consuming ordered
-bytes over a real socket with consumer-group offset storage, not
-re-implementing KIP-482 tagged fields. The in-repo broker
-(``kafka_broker``) speaks the same subset, so client and broker are
-interoperable test doubles for the compose topology's real broker.
+Record formats: the v0 MessageSet (magic 0) for the legacy path, and
+the **v2 RecordBatch** (magic 2: CRC-32C, varint-packed records, and
+per-record HEADERS) used by Produce v3 / Fetch v4 — the headers slot is
+how the reference's checkout injects W3C trace context into the orders
+topic (/root/reference/src/checkout/main.go:631-637), so the batch
+format is required for context to cross the async boundary the way the
+reference's does. Modern brokers (Kafka ≥3.0) dropped Produce <v3 and
+Fetch <v4, so the v3/v4 path is also what makes the client speak to the
+compose overlay's real broker. Other APIs stay in the non-flexible era —
+ListOffsets v0, Metadata v0, FindCoordinator v0, OffsetCommit v2,
+OffsetFetch v1 — real Kafka wire format, without re-implementing
+KIP-482 tagged fields. The in-repo broker (``kafka_broker``) speaks the
+same subset, so client and broker are interoperable test doubles for
+the compose topology's real broker.
 """
 
 from __future__ import annotations
@@ -200,6 +206,68 @@ def _read_exact(sock, n: int) -> bytes | None:
     return b"".join(chunks)
 
 
+# --- CRC-32C (Castagnoli) ---------------------------------------------
+# RecordBatch v2 checksums with CRC-32C, NOT zlib's CRC-32/IEEE; the
+# stdlib has no crc32c, so: reflected table-driven implementation of
+# polynomial 0x1EDC6F41 (reflected form 0x82F63B78), the same algorithm
+# every Kafka client ships.
+
+def _crc32c_table() -> list[int]:
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# --- zigzag varints (RecordBatch v2 integer packing) ------------------
+
+
+def enc_varint(v: int) -> bytes:
+    """Signed zigzag varint (the only flavor the record format uses)."""
+    zz = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+    out = bytearray()
+    while True:
+        b = zz & 0x7F
+        zz >>= 7
+        if zz:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def dec_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    """(value, new_pos); signed zigzag."""
+    shift = 0
+    zz = 0
+    while True:
+        if pos >= len(buf):
+            raise KafkaWireError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        zz |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise KafkaWireError("varint overflow")
+    return (zz >> 1) ^ -(zz & 1), pos
+
+
 # --- MessageSet v0 (magic 0) ------------------------------------------
 
 
@@ -250,4 +318,158 @@ def decode_message_set(buf: bytes) -> list[KafkaMessage]:
         key = r.bytes_()
         value = r.bytes_()
         out.append(KafkaMessage(offset=offset, key=key, value=value))
+    return out
+
+
+# --- RecordBatch v2 (magic 2) -----------------------------------------
+# The modern record format: one batch envelope (fixed-width header,
+# CRC-32C over everything after the crc field) wrapping varint-packed
+# records, each with an offset/timestamp delta and a HEADERS list —
+# the slot trace context rides in (main.go:631-637).
+
+
+class KafkaRecord(NamedTuple):
+    offset: int
+    key: bytes | None
+    value: bytes | None
+    headers: tuple  # ((str, bytes|None), ...)
+    timestamp_ms: int = 0
+
+
+def _enc_varbytes(v: bytes | None) -> bytes:
+    if v is None:
+        return enc_varint(-1)
+    return enc_varint(len(v)) + v
+
+
+def encode_record_batch(
+    records,
+    base_offset: int = 0,
+    base_timestamp_ms: int = 0,
+) -> bytes:
+    """[(key, value, headers), ...] → one on-wire v2 RecordBatch.
+
+    ``headers`` per record: iterable of (str, bytes|None) pairs (or a
+    {str: bytes} mapping). Produced with producerId/epoch/sequence -1
+    (idempotence/transactions are out of scope) and no compression.
+    """
+    recs = b""
+    for i, (key, value, headers) in enumerate(records):
+        if hasattr(headers, "items"):
+            headers = list(headers.items())
+        body = (
+            b"\x00"  # record attributes (unused)
+            + enc_varint(0)  # timestamp delta
+            + enc_varint(i)  # offset delta
+            + _enc_varbytes(key)
+            + _enc_varbytes(value)
+            + enc_varint(len(headers))
+        )
+        for hkey, hval in headers:
+            raw = hkey.encode("utf-8")
+            body += enc_varint(len(raw)) + raw + _enc_varbytes(hval)
+        recs += enc_varint(len(body)) + body
+    n = len(records)
+    tail = (
+        enc_int16(0)  # batch attributes: no compression, CREATE_TIME
+        + enc_int32(max(n - 1, 0))  # lastOffsetDelta
+        + enc_int64(base_timestamp_ms)
+        + enc_int64(base_timestamp_ms)  # maxTimestamp
+        + enc_int64(-1)  # producerId
+        + enc_int16(-1)  # producerEpoch
+        + enc_int32(-1)  # baseSequence
+        + enc_int32(n)
+        + recs
+    )
+    crc = crc32c(tail)
+    after_length = (
+        enc_int32(-1)  # partitionLeaderEpoch
+        + enc_int8(2)  # magic
+        + struct.pack(">I", crc)
+        + tail
+    )
+    return enc_int64(base_offset) + enc_int32(len(after_length)) + after_length
+
+
+def decode_record_batches(buf: bytes) -> list[KafkaRecord]:
+    """On-wire record data → records with absolute offsets + headers.
+
+    Handles multiple concatenated batches (a fetch may return several);
+    a trailing partial batch — the protocol lets brokers cut one at the
+    byte limit — is dropped, like every real client does. A magic-0/1
+    segment in the same buffer raises: mixed-format logs don't occur in
+    this subset.
+    """
+    out: list[KafkaRecord] = []
+    pos = 0
+    n = len(buf)
+    while pos + 12 <= n:
+        base_offset, batch_len = struct.unpack(">qi", buf[pos : pos + 12])
+        if pos + 12 + batch_len > n:
+            break  # partial trailing batch
+        batch = buf[pos + 12 : pos + 12 + batch_len]
+        pos += 12 + batch_len
+        if len(batch) < 9:
+            raise KafkaWireError("runt record batch")
+        magic = batch[4]
+        if magic != 2:
+            raise KafkaWireError(f"unsupported batch magic {magic}")
+        (crc_stored,) = struct.unpack(">I", batch[5:9])
+        tail = batch[9:]
+        if crc32c(tail) != crc_stored:
+            raise KafkaWireError(f"bad batch CRC at offset {base_offset}")
+        r = Reader(tail)
+        r.int16()  # attributes (no compression in this subset)
+        r.int32()  # lastOffsetDelta
+        base_ts = r.int64()
+        r.int64()  # maxTimestamp
+        r.int64()  # producerId
+        r.int16()  # producerEpoch
+        r.int32()  # baseSequence
+        num_records = r.int32()
+        rest = tail[r.pos :]
+        rpos = 0
+        for _ in range(num_records):
+            length, rpos = dec_varint(rest, rpos)
+            end = rpos + length
+            if length < 0 or end > len(rest):
+                raise KafkaWireError("truncated record")
+            rpos += 1  # record attributes
+            ts_delta, rpos = dec_varint(rest, rpos)
+            off_delta, rpos = dec_varint(rest, rpos)
+            klen, rpos = dec_varint(rest, rpos)
+            key = None
+            if klen >= 0:
+                key = rest[rpos : rpos + klen]
+                rpos += klen
+            vlen, rpos = dec_varint(rest, rpos)
+            value = None
+            if vlen >= 0:
+                value = rest[rpos : rpos + vlen]
+                rpos += vlen
+            hcount, rpos = dec_varint(rest, rpos)
+            headers = []
+            for _h in range(max(hcount, 0)):
+                hklen, rpos = dec_varint(rest, rpos)
+                if hklen < 0 or rpos + hklen > len(rest):
+                    raise KafkaWireError("truncated header key")
+                hkey = rest[rpos : rpos + hklen].decode("utf-8")
+                rpos += hklen
+                hvlen, rpos = dec_varint(rest, rpos)
+                hval = None
+                if hvlen >= 0:
+                    hval = rest[rpos : rpos + hvlen]
+                    rpos += hvlen
+                headers.append((hkey, hval))
+            if rpos != end:
+                rpos = end  # tolerate future per-record extensions
+            out.append(
+                KafkaRecord(
+                    offset=base_offset + off_delta,
+                    key=key,
+                    value=value,
+                    headers=tuple(headers),
+                    timestamp_ms=base_ts + ts_delta,
+                )
+            )
     return out

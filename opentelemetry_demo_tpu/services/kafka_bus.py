@@ -1,0 +1,215 @@
+"""The live shop's async tier over a real network broker.
+
+Drop-in for :class:`services.bus.Bus` backed by the Kafka wire client:
+``checkout`` publishes OrderResult bytes with trace headers via a real
+Produce (/root/reference/src/checkout/kafka/producer.go:11-43), and the
+``accounting`` / ``fraud-detection`` consumer groups poll the broker
+over the socket (Consumer.cs:77-80, main.kt:54-69) — the path the
+reference runs continuously, now the repo's own topology when
+``serve_shop --kafka`` is up (pointing at ``runtime.kafka_broker`` or a
+real Kafka ≥3.0; same protocol either way).
+
+Connection model: everything is lazy with backoff — compose starts
+services in parallel, so a broker that isn't up yet means "retry", not
+a boot crash. Until the producer connects, publishes buffer in memory
+(bounded) the way sarama's async producer queues; consumers simply see
+the messages later, preserving ordered delivery.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from .bus import BusMessage
+from ..runtime.kafka_client import KafkaConsumer, KafkaProducer, _parse_bootstrap
+from ..runtime.kafka_wire import KafkaWireError
+
+# What "the broker is unavailable / the connection is broken" looks
+# like from the wire client: socket errors, OR KafkaWireError (a
+# ValueError) for half-open connections ("broker closed connection"),
+# produce error codes, and malformed frames mid-restart. Catching only
+# OSError would let a broker bounce crash checkout.place_order.
+_TRANSPORT_ERRORS = (OSError, KafkaWireError)
+
+log = logging.getLogger(__name__)
+
+RECONNECT_BACKOFF_S = 1.0
+PENDING_MAX = 4096  # producer-side buffer while the broker is down
+
+
+class _TopicHandle:
+    """What ``checkout`` sees: ``bus.topic(name).produce(...)``."""
+
+    def __init__(self, bus: "KafkaBus", name: str):
+        self._bus = bus
+        self.name = name
+
+    def produce(self, key: bytes, value: bytes,
+                headers: dict[str, str] | None = None) -> int:
+        return self._bus._produce(self.name, key, value, headers or {})
+
+
+class _Subscription:
+    def __init__(self, topic: str, group: str,
+                 handler: Callable[[BusMessage], None]):
+        self.topic = topic
+        self.group = group
+        self.handler = handler
+        self.consumer: KafkaConsumer | None = None
+        self.next_connect = 0.0
+
+
+class KafkaBus:
+    """Bus facade over the wire client (one producer, one consumer per
+    subscribed group — each group is its own real connection, like the
+    reference's separate consumer containers)."""
+
+    def __init__(self, bootstrap: str):
+        # Validate now: a malformed address is a config error and must
+        # refuse to boot (mustMapEnv discipline), unlike a broker that
+        # is merely not up yet.
+        _parse_bootstrap(bootstrap)
+        self.bootstrap = bootstrap
+        self._producer: KafkaProducer | None = None
+        self._producer_next_connect = 0.0
+        self._pending: deque = deque(maxlen=PENDING_MAX)
+        self._pending_dropped = 0
+        self._subs: list[_Subscription] = []
+        self._lock = threading.Lock()
+
+    # -- producer side --------------------------------------------------
+
+    def topic(self, name: str) -> _TopicHandle:
+        return _TopicHandle(self, name)
+
+    def _ensure_producer(self) -> KafkaProducer | None:
+        if self._producer is not None:
+            return self._producer
+        if time.monotonic() < self._producer_next_connect:
+            return None
+        try:
+            self._producer = KafkaProducer(self.bootstrap)
+        except _TRANSPORT_ERRORS as e:
+            log.warning("Kafka producer connect to %s failed (%s); retrying",
+                        self.bootstrap, e)
+            return None
+        finally:
+            # Arm the backoff from attempt COMPLETION: a blackholed
+            # address makes connect block for its full socket timeout
+            # (5 s) — arming from the start would expire the window
+            # mid-attempt and turn every order into a fresh 5 s stall.
+            self._producer_next_connect = time.monotonic() + RECONNECT_BACKOFF_S
+        return self._producer
+
+    def _produce(self, topic: str, key: bytes, value: bytes,
+                 headers: dict[str, str]) -> int:
+        wire_headers = [(k, v.encode("utf-8")) for k, v in headers.items()]
+        with self._lock:
+            # Drain any buffered publishes first so ordering holds.
+            producer = self._ensure_producer()
+            if producer is not None and self._pending:
+                try:
+                    while self._pending:
+                        t, k, v, h = self._pending[0]
+                        producer.send(t, v, key=k, headers=h)
+                        self._pending.popleft()
+                except _TRANSPORT_ERRORS:
+                    self._drop_producer()
+                    producer = None
+            if producer is not None:
+                try:
+                    return producer.send(
+                        topic, value, key=key, headers=wire_headers
+                    )
+                except _TRANSPORT_ERRORS:
+                    self._drop_producer()
+            if len(self._pending) == self._pending.maxlen:
+                self._pending_dropped += 1
+            self._pending.append((topic, key, value, wire_headers))
+            return -1  # buffered: no broker offset yet
+
+    def _drop_producer(self) -> None:
+        if self._producer is not None:
+            try:
+                self._producer.close()
+            finally:
+                self._producer = None
+
+    # -- consumer side --------------------------------------------------
+
+    def subscribe(self, topic: str, group: str,
+                  handler: Callable[[BusMessage], None]) -> None:
+        self._subs.append(_Subscription(topic, group, handler))
+
+    def pump(self, max_messages: int = 64) -> int:
+        """Poll every subscribed group once; returns delivered count.
+
+        EVERY fetched message is delivered — the consumer's position
+        and auto-commit already advanced past them, so dropping a tail
+        here would be silent, unrecoverable loss (``max_messages`` is
+        accepted for Bus-signature compatibility; the fetch size itself
+        is bounded by the consumer's ``max_bytes``). A handler exception
+        skips that message (it is already consumed and auto-committed —
+        reference consumers log and poll on, main.kt:64) rather than
+        wedging the subscription.
+        """
+        del max_messages
+        delivered = 0
+        for sub in self._subs:
+            consumer = self._ensure_consumer(sub)
+            if consumer is None:
+                continue
+            try:
+                msgs = consumer.poll(max_wait_ms=0)
+            except Exception:
+                try:
+                    consumer.close()
+                finally:
+                    sub.consumer = None
+                continue
+            for msg in msgs:
+                headers = {
+                    k: (v.decode("utf-8", "replace") if v is not None else "")
+                    for k, v in msg.headers
+                }
+                try:
+                    sub.handler(
+                        BusMessage(msg.offset, msg.key, msg.value, headers)
+                    )
+                except Exception:
+                    log.exception(
+                        "%s handler failed on %s@%s; skipping",
+                        sub.group, sub.topic, msg.offset,
+                    )
+                delivered += 1
+        return delivered
+
+    def _ensure_consumer(self, sub: _Subscription) -> KafkaConsumer | None:
+        if sub.consumer is not None:
+            return sub.consumer
+        if time.monotonic() < sub.next_connect:
+            return None
+        try:
+            sub.consumer = KafkaConsumer(self.bootstrap, sub.group, sub.topic)
+        except _TRANSPORT_ERRORS as e:
+            log.warning("Kafka consumer %s connect to %s failed (%s); retrying",
+                        sub.group, self.bootstrap, e)
+            return None
+        finally:
+            # From completion, not start — see _ensure_producer.
+            sub.next_connect = time.monotonic() + RECONNECT_BACKOFF_S
+        return sub.consumer
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_producer()
+        for sub in self._subs:
+            if sub.consumer is not None:
+                try:
+                    sub.consumer.close()
+                finally:
+                    sub.consumer = None

@@ -46,6 +46,12 @@ class ShopConfig:
     users: int = 5
     seed: int = 0
     pump_interval_s: float = 0.25  # how often spans flush downstream
+    # Network broker address ("host:port"). Unset = in-proc Bus (the
+    # minimal-compose analogue, which also drops kafka); set = orders
+    # cross a real TCP broker exactly like the reference's full compose
+    # (checkout → Produce v3 with trace headers → accounting /
+    # fraud-detection consumer groups polling over the socket).
+    kafka_bootstrap: str | None = None
 
 
 class Shop:
@@ -77,7 +83,12 @@ class Shop:
         )
         self.env = env
 
-        self.bus = Bus()
+        if self.config.kafka_bootstrap:
+            from .kafka_bus import KafkaBus
+
+            self.bus = KafkaBus(self.config.kafka_bootstrap)
+        else:
+            self.bus = Bus()
         self.catalog = ProductCatalog(env)
         self.currency = CurrencyService(env)
         self.cart = CartService(env)

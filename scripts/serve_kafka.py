@@ -1,0 +1,50 @@
+"""Run the in-repo Kafka broker standalone — the compose topology's
+kafka container (/root/reference/docker-compose.yml kafka service) as
+its own OS process.
+
+The reference consumes an Apache Kafka image; this repo's broker is the
+from-scratch wire-subset server in ``runtime.kafka_broker`` (Produce
+v0/v3, Fetch v0/v4 with v2 RecordBatch headers, consumer-group offset
+storage). Point ``serve_shop --kafka host:port`` and the detector
+daemon's ``KAFKA_ADDR`` at it for the full three-process orders
+topology: shop (producer + accounting/fraud groups) and daemon
+(anomaly-detector group) on one broker.
+
+Usage: python scripts/serve_kafka.py [--host 0.0.0.0] [--port 9092]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from opentelemetry_demo_tpu.runtime.kafka_broker import KafkaBroker  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--port", type=int, default=int(os.getenv("KAFKA_PORT", "9092")),
+        help="listen port (0 = ephemeral, printed at boot)",
+    )
+    args = parser.parse_args()
+
+    broker = KafkaBroker(host=args.host, port=args.port)
+    broker.start()
+    print(f"kafka broker on {args.host}:{broker.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    broker.stop()
+
+
+if __name__ == "__main__":
+    main()

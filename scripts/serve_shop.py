@@ -38,7 +38,24 @@ from opentelemetry_demo_tpu.utils.flag_ui import FlagEditorUI
 
 
 def serve(args) -> None:
-    shop = Shop(ShopConfig(users=0, seed=args.seed))
+    broker = None
+    kafka_bootstrap = None
+    if args.kafka == "auto":
+        # Boot the in-repo broker beside the shop: one process fewer
+        # than the compose topology, same wire path (checkout still
+        # publishes over a real socket).
+        from opentelemetry_demo_tpu.runtime.kafka_broker import KafkaBroker
+
+        broker = KafkaBroker(host="127.0.0.1", port=args.kafka_port)
+        broker.start()
+        kafka_bootstrap = f"127.0.0.1:{broker.port}"
+        print(f"kafka broker on {kafka_bootstrap}", flush=True)
+    elif args.kafka:
+        kafka_bootstrap = args.kafka
+
+    shop = Shop(ShopConfig(
+        users=0, seed=args.seed, kafka_bootstrap=kafka_bootstrap,
+    ))
 
     pipeline = None
     span_exporter = None
@@ -131,6 +148,10 @@ def serve(args) -> None:
         if exporter is not None:
             exporter.flush()
             exporter.close()
+    if hasattr(shop.bus, "close"):
+        shop.bus.close()
+    if broker is not None:
+        broker.stop()
 
 
 def load_only(args) -> None:
@@ -167,6 +188,17 @@ def main() -> None:
         default=int(os.getenv("SHOP_GRPC_PORT", "-1")),
         help="serve the oteldemo gRPC surface on this port "
         "(0 = ephemeral, -1 = disabled)",
+    )
+    parser.add_argument(
+        "--kafka", default=os.getenv("KAFKA_ADDR", ""),
+        help="orders over a real TCP broker: 'auto' boots the in-repo "
+        "KafkaBroker beside the shop, 'host:port' points at an external "
+        "one (the compose overlay sets KAFKA_ADDR); empty = in-proc bus "
+        "(the minimal-compose analogue, which also drops kafka)",
+    )
+    parser.add_argument(
+        "--kafka-port", type=int, default=int(os.getenv("KAFKA_PORT", "0")),
+        help="listen port for --kafka auto (0 = ephemeral)",
     )
     parser.add_argument(
         "--otlp-endpoint",
