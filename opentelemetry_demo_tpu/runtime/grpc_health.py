@@ -1,0 +1,105 @@
+"""grpc.health.v1 — ONE implementation for every server in the tree.
+
+The reference registers the standard health service on each gRPC server
+(/root/reference/src/checkout/main.go:223-224,
+src/currency/src/server.cpp:92-102); here the gRPC shop edge and the
+daemon's OTLP ingress both attach THIS module's handlers, and the
+container probe (``runtime.health_probe``) shares its constants — the
+protocol exists in exactly one place.
+
+Raw-bytes handlers (no generated stubs): HealthCheckRequest{service=1},
+HealthCheckResponse{status=1} with SERVING/NOT_SERVING.
+
+Watch and thread budgets: a sync gRPC server pins one executor thread
+per open server-stream, so unauthenticated Watch clients could starve
+the pool (the OTLP ingress runs 4 workers). ``watcher_slots`` bounds
+concurrent watchers; beyond it a Watch answers with the current status
+and ENDS the stream — spec-legal (clients re-watch) and starvation-
+proof, instead of silently queueing Export RPCs behind parked watchers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from . import wire
+
+SERVING = 1
+NOT_SERVING = 2
+
+CHECK_METHOD = "/grpc.health.v1.Health/Check"
+WATCH_METHOD = "/grpc.health.v1.Health/Watch"
+
+
+class HealthService:
+    """Check/Watch handlers over a stop event + known-service set."""
+
+    def __init__(
+        self,
+        known_services: Iterable[str],
+        stop_event: threading.Event,
+        watcher_slots: int = 2,
+    ):
+        self.known = set(known_services)
+        self.stop_event = stop_event
+        self._watchers = threading.Semaphore(max(watcher_slots, 0))
+
+    def _status_response(self, request: bytes) -> bytes | None:
+        """Response bytes, or None for an unknown service name."""
+        f = wire.scan_fields(request)
+        raw = wire.first(f, 1, b"")
+        service = raw.decode("utf-8", "replace") if isinstance(raw, bytes) else ""
+        if service and service not in self.known:
+            return None
+        status = NOT_SERVING if self.stop_event.is_set() else SERVING
+        return wire.encode_int(1, status)
+
+    # -- grpc handler callables ----------------------------------------
+
+    def check(self, request: bytes, context) -> bytes:
+        import grpc
+
+        # Deliberately outside any application lock: health must answer
+        # while the serving graph is busy — that is its whole job.
+        resp = self._status_response(request)
+        if resp is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+        return resp
+
+    def watch(self, request: bytes, context):
+        import grpc
+
+        resp = self._status_response(request)
+        if resp is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+            return
+        yield resp
+        if not self._watchers.acquire(blocking=False):
+            # Slots exhausted: current status delivered, stream ends —
+            # never park another executor thread.
+            return
+        try:
+            # Stream the SERVING→NOT_SERVING transition at shutdown; a
+            # cancelled/deadline-expired watcher exits the poll loop.
+            while context.is_active() and not self.stop_event.wait(0.2):
+                pass
+            if context.is_active():
+                yield wire.encode_int(1, NOT_SERVING)
+        finally:
+            self._watchers.release()
+
+    def add_to_generic_handlers(self, grpc_module, method: str):
+        """Method-path dispatch helper for GenericRpcHandler.service():
+        returns the grpc method handler for ``method`` or None."""
+        if method == CHECK_METHOD:
+            return grpc_module.unary_unary_rpc_method_handler(
+                self.check, request_deserializer=None,
+                response_serializer=None,
+            )
+        if method == WATCH_METHOD:
+            return grpc_module.unary_stream_rpc_method_handler(
+                self.watch, request_deserializer=None,
+                response_serializer=None,
+            )
+        return None

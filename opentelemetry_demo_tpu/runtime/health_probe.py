@@ -1,0 +1,54 @@
+"""grpc_health_probe analogue: exit 0 iff a gRPC server reports SERVING.
+
+The reference's deploy story health-gates startup on gRPC health
+(every service registers grpc.health.v1 — /root/reference/src/checkout/
+main.go:223-224, src/currency/src/server.cpp:92-102); container images
+ship the ``grpc_health_probe`` binary for compose/k8s probes. This is
+that probe for this framework's images:
+
+    python -m opentelemetry_demo_tpu.runtime.health_probe \
+        [--addr 127.0.0.1:4317] [--service oteldemo.CartService]
+
+Raw-bytes unary call (no stubs): request = HealthCheckRequest{service},
+response field 1 must equal SERVING (1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import wire
+from .grpc_health import SERVING
+
+
+def probe(addr: str, service: str = "", timeout_s: float = 3.0) -> bool:
+    import grpc
+
+    channel = grpc.insecure_channel(addr)
+    check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=None,
+        response_deserializer=None,
+    )
+    request = wire.encode_len(1, service.encode()) if service else b""
+    try:
+        resp = check(request, timeout=timeout_s)
+    except grpc.RpcError:
+        return False
+    finally:
+        channel.close()
+    return wire.first(wire.scan_fields(resp), 1) == SERVING
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--addr", default="127.0.0.1:4317")
+    parser.add_argument("--service", default="")
+    parser.add_argument("--timeout", type=float, default=3.0)
+    args = parser.parse_args()
+    sys.exit(0 if probe(args.addr, args.service, args.timeout) else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,22 @@ class OtlpGrpcReceiver:
                 receiver.on_metric_records(records)
             return b""  # empty ExportMetricsServiceResponse
 
+        # grpc.health.v1 beside the OTLP ingress: the registration every
+        # reference service performs (main.go:223-224, server.cpp:92-102),
+        # and what the compose/k8s healthchecks probe on this daemon.
+        # One watcher slot: the ingress pool is small (4 workers) and
+        # Export throughput must never queue behind parked watchers.
+        import threading
+
+        from .grpc_health import HealthService
+
+        self._stop_event = threading.Event()
+        self._health = HealthService(
+            {m.split("/")[1] for m in (TRACE_EXPORT, METRICS_EXPORT)},
+            self._stop_event,
+            watcher_slots=1,
+        )
+
         handlers = {
             TRACE_EXPORT: export_traces,
             METRICS_EXPORT: export_metrics,
@@ -91,6 +107,11 @@ class OtlpGrpcReceiver:
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, details):
+                health = receiver._health.add_to_generic_handlers(
+                    grpc, details.method
+                )
+                if health is not None:
+                    return health
                 fn = handlers.get(details.method)
                 if fn is None:
                     return None
@@ -115,6 +136,8 @@ class OtlpGrpcReceiver:
         self._server.start()
 
     def stop(self, grace: float = 1.0) -> None:
+        # NOT_SERVING reaches health watchers before the teardown.
+        self._stop_event.set()
         self._server.stop(grace).wait()
 
 

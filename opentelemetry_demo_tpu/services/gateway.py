@@ -44,6 +44,7 @@ from xml.sax.saxutils import escape as _xml_escape
 
 from .base import ServiceError
 from .checkout import money_json as _money_json, placed_order_json
+from ..utils.concurrency import RWLock
 from .frontend import FLAG_IMAGE_SLOW_LOAD
 from .shop import Shop
 from .webui import WebStorefront
@@ -80,7 +81,11 @@ class ShopGateway:
     ):
         self.shop = shop
         self.on_spans = on_spans  # Callable[[float, list[SpanRecord]], None]
-        self._lock = threading.Lock()
+        # Writer-preference RW lock: the gateway itself always takes
+        # exclusive (every route pumps/flushes shared state), but the
+        # gRPC edge shares this lock and runs its read-only RPCs
+        # concurrently under .shared() (grpc_edge.READ_METHODS).
+        self._lock = RWLock()
         self._t0 = time.monotonic()
         self.requests_served = 0
         # Mount point for the flag editor (flagd-ui analogue): an object

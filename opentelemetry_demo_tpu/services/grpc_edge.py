@@ -14,8 +14,14 @@ responses encode with the wire helpers — no generated stubs anywhere in
 the runtime. Interop with REAL protoc stubs is pinned by
 tests/test_grpc_edge.py.
 
-Every call runs under one lock: the shop object graph is single-writer
-by design (the HTTP gateway serializes the same way).
+Concurrency: mutating RPCs take the shop lock exclusively (the graph is
+single-writer by design — the HTTP gateway serializes the same way),
+but read-only RPCs (:data:`READ_METHODS`) run CONCURRENTLY under the
+shared side of a :class:`~..utils.concurrency.RWLock` — a product-
+catalog fan-out no longer queues behind a PlaceOrder. The health
+service (``grpc.health.v1``, the registration every reference service
+performs — /root/reference/src/checkout/main.go:223-224,
+src/currency/src/server.cpp:92-102) answers entirely outside the lock.
 """
 
 from __future__ import annotations
@@ -25,10 +31,29 @@ import threading
 from ..runtime import wire
 from ..runtime.kafka_orders import encode_placed_order
 from ..telemetry.tracer import TraceContext
+from ..utils.concurrency import RWLock
 from .base import ServiceError
 from .money import Money
 
 PKG = "oteldemo"
+
+# RPCs with no shop-graph writes: safe under the shared lock. Span
+# emission, metrics, and rng draws inside them are individually
+# thread-safe (atomic list append / MetricRegistry mutex / LockedRng).
+READ_METHODS = frozenset({
+    f"/{PKG}.CartService/GetCart",
+    f"/{PKG}.RecommendationService/ListRecommendations",
+    f"/{PKG}.ProductCatalogService/ListProducts",
+    f"/{PKG}.ProductCatalogService/GetProduct",
+    f"/{PKG}.ProductCatalogService/SearchProducts",
+    f"/{PKG}.ShippingService/GetQuote",
+    f"/{PKG}.CurrencyService/GetSupportedCurrencies",
+    f"/{PKG}.CurrencyService/Convert",
+    f"/{PKG}.AdService/GetAds",
+    f"/{PKG}.FeatureFlagService/GetFlag",
+    f"/{PKG}.FeatureFlagService/ListFlags",
+})
+
 
 
 # -- message codecs (field numbers = proto/demo.proto) ------------------
@@ -80,12 +105,18 @@ class GrpcShopEdge:
     """Serves the oteldemo gRPC surface; delegates into a Shop."""
 
     def __init__(self, shop, host: str = "0.0.0.0", port: int = 0,
-                 lock: threading.Lock | None = None, max_workers: int = 4):
+                 lock: threading.Lock | RWLock | None = None,
+                 max_workers: int = 8):
         import grpc
         from concurrent import futures
 
         self.shop = shop
-        self._lock = lock or threading.Lock()
+        # An RWLock (default, and what the gateway shares) runs read
+        # RPCs concurrently; a plain Lock (legacy callers) degrades to
+        # exclusive-for-everything.
+        self._lock = lock if lock is not None else RWLock()
+        self._shared = getattr(self._lock, "shared", None)
+        self._stop_event = threading.Event()
         edge = self
 
         handlers = {
@@ -113,11 +144,28 @@ class GrpcShopEdge:
             f"/{PKG}.FeatureFlagService/DeleteFlag": self._delete_flag,
         }
 
+        # grpc.health.v1 (shared implementation, runtime.grpc_health):
+        # answers for the oteldemo services plus "" (overall server
+        # health, the probe every reference healthcheck queries).
+        from ..runtime.grpc_health import HealthService
+
+        self._health = HealthService(
+            {m.split("/")[1] for m in handlers},
+            self._stop_event,
+            watcher_slots=2,
+        )
+
         class Handler(grpc.GenericRpcHandler):
             def service(self, details):
+                health = edge._health.add_to_generic_handlers(
+                    grpc, details.method
+                )
+                if health is not None:
+                    return health
                 fn = handlers.get(details.method)
                 if fn is None:
                     return None
+                read_only = details.method in READ_METHODS
 
                 def call(request: bytes, context) -> bytes:
                     # W3C context rides gRPC metadata (every reference
@@ -130,6 +178,9 @@ class GrpcShopEdge:
                     }
                     ctx = TraceContext.from_headers(meta)
                     try:
+                        if read_only and edge._shared is not None:
+                            with edge._shared():
+                                return fn(ctx, request)
                         with edge._lock:
                             return fn(ctx, request)
                     except ServiceError as e:
@@ -155,6 +206,10 @@ class GrpcShopEdge:
         self._server.start()
 
     def stop(self, grace: float = 1.0) -> None:
+        # Flip health to NOT_SERVING first so Watch streams deliver the
+        # transition before the server tears down (the drain order
+        # health-gated load balancers rely on).
+        self._stop_event.set()
         self._server.stop(grace).wait()
 
     # -- cart ----------------------------------------------------------
@@ -299,11 +354,11 @@ class GrpcShopEdge:
     # editable through the flag-editor UI, which shares the store).
 
     def _flags_copy(self) -> dict:
-        """Copy-for-write of the flag doc (flags map + each spec dict);
-        reads go straight to the live doc — the edge lock serialises
-        all mutation."""
-        live = self.shop.flags._doc.get("flags", {})
-        return {"flags": {k: dict(v) for k, v in live.items()}}
+        """Copy-for-write via the store's public snapshot API; the edge
+        lock serialises mutation (snapshot → edit → replace)."""
+        doc = self.shop.flags.snapshot()
+        doc.setdefault("flags", {})
+        return doc
 
     def _enc_flag(self, name: str, spec: dict) -> bytes:
         enabled = (
@@ -321,7 +376,7 @@ class GrpcShopEdge:
     def _get_flag(self, ctx, request: bytes) -> bytes:
         f = wire.scan_fields(request)
         name = _dec_str(f, 1)
-        spec = self.shop.flags._doc.get("flags", {}).get(name)
+        spec = self.shop.flags.flag_spec(name)  # read-only live view
         if spec is None:
             raise ValueError(f"no such flag {name!r}")
         return wire.encode_len(1, self._enc_flag(name, spec))
@@ -378,10 +433,10 @@ class GrpcShopEdge:
         return b""
 
     def _list_flags(self, ctx, request: bytes) -> bytes:
-        live = self.shop.flags._doc.get("flags", {})
+        flags = self.shop.flags.flag_specs()  # read-only live view
         return b"".join(
             wire.encode_len(1, self._enc_flag(name, spec))
-            for name, spec in sorted(live.items())
+            for name, spec in sorted(flags.items())
         )
 
     def _delete_flag(self, ctx, request: bytes) -> bytes:

@@ -80,19 +80,107 @@ class KafkaBus:
         self._pending_dropped = 0
         self._subs: list[_Subscription] = []
         self._lock = threading.Lock()
+        self._last_send_error: str | None = None
+        self._closed = False
+        # Background sender (sarama's async-producer shape,
+        # producer.go:15-43): connects and drains the buffer OFF the
+        # caller's thread. checkout.place_order runs under the shop's
+        # exclusive lock — a blocking connect there (5 s socket timeout
+        # against a blackholed broker) would stall the whole site, so
+        # _produce never connects: it fast-paths on an already-open
+        # producer or enqueues and wakes this thread.
+        self._send_wake = threading.Event()
+        self._sender = threading.Thread(
+            target=self._sender_loop, name="kafka-bus-sender", daemon=True
+        )
+        self._sender.start()
 
     # -- producer side --------------------------------------------------
 
     def topic(self, name: str) -> _TopicHandle:
         return _TopicHandle(self, name)
 
+    def _note_send_error(self, e: Exception) -> None:
+        """Log once per distinct failure — produce errors from a live
+        broker (e.g. UNKNOWN_TOPIC with auto-create off) would otherwise
+        loop silently while orders drain into the void."""
+        msg = f"{type(e).__name__}: {e}"
+        if msg != self._last_send_error:
+            log.warning("Kafka produce to %s failing (%s); buffering "
+                        "(%d queued, %d dropped)", self.bootstrap, msg,
+                        len(self._pending), self._pending_dropped)
+            self._last_send_error = msg
+
+    def _produce(self, topic: str, key: bytes, value: bytes,
+                 headers: dict[str, str]) -> int:
+        wire_headers = [(k, v.encode("utf-8")) for k, v in headers.items()]
+        with self._lock:
+            producer = self._producer
+            fast = producer is not None and not self._pending
+        if fast:
+            # Already-connected send: synchronous acks=1, broker offset
+            # back to the caller (the common healthy-path case).
+            try:
+                return producer.send(topic, value, key=key,
+                                     headers=wire_headers)
+            except _TRANSPORT_ERRORS as e:
+                self._note_send_error(e)
+                with self._lock:
+                    self._drop_producer()
+        with self._lock:
+            if len(self._pending) == self._pending.maxlen:
+                self._pending_dropped += 1
+                if self._pending_dropped == 1 or self._pending_dropped % 500 == 0:
+                    log.error(
+                        "Kafka pending buffer full (%d): %d publishes "
+                        "dropped so far (broker down too long?)",
+                        self._pending.maxlen, self._pending_dropped,
+                    )
+            self._pending.append((topic, key, value, wire_headers))
+        self._send_wake.set()
+        return -1  # buffered: no broker offset yet
+
+    def _sender_loop(self) -> None:
+        while True:
+            self._send_wake.wait(timeout=0.5)
+            self._send_wake.clear()
+            if self._closed:
+                return
+            # Consumer connects also live here: pump() must never block
+            # a site-wide lock for a 5 s connect timeout.
+            for sub in self._subs:
+                if sub.consumer is None:
+                    self._ensure_consumer(sub)
+            if not self._pending:
+                continue
+            producer = self._ensure_producer()  # blocking connect OK here
+            if producer is None:
+                continue
+            while not self._closed:
+                with self._lock:
+                    if not self._pending:
+                        break
+                    t, k, v, h = self._pending[0]
+                try:
+                    producer.send(t, v, key=k, headers=h)
+                except _TRANSPORT_ERRORS as e:
+                    self._note_send_error(e)
+                    with self._lock:
+                        self._drop_producer()
+                    break
+                with self._lock:
+                    # Only this thread pops, so the head is still ours.
+                    self._pending.popleft()
+
     def _ensure_producer(self) -> KafkaProducer | None:
-        if self._producer is not None:
-            return self._producer
+        """Sender-thread only (blocking connect)."""
+        with self._lock:
+            if self._producer is not None:
+                return self._producer
         if time.monotonic() < self._producer_next_connect:
             return None
         try:
-            self._producer = KafkaProducer(self.bootstrap)
+            producer = KafkaProducer(self.bootstrap)
         except _TRANSPORT_ERRORS as e:
             log.warning("Kafka producer connect to %s failed (%s); retrying",
                         self.bootstrap, e)
@@ -100,37 +188,12 @@ class KafkaBus:
         finally:
             # Arm the backoff from attempt COMPLETION: a blackholed
             # address makes connect block for its full socket timeout
-            # (5 s) — arming from the start would expire the window
-            # mid-attempt and turn every order into a fresh 5 s stall.
+            # (5 s); arming from the start would expire the window
+            # mid-attempt and retry back-to-back.
             self._producer_next_connect = time.monotonic() + RECONNECT_BACKOFF_S
-        return self._producer
-
-    def _produce(self, topic: str, key: bytes, value: bytes,
-                 headers: dict[str, str]) -> int:
-        wire_headers = [(k, v.encode("utf-8")) for k, v in headers.items()]
         with self._lock:
-            # Drain any buffered publishes first so ordering holds.
-            producer = self._ensure_producer()
-            if producer is not None and self._pending:
-                try:
-                    while self._pending:
-                        t, k, v, h = self._pending[0]
-                        producer.send(t, v, key=k, headers=h)
-                        self._pending.popleft()
-                except _TRANSPORT_ERRORS:
-                    self._drop_producer()
-                    producer = None
-            if producer is not None:
-                try:
-                    return producer.send(
-                        topic, value, key=key, headers=wire_headers
-                    )
-                except _TRANSPORT_ERRORS:
-                    self._drop_producer()
-            if len(self._pending) == self._pending.maxlen:
-                self._pending_dropped += 1
-            self._pending.append((topic, key, value, wire_headers))
-            return -1  # buffered: no broker offset yet
+            self._producer = producer
+        return producer
 
     def _drop_producer(self) -> None:
         if self._producer is not None:
@@ -160,8 +223,11 @@ class KafkaBus:
         del max_messages
         delivered = 0
         for sub in self._subs:
-            consumer = self._ensure_consumer(sub)
+            consumer = sub.consumer
             if consumer is None:
+                # Connects happen on the sender thread (a 5 s connect
+                # timeout must never run under the caller's shop lock).
+                self._send_wake.set()
                 continue
             try:
                 msgs = consumer.poll(max_wait_ms=0)
@@ -189,6 +255,7 @@ class KafkaBus:
         return delivered
 
     def _ensure_consumer(self, sub: _Subscription) -> KafkaConsumer | None:
+        """Sender-thread only (blocking connect)."""
         if sub.consumer is not None:
             return sub.consumer
         if time.monotonic() < sub.next_connect:
@@ -205,6 +272,9 @@ class KafkaBus:
         return sub.consumer
 
     def close(self) -> None:
+        self._closed = True
+        self._send_wake.set()
+        self._sender.join(timeout=10.0)
         with self._lock:
             self._drop_producer()
         for sub in self._subs:

@@ -12,6 +12,8 @@ to catch.
 
 from __future__ import annotations
 
+import threading
+
 from .base import ServiceBase
 from .catalog import ProductCatalog
 from ..telemetry.tracer import TraceContext
@@ -27,21 +29,27 @@ class RecommendationService(ServiceBase):
         super().__init__(env)
         self.catalog = catalog
         self._cache_entries = 0  # simulated leak size
+        # The gRPC edge runs ListRecommendations under the SHARED lock
+        # (concurrent readers), and the leak counter is read-modify-
+        # write: unlocked increments would lose counts and flatten the
+        # very latency ramp the leak scenario exists to produce.
+        self._cache_lock = threading.Lock()
 
     def list_recommendations(
         self, ctx: TraceContext, exclude_ids: list[str]
     ) -> list[str]:
         leak = bool(self.flag(FLAG_RECO_CACHE, False, ctx))
         extra_us = 0.0
-        if leak:
-            # Each hit grows the "cache"; latency grows with it. The
-            # reference's leak re-caches the whole catalog per request
-            # (recommendation_server.py:79-93), so growth is steep:
-            # a few dozen hits already multiply the base latency.
-            self._cache_entries += 1
-            extra_us = min(self._cache_entries * 150.0, 50_000.0)
-        else:
-            self._cache_entries = 0
+        with self._cache_lock:
+            if leak:
+                # Each hit grows the "cache"; latency grows with it. The
+                # reference's leak re-caches the whole catalog per
+                # request (recommendation_server.py:79-93), so growth is
+                # steep: a few dozen hits multiply the base latency.
+                self._cache_entries += 1
+                extra_us = min(self._cache_entries * 150.0, 50_000.0)
+            else:
+                self._cache_entries = 0
         products = self.catalog.list_products(ctx)
         pool = [p["id"] for p in products if p["id"] not in set(exclude_ids)]
         k = min(5, len(pool))

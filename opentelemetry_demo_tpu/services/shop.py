@@ -72,7 +72,13 @@ class Shop:
         # Receiver family parity (otelcol-config.yml:15-23): cart-store
         # stats (redis receiver analogue) + httpcheck wired after the
         # services exist (see below).
-        rng = np.random.default_rng(self.config.seed)
+        # One sim rng behind a mutex: the gRPC edge runs read RPCs
+        # concurrently, and every service draw (latency jitter, ad
+        # choice) is a read-modify-write of generator state. Draw ORDER
+        # is unchanged single-threaded, so seeded runs stay exact.
+        from ..utils.concurrency import LockedRng
+
+        rng = LockedRng(np.random.default_rng(self.config.seed))
         env = ServiceEnv(
             tracer=self.tracer,
             flags=self.flags,
@@ -142,9 +148,9 @@ class Shop:
     # -- flag control (flagd-ui analogue) ------------------------------
 
     def set_flag(self, key: str, value, variants: dict | None = None) -> None:
-        doc = {"flags": dict(self.flags._doc.get("flags", {}))}
+        doc = self.flags.snapshot()
         variants = variants or {"on": value}
-        doc["flags"][key] = {
+        doc.setdefault("flags", {})[key] = {
             "state": "ENABLED",
             "variants": variants,
             "defaultVariant": next(iter(variants)),
@@ -152,8 +158,8 @@ class Shop:
         self.flags.replace(doc)
 
     def clear_flag(self, key: str) -> None:
-        doc = {"flags": dict(self.flags._doc.get("flags", {}))}
-        doc["flags"].pop(key, None)
+        doc = self.flags.snapshot()
+        doc.get("flags", {}).pop(key, None)
         self.flags.replace(doc)
 
     # -- simulation loop ----------------------------------------------

@@ -1,0 +1,109 @@
+"""Concurrency primitives for serving the shop graph.
+
+The reference's services are separate processes, each concurrent by
+construction (gRPC thread pools, Go goroutines); this framework's shop
+is ONE object graph, so its edge servers need explicit discipline:
+
+- :class:`RWLock` — writer-preference readers-writer lock. Exclusive
+  mode is a drop-in for ``threading.Lock`` (``with lock:``), so the
+  HTTP gateway's single-writer pump keeps its exact semantics, while
+  the gRPC edge runs read-only RPCs (GetProduct, Convert, GetQuote, …)
+  concurrently under ``lock.shared()``.
+- :class:`LockedRng` — a thread-safe facade over one
+  ``numpy.random.Generator``. Every service draw (latency jitter, ad
+  choice, quote cost) is a read-modify-write of shared generator state;
+  under concurrent readers an unlocked Generator corrupts silently.
+  Single-threaded draws keep their exact order, so seeded tests stay
+  deterministic.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWLock:
+    """Writer-preference readers-writer lock.
+
+    Writer preference: once a writer waits, new readers queue behind
+    it — a read-heavy gRPC edge can then never starve the gateway's
+    pump (which holds exclusive for every span flush).
+    Not reentrant in either mode (``threading.Lock`` discipline).
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    # -- exclusive (threading.Lock drop-in) ----------------------------
+
+    def acquire(self) -> bool:
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+        return True
+
+    def release(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    def __enter__(self) -> "RWLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- shared --------------------------------------------------------
+
+    @contextmanager
+    def shared(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+
+class LockedRng:
+    """Thread-safe proxy over a ``numpy.random.Generator``.
+
+    Method calls run under one mutex; attribute reads pass through.
+    Bound methods are cached so the hot path costs one dict hit + one
+    lock, not a ``getattr`` chain per draw.
+    """
+
+    def __init__(self, rng):
+        self._rng = rng
+        self._lock = threading.Lock()
+        self._cache: dict = {}
+
+    def __getattr__(self, name):
+        cached = self._cache.get(name)
+        if cached is not None:
+            return cached
+        attr = getattr(self._rng, name)
+        if not callable(attr):
+            return attr
+
+        def locked(*args, **kwargs):
+            with self._lock:
+                return attr(*args, **kwargs)
+
+        self._cache[name] = locked
+        return locked

@@ -67,7 +67,7 @@ class FlagEditorUI:
                 return json.load(f)
         # Deep copy: handlers mutate the returned doc before validation,
         # and a rejected write must never corrupt the live store.
-        return json.loads(json.dumps(self.store._doc))
+        return self.store.snapshot()
 
     def _write_doc(self, doc: dict) -> None:
         validate_flag_doc(doc)

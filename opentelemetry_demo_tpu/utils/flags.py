@@ -41,8 +41,29 @@ class FlagEvaluator:
     def replace(self, doc: dict) -> None:
         self._doc = doc or {"flags": {}}
 
+    def snapshot(self) -> dict:
+        """Deep copy of the live flagd document — THE public read /
+        copy-for-write surface (callers mutate the copy and
+        :meth:`replace` it back; nobody reaches into ``_doc``).
+        JSON round-trip: the document is JSON by contract (flagd file
+        schema), and this also catches non-JSON values early."""
+        return json.loads(json.dumps(self._doc))
+
     def flag_keys(self) -> list[str]:
         return list(self._doc.get("flags", {}))
+
+    def flag_spec(self, key: str) -> dict | None:
+        """READ-ONLY view of one flag's live spec (no copy) — callers
+        must not mutate; use :meth:`snapshot` + :meth:`replace` to
+        write. Safe concurrently: ``replace`` swaps the whole document
+        reference atomically."""
+        spec = self._doc.get("flags", {}).get(key)
+        return spec if isinstance(spec, dict) else None
+
+    def flag_specs(self) -> dict:
+        """READ-ONLY view of the live flags mapping (same contract as
+        :meth:`flag_spec`)."""
+        return self._doc.get("flags", {})
 
     def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
         """Return the flag's value, or ``default`` if absent/disabled."""

@@ -49,6 +49,7 @@ def deployment(
     volume_mounts: list[dict] | None = None,
     volumes: list[dict] | None = None,
     readiness_http: tuple[str, int] | None = None,
+    grpc_health_port: int | None = None,
     replicas: int = 1,
     strategy: str | None = None,
 ) -> dict:
@@ -72,6 +73,21 @@ def deployment(
             "httpGet": {"path": path, "port": port},
             "initialDelaySeconds": 5,
             "periodSeconds": 10,
+        }
+    if grpc_health_port:
+        # Native kubelet gRPC probe (k8s ≥1.24): queries the same
+        # grpc.health.v1 service the reference's containers register
+        # (main.go:223-224); liveness uses it too, with a longer grace.
+        container["readinessProbe"] = {
+            "grpc": {"port": grpc_health_port},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+        container["livenessProbe"] = {
+            "grpc": {"port": grpc_health_port},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 20,
+            "failureThreshold": 3,
         }
     spec: dict = {
         "replicas": replicas,
@@ -144,6 +160,7 @@ def _detector_resources(kafka_addr: str | None) -> list[dict]:
     """Detector Deployment + Service + PVC + PDB (shared by both bundles)."""
     env = {
         "ANOMALY_OTLP_PORT": "4318",
+        "ANOMALY_OTLP_GRPC_PORT": "4317",
         "ANOMALY_METRICS_PORT": "9464",
         "ANOMALY_BATCH": "2048",
         "ANOMALY_CHECKPOINT": "/var/lib/anomaly/detector",
@@ -156,7 +173,8 @@ def _detector_resources(kafka_addr: str | None) -> list[dict]:
             "anomaly-detector",
             IMAGE_DETECTOR,
             env=env,
-            ports=[4318, 9464],
+            ports=[4317, 4318, 9464],
+            grpc_health_port=4317,
             memory="1500Mi",
             # Recreate: the RWO checkpoint PVC can't be attached by old
             # and new pods at once; RollingUpdate would wedge on
@@ -177,7 +195,7 @@ def _detector_resources(kafka_addr: str | None) -> list[dict]:
                 },
             ],
         ),
-        service("anomaly-detector", [4318, 9464]),
+        service("anomaly-detector", [4317, 4318, 9464]),
         pvc("anomaly-state"),
         pod_disruption_budget("anomaly-detector"),
     ]

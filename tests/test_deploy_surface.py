@@ -45,9 +45,13 @@ class TestManifests:
         assert env["ANOMALY_OTLP_PORT"] == "4318"
         assert env["FLAGD_FILE"] == "/app/flagd/demo.flagd.json"
         ports = {p["containerPort"] for p in container["ports"]}
-        assert ports == {4318, 9464}
+        assert ports == {4317, 4318, 9464}
         mounts = {m["mountPath"] for m in container["volumeMounts"]}
         assert "/var/lib/anomaly" in mounts and "/app/flagd" in mounts
+        # Health-gated like every reference service (main.go:223-224):
+        # kubelet-native gRPC probes against grpc.health.v1 on :4317.
+        assert container["readinessProbe"]["grpc"]["port"] == 4317
+        assert container["livenessProbe"]["grpc"]["port"] == 4317
 
     def test_selectors_match_pod_labels(self):
         for docs in (k8s.standalone_stack(), k8s.sidecar_overlay()):

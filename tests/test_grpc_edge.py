@@ -260,3 +260,156 @@ def test_service_error_is_internal_status(edge, pb2):
         place(pb2.PlaceOrderRequest(
             user_id="nobody", user_currency="USD", email="x@y.z"), timeout=5)
     assert exc.value.code() == grpc.StatusCode.INTERNAL
+
+
+# --- grpc.health.v1 (VERDICT r2 Next #4) ------------------------------
+
+HEALTH_PROTO = '''syntax = "proto3";
+package grpc.health.v1;
+message HealthCheckRequest { string service = 1; }
+message HealthCheckResponse {
+  enum ServingStatus {
+    UNKNOWN = 0; SERVING = 1; NOT_SERVING = 2; SERVICE_UNKNOWN = 3;
+  }
+  ServingStatus status = 1;
+}
+service Health {
+  rpc Check(HealthCheckRequest) returns (HealthCheckResponse);
+  rpc Watch(HealthCheckRequest) returns (stream HealthCheckResponse);
+}
+'''
+
+
+@pytest.fixture(scope="module")
+def health_pb2(tmp_path_factory):
+    """REAL protoc stubs of the public grpc.health.v1 proto (the
+    package is not installed in this image; the proto is the contract)."""
+    out = tmp_path_factory.mktemp("health_gen")
+    proto_dir = out / "proto"
+    proto_dir.mkdir()
+    (proto_dir / "health.proto").write_text(HEALTH_PROTO)
+    subprocess.run(
+        ["protoc", "--python_out", str(out), "proto/health.proto"],
+        check=True, cwd=out,
+    )
+    sys.path.insert(0, str(out / "proto"))
+    try:
+        import health_pb2 as mod
+
+        yield mod
+    finally:
+        sys.path.remove(str(out / "proto"))
+        sys.modules.pop("health_pb2", None)
+
+
+def _health_stub(port, health_pb2, method="Check"):
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    kind = channel.unary_unary if method == "Check" else channel.unary_stream
+    return kind(
+        f"/grpc.health.v1.Health/{method}",
+        request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+        response_deserializer=health_pb2.HealthCheckResponse.FromString,
+    )
+
+
+def test_health_check_round_trip(edge, health_pb2):
+    check = _health_stub(edge.port, health_pb2)
+    # Overall server health ("" service — what healthchecks probe).
+    resp = check(health_pb2.HealthCheckRequest(service=""), timeout=5)
+    assert resp.status == health_pb2.HealthCheckResponse.SERVING
+    # Every served oteldemo service answers by name (main.go:223-224
+    # registers per-service health the same way).
+    resp = check(
+        health_pb2.HealthCheckRequest(service="oteldemo.CartService"),
+        timeout=5,
+    )
+    assert resp.status == health_pb2.HealthCheckResponse.SERVING
+    with pytest.raises(grpc.RpcError) as exc:
+        check(health_pb2.HealthCheckRequest(service="no.such.Service"),
+              timeout=5)
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_health_watch_streams_shutdown_transition(health_pb2):
+    import threading
+
+    shop = Shop(ShopConfig(users=0, seed=12))
+    e = GrpcShopEdge(shop, host="127.0.0.1", port=0)
+    e.start()
+    watch = _health_stub(e.port, health_pb2, method="Watch")
+    stream = watch(health_pb2.HealthCheckRequest(service=""), timeout=30)
+    statuses = []
+
+    def consume():
+        try:
+            for resp in stream:
+                statuses.append(resp.status)
+        except grpc.RpcError:
+            pass  # stream torn down with the server
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = __import__("time").monotonic() + 5
+    while not statuses and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.05)
+    assert statuses[:1] == [health_pb2.HealthCheckResponse.SERVING]
+    e.stop()
+    t.join(timeout=5)
+    # The SERVING -> NOT_SERVING transition reached the watcher before
+    # teardown (the drain signal health-gated balancers rely on).
+    assert health_pb2.HealthCheckResponse.NOT_SERVING in statuses
+
+
+# --- concurrent clients (VERDICT r2 Next #5) --------------------------
+
+
+def test_parallel_clients_across_services(edge, pb2):
+    """≥4 concurrent clients across read and write RPCs: reads run
+    under the shared lock, writes exclusively; everything must land
+    consistently (no lost cart items, no wire corruption)."""
+    import threading
+
+    n_clients = 6
+    per_client = 8
+    errors = []
+
+    def client(i: int) -> None:
+        try:
+            user = f"par-{i}"
+            add = _stub(edge, pb2, "CartService", "AddItem",
+                        pb2.AddItemRequest, pb2.Empty)
+            get = _stub(edge, pb2, "CartService", "GetCart",
+                        pb2.GetCartRequest, pb2.Cart)
+            lst = _stub(edge, pb2, "ProductCatalogService", "ListProducts",
+                        pb2.Empty, pb2.ListProductsResponse)
+            conv = _stub(edge, pb2, "CurrencyService", "Convert",
+                         pb2.CurrencyConversionRequest, pb2.Money)
+            for k in range(per_client):
+                lst(pb2.Empty(), timeout=10)
+                add(pb2.AddItemRequest(
+                    user_id=user,
+                    item=pb2.CartItem(product_id="OLJCESPC7Z", quantity=1),
+                ), timeout=10)
+                conv(_conv_req(pb2), timeout=10)
+            cart = get(pb2.GetCartRequest(user_id=user), timeout=10)
+            total = sum(item.quantity for item in cart.items)
+            if total != per_client:
+                errors.append(f"{user}: {total} != {per_client}")
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+
+def _conv_req(pb2):
+    # "from" is a Python keyword; protoc exposes the field via setattr.
+    req = pb2.CurrencyConversionRequest(to_code="EUR")
+    getattr(req, "from").CopyFrom(pb2.Money(currency_code="USD", units=10))
+    return req

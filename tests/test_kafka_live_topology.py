@@ -50,6 +50,20 @@ class TestLiveShopOverBroker:
         shop.cart.add_item(ctx, user, "EYE-PLO-25", 2)
         shop.checkout.place_order(ctx, user, "USD", f"{user}@example.com")
 
+    @staticmethod
+    def _pump_until(shop: Shop, cond, timeout_s: float = 10.0) -> None:
+        """Delivery is asynchronous (background sender + socket), so
+        pump on a loop until the condition holds."""
+        deadline = time.monotonic() + timeout_s
+        t = 1.0
+        while time.monotonic() < deadline:
+            shop.pump(t)
+            if cond():
+                return
+            t += 0.25
+            time.sleep(0.05)
+        raise AssertionError("condition not reached before timeout")
+
     def test_orders_cross_the_socket_to_both_groups(self):
         broker = KafkaBroker()
         broker.start()
@@ -57,7 +71,11 @@ class TestLiveShopOverBroker:
             shop = self._shop(broker)
             for i in range(3):
                 self._checkout(shop, f"u{i}")
-            shop.pump(1.0)
+            self._pump_until(
+                shop,
+                lambda: shop.accounting.orders_seen >= 3
+                and shop.fraud.orders_checked >= 3,
+            )
             assert shop.accounting.orders_seen == 3
             assert shop.fraud.orders_checked == 3
             # Both groups committed their positions ON THE BROKER — the
@@ -75,7 +93,10 @@ class TestLiveShopOverBroker:
         try:
             shop = self._shop(broker)
             self._checkout(shop, "u-trace")
-            shop.pump(1.0)
+            self._pump_until(
+                shop, lambda: shop.accounting.orders_seen >= 1
+            )
+            shop.pump(20.0)  # flush consumer spans to the collector
             # One trace spans the producer AND both consumers: the W3C
             # context rode the v2 record headers (main.go:631-637).
             crossing = [
@@ -99,18 +120,20 @@ class TestLiveShopOverBroker:
         port = broker.port
         shop = self._shop(broker)
         self._checkout(shop, "u-pre")
-        shop.pump(1.0)
-        assert shop.accounting.orders_seen == 1
+        self._pump_until(shop, lambda: shop.accounting.orders_seen >= 1)
         broker.stop()
         self._checkout(shop, "u-down")  # must not raise
         shop.pump(2.0)
         broker2 = KafkaBroker(port=port)
         broker2.start()
         try:
-            deadline = time.monotonic() + 10.0
+            deadline = time.monotonic() + 15.0
             t = 3.0
+            posted = False
             while time.monotonic() < deadline:
-                self._checkout(shop, "u-post")
+                if not posted:
+                    self._checkout(shop, "u-post")
+                    posted = True
                 t += 0.5
                 shop.pump(t)
                 if shop.accounting.orders_seen >= 3:

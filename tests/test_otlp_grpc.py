@@ -126,3 +126,27 @@ def test_daemon_serves_grpc(tmp_path, monkeypatch):
         assert daemon.pipeline.stats.spans >= 64
     finally:
         daemon.shutdown()
+
+
+def test_health_check_on_the_daemon_ingress(receiver):
+    """grpc.health.v1 beside the OTLP ingress: what the compose
+    healthcheck and k8s probes query (reference services register the
+    same service, main.go:223-224)."""
+    recv, _, _ = receiver
+    channel = grpc.insecure_channel(f"127.0.0.1:{recv.port}")
+    check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=None, response_deserializer=None,
+    )
+    # "" = overall server health; response is HealthCheckResponse with
+    # status=SERVING(1) — decoded with the wire scanner (no stubs).
+    resp = check(b"", timeout=5)
+    assert wire.first(wire.scan_fields(resp), 1) == 1
+    # A served service by name; an unknown one is NOT_FOUND.
+    named = wire.encode_len(
+        1, b"opentelemetry.proto.collector.trace.v1.TraceService"
+    )
+    assert wire.first(wire.scan_fields(check(named, timeout=5)), 1) == 1
+    with pytest.raises(grpc.RpcError) as exc:
+        check(wire.encode_len(1, b"nope.Service"), timeout=5)
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
