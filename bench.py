@@ -168,9 +168,12 @@ def measure_impl_matrix(rng) -> dict[str, float]:
     if jax.default_backend() != "tpu":
         return {}
     out: dict[str, float] = {}
-    # Three regimes, both impls: 6 compiles ≈ the bulk of the cost.
+    # Four regimes, both impls: 8 compiles ≈ the bulk of the cost.
+    # 16384 audits the r3 crossover (fused.IMPL_CROSSOVER_BATCH): the
+    # wide-chunk kernel's last winning point before the xla sort path's
+    # O(B log B) scaling takes over.
     for impl in ("pallas", "xla"):
-        for batch in (2048, 65536, 524288):
+        for batch in (2048, 16384, 65536, 524288):
             config = DetectorConfig(sketch_impl=impl)
             try:
                 rate = measure_throughput(

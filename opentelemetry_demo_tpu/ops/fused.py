@@ -25,20 +25,22 @@ An ``impl="xla"`` reference path (the scatter formulation built from
 ``ops.hll`` / ``ops.cms`` / ``ops.ewma``) defines the semantics; the
 Pallas path is property-tested against it (interpret mode on CPU, native
 on TPU). Honest fetch-synchronized timing on v5e-1 (S=32, p=12, 4×8192
-CMS): the dense kernel wins the small-batch regime (~2.6M spans/s
-through the full detector step at B=2048, vs ~1.5M for the scatter
-path) because its cost is one cell sweep per batch tile; the XLA path
-wins large batches (~20M spans/s from B≈128k) with O(1)-per-span work —
-a scatter-max for HLL and the scatter-free sort+searchsorted histogram
-for the CMS count (``cms.cms_update_hist``; TPU scatters serialize on
-duplicate indices, and a CMS batch is nothing but duplicates).
-``resolve_impl`` auto-selects by batch size. The kernel's further wins
-are determinism (fixed VPU/MXU schedule, no batch-order dependence) and
-keeping the whole delta VMEM-resident.
+CMS), after the r3 wide-chunk retune (see ``_cell_chunk`` /
+``IMPL_CROSSOVER_BATCH`` for the measured table): the dense kernel owns
+batches through ~16k (7.5M spans/s at B=8192, 3.3× the xla path there)
+and sits at its VPU dense-compare roofline ~7.6M spans/s; the XLA path
+wins from ~32k up (47M spans/s at B=512k) with O(B log B) work — the
+scatter-free sort+searchsorted histogram for the CMS count
+(``cms.cms_update_hist``; TPU scatters serialize on duplicate indices,
+and a CMS batch is nothing but duplicates). ``resolve_impl``
+auto-selects by batch size. The kernel's further wins are determinism
+(fixed VPU/MXU schedule, no batch-order dependence) and keeping the
+whole delta VMEM-resident.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -57,12 +59,32 @@ class SketchDelta(NamedTuple):
     stats: jnp.ndarray  # float32[4, S] — cnt, Σlog-lat, Σlog-lat², Σerr
 
 
-def _cell_chunk(total_cells: int, batch: int) -> int:
-    """Lane-chunk size: biggest power-of-two tile dividing the cell count
-    while keeping the [B, chunk] compare intermediate ≲4 MiB of VMEM."""
-    cap = max(128, (1 << 20) // max(batch, 1))
+def _cell_chunk(total_cells: int, batch: int, wide: bool = False) -> int:
+    """Lane-chunk size: biggest power-of-two tile dividing the cell count.
+
+    Two regimes, measured on v5e-1 (S=32, p=12, 4×8192 CMS):
+
+    - ``wide`` (multi-tile grids, large B): chunks up to 2048 lanes.
+      The kernel's cost is pure compare-reduce sweeps (O(B·cells)
+      total), so its throughput is set by how much of each sweep runs
+      per loop iteration — C=128 leaves ~1300 tiny sequential
+      fori_loop steps per grid step and 1.7M spans/s; C=2048 cuts the
+      loop overhead ~16× and reaches 7.6M spans/s (the dense-compare
+      VPU roofline for this geometry), for [TB, 2048] int32 compare
+      intermediates of 32 MiB inside the raised VMEM grant.
+    - narrow (single-tile grids, small B — the low-latency pipeline
+      regime): big chunks measurably HURT (1.67M → 1.02M at B=2048);
+      without grid pipelining the wide intermediates only add VMEM
+      pressure. Keep the [B, chunk] intermediate ≲4 MiB.
+    """
+    if wide:
+        cap = max(128, (1 << 24) // max(batch, 1))
+        limit = 2048
+    else:
+        cap = max(128, (1 << 20) // max(batch, 1))
+        limit = 512
     c = 128
-    while c * 2 <= min(512, cap) and total_cells % (c * 2) == 0:
+    while c * 2 <= min(limit, cap) and total_cells % (c * 2) == 0:
         c *= 2
     if total_cells % c:
         raise ValueError(f"cell count {total_cells} not divisible by {c}")
@@ -79,6 +101,8 @@ def _delta_kernel(
     hll_ref,  # out int32[SR/C, C] — same block every grid step
     cms_ref,  # out int32[D, W] — same block every grid step
     stats_ref,  # out float32[4, S] — same block every grid step
+    *,
+    wide: bool,  # multi-tile grid → wide cell chunks (see _cell_chunk)
 ):
     """One grid step absorbs one batch tile into the delta.
 
@@ -113,7 +137,7 @@ def _delta_kernel(
     weight = weight_ref[:]  # [TB, 1] int32
     # 2*b: the grid pipeline double-buffers blocks, so budget the
     # [TB, chunk] intermediates as if two tiles were resident.
-    c_cms = _cell_chunk(w, 2 * b)
+    c_cms = _cell_chunk(w, 2 * b, wide=wide)
     for di in range(d):  # depth is small and static — unrolled
         col = cidx_ref[:, pl.ds(di, 1)]  # [TB, 1]
 
@@ -182,7 +206,8 @@ def _delta_pallas(
             f"for the pallas impl; {hint}"
         )
     sr = num_services * hll_regs
-    c_hll = _cell_chunk(sr, 2 * tb)  # 2*: grid double-buffering headroom
+    wide = nb > 1  # multi-tile grid: pipelined sweeps want wide chunks
+    c_hll = _cell_chunk(sr, 2 * tb, wide=wide)  # 2*: double-buffer headroom
     # Under shard_map the per-shard delta varies across every mesh axis
     # any input varies across (batch-sharded lanes, sketch-localised
     # ids); pallas_call can't infer that, so propagate the union.
@@ -206,13 +231,13 @@ def _delta_pallas(
         return (0, 0)
 
     hll_d, cms_d, stats = pl.pallas_call(
-        _delta_kernel,
+        functools.partial(_delta_kernel, wide=wide),
         grid=(nb,),
         # The compiler's default scoped-VMEM budget (16 MiB) sits ~36 KiB
         # under what the grid pipeline requests at very large B; v5e has
         # 128 MiB physical VMEM, so grant headroom explicitly.
         compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=32 * 1024 * 1024,
+            vmem_limit_bytes=100 * 1024 * 1024,
         ),
         out_shape=out_shape,
         in_specs=[
@@ -319,23 +344,41 @@ def sketch_batch_delta(
     )
 
 
+IMPL_CROSSOVER_BATCH = 16384
+"""Auto-select boundary, measured on v5e-1 (S=32, p=12, 4×8192 CMS;
+fetch-synchronized slope timing of the isolated delta op, r3):
+
+    B        pallas      xla
+    2048     1.4M/s      0.7M/s     ← pallas (narrow chunks)
+    8192     7.5M/s      2.3M/s     ← pallas (wide chunks)
+    16384    7.4M/s      4.3M/s     ← pallas
+    32768    6.7M/s      7.0M/s     ← tie
+    65536    7.9M/s     13.4M/s     ← xla
+    524288   7.6M/s     47.7M/s     ← xla
+
+The kernel's total work is O(B·cells) dense compares by construction —
+wide chunks (see ``_cell_chunk``) brought it from 1.7M to ~7.6M
+spans/s, which IS the VPU dense-compare roofline for this geometry
+(~164k cells × ~3 ops per span ≈ 0.5M VPU ops/span against ~3.8T
+int-ops/s) — while the xla path's sort+searchsorted histogram is
+O(B log B) and keeps scaling. Past the tie at 32k the gap is
+algorithmic, not schedule: no amount of kernel tuning buys back a
+different asymptotic. See PARITY.md for the full roofline argument."""
+
+
 def resolve_impl(requested: str | None, batch: int | None = None) -> str:
     """Map a config's ``sketch_impl`` field to a concrete impl name.
 
-    ``None`` auto-selects by backend AND batch size. The dense
-    compare-reduction kernel's cost per batch tile is a full sweep of
-    all sketch cells, so its per-span cost is ~O(cells / tile): it wins
-    in the small-batch low-latency regime (measured ~2.6M spans/s at
-    B=2048 vs the xla path on v5e-1, honest fetch-synchronized timing)
-    but loses at large batches where the xla path's O(1)-per-span work
-    (HLL scatter-max + scatter-free CMS histogram) saturates ~20M
-    spans/s (B ≥ 128k). CPU interpret mode is for tests, not production
+    ``None`` auto-selects by backend AND batch size at the measured
+    ``IMPL_CROSSOVER_BATCH`` (see its table): the dense kernel owns the
+    small/medium-batch low-latency regime, the xla path the large-batch
+    throughput regime. CPU interpret mode is for tests, not production
     CPU runs.
     """
     if requested is None:
         if jax.default_backend() != "tpu":
             return "xla"
-        if batch is not None and batch > 4096:
+        if batch is not None and batch > IMPL_CROSSOVER_BATCH:
             return "xla"
         return "pallas"
     if requested not in ("xla", "pallas", "interpret"):

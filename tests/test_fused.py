@@ -86,12 +86,19 @@ class TestSketchBatchDelta:
         _assert_delta_equal(ref, tiled)
 
     def test_resolve_impl_batch_crossover(self, monkeypatch):
-        """Auto-selection routes small batches to the dense kernel and
-        large ones to the scatter path (measured crossover ~4096)."""
+        """Auto-selection routes small/medium batches to the dense
+        kernel and large ones to the scatter path, at the crossover the
+        r3 v5e measurements pin (fused.IMPL_CROSSOVER_BATCH table:
+        pallas 7.5M vs xla 2.3M at 8192, tie ~32k, xla 13.4M vs 7.9M at
+        65536 — the wide-chunk kernel sits at its dense-compare
+        roofline, the sort path keeps scaling)."""
+        assert fused.IMPL_CROSSOVER_BATCH == 16384
         monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
         assert fused.resolve_impl(None, batch=2048) == "pallas"
-        assert fused.resolve_impl(None, batch=4096) == "pallas"
-        assert fused.resolve_impl(None, batch=4097) == "xla"
+        assert fused.resolve_impl(None, batch=8192) == "pallas"
+        assert fused.resolve_impl(None, batch=16384) == "pallas"
+        assert fused.resolve_impl(None, batch=16385) == "xla"
+        assert fused.resolve_impl(None, batch=65536) == "xla"
         assert fused.resolve_impl(None) == "pallas"  # no batch hint
         # Explicit requests are never overridden by the batch hint.
         assert fused.resolve_impl("pallas", batch=524288) == "pallas"
