@@ -242,6 +242,15 @@ class DetectorDaemon:
         now_mono = time.monotonic()
         if now_mono - getattr(self, "_last_self_report", 0.0) >= 1.0:
             self._last_self_report = now_mono
+            # docker_stats analogue: this container's resource stats on
+            # the same exposition the shop's processes use.
+            if not hasattr(self, "_proc_stats"):
+                from ..telemetry.receivers import ProcessStatsReceiver
+
+                self._proc_stats = ProcessStatsReceiver(
+                    "anomaly-detector", registry=self.registry
+                )
+            self._proc_stats.scrape()
             self.registry.gauge_set(
                 "app_anomaly_pending_rows", float(self.pipeline._pending_rows)
             )

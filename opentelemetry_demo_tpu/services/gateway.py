@@ -91,6 +91,7 @@ class ShopGateway:
         # Mount point for the flag editor (flagd-ui analogue): an object
         # with handle(method, path, body) -> (status, content_type, bytes).
         self.feature_ui = None
+        self.loadgen_ui = None  # LoadControl, mounted at /loadgen
         # Server-rendered storefront at "/" (the Next.js tier analogue);
         # HTML pages live beside the JSON /api routes.
         self.web_ui = WebStorefront(shop.frontend)
@@ -310,6 +311,16 @@ class ShopGateway:
             sub = route[len("/feature"):] or "/"
             return self.feature_ui.handle(method, sub, body)
 
+        if route.startswith("/loadgen"):
+            # The Locust web UI behind the edge (envoy.tmpl.yaml:46):
+            # view/set users + spawn rate at runtime. Deliberately
+            # OUTSIDE the shop lock — the control plane must answer
+            # while the load it controls saturates the data plane.
+            if self.loadgen_ui is None:
+                return 503, "text/plain", b"loadgen UI not mounted"
+            sub = route[len("/loadgen"):] or "/"
+            return self.loadgen_ui.handle(method, sub, body)
+
         if route.startswith("/images/"):
             product_id = route[len("/images/"):].removesuffix(".svg")
             with self._lock:
@@ -339,14 +350,6 @@ class ShopGateway:
 
         if route == "/metrics":
             return 200, "text/plain; version=0.0.4", self.shop.metrics.render().encode()
-
-        if route == "/loadgen":
-            stats = {
-                "requests_served": self.requests_served,
-                "spans_emitted": self.shop.tracer.spans_emitted,
-                "virtual_time_s": self.shop.now,
-            }
-            return (*ok, json.dumps(stats).encode())
 
         if route == "/api/products" and method == "GET":
             return (*ok, json.dumps({"products": fe.api_products(ctx)}).encode())

@@ -29,7 +29,109 @@ from .loadgen import TASK_WEIGHTS
 from ..telemetry.tracer import TraceContext
 
 
-class HttpLoadGenerator:
+class _UserPool:
+    """Per-user threads with RUNTIME resize — the Locust web-UI contract
+    (users / spawn rate editable while the swarm runs, the surface the
+    reference exposes through Envoy at /loadgen, envoy.tmpl.yaml:46).
+
+    Each user thread owns a stop event; ``set_users`` retires excess
+    users (their event fires, they exit at the next wait) or spawns
+    missing ones — immediately, or paced at ``spawn_rate`` users/s by a
+    spawner thread (Locust's ramp). ``_user_loop(idx, stop_ev)`` is the
+    subclass's task loop.
+    """
+
+    def _pool_init(self, thread_prefix: str) -> None:
+        self._thread_prefix = thread_prefix
+        self._pool_lock = threading.Lock()
+        self._pool: list[tuple[threading.Thread, threading.Event]] = []
+        self._next_user_idx = 0
+        self._spawn_cancel = threading.Event()
+        self._spawner: threading.Thread | None = None
+
+    def _spawn_one_locked(self) -> None:
+        ev = threading.Event()
+        idx = self._next_user_idx
+        self._next_user_idx += 1
+        t = threading.Thread(
+            target=self._user_loop, args=(idx, ev),
+            name=f"{self._thread_prefix}-{idx}", daemon=True,
+        )
+        self._pool.append((t, ev))
+        t.start()
+
+    def running_users(self) -> int:
+        with self._pool_lock:
+            return sum(
+                1 for t, ev in self._pool if t.is_alive() and not ev.is_set()
+            )
+
+    def set_users(self, n: int, spawn_rate: float = 0.0) -> None:
+        """Resize the swarm to ``n`` users; growth paced at
+        ``spawn_rate`` users/s when positive, immediate otherwise."""
+        n = max(int(n), 0)
+        # Cancel any in-flight ramp: the newest target wins.
+        self._spawn_cancel.set()
+        spawner = self._spawner
+        if spawner is not None:
+            spawner.join(timeout=5.0)
+        self._spawn_cancel = threading.Event()
+        with self._pool_lock:
+            self._pool = [
+                (t, ev) for t, ev in self._pool
+                if t.is_alive() and not ev.is_set()
+            ]
+            current = len(self._pool)
+            self.users = n
+            if n <= current:
+                for _t, ev in self._pool[n:]:
+                    ev.set()
+                self._pool = self._pool[:n]
+                return
+            missing = n - current
+            if spawn_rate <= 0:
+                for _ in range(missing):
+                    self._spawn_one_locked()
+                return
+        cancel = self._spawn_cancel
+
+        def ramp():
+            for _ in range(missing):
+                if cancel.wait(1.0 / spawn_rate):
+                    return
+                with self._pool_lock:
+                    if cancel.is_set():
+                        return
+                    self._spawn_one_locked()
+
+        self._spawner = threading.Thread(
+            target=ramp, name=f"{self._thread_prefix}-spawner", daemon=True
+        )
+        self._spawner.start()
+
+    def start(self) -> None:
+        self.set_users(self.users)
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        self._spawn_cancel.set()
+        spawner = self._spawner
+        if spawner is not None:
+            spawner.join(timeout=timeout_s)
+        with self._pool_lock:
+            pool = list(self._pool)
+            self._pool = []
+        for _t, ev in pool:
+            ev.set()
+        for t, _ev in pool:
+            t.join(timeout=timeout_s)
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.stop()
+
+
+class HttpLoadGenerator(_UserPool):
     """N user threads issuing the Locust task mix against a base URL."""
 
     def __init__(
@@ -47,11 +149,10 @@ class HttpLoadGenerator:
         self.flood_enabled = flood_enabled
         self.timeout_s = timeout_s
         self._seed = seed
-        self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
         self.requests_sent = 0
         self.errors = 0
         self._count_lock = threading.Lock()
+        self._pool_init("http-loadgen")
 
     # -- plumbing ------------------------------------------------------
 
@@ -123,7 +224,7 @@ class HttpLoadGenerator:
         else:  # index
             self._request("GET", "/", session_id)
 
-    def _user_loop(self, user_idx: int):
+    def _user_loop(self, user_idx: int, stop_ev: threading.Event):
         rng = np.random.default_rng(self._seed + user_idx)
         session_id = str(uuid.UUID(int=int(rng.integers(0, 2**63)) << 64))
         products = self._products(session_id)
@@ -131,31 +232,10 @@ class HttpLoadGenerator:
         weights = np.array([w for _, w in TASK_WEIGHTS], dtype=np.float64)
         weights /= weights.sum()
         lo, hi = self.wait_range_s
-        while not self._stop.is_set():
+        while not stop_ev.is_set():
             task = names[int(rng.choice(len(names), p=weights))]
             self._run_task(rng, task, session_id, products)
-            self._stop.wait(float(rng.uniform(lo, hi)))
-
-    # -- lifecycle -----------------------------------------------------
-
-    def start(self) -> None:
-        for i in range(self.users):
-            t = threading.Thread(
-                target=self._user_loop, args=(i,),
-                name=f"http-loadgen-{i}", daemon=True,
-            )
-            self._threads.append(t)
-            t.start()
-
-    def stop(self, timeout_s: float = 15.0) -> None:
-        self._stop.set()
-        for t in self._threads:
-            t.join(timeout=timeout_s)
-
-    def run_for(self, seconds: float) -> None:
-        self.start()
-        time.sleep(seconds)
-        self.stop()
+            stop_ev.wait(float(rng.uniform(lo, hi)))
 
 
 def browser_traffic_enabled() -> bool:
@@ -167,7 +247,7 @@ def browser_traffic_enabled() -> bool:
     )
 
 
-class BrowserLoadGenerator:
+class BrowserLoadGenerator(_UserPool):
     """WebsiteBrowserUser analogue: drives the RENDERED storefront.
 
     The reference's browser users (locustfile.py:184-211, Playwright,
@@ -200,13 +280,12 @@ class BrowserLoadGenerator:
         self.wait_range_s = wait_range_s
         self.timeout_s = timeout_s
         self._seed = seed
-        self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
         self._count_lock = threading.Lock()
         self.pages_loaded = 0
         self.images_loaded = 0
         self.spans_exported = 0
         self.errors = 0
+        self._pool_init("browser-loadgen")
 
     # -- a minimal browser ---------------------------------------------
 
@@ -323,34 +402,13 @@ class BrowserLoadGenerator:
         self._load_page("/cart/add", cookies,
                         form={"productId": pid, "quantity": "1"})
 
-    def _user_loop(self, user_idx: int) -> None:
+    def _user_loop(self, user_idx: int, stop_ev: threading.Event) -> None:
         rng = np.random.default_rng(self._seed + 1000 + user_idx)
         cookies: dict[str, str] = {}
         lo, hi = self.wait_range_s
-        while not self._stop.is_set():
+        while not stop_ev.is_set():
             if int(rng.integers(2)):
                 self.add_product_to_cart(rng, cookies)
             else:
                 self.open_cart_page_and_change_currency(cookies)
-            self._stop.wait(float(rng.uniform(lo, hi)))
-
-    # -- lifecycle -----------------------------------------------------
-
-    def start(self) -> None:
-        for i in range(self.users):
-            t = threading.Thread(
-                target=self._user_loop, args=(i,),
-                name=f"browser-loadgen-{i}", daemon=True,
-            )
-            self._threads.append(t)
-            t.start()
-
-    def stop(self, timeout_s: float = 15.0) -> None:
-        self._stop.set()
-        for t in self._threads:
-            t.join(timeout=timeout_s)
-
-    def run_for(self, seconds: float) -> None:
-        self.start()
-        time.sleep(seconds)
-        self.stop()
+            stop_ev.wait(float(rng.uniform(lo, hi)))

@@ -67,7 +67,16 @@ class Shop:
         # Jaeger/Prometheus/OpenSearch-analogue stores and to any
         # subscribed exporters (the anomaly-detector seam).
         self.collector = Collector(clock=lambda: self._t)
-        self.collector.add_scrape_target("shop", self.metrics)
+        # docker_stats analogue (otelcol-config.yml:18-19): this
+        # process's container_* self stats ride the shop registry, so
+        # they reach BOTH the TSDB (collector scrape) and the /metrics
+        # exposition the compose Prometheus scrapes.
+        from ..telemetry.receivers import ProcessStatsReceiver
+
+        proc_stats = ProcessStatsReceiver("shop", registry=self.metrics)
+        self.collector.add_scrape_target(
+            "shop", self.metrics, before=proc_stats.scrape
+        )
         self.collector.attach_hostmetrics()
         # Receiver family parity (otelcol-config.yml:15-23): cart-store
         # stats (redis receiver analogue) + httpcheck wired after the

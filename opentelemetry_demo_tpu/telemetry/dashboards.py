@@ -101,6 +101,17 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("rate", "otelcol_exporter_sent_spans"), "spans/s"),
                 Panel("Queue size",
                       Query("instant", "otelcol_exporter_queue_size"), "spans"),
+                # docker_stats receiver analogue (otelcol-config.yml:18-19):
+                # per-container resource breakdown across the topology.
+                Panel("Container CPU",
+                      Query("rate", "container_cpu_usage_seconds_total",
+                            by=("container_name",)), "cores"),
+                Panel("Container memory (RSS)",
+                      Query("instant", "container_memory_usage_bytes",
+                            by=("container_name",)), "bytes"),
+                Panel("Container threads",
+                      Query("instant", "container_threads",
+                            by=("container_name",)), "threads"),
             ],
         ),
         Dashboard(

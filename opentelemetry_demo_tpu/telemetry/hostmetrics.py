@@ -16,6 +16,19 @@ import os
 from .metrics import MetricRegistry
 
 
+def self_rss_bytes(proc_root: str = "/proc") -> float:
+    """This process's resident set size from {proc_root}/self/statm
+    (field 1 × page size); 0.0 when /proc is unavailable. THE one statm
+    parse — hostmetrics' process scraper and the docker_stats-analogue
+    receiver both call it (``proc_root`` override is the test seam)."""
+    try:
+        with open(os.path.join(proc_root, "self/statm")) as f:
+            pages = float(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
 class HostMetricsReceiver:
     """Reads /proc and publishes system.* gauges (OTel hostmetrics names)."""
 
@@ -105,10 +118,6 @@ class HostMetricsReceiver:
         self.registry.gauge_set("system_network_io_bytes", tx, direction="transmit")
 
     def _scrape_process(self) -> None:
-        text = self._read("self/statm")
-        if not text:
-            return
-        parts = text.split()
-        if len(parts) >= 2:
-            page = os.sysconf("SC_PAGE_SIZE")
-            self.registry.gauge_set("process_memory_usage_bytes", float(parts[1]) * page)
+        rss = self_rss_bytes(self.proc_root)
+        if rss:
+            self.registry.gauge_set("process_memory_usage_bytes", rss)

@@ -18,6 +18,7 @@ need.
 
 from __future__ import annotations
 
+import itertools
 import secrets
 from dataclasses import dataclass, field
 from typing import Callable
@@ -66,6 +67,7 @@ class Tracer:
     def __init__(self, sink: Callable[[SpanRecord], None]):
         self._sink = sink
         self.spans_emitted = 0
+        self._emit_count = itertools.count(1)
 
     def emit(
         self,
@@ -76,7 +78,11 @@ class Tracer:
         is_error: bool = False,
         attr: str | None = None,
     ) -> None:
-        self.spans_emitted += 1
+        # Monotonic-enough ops counter: emit() runs concurrently under
+        # the gRPC edge's shared lock, and += is a read-modify-write —
+        # itertools.count gives a GIL-atomic increment without a mutex
+        # on the span hot path (the value is advisory telemetry).
+        self.spans_emitted = next(self._emit_count)
         self._sink(
             SpanRecord(
                 service=service,

@@ -74,6 +74,12 @@ def deployment(
             "initialDelaySeconds": 5,
             "periodSeconds": 10,
         }
+    if readiness_http and grpc_health_port:
+        raise ValueError(
+            "readiness_http and grpc_health_port both set: the gRPC "
+            "probe would silently replace the HTTP readiness gate — "
+            "pick one per deployment"
+        )
     if grpc_health_port:
         # Native kubelet gRPC probe (k8s ≥1.24): queries the same
         # grpc.health.v1 service the reference's containers register

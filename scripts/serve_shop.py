@@ -115,30 +115,26 @@ def serve(args) -> None:
         grpc_edge.start()
         print(f"gRPC edge on {args.host}:{grpc_edge.port}", flush=True)
 
-    load = None
-    if args.users > 0:
-        load = HttpLoadGenerator(
-            f"http://127.0.0.1:{gw.port}", users=args.users, seed=args.seed
-        )
-        load.start()
-        print(f"in-proc load: {args.users} users", flush=True)
-    browser_load = None
-    if browser_traffic_enabled():
-        # The reference gates Playwright browser users the same way
-        # (locustfile.py:180-211, LOCUST_BROWSER_TRAFFIC_ENABLED).
-        browser_load = BrowserLoadGenerator(
-            f"http://127.0.0.1:{gw.port}", users=1, seed=args.seed
-        )
-        browser_load.start()
-        print("in-proc browser load: 1 user", flush=True)
+    # Loadgen control plane at /loadgen (the Locust web UI behind the
+    # edge, envoy.tmpl.yaml:46): --users is the autostart default
+    # (.env LOCUST_USERS/LOCUST_AUTOSTART), runtime-editable after.
+    from opentelemetry_demo_tpu.services.load_control import LoadControl
+
+    load = LoadControl(f"http://127.0.0.1:{gw.port}", seed=args.seed)
+    gw.loadgen_ui = load
+    browser_users = 1 if browser_traffic_enabled() else 0
+    if args.users > 0 or browser_users:
+        load.set_users(args.users, browser_users=browser_users or None)
+        print(f"in-proc load: {args.users} users"
+              + (", 1 browser user" if browser_users else ""), flush=True)
+    print(f"loadgen control at http://{args.host}:{gw.port}/loadgen",
+          flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
-    for lg in (load, browser_load):
-        if lg is not None:
-            lg.stop()
+    load.stop()
     if grpc_edge is not None:
         grpc_edge.stop()
     gw.stop()

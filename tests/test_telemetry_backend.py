@@ -331,6 +331,22 @@ def test_receiver_family_metrics_in_tsdb(busy_shop):
     assert keys and keys[0][0]["job"] == "valkey-cart"
 
 
+def test_container_stats_in_tsdb(busy_shop):
+    """docker_stats receiver analogue (otelcol-config.yml:18-19):
+    container_*-shaped per-process resource gauges on the scrape cycle,
+    labeled with the compose service name."""
+    tsdb = busy_shop.collector.tsdb
+    at = busy_shop.now
+    cpu = tsdb.instant("container_cpu_usage_seconds_total", at=at)
+    assert cpu, "no container cpu series scraped"
+    labels, v = cpu[0]
+    assert labels["container_name"] == "shop" and v > 0
+    rss = tsdb.instant("container_memory_usage_bytes", at=at)
+    assert rss and rss[0][1] > 1e6  # a Python+JAX process is >1 MB
+    threads = tsdb.instant("container_threads", at=at)
+    assert threads and threads[0][1] >= 1
+
+
 def test_httpcheck_receiver_real_http():
     from opentelemetry_demo_tpu.services.gateway import ShopGateway
     from opentelemetry_demo_tpu.services.shop import Shop as _Shop
