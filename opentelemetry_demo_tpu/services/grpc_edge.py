@@ -26,9 +26,11 @@ src/currency/src/server.cpp:92-102) answers entirely outside the lock.
 
 from __future__ import annotations
 
+import functools
+import struct as _struct
 import threading
 
-from ..runtime import wire
+from ..runtime import structpb, wire
 from ..runtime.kafka_orders import encode_placed_order
 from ..telemetry.tracer import TraceContext
 from ..utils.concurrency import RWLock
@@ -36,6 +38,7 @@ from .base import ServiceError
 from .money import Money
 
 PKG = "oteldemo"
+FLAGD_PKG = "flagd.evaluation.v1"
 
 # RPCs with no shop-graph writes: safe under the shared lock. Span
 # emission, metrics, and rng draws inside them are individually
@@ -52,6 +55,12 @@ READ_METHODS = frozenset({
     f"/{PKG}.AdService/GetAds",
     f"/{PKG}.FeatureFlagService/GetFlag",
     f"/{PKG}.FeatureFlagService/ListFlags",
+    f"/{FLAGD_PKG}.Service/ResolveBoolean",
+    f"/{FLAGD_PKG}.Service/ResolveString",
+    f"/{FLAGD_PKG}.Service/ResolveFloat",
+    f"/{FLAGD_PKG}.Service/ResolveInt",
+    f"/{FLAGD_PKG}.Service/ResolveObject",
+    f"/{FLAGD_PKG}.Service/ResolveAll",
 })
 
 
@@ -142,6 +151,21 @@ class GrpcShopEdge:
             f"/{PKG}.FeatureFlagService/UpdateFlag": self._update_flag,
             f"/{PKG}.FeatureFlagService/ListFlags": self._list_flags,
             f"/{PKG}.FeatureFlagService/DeleteFlag": self._delete_flag,
+            # flagd's own gRPC evaluation protocol (the :8013 surface
+            # every OpenFeature flagd provider dials — schemas.flagd.dev;
+            # SURVEY §1 "flagd gRPC :8013"). Typed resolvers + ResolveAll;
+            # EventStream is registered as a streaming method below.
+            f"/{FLAGD_PKG}.Service/ResolveBoolean":
+                functools.partial(self._resolve_typed, bool),
+            f"/{FLAGD_PKG}.Service/ResolveString":
+                functools.partial(self._resolve_typed, str),
+            f"/{FLAGD_PKG}.Service/ResolveFloat":
+                functools.partial(self._resolve_typed, float),
+            f"/{FLAGD_PKG}.Service/ResolveInt":
+                functools.partial(self._resolve_typed, int),
+            f"/{FLAGD_PKG}.Service/ResolveObject":
+                functools.partial(self._resolve_typed, dict),
+            f"/{FLAGD_PKG}.Service/ResolveAll": self._resolve_all,
         }
 
         # grpc.health.v1 (shared implementation, runtime.grpc_health):
@@ -155,6 +179,11 @@ class GrpcShopEdge:
             watcher_slots=2,
         )
 
+        # flagd EventStream watchers share the health-watch thread
+        # budget rationale: slot-bounded so parked streams can't starve
+        # the executor pool.
+        self._event_watchers = threading.Semaphore(2)
+
         class Handler(grpc.GenericRpcHandler):
             def service(self, details):
                 health = edge._health.add_to_generic_handlers(
@@ -162,10 +191,16 @@ class GrpcShopEdge:
                 )
                 if health is not None:
                     return health
+                if details.method == f"/{FLAGD_PKG}.Service/EventStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        edge._event_stream_rpc,
+                        request_deserializer=None, response_serializer=None,
+                    )
                 fn = handlers.get(details.method)
                 if fn is None:
                     return None
                 read_only = details.method in READ_METHODS
+                is_flagd = details.method.startswith(f"/{FLAGD_PKG}.")
 
                 def call(request: bytes, context) -> bytes:
                     # W3C context rides gRPC metadata (every reference
@@ -185,6 +220,19 @@ class GrpcShopEdge:
                             return fn(ctx, request)
                     except ServiceError as e:
                         context.abort(grpc.StatusCode.INTERNAL, str(e))
+                    except KeyError as e:
+                        if not is_flagd:
+                            # A KeyError in a business handler is a
+                            # server bug, not a missing flag — let the
+                            # framework surface INTERNAL, never a
+                            # plausible-looking NOT_FOUND.
+                            raise
+                        # flagd contract: unknown/disabled flag =
+                        # FLAG_NOT_FOUND → gRPC NOT_FOUND.
+                        context.abort(
+                            grpc.StatusCode.NOT_FOUND,
+                            f"flag not found: {e.args[0] if e.args else e}",
+                        )
                     except (wire.WireError, ValueError) as e:
                         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
@@ -446,3 +494,124 @@ class GrpcShopEdge:
         doc["flags"].pop(name, None)
         self.shop.flags.replace(doc)
         return b""
+
+    # -- flagd.evaluation.v1 (the :8013 protocol, schemas.flagd.dev) ----
+    #
+    # Request: {flag_key=1, context=2 Struct}; response: {value=1 typed,
+    # reason=2, variant=3}. targetingKey comes from the evaluation
+    # context Struct (falling back to session.id baggage, the key the
+    # demo's fractional flags bucket on). Unknown/disabled flags raise
+    # KeyError → NOT_FOUND (flagd's FLAG_NOT_FOUND); a value of the
+    # wrong type raises ValueError → INVALID_ARGUMENT (TYPE_MISMATCH).
+
+    def _resolve_typed(self, want: type, ctx, request: bytes) -> bytes:
+        f = wire.scan_fields(request)
+        key = _dec_str(f, 1)
+        raw_ctx = wire.first(f, 2, b"")
+        ectx = structpb.decode_struct(raw_ctx) if isinstance(raw_ctx, bytes) else {}
+        targeting = str(
+            ectx.get("targetingKey") or ctx.baggage.get("session.id", "")
+        )
+        value, variant, reason = self.shop.flags.resolve(key, targeting)
+        out = self._enc_resolved_value(want, key, value)
+        out += wire.encode_len(2, reason.encode())
+        out += wire.encode_len(3, variant.encode())
+        return out
+
+    @staticmethod
+    def _enc_resolved_value(want: type, key: str, value) -> bytes:
+        def mismatch():
+            return ValueError(
+                f"flag {key!r}: variant value {value!r} is not "
+                f"{want.__name__} (TYPE_MISMATCH)"
+            )
+
+        if want is bool:
+            if not isinstance(value, bool):
+                raise mismatch()
+            return wire.encode_int(1, 1) if value else b""  # proto3 default
+        if want is str:
+            if not isinstance(value, str):
+                raise mismatch()
+            return wire.encode_len(1, value.encode())
+        if want is int:
+            if isinstance(value, bool) or not isinstance(value, int):
+                raise mismatch()
+            return wire.encode_int(1, value) if value else b""
+        if want is float:
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise mismatch()
+            v = float(value)
+            # Plain (non-oneof) field: +0.0 is the proto3 default and
+            # is omitted; -0.0 has nonzero bits and must be emitted.
+            if _struct.pack("<d", v) == bytes(8):
+                return b""
+            return wire.encode_double(1, v)
+        # object: Struct value
+        if not isinstance(value, dict):
+            raise mismatch()
+        return wire.encode_len(1, structpb.encode_struct(value))
+
+    def _resolve_all(self, ctx, request: bytes) -> bytes:
+        """ResolveAll: every enabled flag as an AnyFlag{reason=1,
+        variant=2, bool=3|string=4|double=5|object=6} map entry (the
+        flagd schema has no int lane — numbers ride the double, exactly
+        like flagd itself)."""
+        f = wire.scan_fields(request)
+        raw_ctx = wire.first(f, 1, b"")
+        ectx = structpb.decode_struct(raw_ctx) if isinstance(raw_ctx, bytes) else {}
+        targeting = str(
+            ectx.get("targetingKey") or ctx.baggage.get("session.id", "")
+        )
+        out = b""
+        for key in sorted(self.shop.flags.flag_keys()):
+            try:
+                value, variant, reason = self.shop.flags.resolve(key, targeting)
+            except KeyError:
+                continue  # DISABLED flags are omitted, like flagd
+            af = wire.encode_len(1, reason.encode())
+            af += wire.encode_len(2, variant.encode())
+            # The value lanes live in a proto3 ONEOF: presence is
+            # tracked, so the chosen lane is serialized even at its
+            # default (False/0.0/"") — an off-state flag must not
+            # vanish from WhichOneof("value").
+            if isinstance(value, bool):
+                af += wire.encode_int(3, 1 if value else 0)
+            elif isinstance(value, str):
+                af += wire.encode_len(4, value.encode())
+            elif isinstance(value, (int, float)):
+                af += wire.encode_double(5, float(value))
+            elif isinstance(value, dict):
+                af += wire.encode_len(6, structpb.encode_struct(value))
+            else:
+                continue  # unmappable variant value: skip the flag
+            entry = wire.encode_len(1, key.encode()) + wire.encode_len(2, af)
+            out += wire.encode_len(1, entry)
+        return out
+
+    def _event_stream_rpc(self, request: bytes, context):
+        """flagd EventStream: provider_ready immediately, then a
+        configuration_change event per flag-store version bump (the
+        push channel OpenFeature providers re-evaluate on). Slot-
+        bounded like health Watch — an over-budget watcher gets
+        provider_ready and the stream ends (providers reconnect)."""
+        del request
+        yield self._enc_event("provider_ready", {})
+        if not self._event_watchers.acquire(blocking=False):
+            return
+        try:
+            last = self.shop.flags.version
+            while context.is_active() and not self._stop_event.wait(0.2):
+                version = self.shop.flags.version
+                if version != last:
+                    last = version
+                    yield self._enc_event("configuration_change", {})
+        finally:
+            self._event_watchers.release()
+
+    @staticmethod
+    def _enc_event(event_type: str, data: dict) -> bytes:
+        out = wire.encode_len(1, event_type.encode())
+        if data:
+            out += wire.encode_len(2, structpb.encode_struct(data))
+        return out

@@ -37,9 +37,18 @@ class FlagEvaluator:
 
     def __init__(self, doc: dict | None = None):
         self._doc = doc or {"flags": {}}
+        # Bumped on every replace(): the change signal flagd's
+        # EventStream pushes as configuration_change events.
+        self.version = 0
 
     def replace(self, doc: dict) -> None:
         self._doc = doc or {"flags": {}}
+        self.version += 1
+
+    def _refresh(self) -> None:
+        """Pre-read hook; file-backed subclasses hot-reload here so
+        EVERY public read path (resolve/evaluate/keys/specs/snapshot)
+        sees the current document, not just evaluate()."""
 
     def snapshot(self) -> dict:
         """Deep copy of the live flagd document — THE public read /
@@ -47,9 +56,11 @@ class FlagEvaluator:
         :meth:`replace` it back; nobody reaches into ``_doc``).
         JSON round-trip: the document is JSON by contract (flagd file
         schema), and this also catches non-JSON values early."""
+        self._refresh()
         return json.loads(json.dumps(self._doc))
 
     def flag_keys(self) -> list[str]:
+        self._refresh()
         return list(self._doc.get("flags", {}))
 
     def flag_spec(self, key: str) -> dict | None:
@@ -57,30 +68,50 @@ class FlagEvaluator:
         must not mutate; use :meth:`snapshot` + :meth:`replace` to
         write. Safe concurrently: ``replace`` swaps the whole document
         reference atomically."""
+        self._refresh()
         spec = self._doc.get("flags", {}).get(key)
         return spec if isinstance(spec, dict) else None
 
     def flag_specs(self) -> dict:
         """READ-ONLY view of the live flags mapping (same contract as
         :meth:`flag_spec`)."""
+        self._refresh()
         return self._doc.get("flags", {})
 
     def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
         """Return the flag's value, or ``default`` if absent/disabled."""
+        try:
+            value, _variant, _reason = self.resolve(key, targeting_key)
+        except KeyError:
+            return default
+        return value
+
+    def resolve(self, key: str, targeting_key: str = "") -> tuple:
+        """Full resolution: ``(value, variant_name, reason)``.
+
+        The flagd evaluation contract (schemas.flagd.dev): raises
+        ``KeyError`` for a flag that is absent, DISABLED, or whose
+        selected variant does not exist — the cases flagd answers with
+        FLAG_NOT_FOUND. Reason is ``TARGETING_MATCH`` when a fractional
+        rule picked the variant, ``STATIC`` otherwise.
+        """
+        self._refresh()
         flag = self._doc.get("flags", {}).get(key)
         if not isinstance(flag, dict):
-            return default
+            raise KeyError(key)
         if str(flag.get("state", "ENABLED")).upper() == "DISABLED":
-            return default
+            raise KeyError(key)
         variants = flag.get("variants", {})
         variant = flag.get("defaultVariant")
+        reason = "STATIC"
         targeting = flag.get("targeting") or {}
         frac = targeting.get("fractional")
         if isinstance(frac, list) and frac:
             variant = self._fractional(key, frac, targeting_key, variant)
-        if variant in variants:
-            return variants[variant]
-        return default
+            reason = "TARGETING_MATCH"
+        if variant not in variants:
+            raise KeyError(key)
+        return variants[variant], str(variant), reason
 
     @staticmethod
     def _fractional(
@@ -133,9 +164,11 @@ class FlagFileStore(FlagEvaluator):
                 # flagd-ui rewrites the file in place.
                 pass
 
-    def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
+    def _refresh(self) -> None:
+        # The base class calls this before EVERY public read
+        # (resolve/evaluate/keys/specs/snapshot), so a file edit is
+        # visible on the next read of any kind, not just evaluate().
         self._maybe_reload()
-        return super().evaluate(key, default, targeting_key)
 
 
 class OfrepClient:

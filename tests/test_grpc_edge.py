@@ -413,3 +413,183 @@ def _conv_req(pb2):
     req = pb2.CurrencyConversionRequest(to_code="EUR")
     getattr(req, "from").CopyFrom(pb2.Money(currency_code="USD", units=10))
     return req
+
+
+# --- flagd.evaluation.v1 (the :8013 protocol) -------------------------
+
+FLAGD_PROTO = '''syntax = "proto3";
+package flagd.evaluation.v1;
+import "google/protobuf/struct.proto";
+message ResolveBooleanRequest { string flag_key = 1; google.protobuf.Struct context = 2; }
+message ResolveBooleanResponse { bool value = 1; string reason = 2; string variant = 3; }
+message ResolveStringRequest { string flag_key = 1; google.protobuf.Struct context = 2; }
+message ResolveStringResponse { string value = 1; string reason = 2; string variant = 3; }
+message ResolveFloatRequest { string flag_key = 1; google.protobuf.Struct context = 2; }
+message ResolveFloatResponse { double value = 1; string reason = 2; string variant = 3; }
+message ResolveIntRequest { string flag_key = 1; google.protobuf.Struct context = 2; }
+message ResolveIntResponse { int64 value = 1; string reason = 2; string variant = 3; }
+message ResolveObjectRequest { string flag_key = 1; google.protobuf.Struct context = 2; }
+message ResolveObjectResponse { google.protobuf.Struct value = 1; string reason = 2; string variant = 3; }
+message ResolveAllRequest { google.protobuf.Struct context = 1; }
+message AnyFlag {
+  string reason = 1;
+  string variant = 2;
+  oneof value {
+    bool bool_value = 3;
+    string string_value = 4;
+    double double_value = 5;
+    google.protobuf.Struct object_value = 6;
+  }
+}
+message ResolveAllResponse { map<string, AnyFlag> flags = 1; }
+message EventStreamRequest {}
+message EventStreamResponse { string type = 1; google.protobuf.Struct data = 2; }
+service Service {
+  rpc ResolveBoolean(ResolveBooleanRequest) returns (ResolveBooleanResponse);
+  rpc ResolveString(ResolveStringRequest) returns (ResolveStringResponse);
+  rpc ResolveFloat(ResolveFloatRequest) returns (ResolveFloatResponse);
+  rpc ResolveInt(ResolveIntRequest) returns (ResolveIntResponse);
+  rpc ResolveObject(ResolveObjectRequest) returns (ResolveObjectResponse);
+  rpc ResolveAll(ResolveAllRequest) returns (ResolveAllResponse);
+  rpc EventStream(EventStreamRequest) returns (stream EventStreamResponse);
+}
+'''
+
+
+@pytest.fixture(scope="module")
+def flagd_pb2(tmp_path_factory):
+    out = tmp_path_factory.mktemp("flagd_gen")
+    proto_dir = out / "proto"
+    proto_dir.mkdir()
+    (proto_dir / "flagd.proto").write_text(FLAGD_PROTO)
+    subprocess.run(
+        ["protoc", "--python_out", str(out), "proto/flagd.proto"],
+        check=True, cwd=out,
+    )
+    sys.path.insert(0, str(out / "proto"))
+    try:
+        import flagd_pb2 as mod
+
+        yield mod
+    finally:
+        sys.path.remove(str(out / "proto"))
+        sys.modules.pop("flagd_pb2", None)
+
+
+def _flagd_stub(edge, flagd_pb2, method, req_cls, resp_cls, stream=False):
+    channel = grpc.insecure_channel(f"127.0.0.1:{edge.port}")
+    kind = channel.unary_stream if stream else channel.unary_unary
+    return kind(
+        f"/flagd.evaluation.v1.Service/{method}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+def test_flagd_typed_resolvers(edge, flagd_pb2):
+    shop = edge.shop
+    shop.set_flag("boolFlag", True)
+    shop.set_flag("stringFlag", "blue")
+    shop.set_flag("intFlag", 40)
+    shop.set_flag("floatFlag", 0.25)
+    shop.set_flag("objFlag", {"limit": 3, "mode": "slow"})
+
+    rb = _flagd_stub(edge, flagd_pb2, "ResolveBoolean",
+                     flagd_pb2.ResolveBooleanRequest,
+                     flagd_pb2.ResolveBooleanResponse)
+    resp = rb(flagd_pb2.ResolveBooleanRequest(flag_key="boolFlag"), timeout=5)
+    assert resp.value is True and resp.variant == "on"
+    assert resp.reason == "STATIC"
+
+    rs = _flagd_stub(edge, flagd_pb2, "ResolveString",
+                     flagd_pb2.ResolveStringRequest,
+                     flagd_pb2.ResolveStringResponse)
+    assert rs(flagd_pb2.ResolveStringRequest(flag_key="stringFlag"),
+              timeout=5).value == "blue"
+
+    ri = _flagd_stub(edge, flagd_pb2, "ResolveInt",
+                     flagd_pb2.ResolveIntRequest,
+                     flagd_pb2.ResolveIntResponse)
+    assert ri(flagd_pb2.ResolveIntRequest(flag_key="intFlag"),
+              timeout=5).value == 40
+
+    rf = _flagd_stub(edge, flagd_pb2, "ResolveFloat",
+                     flagd_pb2.ResolveFloatRequest,
+                     flagd_pb2.ResolveFloatResponse)
+    assert rf(flagd_pb2.ResolveFloatRequest(flag_key="floatFlag"),
+              timeout=5).value == 0.25
+
+    ro = _flagd_stub(edge, flagd_pb2, "ResolveObject",
+                     flagd_pb2.ResolveObjectRequest,
+                     flagd_pb2.ResolveObjectResponse)
+    obj = ro(flagd_pb2.ResolveObjectRequest(flag_key="objFlag"), timeout=5)
+    from google.protobuf.json_format import MessageToDict
+
+    assert MessageToDict(obj.value) == {"limit": 3.0, "mode": "slow"}
+
+
+def test_flagd_error_contract(edge, flagd_pb2):
+    rb = _flagd_stub(edge, flagd_pb2, "ResolveBoolean",
+                     flagd_pb2.ResolveBooleanRequest,
+                     flagd_pb2.ResolveBooleanResponse)
+    # Unknown flag → NOT_FOUND (flagd FLAG_NOT_FOUND).
+    with pytest.raises(grpc.RpcError) as exc:
+        rb(flagd_pb2.ResolveBooleanRequest(flag_key="nope"), timeout=5)
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+    # Wrong type → INVALID_ARGUMENT (flagd TYPE_MISMATCH).
+    edge.shop.set_flag("intFlag2", 7)
+    with pytest.raises(grpc.RpcError) as exc:
+        rb(flagd_pb2.ResolveBooleanRequest(flag_key="intFlag2"), timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_flagd_resolve_all_and_event_stream(edge, flagd_pb2):
+    import threading
+    import time as _time
+
+    shop = edge.shop
+    shop.set_flag("allBool", True)
+    shop.set_flag("allNum", 5)
+    ra = _flagd_stub(edge, flagd_pb2, "ResolveAll",
+                     flagd_pb2.ResolveAllRequest,
+                     flagd_pb2.ResolveAllResponse)
+    resp = ra(flagd_pb2.ResolveAllRequest(), timeout=5)
+    assert resp.flags["allBool"].bool_value is True
+    # flagd's AnyFlag has no int lane: numbers ride the double.
+    assert resp.flags["allNum"].double_value == 5.0
+
+    es = _flagd_stub(edge, flagd_pb2, "EventStream",
+                     flagd_pb2.EventStreamRequest,
+                     flagd_pb2.EventStreamResponse, stream=True)
+    stream = es(flagd_pb2.EventStreamRequest(), timeout=30)
+    events = []
+
+    def consume():
+        try:
+            for ev in stream:
+                events.append(ev.type)
+                if "configuration_change" in events:
+                    stream.cancel()
+                    return
+        except grpc.RpcError:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = _time.monotonic() + 5
+    while "provider_ready" not in events and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert events[:1] == ["provider_ready"]
+    # A flag write is the configuration_change push.
+    shop.set_flag("allBool", False, variants={"off": False})
+    deadline = _time.monotonic() + 5
+    while "configuration_change" not in events and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    t.join(timeout=5)
+    assert "configuration_change" in events
+    # An OFF flag must still carry its oneof lane (proto3 oneof tracks
+    # presence even at default False) — off-state flags cannot vanish
+    # from bulk resolution.
+    resp2 = ra(flagd_pb2.ResolveAllRequest(), timeout=5)
+    assert resp2.flags["allBool"].WhichOneof("value") == "bool_value"
+    assert resp2.flags["allBool"].bool_value is False

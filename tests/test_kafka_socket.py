@@ -106,6 +106,44 @@ def test_consumer_group_offsets_survive_reconnect(broker):
     c3.close()
 
 
+def test_multi_partition_produce_fetch_and_offsets():
+    """Sharded ingestion (SURVEY §2.3 consumer groups → per-partition
+    streams): a 3-partition topic — partition-targeted produces, one
+    consumer assigned ALL partitions via Metadata, per-partition
+    committed offsets, per-partition seek replay."""
+    b = KafkaBroker(num_partitions=3)
+    b.start()
+    try:
+        producer = KafkaProducer(_addr(b))
+        for p in range(3):
+            for i in range(2):
+                producer.send("orders", f"p{p}m{i}".encode(), partition=p)
+        consumer = KafkaConsumer(_addr(b), "g1", "orders")
+        msgs = consumer.poll()
+        assert len(msgs) == 6
+        by_part = {}
+        for m in msgs:
+            by_part.setdefault(m.partition, []).append(m.value)
+        assert by_part == {
+            0: [b"p0m0", b"p0m1"],
+            1: [b"p1m0", b"p1m1"],
+            2: [b"p2m0", b"p2m1"],
+        }
+        # Offsets committed per partition on the broker.
+        for p in range(3):
+            assert b.committed("g1", "orders", p) == 2
+        # Per-partition seek: replay only partition 1.
+        consumer.seek(1, 0)
+        replay = consumer.poll()
+        assert [(m.partition, m.value) for m in replay] == [
+            (1, b"p1m0"), (1, b"p1m1"),
+        ]
+        producer.close()
+        consumer.close()
+    finally:
+        b.stop()
+
+
 def test_two_groups_are_independent(broker):
     # The reference runs fraud-detection AND accounting as independent
     # groups on one topic (SURVEY §2.1) — each sees every message.

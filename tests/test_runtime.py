@@ -276,6 +276,29 @@ class TestFlags:
         os.utime(path, (time.time() + 5, time.time() + 5))
         assert store.evaluate("anomalyDetectorEnabled", True) is False
 
+    def test_file_store_resolve_and_keys_hot_reload(self, tmp_path):
+        """EVERY read path hot-reloads, not just evaluate(): resolve()
+        (the flagd gRPC surface) and flag_keys() (ResolveAll) must see
+        file edits, and the version counter must bump (the EventStream
+        configuration_change signal)."""
+        path = tmp_path / "flags.json"
+        path.write_text(json.dumps(self.DOC))
+        store = FlagFileStore(str(path))
+        value, variant, reason = store.resolve("anomalyDetectorEnabled")
+        assert value is True and reason == "STATIC"
+        v0 = store.version
+        doc2 = json.loads(json.dumps(self.DOC))
+        doc2["flags"]["anomalyDetectorEnabled"]["defaultVariant"] = "off"
+        doc2["flags"]["newFlag"] = {
+            "state": "ENABLED", "variants": {"on": 1}, "defaultVariant": "on",
+        }
+        path.write_text(json.dumps(doc2))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        value, _, _ = store.resolve("anomalyDetectorEnabled")
+        assert value is False
+        assert "newFlag" in store.flag_keys()
+        assert store.version > v0
+
     def test_file_store_survives_torn_write(self, tmp_path):
         path = tmp_path / "flags.json"
         path.write_text(json.dumps(self.DOC))
