@@ -204,6 +204,19 @@ def main():
     fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
 
+    # ---- stress config (BASELINE #4: 10× the Locust profile) ---------
+    # Same methodology at 10× the rate with the async harvester (the
+    # stress deployment shape); paired-RTT fields ride along.
+    stress = {}
+    if os.environ.get("BENCH_LAG_STRESS", "1") != "0":
+        from opentelemetry_demo_tpu.runtime.lagbench import (
+            measure_lag as run_lag,
+        )
+
+        stress = run_lag(
+            rate=20_000.0, seconds=6.0, batch=1024, harvest_async=True
+        )
+
     print(
         json.dumps(
             {
@@ -227,6 +240,10 @@ def main():
                 "lag_rtt_pairs": lag.get("rtt_pairs"),
                 "lag_rate_spans_per_sec": lag["rate"],
                 "lag_batches": lag["batches"],
+                "lag_stress_p99_ms": stress.get("p99_ms"),
+                "lag_stress_p99_net_ms": stress.get("p99_net_ms"),
+                "lag_stress_rate_spans_per_sec": stress.get("rate"),
+                "lag_stress_reports_skipped": stress.get("reports_skipped"),
                 "fetch_rtt_ms": fetch_rtt_ms,
                 "sketch_impl_matrix": matrix,
                 "lag_note": (

@@ -46,6 +46,20 @@ from .windows import WindowClock
 HH_QUERY_CAP = 16384
 
 
+def hh_sample_indices(b_total: int, bq: int) -> np.ndarray:
+    """Evenly-distributed candidate indices: ``(i·B)//BQ`` for i<BQ.
+
+    Host-side int64 on purpose: both sizes are static, and an int32
+    DEVICE product ``i·B`` overflows from i=4096 at B=512k — wrapping
+    negative and silently unsampling the middle of the batch. The
+    result covers [0, B) end to end (no unsampled tail) and is strictly
+    increasing whenever BQ ≤ B.
+    """
+    return (
+        np.arange(bq, dtype=np.int64) * b_total // bq
+    ).astype(np.int32)
+
+
 class DetectorConfig(NamedTuple):
     """Static shape/threshold configuration (closed over at jit time).
 
@@ -442,14 +456,13 @@ def detector_step(
     b_total = svc.shape[0]
     bq = min(b_total, HH_QUERY_CAP)
     if bq < b_total:
-        # Evenly-distributed sample indices over the WHOLE batch:
-        # (i·B)//BQ, not i·(B//BQ) — floor-division stride would leave
-        # the batch tail permanently unsampled whenever B is not a
-        # multiple of the cap (a late-arriving hot burst would be
-        # systematically invisible).
-        q_idx = (
-            jnp.arange(bq, dtype=jnp.int32) * b_total // bq
-        ).astype(jnp.int32)
+        # Evenly-distributed sample indices over the WHOLE batch,
+        # computed by hh_sample_indices: (i·B)//BQ in HOST int64 — a
+        # floor-division stride would leave the batch tail permanently
+        # unsampled whenever B is not a multiple of the cap, and an
+        # int32 device product i·B wraps negative from i=4096 at
+        # B=512k, silently unsampling the middle half of the batch.
+        q_idx = jnp.asarray(hh_sample_indices(b_total, bq))
         q_svc = svc[q_idx]
         q_valid = valid_f[q_idx]
         q_cidx = cidx[:, q_idx]
@@ -460,29 +473,29 @@ def detector_step(
     counts = comm.pmin_sketch(
         jax.vmap(cms.cms_query, in_axes=(0, None))(cms_bank[:, 0], q_cidx)
     ).astype(jnp.float32)  # [W#, BQ]
-    # Per-service max, chunked over the batch: a single dense
-    # [W#, B, S] one-hot product would materialise ~200 MB of HBM at
-    # B=512k, and a scatter-max serializes on duplicate service ids
+    # Per-service max, chunked over the CANDIDATE set (≤ the cap): a
+    # single dense [W#, BQ, S] one-hot product could still materialise
+    # tens of MB, and a scatter-max serializes on duplicate service ids
     # (a span batch is nothing but duplicates). The scan sweeps the
-    # batch in fixed chunks — each step's [W#, chunk, S] intermediate
-    # is a few MB of dense VPU work — and max-accumulates.
+    # candidates in fixed chunks — each step's [W#, chunk, S]
+    # intermediate is a few MB of dense VPU work — and max-accumulates.
     nw = counts.shape[0]
-    b_total = bq
-    chunk = min(b_total, 8192)
+    b_q = bq  # candidate count, NOT the batch total
+    chunk = min(b_q, 8192)
     masked = counts * q_valid[None, :]
     hh_svc = q_svc
-    pad = (-b_total) % chunk  # static
+    pad = (-b_q) % chunk  # static
     if pad:
         # Pad to a chunk multiple: padding lanes carry svc == s_axis
         # (all-zero one-hot row) and zero counts — max identities.
         masked = jnp.pad(masked, ((0, 0), (0, pad)))
         hh_svc = jnp.pad(hh_svc, (0, pad), constant_values=s_axis)
-    if chunk == b_total + pad:
+    if chunk == b_q + pad:
         col = jax.lax.broadcasted_iota(jnp.int32, (chunk, s_axis), 1)
         onehot = (col == hh_svc[:, None]).astype(jnp.float32)
         local_max = jnp.max(masked[:, :, None] * onehot[None, :, :], axis=1)
     else:
-        n_chunks = (b_total + pad) // chunk
+        n_chunks = (b_q + pad) // chunk
 
         def hh_chunk(acc, xs):
             cnt_c, svc_c = xs  # [W#, chunk], [chunk]

@@ -600,15 +600,9 @@ class GrpcShopEdge:
         if not self._event_watchers.acquire(blocking=False):
             return
         try:
-            last = self.shop.flags.version
+            last = self.shop.flags.poll_version()
             while context.is_active() and not self._stop_event.wait(0.2):
-                # A refreshing read first: file-backed stores only bump
-                # version inside their reload hook, which runs on read
-                # paths — polling the bare attribute would miss
-                # file-only writes (the flag-editor's file branch)
-                # whenever no other reader is active.
-                self.shop.flags.flag_keys()
-                version = self.shop.flags.version
+                version = self.shop.flags.poll_version()
                 if version != last:
                     last = version
                     yield self._enc_event("configuration_change", {})

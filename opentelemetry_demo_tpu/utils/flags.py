@@ -50,6 +50,14 @@ class FlagEvaluator:
         EVERY public read path (resolve/evaluate/keys/specs/snapshot)
         sees the current document, not just evaluate()."""
 
+    def poll_version(self) -> int:
+        """Refresh, then return the document version — THE way to watch
+        for changes (flagd EventStream et al). Reading the bare
+        ``version`` attribute skips the file-store reload hook and
+        misses file-only writes."""
+        self._refresh()
+        return self.version
+
     def snapshot(self) -> dict:
         """Deep copy of the live flagd document — THE public read /
         copy-for-write surface (callers mutate the copy and

@@ -247,3 +247,24 @@ class TestHeavyHitterSampling:
         ratio = float(np.asarray(report.hh_ratio)[svc_id, 0])
         # ~60% share, CMS over-count tolerance upward.
         assert 0.5 < ratio < 1.2, ratio
+
+    def test_sample_indices_cover_full_batch_at_512k(self):
+        """The index math must hold in the overflow regime: an int32
+        device product i*B wraps from i=4096 at B=512k, which would
+        silently unsample the middle half of the batch. Host int64
+        computation covers [0, B) end to end, strictly increasing."""
+        from opentelemetry_demo_tpu.models.detector import (
+            HH_QUERY_CAP,
+            hh_sample_indices,
+        )
+
+        for b in (524288, 1 << 20, HH_QUERY_CAP + 1, 3 * HH_QUERY_CAP - 7):
+            idx = hh_sample_indices(b, min(b, HH_QUERY_CAP))
+            assert idx.dtype == np.int32
+            assert idx[0] == 0 and 0 <= idx[-1] < b
+            assert (np.diff(idx.astype(np.int64)) > 0).all(), b
+            # Even coverage: largest gap within 1 of the ideal stride.
+            gaps = np.diff(idx.astype(np.int64))
+            assert gaps.max() <= b // min(b, HH_QUERY_CAP) + 1, b
+            # No region longer than ~2 strides unsampled at the ends.
+            assert b - idx[-1] <= b // min(b, HH_QUERY_CAP) + 1, b
