@@ -1,4 +1,18 @@
-"""Component-level timing of detector_step to locate fixed per-step cost."""
+"""Component-level timing of detector_step to locate fixed per-step cost.
+
+SLOPE timing with a device→host fetch terminating every region — the
+only honest method on this repo's tunneled topology, where
+``block_until_ready`` can return before device compute completes (the
+r3 bisection found a 14 ms CMS gather this way; the old
+block_until_ready version of this script reported every component as
+~100 µs of dispatch cost). Variants chain a donated state so XLA cannot
+dead-code-eliminate the part under test — note the r3 lesson: a variant
+whose CMS delta is unused gets the whole histogram sort DCE'd and reads
+8 ms too fast.
+
+Usage: python scripts/profile_step.py [B]   (default 524288; real TPU)
+"""
+
 import sys
 import time
 from functools import partial
@@ -10,79 +24,85 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from opentelemetry_demo_tpu.models import DetectorConfig, detector_init, detector_step
-from opentelemetry_demo_tpu.ops import cms, ewma, hll
+from opentelemetry_demo_tpu.models import (
+    DetectorConfig,
+    detector_init,
+    detector_step,
+)
+from opentelemetry_demo_tpu.ops import cms, fused
 from bench import BASELINE_SPANS_PER_SEC, make_batch_pool
 
 config = DetectorConfig()
-B = 2048
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 524288
 rng = np.random.default_rng(0)
-pool = make_batch_pool(config, B, 4, rng)
-state = detector_init(config)
-
-
-def timeit(name, fn, *args, iters=200):
-    jfn = jax.jit(fn)
-    out = jfn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = jfn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    print(f"{name:30s} {dt*1e6:9.1f} us")
-    return dt
-
-
-svc, lat_us, is_error, trace_hi, trace_lo, attr_hi, attr_lo, valid = pool[0]
+pool = make_batch_pool(config, B, 2, rng)
 dt = jnp.float32(B / BASELINE_SPANS_PER_SEC)
-rot = jnp.asarray([False, False, False])
-rot_t = jnp.asarray([True, False, False])
-
-# Full step
-step = partial(detector_step, config)
-timeit("full step (no rotate)", step, state, *pool[0], dt, rot)
-timeit("full step (rotate w0)", step, state, *pool[0], dt, rot_t)
-
-# Components
-hll_bank = state.hll_bank
-cms_bank = state.cms_bank
+mask = jnp.asarray([False] * len(config.windows_s))
 
 
-def f_hll(bank, th, tl, s, v):
-    bucket, rank = hll.hll_indices(th, tl, p=config.hll_p)
-    upd = jax.vmap(hll.hll_update, in_axes=(0, None, None, None, None))
-    return bank.at[:, 0].set(upd(bank[:, 0], s, bucket, rank, v))
+def slope(name, fn, iters=20):
+    """Per-step seconds of a state-chained fn(state, *batch, dt, mask)."""
+    jfn = jax.jit(fn, donate_argnums=0)
+
+    def region(k, st):
+        t0 = time.perf_counter()
+        for i in range(k):
+            st, _ = jfn(st, *pool[i % 2], dt, mask)
+        _ = float(np.asarray(st.step_idx))  # fetch forces the chain
+        return time.perf_counter() - t0, st
+
+    st = detector_init(config)
+    _, st = region(3, st)
+    t1, st = region(iters, st)
+    t2, st = region(3 * iters, st)
+    per = (t2 - t1) / (2 * iters)
+    print(f"{name:34s} {per*1e3:8.2f} ms   {B/per/1e6:7.1f}M spans/s")
+    return per
 
 
-def f_cms(bank, ah, al, v):
-    cidx = cms.cms_indices(ah, al, config.cms_depth, config.cms_width)
-    upd = jax.vmap(cms.cms_update, in_axes=(0, None, None, None))
-    return bank.at[:, 0].set(upd(bank[:, 0], cidx, None, v))
+full = slope("full step", partial(detector_step, config))
 
 
-def f_est(bank):
-    return hll.hll_estimate(bank[:, 0])
+def make_delta(use_cms: bool):
+    """Delta-only step variant; ``use_cms=False`` leaves the CMS delta
+    unused so XLA DCE's its histogram sort — the gap between the two
+    variants IS the sort's cost. ONE body builds both so they cannot
+    silently measure different computations.
+
+    impl is FORCED to "xla": a pallas_call is opaque to XLA, so
+    dropping the cms output would NOT eliminate the CMS work inside
+    the fused kernel and the subtraction would read ~0. (The dense
+    kernel has no sort to isolate anyway — this decomposition is a
+    property of the xla path.)
+    """
+
+    def fn(st, svc, lat_us, is_error, hi, lo, ahi, alo, valid, dt, mask):
+        log_lat = jnp.log1p(jnp.maximum(lat_us, 0.0))
+        cidx = cms.cms_indices(ahi, alo, config.cms_depth, config.cms_width)
+        d = fused.sketch_batch_delta(
+            svc.astype(jnp.int32), log_lat, is_error, hi, lo, cidx, valid,
+            num_services=config.num_services, hll_p=config.hll_p,
+            cms_width=config.cms_width, impl="xla",
+        )
+        st = st._replace(
+            hll_bank=st.hll_bank.at[:, 0].set(
+                jnp.maximum(st.hll_bank[:, 0], d.hll[None])
+            ),
+            obs_batches=st.obs_batches + d.stats[0],
+            step_idx=st.step_idx + 1,
+        )
+        if use_cms:
+            st = st._replace(
+                cms_bank=st.cms_bank.at[:, 0].set(
+                    st.cms_bank[:, 0] + d.cms[None]
+                )
+            )
+        return st, None
+
+    return fn
 
 
-def f_rot(bank, mask):
-    rolled = jnp.stack([jnp.zeros_like(bank[:, 0]), bank[:, 0]], axis=1)
-    m = mask.reshape((-1,) + (1,) * (bank.ndim - 1))
-    return jnp.where(m, rolled, bank)
-
-
-def f_seg(lat, s, v):
-    return ewma.segment_stats(jnp.log1p(lat), s, config.num_services, valid=v)
-
-
-def f_cmsq(bank, ah, al):
-    cidx = cms.cms_indices(ah, al, config.cms_depth, config.cms_width)
-    return jax.vmap(cms.cms_query, in_axes=(0, None))(bank[:, 0], cidx)
-
-
-timeit("hll scatter-max (3 win)", f_hll, hll_bank, trace_hi, trace_lo, svc, valid)
-timeit("cms scatter-add (3 win)", f_cms, cms_bank, attr_hi, attr_lo, valid)
-timeit("hll estimate (3 win)", f_est, hll_bank)
-timeit("rotate hll bank", f_rot, hll_bank, rot_t)
-timeit("segment stats", f_seg, lat_us, svc, valid)
-timeit("cms query (3 win)", f_cmsq, cms_bank, attr_hi, attr_lo)
+delta = slope("delta xla (hll+cms+stats, used)", make_delta(use_cms=True))
+no_cms = slope("delta xla w/o cms hist (DCE'd)", make_delta(use_cms=False))
+print(f"{'-> cms histogram sort (xla)':34s} {(delta - no_cms)*1e3:8.2f} ms")
+print(f"{'-> rest (queries/heads/report)':34s} {(full - delta)*1e3:8.2f} ms")

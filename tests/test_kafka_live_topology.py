@@ -311,7 +311,7 @@ def test_flood_flag_lights_detector_through_the_broker(kafka_topology):
     # past its z warmup (40 observed batches) — the burst must be
     # scored against a SETTLED baseline, not absorbed into a warming
     # one. Each checkout is one record, and at this pacing one batch.
-    deadline = time.monotonic() + 240.0
+    deadline = time.monotonic() + 360.0
     ingested = 0.0
     i = 0
     while time.monotonic() < deadline:
@@ -342,7 +342,7 @@ def test_flood_flag_lights_detector_through_the_broker(kafka_topology):
     assert status == 200
 
     flagged = False
-    deadline = time.monotonic() + 120.0
+    deadline = time.monotonic() + 240.0
     j = 0
     while time.monotonic() < deadline and not flagged:
         _checkout_http(shop, f"flood-{j}")
