@@ -90,28 +90,140 @@ def cms_update_hist(
     table: jnp.ndarray,
     idx: jnp.ndarray,
     valid: jnp.ndarray | None = None,
+    impl: str | None = None,
 ) -> jnp.ndarray:
-    """Scatter-free unit-weight batch count: sort + searchsorted.
+    """Scatter-free unit-weight batch count.
 
     Semantically identical to :func:`cms_update` with ``weight=None``.
     TPU scatters serialize on duplicate indices, and a CMS batch is
-    nothing but duplicates (B ≫ W); a histogram computed as
-    ``diff(searchsorted(sort(ids), bin_edges))`` avoids scatters
-    entirely — measured ~2× faster at B=512k, D=4, W=8192 on v5e-1
-    (7.3 ms vs 14.2 ms), which matters because the CMS update dominates
-    the large-batch detector step. 2-D tables only (the delta path);
-    invalid lanes sort past the last edge and fall out of the counts.
+    nothing but duplicates (B ≫ W), so the histogram is computed
+    scatter-free. Two interchangeable engines (bit-exact, both tested):
+
+    - ``"mxu"`` (TPU default when the table fits 16-bit keys and the
+      batch tiles evenly): the one-hot OUTER-PRODUCT histogram — each
+      flat key splits into (hi, lo) bytes, a Pallas kernel builds
+      [TB, 256] one-hots for both halves IN VMEM and contracts them on
+      the MXU into a [HI, 256] f32 count matrix
+      (``count[hi, lo] = Σ_b 1[hi_b=hi]·1[lo_b=lo]``). Counts ≤ B ≪ 2²⁴
+      so f32 accumulation is exact. Measured v5e-1, D=4 W=8192 B=512k:
+      **~3.9 ms vs 7.3 ms** for the sort engine (the XLA-level version
+      of the same trick stays at ~7.5 ms because its 32 MB one-hot
+      tiles round-trip HBM; VMEM residency is the win — the residual
+      gap to the ~0.7 ms MXU FLOP bound is one-hot construction and
+      the skinny [TB, HI] operand).
+    - ``"sort"``: ``diff(searchsorted(sort(ids), edges))`` — the
+      engine everywhere the kernel can't run (CPU tests, odd
+      geometries), and itself ~2× over the scatter at large B.
+
+    2-D tables only (the delta path); invalid lanes carry key ``d·w``,
+    one past the counted range, and fall out of either engine.
     """
     d, w = table.shape
     row_offset = jnp.arange(d, dtype=jnp.int32)[:, None] * w
     flat_idx = idx + row_offset
     if valid is not None:
+        # Invalid lanes take key d·w — one past the counted range: the
+        # sort engine's edge sweep stops before it, and the mxu engine
+        # gives it a dedicated overflow row that is then dropped.
         flat_idx = jnp.where(valid[None, :], flat_idx, d * w)
-    s = jnp.sort(flat_idx.reshape(-1))
-    edges = jnp.arange(d * w + 1, dtype=flat_idx.dtype)
-    cuts = jnp.searchsorted(s, edges)
-    counts = (cuts[1:] - cuts[:-1]).astype(table.dtype)
+    flat = flat_idx.reshape(-1)
+    if impl is None:
+        impl = "mxu" if _mxu_hist_usable(d * w, flat.shape[0]) else "sort"
+    if impl == "mxu":
+        counts = _hist_mxu(flat, d * w).astype(table.dtype)
+    else:
+        s = jnp.sort(flat)
+        edges = jnp.arange(d * w + 1, dtype=s.dtype)
+        cuts = jnp.searchsorted(s, edges)
+        counts = (cuts[1:] - cuts[:-1]).astype(table.dtype)
     return table + counts.reshape(d, w)
+
+
+_HIST_TILE = 32768  # keys per MXU-histogram grid step (VMEM-resident)
+
+
+def _mxu_hist_usable(n_bins: int, n_keys: int) -> bool:
+    import jax
+
+    return (
+        jax.default_backend() == "tpu"
+        # (hi, lo) byte split: bins + the invalid-lane sentinel must
+        # fit 16-bit keys, and bins must fill whole 256-wide lo rows.
+        and n_bins + 1 <= 65536
+        and n_bins % 256 == 0
+        # the kernel tiles the key axis; a partial tile would need a
+        # second masked pass — keys are D·B with B a power of two in
+        # every real config, so just fall back otherwise.
+        and n_keys % _HIST_TILE == 0
+    )
+
+
+def _hist_mxu_kernel(keys_ref, out_ref):
+    """One grid step: [TB] keys → one-hot halves in VMEM → MXU
+    contraction accumulated into the [HI, 256] count block. (A
+    separate validity-mask input measured ~2× slower than letting the
+    sentinel key ride an extra hi row, so invalid lanes stay key
+    ``n_bins``, counted into a row the host slices off.)"""
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    first = pl.program_id(0) == 0
+    k = keys_ref[:]  # [TB, 1] int32
+    n_hi = out_ref.shape[0]
+    iota_hi = lax.broadcasted_iota(jnp.int32, (1, n_hi), 1)
+    iota_lo = lax.broadcasted_iota(jnp.int32, (1, 256), 1)
+    oh_hi = ((k >> 8) == iota_hi).astype(jnp.bfloat16)  # [TB, HI]
+    oh_lo = ((k & 255) == iota_lo).astype(jnp.bfloat16)  # [TB, 256]
+    tile = lax.dot_general(
+        oh_hi, oh_lo, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [HI, 256]
+    prev = jnp.where(first, 0.0, out_ref[:])
+    out_ref[:] = prev + tile
+
+
+def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """Exact histogram of int32 keys in [0, n_bins] → counts[n_bins]
+    (the sentinel bin n_bins is dropped). See cms_update_hist."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = flat.shape[0]
+    if n == 0 or n % _HIST_TILE:
+        # The grid would silently truncate (or never write the output
+        # block): a forced impl="mxu" at a non-tileable key count must
+        # be an error, not wrong counts. Auto-select gates on this same
+        # condition (_mxu_hist_usable).
+        raise ValueError(
+            f"mxu histogram needs a key count that is a nonzero "
+            f"multiple of {_HIST_TILE}; got {n} (use impl='sort')"
+        )
+    # hi covers the sentinel row too: bins occupy hi < n_bins//256;
+    # the sentinel key n_bins lands at (n_bins >> 8, 0) one row past.
+    n_hi = n_bins // 256 + 1
+    vma = jax.typeof(flat).vma
+
+    counts2d = pl.pallas_call(
+        _hist_mxu_kernel,
+        grid=(n // _HIST_TILE,),
+        # [TB, 256]+[TB, HI] bf16 one-hots double-buffered exceed the
+        # default 16 MiB scoped-VMEM budget from TB=16k; v5e has 128 MiB.
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_hi, 256), jnp.float32, vma=vma),
+        in_specs=[
+            pl.BlockSpec(
+                (_HIST_TILE, 1), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (n_hi, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+    )(flat.reshape(n, 1))
+    return counts2d.reshape(-1)[:n_bins].astype(jnp.int32)
 
 
 def cms_query(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:

@@ -174,6 +174,31 @@ class TestCMS:
             np.asarray(cms_update(cms_init(DEPTH, WIDTH), idx)),
         )
 
+    def test_hist_mxu_engine_matches_sort(self, rng):
+        """The MXU one-hot outer-product engine is bit-exact against
+        the sort engine (full kernel on TPU; interpret-free CPU runs
+        auto-select sort, so here the selection logic is what's pinned,
+        and the TPU equality runs wherever a TPU is attached)."""
+        import jax
+
+        from opentelemetry_demo_tpu.ops import cms as cms_mod
+
+        # Auto-select: never "mxu" off-TPU; geometry gates respected.
+        if jax.default_backend() != "tpu":
+            assert not cms_mod._mxu_hist_usable(DEPTH * WIDTH, 2 * 32768)
+            return
+        n = 2 * cms_mod._HIST_TILE // DEPTH
+        h64, hi, lo = _hashes(rng, n)
+        idx = cms_indices(hi, lo, DEPTH, WIDTH)
+        valid = jnp.asarray(rng.integers(0, 2, size=n).astype(bool))
+        a = cms_mod.cms_update_hist(
+            cms_init(DEPTH, WIDTH), idx, valid=valid, impl="sort"
+        )
+        b = cms_mod.cms_update_hist(
+            cms_init(DEPTH, WIDTH), idx, valid=valid, impl="mxu"
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_weights_and_mask(self, rng):
         h64, hi, lo = _hashes(rng, 100)
         idx = cms_indices(hi, lo, DEPTH, WIDTH)
