@@ -6,10 +6,13 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-load test tracetest bench gen-k8s gen-proto gen-dashboards build-native check clean
+.PHONY: start start-kafka start-load test tracetest bench gen-k8s gen-proto gen-dashboards build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
+
+start-kafka:    ## shop with the async tier over a REAL broker socket
+	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5 --kafka auto
 
 start-load:     ## drive a remote gateway (TARGET=http://host:8080)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --load-only --target $(or $(TARGET),http://127.0.0.1:8080) --users 5
