@@ -200,6 +200,18 @@ def main():
     if os.environ.get("BENCH_MATRIX", "1") != "0":
         matrix = measure_impl_matrix(rng)
 
+    # ---- host ingest (SURVEY §7 hard part (a)) -----------------------
+    # The other half of the ≥200k/s budget: OTLP bytes → columns on the
+    # HOST (native C++ decoder). None when the .so can't build here.
+    ingest_rate = None
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        from opentelemetry_demo_tpu.runtime import ingestbench
+
+        try:
+            ingest_rate = ingestbench.measure_native(repeat=3)
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            ingest_rate = None
+
     # ---- north star #2: detection lag through the real pipeline ------
     fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
@@ -245,6 +257,9 @@ def main():
                 "lag_stress_rate_spans_per_sec": stress.get("rate"),
                 "lag_stress_reports_skipped": stress.get("reports_skipped"),
                 "fetch_rtt_ms": fetch_rtt_ms,
+                "host_ingest_spans_per_sec": (
+                    round(ingest_rate, 1) if ingest_rate else None
+                ),
                 "sketch_impl_matrix": matrix,
                 "lag_note": (
                     "gross p99 is submit-to-harvest through the real "

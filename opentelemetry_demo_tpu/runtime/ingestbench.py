@@ -1,0 +1,107 @@
+"""Host-ingest throughput measurement: OTLP bytes → pipeline columns.
+
+The device side does tens of millions of spans/sec (bench.py); this
+measures the other half of the ≥200k spans/sec budget (SURVEY.md §7
+hard part (a)) — wire decode + attribute hashing + interning — so the
+artifact can show the host keeps the chip fed. One methodology, two
+callers: ``scripts/bench_ingest.py`` (the standalone CLI, both decode
+paths) and ``bench.py`` (the driver artifact, native path only).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import native, wire
+from .otlp import MONITORED_ATTR_KEYS, decode_export_request
+from .tensorize import SpanTensorizer
+
+
+def make_payloads(n_requests: int = 64, spans_per_request: int = 128,
+                  seed: int = 0) -> list[bytes]:
+    """Realistic OTLP ExportTraceServiceRequest payloads (shop-shaped
+    service names, product-id attrs, ~2% error spans)."""
+    rng = np.random.default_rng(seed)
+    services = [
+        "frontend", "checkout", "cart", "payment", "currency",
+        "product-catalog", "shipping", "ad", "recommendation", "quote",
+    ]
+
+    def anyval(s):
+        return wire.encode_len(1, s.encode())
+
+    def kv(k, v):
+        return wire.encode_len(1, k.encode()) + wire.encode_len(2, anyval(v))
+
+    payloads = []
+    for _ in range(n_requests):
+        svc = services[int(rng.integers(0, len(services)))]
+        spans = b""
+        for _ in range(spans_per_request):
+            start = int(rng.integers(10**18, 2 * 10**18))
+            span = (
+                wire.encode_len(1, bytes(rng.integers(0, 256, 16, dtype=np.uint8)))
+                + wire.encode_len(5, b"oteldemo.rpc/Call")
+                + wire.encode_fixed64(7, start)
+                + wire.encode_fixed64(8, start + int(rng.integers(10**5, 10**9)))
+                + wire.encode_len(9, kv("app.product.id", f"P-{int(rng.integers(0, 100))}"))
+                + wire.encode_len(9, kv("rpc.system", "grpc"))
+            )
+            if rng.random() < 0.02:
+                span += wire.encode_len(15, wire.encode_int(3, 2))
+            spans += wire.encode_len(2, span)
+        resource = wire.encode_len(1, kv("service.name", svc))
+        rs = wire.encode_len(1, resource) + wire.encode_len(2, spans)
+        payloads.append(wire.encode_len(1, rs))
+    return payloads
+
+
+def measure(fn, payloads: list[bytes], n_spans: int, repeat: int = 5) -> float:
+    """Best-of-``repeat`` spans/sec of ``fn`` over all payloads."""
+    fn(payloads[0])  # warmup
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        for p in payloads:
+            fn(p)
+        best = min(best, time.perf_counter() - t0)
+    return n_spans / best
+
+
+def measure_native(n_requests: int = 64, spans_per_request: int = 128,
+                   repeat: int = 5,
+                   payloads: list[bytes] | None = None) -> float | None:
+    """Native C++ columnar decode rate (spans/s), or None when the
+    native library is unavailable in this environment. Pass prebuilt
+    ``payloads`` (from :func:`make_payloads` with the same dims) to
+    share generation across paths."""
+    if not native.available():
+        return None
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    tz = SpanTensorizer(num_services=32)
+    return measure(
+        lambda p: tz.columns_from_columnar(
+            native.decode_otlp(p, MONITORED_ATTR_KEYS)
+        ),
+        payloads,
+        n_requests * spans_per_request,
+        repeat=repeat,
+    )
+
+
+def measure_python(n_requests: int = 64, spans_per_request: int = 128,
+                   repeat: int = 5,
+                   payloads: list[bytes] | None = None) -> float:
+    """Pure-Python record-path decode rate (spans/s)."""
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    tz = SpanTensorizer(num_services=32)
+    return measure(
+        lambda p: tz.columns_from_records(decode_export_request(p)),
+        payloads,
+        n_requests * spans_per_request,
+        repeat=repeat,
+    )

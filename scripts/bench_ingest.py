@@ -1,102 +1,33 @@
 """Host-ingest throughput: protobuf OTLP bytes → pipeline columns.
 
-The device side does millions of spans/sec (bench.py); this measures
-the other half of the ≥200k spans/sec budget (SURVEY.md §7 hard part
-(a)) — wire decode + attribute hashing + interning — for the pure-
-Python record path vs the native C++ columnar path.
+The device side does tens of millions of spans/sec (bench.py); this
+measures the other half of the ≥200k spans/sec budget (SURVEY.md §7
+hard part (a)) — wire decode + attribute hashing + interning — for the
+pure-Python record path vs the native C++ columnar path. Methodology
+lives in ``runtime.ingestbench`` (shared with bench.py's artifact
+field).
 
 Run: python scripts/bench_ingest.py   (CPU only, no TPU needed)
 """
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-import numpy as np
-
-from opentelemetry_demo_tpu.runtime import native, wire
-from opentelemetry_demo_tpu.runtime.otlp import (
-    MONITORED_ATTR_KEYS,
-    decode_export_request,
-)
-from opentelemetry_demo_tpu.runtime.tensorize import SpanTensorizer
-
-
-def make_payloads(n_requests=64, spans_per_request=128, seed=0):
-    rng = np.random.default_rng(seed)
-    services = [
-        "frontend", "checkout", "cart", "payment", "currency",
-        "product-catalog", "shipping", "ad", "recommendation", "quote",
-    ]
-
-    def anyval(s):
-        return wire.encode_len(1, s.encode())
-
-    def kv(k, v):
-        return wire.encode_len(1, k.encode()) + wire.encode_len(2, anyval(v))
-
-    payloads = []
-    for _ in range(n_requests):
-        svc = services[int(rng.integers(0, len(services)))]
-        spans = b""
-        for _ in range(spans_per_request):
-            start = int(rng.integers(10**18, 2 * 10**18))
-            span = (
-                wire.encode_len(1, bytes(rng.integers(0, 256, 16, dtype=np.uint8)))
-                + wire.encode_len(5, b"oteldemo.rpc/Call")
-                + wire.encode_fixed64(7, start)
-                + wire.encode_fixed64(8, start + int(rng.integers(10**5, 10**9)))
-                + wire.encode_len(9, kv("app.product.id", f"P-{int(rng.integers(0, 100))}"))
-                + wire.encode_len(9, kv("rpc.system", "grpc"))
-            )
-            if rng.random() < 0.02:
-                span += wire.encode_len(15, wire.encode_int(3, 2))
-            spans += wire.encode_len(2, span)
-        resource = wire.encode_len(1, kv("service.name", svc))
-        rs = wire.encode_len(1, resource) + wire.encode_len(2, spans)
-        payloads.append(wire.encode_len(1, rs))
-    return payloads
-
-
-def bench(label, fn, payloads, n_spans, repeat=5):
-    fn(payloads[0])  # warmup
-    best = float("inf")
-    for _ in range(repeat):
-        t0 = time.perf_counter()
-        for p in payloads:
-            fn(p)
-        best = min(best, time.perf_counter() - t0)
-    rate = n_spans / best
-    print(f"{label:>14}: {rate/1e3:10.1f} k spans/s  ({best*1e3:.1f} ms/pass)")
-    return rate
+from opentelemetry_demo_tpu.runtime import ingestbench, native  # noqa: E402
 
 
 def main():
-    payloads = make_payloads()
-    n_spans = 64 * 128
-
-    tz = SpanTensorizer(num_services=32)
-    bench(
-        "python-records",
-        lambda p: tz.columns_from_records(decode_export_request(p)),
-        payloads,
-        n_spans,
-    )
-    if native.available():
-        tz2 = SpanTensorizer(num_services=32)
-        bench(
-            "native-columns",
-            lambda p: tz2.columns_from_columnar(
-                native.decode_otlp(p, MONITORED_ATTR_KEYS)
-            ),
-            payloads,
-            n_spans,
-        )
-    else:
+    payloads = ingestbench.make_payloads()  # built once, shared by both
+    py = ingestbench.measure_python(payloads=payloads)
+    print(f"python-records: {py/1e3:10.1f} k spans/s")
+    nat = ingestbench.measure_native(payloads=payloads)
+    if nat is None:
         print(f"native unavailable: {native.load_error()}")
+    else:
+        print(f"native-columns: {nat/1e3:10.1f} k spans/s")
 
 
 if __name__ == "__main__":
