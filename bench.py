@@ -168,12 +168,13 @@ def measure_impl_matrix(rng) -> dict[str, float]:
     if jax.default_backend() != "tpu":
         return {}
     out: dict[str, float] = {}
-    # Four regimes, both impls: 8 compiles ≈ the bulk of the cost.
-    # 16384 audits the r3 crossover (fused.IMPL_CROSSOVER_BATCH): the
-    # wide-chunk kernel's last winning point before the xla sort path's
-    # O(B log B) scaling takes over.
+    # Both impls at both sides of the 8192 crossover
+    # (fused.IMPL_CROSSOVER_BATCH) plus the endpoints: 8192 is the
+    # dense kernel's last winning point, 16384 the first where the xla
+    # path's MXU-histogram CMS engages and overtakes it. Compiles
+    # dominate the cost, so the sweep stays at 8 entries.
     for impl in ("pallas", "xla"):
-        for batch in (2048, 16384, 65536, 524288):
+        for batch in (2048, 8192, 16384, 524288):
             config = DetectorConfig(sketch_impl=impl)
             try:
                 rate = measure_throughput(
@@ -188,9 +189,9 @@ def measure_impl_matrix(rng) -> dict[str, float]:
 
 def main():
     # 512k: the XLA path (auto-selected for large batches; CMS counting
-    # via the scatter-free sort+searchsorted histogram) saturates ~20M
-    # spans/s from B≈128k on v5e-1; 512k keeps the timed regions long
-    # relative to any fixed overheads.
+    # via the MXU one-hot outer-product histogram, cms.cms_update_hist)
+    # saturates ~66M spans/s at B=512k on v5e-1; 512k keeps the timed
+    # regions long relative to any fixed overheads.
     batch_size = int(os.environ.get("BENCH_BATCH", 524288))
     rng = np.random.default_rng(0)
     spans_per_sec = measure_throughput(DetectorConfig(), batch_size, rng)

@@ -339,7 +339,10 @@ def detector_step(
         num_services=s_axis,
         hll_p=config.hll_p,
         cms_width=config.cms_width,
-        impl=fused.resolve_impl(config.sketch_impl, batch=int(svc.shape[0])),
+        impl=fused.resolve_impl(
+            config.sketch_impl, batch=int(svc.shape[0]),
+            cms_depth=config.cms_depth, cms_width=config.cms_width,
+        ),
     )
     hll_delta = comm.pmax_batch(delta.hll)
     cms_delta = comm.psum_batch(delta.cms)

@@ -142,19 +142,28 @@ def cms_update_hist(
 _HIST_TILE = 32768  # keys per MXU-histogram grid step (VMEM-resident)
 
 
-def _mxu_hist_usable(n_bins: int, n_keys: int) -> bool:
-    import jax
-
+def mxu_hist_geometry_ok(n_bins: int, n_keys: int) -> bool:
+    """Pure-geometry gate for the MXU histogram engine (no backend
+    check — also used by ``fused.resolve_impl`` to predict whether the
+    xla path will get the fast engine at a given batch size)."""
     return (
-        jax.default_backend() == "tpu"
         # (hi, lo) byte split: bins + the invalid-lane sentinel must
         # fit 16-bit keys, and bins must fill whole 256-wide lo rows.
-        and n_bins + 1 <= 65536
+        n_bins + 1 <= 65536
         and n_bins % 256 == 0
         # the kernel tiles the key axis; a partial tile would need a
         # second masked pass — keys are D·B with B a power of two in
         # every real config, so just fall back otherwise.
+        and n_keys > 0
         and n_keys % _HIST_TILE == 0
+    )
+
+
+def _mxu_hist_usable(n_bins: int, n_keys: int) -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu" and mxu_hist_geometry_ok(
+        n_bins, n_keys
     )
 
 

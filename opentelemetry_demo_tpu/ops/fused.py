@@ -27,12 +27,15 @@ Pallas path is property-tested against it (interpret mode on CPU, native
 on TPU). Honest fetch-synchronized timing on v5e-1 (S=32, p=12, 4×8192
 CMS), after the r3 wide-chunk retune (see ``_cell_chunk`` /
 ``IMPL_CROSSOVER_BATCH`` for the measured table): the dense kernel owns
-batches through ~16k (7.5M spans/s at B=8192, 3.3× the xla path there)
-and sits at its VPU dense-compare roofline ~7.6M spans/s; the XLA path
-wins from ~32k up (47M spans/s at B=512k) with O(B log B) work — the
-scatter-free sort+searchsorted histogram for the CMS count
-(``cms.cms_update_hist``; TPU scatters serialize on duplicate indices,
-and a CMS batch is nothing but duplicates). ``resolve_impl``
+the small-batch low-latency regime through B=8192 (3.3M vs 1.7M
+full-step at 8192; the isolated delta op runs at its ~7.6M VPU
+dense-compare roofline — the step's other stages account for the
+difference); the XLA path
+wins from 16k up (42.7M at 16384, 67M at 512k) — its CMS count rides
+the scatter-free histogram engines in ``cms.cms_update_hist`` (the
+MXU one-hot outer-product Pallas kernel at tile-divisible geometries,
+sort+searchsorted elsewhere; TPU scatters serialize on duplicate
+indices, and a CMS batch is nothing but duplicates). ``resolve_impl``
 auto-selects by batch size. The kernel's further wins are determinism
 (fixed VPU/MXU schedule, no batch-order dependence) and keeping the
 whole delta VMEM-resident.
@@ -348,42 +351,64 @@ def sketch_batch_delta(
     )
 
 
-IMPL_CROSSOVER_BATCH = 16384
+IMPL_CROSSOVER_BATCH = 8192
 """Auto-select boundary, measured on v5e-1 (S=32, p=12, 4×8192 CMS;
-fetch-synchronized slope timing of the isolated delta op, r3):
+fetch-synchronized slope timing of the FULL detector step, r3 after the
+MXU-histogram CMS engine landed in the xla path):
 
     B        pallas      xla
-    2048     1.4M/s      0.7M/s     ← pallas (narrow chunks)
-    8192     7.5M/s      2.3M/s     ← pallas (wide chunks)
-    16384    7.4M/s      4.3M/s     ← pallas
-    32768    6.7M/s      7.0M/s     ← tie
-    65536    7.9M/s     13.4M/s     ← xla
-    524288   7.6M/s     47.7M/s     ← xla
+    2048     1.8M/s      0.6M/s     ← pallas (narrow chunks)
+    4096     1.6M/s      1.2M/s     ← pallas
+    8192     3.3M/s      1.7M/s     ← pallas (wide chunks)
+    16384    6.1M/s     42.7M/s     ← xla (MXU hist fully pipelined)
+    65536    6.5M/s     40.3M/s     ← xla
+    524288   7.2M/s     67.0M/s     ← xla
 
-The kernel's total work is O(B·cells) dense compares by construction —
-wide chunks (see ``_cell_chunk``) brought it from 1.7M to ~7.6M
-spans/s, which IS the VPU dense-compare roofline for this geometry
-(~164k cells × ~3 ops per span ≈ 0.5M VPU ops/span against ~3.8T
-int-ops/s) — while the xla path's sort+searchsorted histogram is
-O(B log B) and keeps scaling. Past the tie at 32k the gap is
-algorithmic, not schedule: no amount of kernel tuning buys back a
-different asymptotic. See PARITY.md for the full roofline argument."""
+The dense kernel's total work is O(B·cells) compares by construction —
+wide chunks (see ``_cell_chunk``) hold it at its VPU roofline ~7M
+spans/s — so it owns only the low-latency small-batch regime the
+pipeline actually runs (256-8192). The xla path's CMS count rides the
+MXU one-hot outer-product histogram from B=8192 (key counts become
+tile-divisible; see ``cms.cms_update_hist``) and its remaining work is
+O(B)-ish, so past 8k the gap is algorithmic, not schedule. Before the
+MXU engine the crossover sat at ~32k with xla@16384=4.3M; the faster
+histogram pulled it down to 8k. See PARITY.md for the roofline
+argument."""
 
 
-def resolve_impl(requested: str | None, batch: int | None = None) -> str:
+SORT_CROSSOVER_BATCH = 32768
+"""Fallback boundary when the MXU histogram's geometry gate fails (a
+batch that is not a multiple of 8192 keeps the xla path on the SORT
+engine): the pre-MXU measurements put the pallas/sort tie at ~32k
+(pallas 6.7M vs sort-xla 7.0M full-step), so such batches stay on the
+dense kernel until then."""
+
+
+def resolve_impl(
+    requested: str | None,
+    batch: int | None = None,
+    cms_depth: int = cms.CMS_DEPTH,
+    cms_width: int = cms.CMS_WIDTH,
+) -> str:
     """Map a config's ``sketch_impl`` field to a concrete impl name.
 
-    ``None`` auto-selects by backend AND batch size at the measured
-    ``IMPL_CROSSOVER_BATCH`` (see its table): the dense kernel owns the
-    small/medium-batch low-latency regime, the xla path the large-batch
-    throughput regime. CPU interpret mode is for tests, not production
-    CPU runs.
+    ``None`` auto-selects by backend AND batch size: past
+    ``IMPL_CROSSOVER_BATCH`` the xla path wins — but only because its
+    CMS count rides the MXU histogram, whose geometry gate
+    (``cms.mxu_hist_geometry_ok``) needs tile-divisible key counts. A
+    batch that fails the gate would get the slower SORT engine instead,
+    so it stays on the dense kernel until ``SORT_CROSSOVER_BATCH``.
+    CPU interpret mode is for tests, not production CPU runs.
     """
     if requested is None:
         if jax.default_backend() != "tpu":
             return "xla"
         if batch is not None and batch > IMPL_CROSSOVER_BATCH:
-            return "xla"
+            mxu = cms.mxu_hist_geometry_ok(
+                cms_depth * cms_width, cms_depth * batch
+            )
+            if mxu or batch > SORT_CROSSOVER_BATCH:
+                return "xla"
         return "pallas"
     if requested not in ("xla", "pallas", "interpret"):
         raise ValueError(f"unknown sketch impl {requested!r}")

@@ -86,19 +86,25 @@ class TestSketchBatchDelta:
         _assert_delta_equal(ref, tiled)
 
     def test_resolve_impl_batch_crossover(self, monkeypatch):
-        """Auto-selection routes small/medium batches to the dense
-        kernel and large ones to the scatter path, at the crossover the
-        r3 v5e measurements pin (fused.IMPL_CROSSOVER_BATCH table:
-        pallas 7.5M vs xla 2.3M at 8192, tie ~32k, xla 13.4M vs 7.9M at
-        65536 — the wide-chunk kernel sits at its dense-compare
-        roofline, the sort path keeps scaling)."""
-        assert fused.IMPL_CROSSOVER_BATCH == 16384
+        """Auto-selection routes small batches to the dense kernel and
+        the rest to the xla path, at the crossover the r3 v5e FULL-STEP
+        measurements pin (fused.IMPL_CROSSOVER_BATCH table: pallas 3.3M
+        vs xla 1.7M at 8192; xla 42.7M vs 6.1M at 16384 once the MXU
+        histogram engages — the wide-chunk kernel sits at its
+        dense-compare roofline, the MXU-hist path keeps scaling)."""
+        assert fused.IMPL_CROSSOVER_BATCH == 8192
         monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
         assert fused.resolve_impl(None, batch=2048) == "pallas"
         assert fused.resolve_impl(None, batch=8192) == "pallas"
-        assert fused.resolve_impl(None, batch=16384) == "pallas"
-        assert fused.resolve_impl(None, batch=16385) == "xla"
+        assert fused.resolve_impl(None, batch=16384) == "xla"
         assert fused.resolve_impl(None, batch=65536) == "xla"
+        # The 8192-crossover only holds where the MXU histogram's
+        # geometry gate passes (batch a multiple of 8192 at D=4): a
+        # non-multiple batch would drop the xla path onto the SLOWER
+        # sort engine, so it stays pallas until the pre-MXU ~32k tie.
+        assert fused.resolve_impl(None, batch=12000) == "pallas"
+        assert fused.resolve_impl(None, batch=24576) == "xla"  # 3×8192
+        assert fused.resolve_impl(None, batch=40000) == "xla"  # >32k tie
         assert fused.resolve_impl(None) == "pallas"  # no batch hint
         # Explicit requests are never overridden by the batch hint.
         assert fused.resolve_impl("pallas", batch=524288) == "pallas"
