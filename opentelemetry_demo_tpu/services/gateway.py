@@ -49,6 +49,7 @@ from .frontend import FLAG_IMAGE_SLOW_LOAD
 from .shop import Shop
 from .webui import WebStorefront
 from ..runtime import otlp
+from ..telemetry.obsui import GrafanaUI, JaegerUI
 from ..telemetry.tracer import TraceContext
 
 MAX_FAULT_DELAY_S = 10.0  # cap on header-triggered fault delays
@@ -92,6 +93,12 @@ class ShopGateway:
         # with handle(method, path, body) -> (status, content_type, bytes).
         self.feature_ui = None
         self.loadgen_ui = None  # LoadControl, mounted at /loadgen
+        # Observability backends at the edge — the reference's Envoy
+        # routes /jaeger and /grafana to the query UIs
+        # (envoy.tmpl.yaml:44-47); here the analogues are served over
+        # the shop's own collector backends.
+        self.jaeger_ui = JaegerUI(shop.collector.trace_store)
+        self.grafana_ui = GrafanaUI(shop.collector)
         # Server-rendered storefront at "/" (the Next.js tier analogue);
         # HTML pages live beside the JSON /api routes.
         self.web_ui = WebStorefront(shop.frontend)
@@ -304,6 +311,28 @@ class ShopGateway:
             return 200, "application/json", json.dumps(
                 {"key": key, "value": value, "reason": "STATIC"}
             ).encode()
+
+        if route == "/jaeger" or route.startswith("/jaeger/"):
+            # Trace query surface (envoy.tmpl.yaml:44-45 analogue).
+            # Pump first so spans the client just generated have had
+            # their batch-timeout chance to reach the trace store.
+            sub = route[len("/jaeger"):] or "/"
+            with self._lock:
+                self._pump_locked()
+                self.shop.collector.force_flush(scrape=False)
+                return self.jaeger_ui.handle(method, sub, query)
+
+        if route == "/grafana" or route.startswith("/grafana/"):
+            # Dashboard surface (envoy.tmpl.yaml:46-47 analogue).
+            sub = route[len("/grafana"):] or "/"
+            # Only the routes that evaluate live panels need a fresh
+            # TSDB sample; the dashboard list and static model JSON
+            # never read the TSDB.
+            live = sub.startswith(("/api/eval/", "/d/"))
+            with self._lock:
+                self._pump_locked()
+                self.shop.collector.force_flush(scrape=live)
+                return self.grafana_ui.handle(method, sub, query)
 
         if route.startswith("/feature"):
             if self.feature_ui is None:

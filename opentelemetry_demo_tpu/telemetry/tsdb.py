@@ -244,7 +244,8 @@ class Scraper:
         self.tsdb = tsdb
         self.interval_s = interval_s
         self._targets: list[tuple[str, object, object]] = []
-        self._last_scrape: float | None = None
+        self._last_scrape: float | None = None  # cadence clock (maybe_scrape)
+        self._last_sample: float | None = None  # dedup clock (any sample)
 
     def add_target(self, job: str, registry, before=None) -> None:
         """Register a registry; ``before()`` (if given) runs at each
@@ -261,6 +262,20 @@ class Scraper:
         if self._last_scrape is not None and now - self._last_scrape < self.interval_s:
             return False
         self._last_scrape = now
+        self._scrape(now)
+        return True
+
+    def scrape(self, now: float) -> None:
+        """Forced sample (behind :meth:`Collector.force_flush`). Takes a
+        sample but does NOT advance the cadence clock — the regular
+        ``maybe_scrape`` cycle, and the metrics exporters that ride it,
+        fire on schedule no matter how often query surfaces poll."""
+        self._scrape(now)
+
+    def _scrape(self, now: float) -> None:
+        if self._last_sample is not None and now <= self._last_sample:
+            return  # same-instant duplicate would poison rate() windows
+        self._last_sample = now
         for job, registry, before in self._targets:
             if before is not None:
                 before()
@@ -273,4 +288,3 @@ class Scraper:
                 labels = dict(label_key)
                 labels["job"] = job
                 self.tsdb.append(name, labels, now, value)
-        return True

@@ -86,7 +86,10 @@ def _json_path(doc, path: str):
     cur = doc
     for part in path.split("."):
         if isinstance(cur, list):
-            cur = cur[int(part)]
+            try:
+                cur = cur[int(part)]
+            except (IndexError, ValueError):
+                return None  # absent element asserts like a missing key
         elif isinstance(cur, dict):
             if part not in cur:
                 return None

@@ -376,3 +376,112 @@ def test_http_loadgen_drives_traffic(rig):
         gw._pump_locked()
     services = {s.service for s in sink}
     assert {"frontend-proxy", "frontend", "product-catalog"} <= services
+
+
+# -- observability surfaces at the edge (/jaeger, /grafana) -----------------
+# The reference serves both UIs through Envoy (envoy.tmpl.yaml:44-47);
+# these tests are the "a person can watch the system" capability check.
+
+
+def _get_status(gw, path):
+    try:
+        status, _, _ = _get(gw, path)
+        return status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def _drive_checkout(gw, user="obs-user"):
+    _post(gw, "/api/cart", {
+        "userId": user, "item": {"productId": "TEL-DOB-10", "quantity": 1},
+    })
+    status, body = _post(gw, "/api/checkout", {
+        "userId": user, "currencyCode": "USD", "email": "obs@example.com",
+    })
+    assert status == 200
+    return json.loads(body)
+
+
+def test_jaeger_api_finds_checkout_trace(rig):
+    shop, gw, sink = rig
+    _drive_checkout(gw)
+    with gw._lock:  # flush past the 0.2s collector batch timeout
+        shop.pump(shop.now + 1.0)
+
+    status, _, body = _get(gw, "/jaeger/api/services")
+    doc = json.loads(body)
+    assert status == 200 and "checkout" in doc["data"]
+
+    status, _, body = _get(gw, "/jaeger/api/services/checkout/operations")
+    assert "PlaceOrder" in json.loads(body)["data"]
+
+    status, _, body = _get(gw, "/jaeger/api/traces?service=checkout&operation=PlaceOrder")
+    traces = json.loads(body)["data"]
+    assert traces, "PlaceOrder trace should be findable at the edge"
+    trace = traces[0]
+    names = {s["operationName"] for s in trace["spans"]}
+    assert "PlaceOrder" in names
+    services = {p["serviceName"] for p in trace["processes"].values()}
+    assert "checkout" in services
+
+    # Single-trace lookup by id, then the human-facing waterfall view.
+    status, _, body = _get(gw, f"/jaeger/api/traces/{trace['traceID']}")
+    assert status == 200 and json.loads(body)["data"][0]["traceID"] == trace["traceID"]
+    status, ctype, body = _get(gw, f"/jaeger/trace/{trace['traceID']}")
+    assert status == 200 and "text/html" in ctype
+    assert b"PlaceOrder" in body and b"<svg" in body
+
+
+def test_jaeger_search_page_and_filters(rig):
+    shop, gw, sink = rig
+    _drive_checkout(gw)
+    with gw._lock:
+        shop.pump(shop.now + 1.0)
+    status, ctype, body = _get(gw, "/jaeger/")
+    assert status == 200 and "text/html" in ctype and b"checkout" in body
+    # minDuration parses Jaeger-style strings; an absurd floor finds nothing.
+    status, _, body = _get(gw, "/jaeger/api/traces?minDuration=100s")
+    assert json.loads(body)["data"] == []
+    assert _get_status(gw, "/jaeger/api/traces/zz-not-hex") == 404
+
+
+def test_grafana_dashboards_render_live_numbers(rig):
+    shop, gw, sink = rig
+    # Two traffic bursts bracketing two scrape cycles so rate() panels
+    # have a nonzero increase between samples.
+    _drive_checkout(gw, "g1")
+    with gw._lock:
+        shop.pump(shop.now + 6.0)
+    _drive_checkout(gw, "g2")
+    with gw._lock:
+        shop.pump(shop.now + 6.0)
+
+    status, _, body = _get(gw, "/grafana/api/search")
+    uids = {d["uid"] for d in json.loads(body)}
+    assert {"demo", "spanmetrics", "exemplars", "anomaly"} <= uids
+
+    # Machine-readable live evaluation (the tracetest surface).
+    status, _, body = _get(gw, "/grafana/api/eval/demo")
+    doc = json.loads(body)
+    panels = {p["title"]: p["rows"] for p in doc["panels"]}
+    req_rows = panels["Requests by service"]
+    assert req_rows and any(v > 0 for _, v in req_rows), (
+        "demo dashboard should show the traffic just driven: %r" % req_rows
+    )
+
+    status, _, body = _get(gw, "/grafana/api/eval/spanmetrics")
+    panels = {p["title"]: p["rows"] for p in json.loads(body)["panels"]}
+    assert any("checkout" in "/".join(map(str, k)) for k, _ in
+               panels["Call rate by operation"])
+
+    # Server-rendered dashboard page: panels + live bar chart.
+    status, ctype, body = _get(gw, "/grafana/d/demo")
+    assert status == 200 and "text/html" in ctype
+    assert b"Requests by service" in body and b"<svg" in body
+
+    # Grafana dashboard-model JSON still exports (deployment shape).
+    status, _, body = _get(gw, "/grafana/api/dashboards/uid/spanmetrics")
+    model = json.loads(body)["dashboard"]
+    assert model["uid"] == "spanmetrics" and model["panels"]
+
+    assert _get_status(gw, "/grafana/d/nope") == 404

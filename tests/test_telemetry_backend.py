@@ -407,3 +407,48 @@ def test_shop_metrics_scraped_into_tsdb(busy_shop):
     rows = tsdb.instant("app_payment_transactions_total", at=busy_shop.now)
     assert rows
     assert all(labels["job"] == "shop" for labels, _ in rows)
+
+
+def test_force_flush_preserves_exporter_cadence():
+    """Forced scrapes (query-surface polling) must not starve the
+    metrics exporters riding the regular maybe_scrape cycle."""
+    from opentelemetry_demo_tpu.telemetry.collector import Collector
+
+    t = [0.0]
+    col = Collector(clock=lambda: t[0])
+    fired = []
+    col.metrics_exporters.append(lambda now, jobs: fired.append(now))
+    col.pump()  # first regular scrape at t=0
+    assert fired == [0.0]
+    # A client hammers /grafana: forced samples every 0.5s for 6s.
+    while t[0] < 6.0:
+        t[0] += 0.5
+        col.force_flush()
+        col.pump()
+    # The 5s cadence still fired despite 12 forced samples in between.
+    assert len(fired) == 2 and fired[1] >= 5.0
+
+
+def test_obsui_escapes_attribute_injection():
+    """Client-controllable service names (via /otlp-http) must not break
+    out of href attributes on the Jaeger search page."""
+    from opentelemetry_demo_tpu.telemetry.obsui import JaegerUI
+    from opentelemetry_demo_tpu.telemetry.tracestore import TraceStore
+
+    store = TraceStore()
+    evil = 'x" onmouseover="alert(1)'
+    store.add_span(1.0, SpanRecord(
+        service=evil, duration_us=100.0, trace_id=b"\x01" * 16, name="op",
+    ))
+    ui = JaegerUI(store)
+    status, ctype, body = ui.handle("GET", "/", {})
+    assert status == 200
+    assert b'onmouseover="alert' not in body
+    assert b"&quot;" in body
+    # href values are percent-encoded BEFORE html-escaping, so URL
+    # metacharacters in a service name can't reshape the query string.
+    store.add_span(2.0, SpanRecord(
+        service="a+b&c", duration_us=50.0, trace_id=b"\x02" * 16, name="op",
+    ))
+    status, _, body = ui.handle("GET", "/", {})
+    assert b'href="/jaeger/?service=a%2Bb%26c"' in body

@@ -58,8 +58,9 @@ def test_select_and_assert():
 
 def test_all_suites_pass_against_live_gateway():
     suites = tt.load_suites(REPO / "tracetesting")
-    # The reference tests 10 services (test/tracetesting/run.bash:10).
-    assert len(suites) == 10
+    # The reference tests 10 services (test/tracetesting/run.bash:10);
+    # this repo adds an 11th suite for the edge observability surfaces.
+    assert len(suites) == 11
     gw, client, stop = tt.make_rig(seed=5)
     try:
         results, code = tt.run_suites(client, suites, parallel=True)
