@@ -117,7 +117,18 @@ def make_sharded_step(
     )
     out_specs = (state_specs, report_specs())
 
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    # Interpret-mode Pallas (the CI stand-in for native multi-chip) hits
+    # a JAX hlo_interpreter limitation: the kernel jaxpr is re-evaluated
+    # under the mesh with vma checking, but kernel-internal iotas /
+    # literals trace unvarying while ref loads resolve varying — the
+    # documented workaround is check_vma=False, scoped here to the
+    # test-only interpret impl. The native Pallas and XLA paths keep
+    # full vma checking (ops/fused.py propagates vma to its out_shape).
+    vma_check = config.sketch_impl != "interpret"
+    fn = shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=vma_check,
+    )
     step = jax.jit(fn, donate_argnums=0)
 
     state = detector_init(config)

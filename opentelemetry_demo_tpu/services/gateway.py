@@ -314,12 +314,17 @@ class ShopGateway:
 
         if route == "/jaeger" or route.startswith("/jaeger/"):
             # Trace query surface (envoy.tmpl.yaml:44-45 analogue).
-            # Pump first so spans the client just generated have had
-            # their batch-timeout chance to reach the trace store.
+            # Pump first (exclusive, brief) so spans the client just
+            # generated have had their batch-timeout chance to reach
+            # the trace store; then query/render under the SHARED side
+            # of the RW lock — observability polling must not serialize
+            # the data plane, only exclude writers while reading
+            # (same discipline as the gRPC edge's read-only RPCs).
             sub = route[len("/jaeger"):] or "/"
             with self._lock:
                 self._pump_locked()
                 self.shop.collector.force_flush(scrape=False)
+            with self._lock.shared():
                 return self.jaeger_ui.handle(method, sub, query)
 
         if route == "/grafana" or route.startswith("/grafana/"):
@@ -332,6 +337,7 @@ class ShopGateway:
             with self._lock:
                 self._pump_locked()
                 self.shop.collector.force_flush(scrape=live)
+            with self._lock.shared():
                 return self.grafana_ui.handle(method, sub, query)
 
         if route.startswith("/feature"):
