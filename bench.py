@@ -256,7 +256,9 @@ def main():
                 "lag_stress_p99_ms": stress.get("p99_ms"),
                 "lag_stress_p99_net_ms": stress.get("p99_net_ms"),
                 "lag_stress_rate_spans_per_sec": stress.get("rate"),
+                "lag_stress_batches": stress.get("batches"),
                 "lag_stress_reports_skipped": stress.get("reports_skipped"),
+                "lag_stress_skip_rate": stress.get("skip_rate"),
                 "fetch_rtt_ms": fetch_rtt_ms,
                 "host_ingest_spans_per_sec": (
                     round(ingest_rate, 1) if ingest_rate else None

@@ -97,12 +97,17 @@ def measure_lag(
         i += 1
     pipe.close()
 
+    batches = pipe.stats.batches - base_batches
+    skipped = pipe.stats.reports_skipped - base_skipped
     out = {
         "p99_ms": round(pipe.stats.lag_p99_ms(), 3),
         "rate": rate,
-        "batches": pipe.stats.batches - base_batches,
+        "batches": batches,
         "spans": pipe.stats.spans - base_spans,
-        "reports_skipped": pipe.stats.reports_skipped - base_skipped,
+        "reports_skipped": skipped,
+        # Skip *rate* beside the raw count: a skipped-report tally is
+        # only judgeable against the batch denominator it came from.
+        "skip_rate": round(skipped / batches, 4) if batches else None,
     }
     net = pipe.stats.lag_net_samples()
     rtt = np.asarray(pipe.stats.rtt_ms, dtype=np.float64)

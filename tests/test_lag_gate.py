@@ -1,0 +1,59 @@
+"""Regression gates on the detection-lag north star.
+
+BASELINE north star #2: <100 ms p99 detection lag at the default Locust
+profile rate. The real number is measured on TPU by ``bench.py`` via the
+same ``runtime.lagbench`` engine these gates drive; here the gates run
+the identical methodology on CPU with a small sketch geometry so a
+regression in the pipeline (submit→harvest path, async harvester, skip
+accounting) fails the suite instead of silently degrading the bench
+artifact. Bounds are deliberately loose for CI jitter: measured CPU
+values sit near 1 ms p99 and 0 skips (see lagbench.measure_lag).
+"""
+
+import pytest
+
+from opentelemetry_demo_tpu.models import DetectorConfig
+from opentelemetry_demo_tpu.runtime.lagbench import BASELINE_LAG_MS, measure_lag
+
+CFG = DetectorConfig(num_services=8, hll_p=8, cms_depth=4, cms_width=512)
+
+
+@pytest.fixture(scope="module")
+def default_rate_lag():
+    return measure_lag(rate=2_000.0, seconds=3.0, batch=256, config=CFG)
+
+
+def test_lag_net_p99_under_north_star(default_rate_lag):
+    out = default_rate_lag
+    assert out["batches"] > 0
+    # Net-of-RTT p99 is the locally-attached-chip number the north star
+    # targets; on CPU it runs ~1 ms, so the 100 ms bound only trips on
+    # a real pipeline regression (serialized harvests, lost async
+    # overlap, per-batch recompiles).
+    net_p99 = out.get("p99_net_ms")  # key absent when no RTT pairs landed
+    assert net_p99 is not None, out
+    assert net_p99 < BASELINE_LAG_MS, out
+
+
+def test_lag_artifact_carries_skip_denominator(default_rate_lag):
+    """The artifact contract bench.py relies on: the skip *rate* is
+    computable because the batch denominator rides beside the count."""
+    out = default_rate_lag
+    assert set(out) >= {"batches", "reports_skipped", "skip_rate"}
+    # skip_rate is rounded to 4 decimals at source — compare likewise.
+    assert out["skip_rate"] == round(out["reports_skipped"] / out["batches"], 4)
+
+
+def test_stress_rate_skip_rate_bounded():
+    """BASELINE config #4 shape (10x rate, async harvester): harvest
+    skipping is the designed relief valve, but it must stay a minority
+    of batches — a majority-skip regime would mean reports are mostly
+    unobservable host-side (see also the fault-under-skip-pressure
+    e2e test)."""
+    out = measure_lag(
+        rate=20_000.0, seconds=3.0, batch=1024, harvest_async=True, config=CFG
+    )
+    assert out["batches"] > 0
+    assert out["skip_rate"] is not None and out["skip_rate"] <= 0.5, out
+    net_p99 = out.get("p99_net_ms")
+    assert net_p99 is not None and net_p99 < BASELINE_LAG_MS, out
