@@ -156,6 +156,10 @@ def mxu_hist_geometry_ok(n_bins: int, n_keys: int) -> bool:
         # every real config, so just fall back otherwise.
         and n_keys > 0
         and n_keys % _HIST_TILE == 0
+        # the MXU accumulates bin counts in f32, exact only below 2^24;
+        # counts are bounded by the key count, so gate on it and let
+        # larger batches take the sort engine.
+        and n_keys < (1 << 24)
     )
 
 

@@ -113,7 +113,7 @@ class KafkaProducer:
         _name, parts = topics[0]
         partition_, error, base_offset = parts[0]
         if error != kw.NO_ERROR:
-            raise kw.KafkaWireError(f"produce error {error} on partition {partition_}")
+            raise kw.KafkaProduceError(error, partition_)
         return base_offset
 
     def close(self) -> None:

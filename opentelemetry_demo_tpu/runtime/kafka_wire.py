@@ -20,9 +20,13 @@ Fetch <v4, so the v3/v4 path is also what makes the client speak to the
 compose overlay's real broker. Other APIs stay in the non-flexible era —
 ListOffsets v0, Metadata v0, FindCoordinator v0, OffsetCommit v2,
 OffsetFetch v1 — real Kafka wire format, without re-implementing
-KIP-482 tagged fields. The in-repo broker (``kafka_broker``) speaks the
-same subset, so client and broker are interoperable test doubles for
-the compose topology's real broker.
+KIP-482 tagged fields. Interop scope: **Kafka 3.x brokers** — 4.0
+removed these auxiliary API versions entirely (KIP-896), so a 4.x
+broker would reject the Metadata/ListOffsets/FindCoordinator calls
+even though the record path (Produce v3 / Fetch v4) would still speak.
+The in-repo broker (``kafka_broker``) speaks the same subset, so client
+and broker are interoperable test doubles for the compose topology's
+real broker.
 """
 
 from __future__ import annotations
@@ -49,6 +53,18 @@ UNSUPPORTED_VERSION = 35
 
 class KafkaWireError(ValueError):
     """Malformed Kafka wire data."""
+
+
+class KafkaProduceError(KafkaWireError):
+    """Broker answered the Produce but rejected the record (non-zero
+    partition error code) — the transport is healthy, so retrying on a
+    fresh connection cannot help; callers should bound retries and
+    dead-letter instead of treating this as a broken broker."""
+
+    def __init__(self, code: int, partition: int):
+        super().__init__(f"produce error {code} on partition {partition}")
+        self.code = code
+        self.partition = partition
 
 
 # --- primitive codecs --------------------------------------------------

@@ -48,6 +48,7 @@ class _UserPool:
         self._next_user_idx = 0
         self._spawn_cancel = threading.Event()
         self._spawner: threading.Thread | None = None
+        self._resume_users = 0  # stop() parks the target here for start()
 
     def _spawn_one_locked(self) -> None:
         ev = threading.Event()
@@ -110,7 +111,9 @@ class _UserPool:
         self._spawner.start()
 
     def start(self) -> None:
-        self.set_users(self.users)
+        # Locust stop→start semantics: resume with the pre-stop target
+        # (stop() zeroes the advertised target, parking it aside).
+        self.set_users(self.users or self._resume_users)
 
     def stop(self, timeout_s: float = 15.0) -> None:
         self._spawn_cancel.set()
@@ -120,6 +123,11 @@ class _UserPool:
         with self._pool_lock:
             pool = list(self._pool)
             self._pool = []
+            # Status surfaces report this as the target — a stopped
+            # pool with a stale nonzero target would read as "running".
+            if self.users:
+                self._resume_users = self.users
+            self.users = 0
         for _t, ev in pool:
             ev.set()
         for t, _ev in pool:

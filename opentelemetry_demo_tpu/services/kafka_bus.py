@@ -7,7 +7,8 @@ Produce (/root/reference/src/checkout/kafka/producer.go:11-43), and the
 over the socket (Consumer.cs:77-80, main.kt:54-69) — the path the
 reference runs continuously, now the repo's own topology when
 ``serve_shop --kafka`` is up (pointing at ``runtime.kafka_broker`` or a
-real Kafka ≥3.0; same protocol either way).
+real Kafka 3.x broker; same protocol either way — see the interop
+scope note in ``runtime.kafka_wire``).
 
 Connection model: everything is lazy with backoff — compose starts
 services in parallel, so a broker that isn't up yet means "retry", not
@@ -26,19 +27,28 @@ from typing import Callable
 
 from .bus import BusMessage
 from ..runtime.kafka_client import KafkaConsumer, KafkaProducer, _parse_bootstrap
-from ..runtime.kafka_wire import KafkaWireError
+from ..runtime.kafka_wire import KafkaProduceError, KafkaWireError
 
 # What "the broker is unavailable / the connection is broken" looks
 # like from the wire client: socket errors, OR KafkaWireError (a
-# ValueError) for half-open connections ("broker closed connection"),
-# produce error codes, and malformed frames mid-restart. Catching only
-# OSError would let a broker bounce crash checkout.place_order.
+# ValueError) for half-open connections ("broker closed connection")
+# and malformed frames mid-restart. Catching only OSError would let a
+# broker bounce crash checkout.place_order. NOTE: KafkaProduceError —
+# the broker answering but REJECTING a record — subclasses
+# KafkaWireError, so it must be caught FIRST wherever the handling
+# differs (keep the producer, bounded retry, dead-letter; see
+# _sender_loop).
 _TRANSPORT_ERRORS = (OSError, KafkaWireError)
 
 log = logging.getLogger(__name__)
 
 RECONNECT_BACKOFF_S = 1.0
 PENDING_MAX = 4096  # producer-side buffer while the broker is down
+# A record the broker REJECTS (produce error code, healthy transport)
+# is retried this many times, then dead-lettered — otherwise one
+# poisoned head record (e.g. topic rejection with auto-create off)
+# head-of-line blocks every later publish until the buffer drops orders.
+MAX_HEAD_ATTEMPTS = 5
 
 
 class _TopicHandle:
@@ -50,6 +60,10 @@ class _TopicHandle:
 
     def produce(self, key: bytes, value: bytes,
                 headers: dict[str, str] | None = None) -> int:
+        """Returns the broker-assigned base offset, or **-1** when the
+        record was buffered instead (broker down / record rejected on
+        the fast path) — callers must not treat -1 as a real offset;
+        the sender loop delivers buffered records later, in order."""
         return self._bus._produce(self.name, key, value, headers or {})
 
 
@@ -78,6 +92,9 @@ class KafkaBus:
         self._producer_next_connect = 0.0
         self._pending: deque = deque(maxlen=PENDING_MAX)
         self._pending_dropped = 0
+        self._head_attempts = 0  # sender-thread only
+        self._head_record = None  # identity of the record being retried
+        self._dead_lettered = 0
         self._subs: list[_Subscription] = []
         self._lock = threading.Lock()
         self._last_send_error: str | None = None
@@ -123,6 +140,10 @@ class KafkaBus:
             try:
                 return producer.send(topic, value, key=key,
                                      headers=wire_headers)
+            except KafkaProduceError as e:
+                # Record rejected, transport healthy: keep the producer,
+                # queue for the sender loop's bounded retry.
+                self._note_send_error(e)
             except _TRANSPORT_ERRORS as e:
                 self._note_send_error(e)
                 with self._lock:
@@ -160,17 +181,53 @@ class KafkaBus:
                 with self._lock:
                     if not self._pending:
                         break
-                    t, k, v, h = self._pending[0]
+                    head = self._pending[0]
+                    # Head identity, not position: a full deque evicts
+                    # its head on caller-side appends, so both the
+                    # rejection tally and the post-send pop must be
+                    # charged to the exact record object we read —
+                    # never to whatever sits at index 0 later.
+                    if head is not self._head_record:
+                        self._head_record = head
+                        self._head_attempts = 0
+                    t, k, v, h = head
                 try:
                     producer.send(t, v, key=k, headers=h)
+                except KafkaProduceError as e:
+                    # Broker rejected THIS record over a healthy
+                    # transport — reconnecting can't fix it. Bound the
+                    # retries, then dead-letter the head so it can't
+                    # block every later publish (ordered delivery
+                    # resumes with the next record).
+                    self._note_send_error(e)
+                    self._head_attempts += 1
+                    if self._head_attempts < MAX_HEAD_ATTEMPTS:
+                        break  # retry this head on the next wake
+                    with self._lock:
+                        if self._pending and self._pending[0] is head:
+                            self._pending.popleft()
+                    self._dead_lettered += 1
+                    self._head_record = None
+                    self._head_attempts = 0
+                    log.error(
+                        "Kafka record to %s dead-lettered after %d broker "
+                        "rejections (%s); %d dead-lettered total",
+                        t, MAX_HEAD_ATTEMPTS, e, self._dead_lettered,
+                    )
+                    continue
                 except _TRANSPORT_ERRORS as e:
                     self._note_send_error(e)
                     with self._lock:
                         self._drop_producer()
                     break
+                self._head_record = None
+                self._head_attempts = 0
                 with self._lock:
-                    # Only this thread pops, so the head is still ours.
-                    self._pending.popleft()
+                    # Pop the record we actually sent; if a full-buffer
+                    # eviction already removed it, there is nothing to
+                    # pop (the eviction was counted as a drop).
+                    if self._pending and self._pending[0] is head:
+                        self._pending.popleft()
 
     def _ensure_producer(self) -> KafkaProducer | None:
         """Sender-thread only (blocking connect)."""

@@ -329,3 +329,75 @@ def test_daemon_kafka_leg_end_to_end(broker, tmp_path, monkeypatch):
         assert daemon2._offsets == {0: 12}
     finally:
         daemon2.shutdown()
+
+
+def test_rejected_record_dead_letters_instead_of_blocking(monkeypatch):
+    """A record the broker REJECTS (produce error code over a healthy
+    transport) must not head-of-line block the buffer forever: after
+    MAX_HEAD_ATTEMPTS it is dead-lettered and later records deliver."""
+    import time as _time
+
+    from opentelemetry_demo_tpu.runtime.kafka_wire import KafkaProduceError
+    from opentelemetry_demo_tpu.services.kafka_bus import (
+        MAX_HEAD_ATTEMPTS,
+        KafkaBus,
+    )
+
+    bus = KafkaBus("127.0.0.1:1")  # never dialed: the stub stands in
+    sent = []
+    rejections = [0]
+
+    class StubProducer:
+        def send(self, topic, value, key=None, headers=()):
+            if value == b"poison":
+                rejections[0] += 1
+                raise KafkaProduceError(code=3, partition=0)
+            sent.append((topic, value))
+            return len(sent) - 1
+
+        def close(self):
+            pass
+
+    stub = StubProducer()
+    monkeypatch.setattr(bus, "_ensure_producer", lambda: stub)
+    with bus._lock:
+        bus._producer = stub
+
+    try:
+        topic = bus.topic("orders")
+        # Fast path rejection: buffered (-1), producer KEPT (healthy).
+        assert topic.produce(b"k", b"poison") == -1
+        assert bus._producer is stub
+        # Later publish queues behind the poisoned head.
+        assert topic.produce(b"k", b"good") == -1
+
+        deadline = _time.monotonic() + 15.0
+        # Wait for the buffer to fully drain, not just first delivery —
+        # the direct-path produce below needs an empty pending queue.
+        while _time.monotonic() < deadline and (bus._pending or not sent):
+            bus._send_wake.set()
+            _time.sleep(0.02)
+        assert ("orders", b"good") in sent, (rejections[0], bus._dead_lettered)
+        assert not bus._pending
+        assert bus._dead_lettered == 1
+        # 1 fast-path rejection + MAX_HEAD_ATTEMPTS sender-loop retries.
+        assert rejections[0] == 1 + MAX_HEAD_ATTEMPTS
+        # Healthy-path offset still returns the broker offset directly.
+        assert topic.produce(b"k", b"direct") == len(sent) - 1
+    finally:
+        bus.close()
+
+
+def test_user_pool_stop_resets_target():
+    """POST /loadgen/api/stop reports 0 running / 0 target afterwards —
+    a stale nonzero target would read as still-running."""
+    from opentelemetry_demo_tpu.services.http_load import HttpLoadGenerator
+
+    lg = HttpLoadGenerator("http://127.0.0.1:1", users=3)
+    # Never started: stop() must still clear the advertised target.
+    lg.stop()
+    assert lg.users == 0
+    assert lg.running_users() == 0
+    # ...but a later start() resumes with the pre-stop target (Locust
+    # stop→start semantics), not a silent zero-user no-op.
+    assert lg._resume_users == 3
