@@ -155,11 +155,20 @@ class DetectorDaemon:
         if meta is not None:
             checkpoint.restore_metrics_feed(meta, self.metrics_feed)
         self._metric_series_seen: set[tuple[str, str]] = set()
+        # Logs leg (the collector's third signal,
+        # otelcol-config.yml:128-131): /v1/logs → bounded store (the
+        # OpenSearch-analogue index, queryable for debugging) + a
+        # severity-rate lane into the metrics head so an error-log burst
+        # is detectable even when the producing service emits no spans.
+        from ..telemetry.logstore import LogStore
+
+        self.log_store = LogStore()
         self.receiver = OtlpHttpReceiver(
             self.pipeline.submit,
             port=self.otlp_port,
             on_columnar=self.pipeline.submit_columnar,
             on_metric_records=self.metrics_feed.submit,
+            on_log_records=self._on_logs,
         )
         # OTLP/gRPC :4317 — the reference collector's primary ingress
         # (otelcol-config.yml:5-8); every SDK defaults to gRPC export.
@@ -174,6 +183,7 @@ class DetectorDaemon:
                     port=grpc_port,
                     on_columnar=self.pipeline.submit_columnar,
                     on_metric_records=self.metrics_feed.submit,
+                    on_log_records=self._on_logs,
                 )
             except ImportError:  # grpcio absent: HTTP leg still serves
                 self.grpc_receiver = None
@@ -194,6 +204,39 @@ class DetectorDaemon:
         self._offsets: dict = dict(restored_offsets)
         self._stop = threading.Event()
         self._last_ckpt = time.monotonic()
+
+    # -- logs ingress ---------------------------------------------------
+
+    def _on_logs(self, docs) -> None:
+        """OTLP logs → store + per-service severity counts.
+
+        The decoders normalize severity at the boundary
+        (logstore.normalize_severity), so docs arrive on the canonical
+        5-level scale. ERROR/FATAL counts also enter the metrics head
+        as a delta-sum lane per service — the "error-log rate" signal
+        the spanmetrics leg can't see.
+        """
+        from .otlp_metrics import TEMPORALITY_DELTA, MetricRecord
+
+        error_counts: dict[str, float] = {}
+        n = 0
+        for doc in docs:
+            self.log_store.add(doc)
+            n += 1
+            if doc.severity in ("ERROR", "FATAL"):
+                error_counts[doc.service] = error_counts.get(doc.service, 0.0) + 1.0
+        if error_counts:
+            self.metrics_feed.submit([
+                MetricRecord(
+                    service=svc, name="log_error_records", value=v,
+                    kind="sum", monotonic=True, temporality=TEMPORALITY_DELTA,
+                )
+                for svc, v in error_counts.items()
+            ])
+        if n:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_LOG_RECORDS_TOTAL, float(n)
+            )
 
     # -- report → metrics ---------------------------------------------
 
@@ -259,6 +302,9 @@ class DetectorDaemon:
             )
             self.registry.gauge_set(
                 "app_anomaly_spans_ingested", float(self.pipeline.stats.spans)
+            )
+            self.registry.gauge_set(
+                "app_anomaly_log_docs_stored", float(self.log_store.count())
             )
         if self._orders is not None:
             for offsets, record in self._orders.poll(0.0):

@@ -140,6 +140,77 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
     return records
 
 
+def decode_logs_request(payload: bytes) -> list:
+    """ExportLogsServiceRequest protobuf → LogDocs.
+
+    The collector's third signal (otelcol-config.yml:128-131, logs →
+    OpenSearch): ResourceLogs{resource=1, scope_logs=2},
+    ScopeLogs{log_records=2}, LogRecord{time_unix_nano=1,
+    severity_text=3, body=5, attributes=6, trace_id=9} per the public
+    opentelemetry-proto logs/v1 field numbers.
+    """
+    from ..telemetry.logstore import LogDoc, normalize_severity
+
+    docs: list = []
+    req = wire.scan_fields(payload)
+    for rl_buf in req.get(1, []):
+        rl = wire.scan_fields(rl_buf)
+        service = "unknown"
+        res_buf = wire.first(rl, 1)
+        if res_buf:
+            res = wire.scan_fields(res_buf)
+            service = _attrs_to_dict(res.get(1, [])).get("service.name", service)
+        for sl_buf in rl.get(2, []):
+            sl = wire.scan_fields(sl_buf)
+            for lr_buf in sl.get(2, []):
+                lr = wire.scan_fields(lr_buf)
+                sev_raw = wire.first(lr, 3)
+                body_buf = wire.first(lr, 5)
+                body = _anyvalue_str(body_buf) if isinstance(body_buf, bytes) else None
+                trace_id = wire.first(lr, 9)
+                docs.append(LogDoc(
+                    ts=int(wire.first(lr, 1, 0) or 0) / 1e9,
+                    service=service,
+                    severity=normalize_severity(
+                        sev_raw.decode("utf-8", "replace")
+                        if isinstance(sev_raw, bytes) else None
+                    ),
+                    body=body or "",
+                    attrs=_attrs_to_dict(lr.get(6, [])),
+                    trace_id=trace_id if isinstance(trace_id, bytes) and trace_id else None,
+                ))
+    return docs
+
+
+def decode_logs_request_json(payload: bytes) -> list:
+    """JSON-encoded OTLP logs (the collector's otlphttp json mode)."""
+    from ..telemetry.logstore import LogDoc, normalize_severity
+
+    doc = json.loads(payload)
+    docs: list = []
+    for rl in doc.get("resourceLogs", []):
+        service = "unknown"
+        for attr in rl.get("resource", {}).get("attributes", []):
+            if attr.get("key") == "service.name":
+                service = attr.get("value", {}).get("stringValue", service)
+        for sl in rl.get("scopeLogs", []):
+            for lr in sl.get("logRecords", []):
+                attrs = {
+                    a.get("key"): a.get("value", {}).get("stringValue")
+                    for a in lr.get("attributes", [])
+                }
+                trace_hex = lr.get("traceId") or ""
+                docs.append(LogDoc(
+                    ts=int(lr.get("timeUnixNano", 0)) / 1e9,
+                    service=service,
+                    severity=normalize_severity(lr.get("severityText")),
+                    body=lr.get("body", {}).get("stringValue", ""),
+                    attrs={k: v for k, v in attrs.items() if v is not None},
+                    trace_id=bytes.fromhex(trace_hex) if trace_hex else None,
+                ))
+    return docs
+
+
 def decode_export_request_columnar(payload: bytes):
     """Protobuf request → native columnar batch, or None to fall back.
 
@@ -166,9 +237,11 @@ class OtlpHttpReceiver:
 
     ``POST /v1/metrics`` decodes OTLP metrics/v1 (runtime.otlp_metrics)
     into ``on_metric_records`` — the collector's metrics-pipeline leg
-    (otelcol-config.yml:124-126). Absent the callback, metric exports
-    are acknowledged and dropped (an ingest-side null sink, matching a
-    collector with no metrics pipeline configured).
+    (otelcol-config.yml:124-126). ``POST /v1/logs`` decodes OTLP
+    logs/v1 into ``on_log_records`` — the third signal
+    (otelcol-config.yml:128-131). Absent the respective callback,
+    exports are acknowledged and dropped (an ingest-side null sink,
+    matching a collector with that pipeline unconfigured).
     """
 
     def __init__(
@@ -178,6 +251,7 @@ class OtlpHttpReceiver:
         port: int = 4318,
         on_columnar: Callable | None = None,
         on_metric_records: Callable | None = None,
+        on_log_records: Callable | None = None,
     ):
         receiver = self
 
@@ -189,8 +263,14 @@ class OtlpHttpReceiver:
                 path = self.path.split("?", 1)[0]
                 columnar = None
                 metric_records = None
+                log_records = None
                 try:
-                    if path.endswith("/v1/metrics"):
+                    if path.endswith("/v1/logs"):
+                        if is_json:
+                            log_records = decode_logs_request_json(body)
+                        else:
+                            log_records = decode_logs_request(body)
+                    elif path.endswith("/v1/metrics"):
                         from . import otlp_metrics
 
                         if is_json:
@@ -221,7 +301,10 @@ class OtlpHttpReceiver:
                     self.send_response(400)
                     self.end_headers()
                     return
-                if metric_records is not None:
+                if log_records is not None:
+                    if receiver.on_log_records is not None:
+                        receiver.on_log_records(log_records)
+                elif metric_records is not None:
                     if receiver.on_metric_records is not None:
                         receiver.on_metric_records(metric_records)
                 elif columnar is not None:
@@ -239,6 +322,7 @@ class OtlpHttpReceiver:
         self.on_records = on_records
         self.on_columnar = on_columnar
         self.on_metric_records = on_metric_records
+        self.on_log_records = on_log_records
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="otlp-receiver", daemon=True

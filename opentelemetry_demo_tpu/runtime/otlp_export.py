@@ -183,7 +183,7 @@ def encode_export_request(
 class grpc_send:
     """A ``send`` hook for :class:`BackgroundPoster` that ships bodies
     over OTLP/gRPC (the collector exporter default) instead of HTTP.
-    ``signal`` ∈ {"traces", "metrics"}. Lazily opens the channel on the
+    ``signal`` ∈ {"traces", "metrics", "logs"}. Lazily opens the channel on the
     sender thread's first call; :meth:`close` (invoked by the poster's
     ``close``) shuts the channel down — grpcio channels are not
     reliably collected by GC and would leak sockets/poller threads."""
@@ -199,10 +199,14 @@ class grpc_send:
         if self._fn is None:
             import grpc
 
-            from .otlp_grpc import METRICS_EXPORT, TRACE_EXPORT
+            from .otlp_grpc import LOGS_EXPORT, METRICS_EXPORT, TRACE_EXPORT
 
             self._channel = grpc.insecure_channel(self._target)
-            path = TRACE_EXPORT if self._signal == "traces" else METRICS_EXPORT
+            path = {
+                "traces": TRACE_EXPORT,
+                "metrics": METRICS_EXPORT,
+                "logs": LOGS_EXPORT,
+            }[self._signal]
             self._fn = self._channel.unary_unary(
                 path, request_serializer=None, response_deserializer=None
             )
@@ -277,3 +281,73 @@ class OtlpHttpSpanExporter(_ExporterBase):
     def __call__(self, now: float, records: list[SpanRecord]) -> None:
         if records:
             self._poster.submit(encode_export_request(records))
+
+
+def encode_logs_request(docs, t_ns: int | None = None) -> bytes:
+    """LogDocs → ExportLogsServiceRequest protobuf.
+
+    The inverse of ``otlp.decode_logs_request`` over the fields the
+    framework's log pipeline carries (otelcol-config.yml:128-131 is the
+    reference leg this crosses): one ResourceLogs block per service,
+    LogRecord{time_unix_nano=1, severity_text=3, body=5, attributes=6,
+    trace_id=9}. ``doc.ts`` is virtual-clock seconds; the wire wants
+    wall nanos, so ``t_ns`` (default now) stamps the batch and per-doc
+    ts rides as the relative offset from the newest doc.
+    """
+    if t_ns is None:
+        t_ns = int(time.time() * 1e9)
+    by_service: dict[str, list] = {}
+    for doc in docs:
+        by_service.setdefault(doc.service, []).append(doc)
+    # One anchor across the whole batch (not per service): the newest
+    # doc maps to t_ns and every other doc keeps its relative offset,
+    # so cross-service ordering survives the wall-clock re-stamping.
+    newest = max((d.ts for d in docs), default=0.0)
+    out = b""
+    for service, items in by_service.items():
+        resource = wire.encode_len(1, _kv_str("service.name", service))
+        records = b""
+        for doc in items:
+            rec = (
+                wire.encode_fixed64(1, max(t_ns + int((doc.ts - newest) * 1e9), 0))
+                + wire.encode_len(3, (doc.severity or "INFO").encode())
+                + wire.encode_len(
+                    5, wire.encode_len(1, (doc.body or "").encode())
+                )
+            )
+            for k, v in (doc.attrs or {}).items():
+                rec += wire.encode_len(6, _kv_str(k, str(v)))
+            if doc.trace_id:
+                rec += wire.encode_len(9, _norm_trace_id(doc.trace_id))
+            records += wire.encode_len(2, rec)
+        rl = wire.encode_len(1, resource) + wire.encode_len(2, records)
+        out += wire.encode_len(1, rl)
+    return out
+
+
+class OtlpHttpLogsExporter(_ExporterBase):
+    """Subscribe on ``Collector.log_exporters``: ships log batches to an
+    OTLP ``/v1/logs`` endpoint — the collector's third-signal leg
+    (otelcol-config.yml:128-131; in-proc the shop's collector indexes
+    into its own LogStore, this exporter extends the same flow across
+    process boundaries to the sidecar daemon). ``grpc://`` endpoints
+    ship over OTLP/gRPC."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 64):
+        scheme, target = split_endpoint(endpoint)
+        if scheme == "grpc":
+            self._poster = BackgroundPoster(
+                target, "application/grpc", timeout_s, queue_max,
+                send=grpc_send(target, "logs", timeout_s),
+            )
+        else:
+            target = target.rstrip("/")
+            if not target.endswith("/v1/logs"):
+                target += "/v1/logs"
+            self._poster = BackgroundPoster(
+                target, "application/x-protobuf", timeout_s, queue_max
+            )
+
+    def __call__(self, now: float, docs: list) -> None:
+        if docs:
+            self._poster.submit(encode_logs_request(docs))

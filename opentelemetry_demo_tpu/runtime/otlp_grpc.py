@@ -26,6 +26,7 @@ TRACE_EXPORT = "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
 METRICS_EXPORT = (
     "/opentelemetry.proto.collector.metrics.v1.MetricsService/Export"
 )
+LOGS_EXPORT = "/opentelemetry.proto.collector.logs.v1.LogsService/Export"
 
 
 class OtlpGrpcReceiver:
@@ -46,6 +47,7 @@ class OtlpGrpcReceiver:
         port: int = 4317,
         on_columnar: Callable | None = None,
         on_metric_records: Callable | None = None,
+        on_log_records: Callable | None = None,
         max_workers: int = 4,
     ):
         import grpc
@@ -54,6 +56,7 @@ class OtlpGrpcReceiver:
         self.on_records = on_records
         self.on_columnar = on_columnar
         self.on_metric_records = on_metric_records
+        self.on_log_records = on_log_records
         receiver = self
 
         def export_traces(request: bytes, context) -> bytes:
@@ -84,6 +87,17 @@ class OtlpGrpcReceiver:
                 receiver.on_metric_records(records)
             return b""  # empty ExportMetricsServiceResponse
 
+        def export_logs(request: bytes, context) -> bytes:
+            try:
+                docs = otlp.decode_logs_request(request)
+            except Exception:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
+                )
+            if receiver.on_log_records is not None:
+                receiver.on_log_records(docs)
+            return b""  # empty ExportLogsServiceResponse
+
         # grpc.health.v1 beside the OTLP ingress: the registration every
         # reference service performs (main.go:223-224, server.cpp:92-102),
         # and what the compose/k8s healthchecks probe on this daemon.
@@ -95,7 +109,7 @@ class OtlpGrpcReceiver:
 
         self._stop_event = threading.Event()
         self._health = HealthService(
-            {m.split("/")[1] for m in (TRACE_EXPORT, METRICS_EXPORT)},
+            {m.split("/")[1] for m in (TRACE_EXPORT, METRICS_EXPORT, LOGS_EXPORT)},
             self._stop_event,
             watcher_slots=1,
         )
@@ -103,6 +117,7 @@ class OtlpGrpcReceiver:
         handlers = {
             TRACE_EXPORT: export_traces,
             METRICS_EXPORT: export_metrics,
+            LOGS_EXPORT: export_logs,
         }
 
         class Handler(grpc.GenericRpcHandler):

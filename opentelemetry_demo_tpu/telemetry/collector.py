@@ -116,7 +116,13 @@ class Collector:
         # sidecar's /v1/metrics leg subscribes here, in-proc or over
         # HTTP via runtime.otlp_metrics.OtlpHttpMetricsExporter.
         self.metrics_exporters: list[Callable[[float, list], None]] = []
+        # Logs-pipeline subscribers (the third signal,
+        # otelcol-config.yml:128-131): invoked per received log with
+        # (now, [LogDoc]) — runtime.otlp_export.OtlpHttpLogsExporter
+        # extends this flow across process boundaries to the sidecar.
+        self.log_exporters: list[Callable[[float, list], None]] = []
         self._pending_spans: list[SpanRecord] = []
+        self._pending_logs: list[LogDoc] = []
         self._last_batch_flush: float | None = None
         self._last_self_report: float | None = None
         self.dropped_spans = 0
@@ -171,16 +177,23 @@ class Collector:
         trace_id: bytes | None = None,
     ) -> None:
         """Logs pipeline → OpenSearch-analogue index ``otel``."""
-        self.log_store.add(
-            LogDoc(
-                ts=self.clock(),
-                service=service,
-                severity=severity,
-                body=body,
-                attrs=dict(attrs or {}),
-                trace_id=trace_id,
-            )
+        now = self.clock()
+        doc = LogDoc(
+            ts=now,
+            service=service,
+            severity=severity,
+            body=body,
+            attrs=dict(attrs or {}),
+            trace_id=trace_id,
         )
+        self.log_store.add(doc)
+        if self.log_exporters:
+            # Export rides the span batch timer (one request per flush
+            # interval, like _flush_spans) — per-record POSTs would
+            # saturate the background sender exactly during the error
+            # bursts the sidecar's log lane exists to detect. Local
+            # indexing above stays immediate.
+            self._pending_logs.append(doc)
         self.self_metrics.counter_add(
             "otelcol_receiver_accepted_log_records", 1.0, receiver="otlp"
         )
@@ -200,7 +213,7 @@ class Collector:
             self.self_metrics.gauge_set(
                 "otelcol_exporter_queue_size", float(len(self._pending_spans))
             )
-        if self._pending_spans and (
+        if (self._pending_spans or self._pending_logs) and (
             self._last_batch_flush is None
             or now - self._last_batch_flush >= self.config.batch_timeout_s
         ):
@@ -221,7 +234,7 @@ class Collector:
         ``scrape=False`` for trace-only surfaces that don't read the
         TSDB at all."""
         now = self.clock() if now is None else now
-        if self._pending_spans:
+        if self._pending_spans or self._pending_logs:
             self._flush_spans(now)
         if scrape:
             self.scraper.scrape(now)
@@ -238,6 +251,15 @@ class Collector:
         self.self_metrics.counter_add(
             "otelcol_exporter_sent_spans", float(len(batch)), exporter="traces"
         )
+        if self._pending_logs:
+            log_batch, self._pending_logs = self._pending_logs, []
+            for exporter in self.log_exporters:
+                exporter(now, log_batch)
+            self.self_metrics.counter_add(
+                "otelcol_exporter_sent_log_records",
+                float(len(log_batch)),
+                exporter="logs",
+            )
 
     def slowest_exemplars(self, limit: int = 10) -> list[tuple[str, str, "Exemplar"]]:
         """Across all series: the slowest recent exemplar observations,

@@ -18,6 +18,28 @@ from dataclasses import dataclass, field
 SEVERITIES = ("DEBUG", "INFO", "WARN", "ERROR", "FATAL")
 
 
+def normalize_severity(text: str | None) -> str:
+    """Free-form OTLP severityText → the store's 5-level scale.
+
+    SDKs disagree on severity text ("Information", "warning", "ERROR2",
+    "Critical"…); the store's invariant is the 5 canonical levels, so
+    normalization lives at this boundary — every decoder producing
+    LogDocs runs it, not each consumer.
+    """
+    sev = (text or "INFO").upper()
+    if sev in SEVERITIES:
+        return sev
+    if sev.startswith("WARN"):
+        return "WARN"
+    if sev.startswith("ERR"):
+        return "ERROR"
+    if sev.startswith(("FATAL", "CRIT")):
+        return "FATAL"
+    if sev.startswith(("DEBUG", "TRACE")):
+        return "DEBUG"
+    return "INFO"
+
+
 @dataclass
 class LogDoc:
     ts: float

@@ -169,6 +169,7 @@ ANOMALY_CUSUM = "app_anomaly_cusum"
 ANOMALY_METRIC_Z = "app_anomaly_metric_z_score"
 ANOMALY_METRIC_FLAG_TOTAL = "app_anomaly_metric_flags_total"
 ANOMALY_METRIC_POINTS_TOTAL = "app_anomaly_metric_points_processed_total"
+ANOMALY_LOG_RECORDS_TOTAL = "app_anomaly_log_records_processed_total"
 
 
 def export_metrics_report(

@@ -60,12 +60,14 @@ def serve(args) -> None:
     pipeline = None
     span_exporter = None
     metrics_exporter = None
+    logs_exporter = None
     if args.otlp_endpoint:
         # Compose topology: the detector runs in its OWN process (the
         # anomaly-detector container); this process exports spans and
         # scraped metrics to it over OTLP/HTTP, the otelcol exporter
         # pattern (otelcol-config.yml:85-92, docker-compose.yml:226-256).
         from opentelemetry_demo_tpu.runtime.otlp_export import (
+            OtlpHttpLogsExporter,
             OtlpHttpSpanExporter,
         )
         from opentelemetry_demo_tpu.runtime.otlp_metrics import (
@@ -75,6 +77,11 @@ def serve(args) -> None:
         span_exporter = OtlpHttpSpanExporter(args.otlp_endpoint)
         metrics_exporter = OtlpHttpMetricsExporter(args.otlp_endpoint)
         shop.collector.metrics_exporters.append(metrics_exporter)
+        # Third signal (otelcol-config.yml:128-131): shop logs cross to
+        # the sidecar's /v1/logs so a cross-process deployment carries
+        # all three signals, not two.
+        logs_exporter = OtlpHttpLogsExporter(args.otlp_endpoint)
+        shop.collector.log_exporters.append(logs_exporter)
         on_spans = span_exporter
     else:
         # Single-process mode: in-proc detector pipeline.
@@ -138,9 +145,13 @@ def serve(args) -> None:
     if grpc_edge is not None:
         grpc_edge.stop()
     gw.stop()
+    # Push the collector's unflushed span/log tail to the exporters
+    # before draining them — batches land on the pump timer, and the
+    # last window before shutdown has no later pump to flush it.
+    shop.collector.force_flush(scrape=False)
     if pipeline is not None:
         pipeline.drain()
-    for exporter in (span_exporter, metrics_exporter):
+    for exporter in (span_exporter, metrics_exporter, logs_exporter):
         if exporter is not None:
             exporter.flush()
             exporter.close()

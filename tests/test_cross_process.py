@@ -213,3 +213,36 @@ def test_fault_flag_lights_detector_across_process_boundary(topology):
             break
         time.sleep(0.5)
     assert flagged, "paymentFailure never flagged across the process boundary"
+
+
+def test_error_logs_cross_to_daemon_store(topology):
+    """The third signal (otelcol-config.yml:128-131): checkout's ERROR
+    logs during the paymentFailure phase cross the process boundary via
+    the shop collector's /v1/logs exporter and land in the daemon's
+    bounded log store (counted + stored, with the error-rate lane fed).
+
+    Runs after the fault test (module-scoped topology): paymentFailure
+    is still enabled, so failing checkouts keep emitting ERROR logs.
+    """
+    shop = topology["shop"]
+    daemon_metrics = topology["daemon_metrics"]
+
+    deadline = time.monotonic() + 60.0
+    seen = 0.0
+    stored = 0.0
+    i = 0
+    while time.monotonic() < deadline:
+        _checkout(shop, f"log-leg-{i}")
+        i += 1
+        text = _get(f"{daemon_metrics}/metrics").decode()
+        m = re.search(
+            r"^app_anomaly_log_records_processed_total (\d+\.?\d*)", text, re.M
+        )
+        s = re.search(r"^app_anomaly_log_docs_stored (\d+\.?\d*)", text, re.M)
+        if m and float(m.group(1)) >= 1 and s and float(s.group(1)) >= 1:
+            seen = float(m.group(1))
+            stored = float(s.group(1))
+            break
+        time.sleep(0.4)
+    assert seen >= 1, "no shop log record reached the daemon over /v1/logs"
+    assert stored >= 1, "log records counted but none stored"

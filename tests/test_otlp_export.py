@@ -131,3 +131,123 @@ def test_grpc_endpoint_ships_both_signals():
         met_exp.close()
     finally:
         recv.stop()
+
+
+def test_logs_roundtrip_proto_and_receiver():
+    """LogDocs → encode_logs_request → wire decode → receiver route."""
+    import json as _json
+
+    from opentelemetry_demo_tpu.runtime.otlp import (
+        OtlpHttpReceiver,
+        decode_logs_request,
+        decode_logs_request_json,
+    )
+    from opentelemetry_demo_tpu.runtime.otlp_export import encode_logs_request
+    from opentelemetry_demo_tpu.telemetry.logstore import LogDoc
+
+    docs = [
+        LogDoc(ts=10.0, service="checkout", severity="ERROR",
+               body="order failed: card declined",
+               attrs={"user": "u1"}, trace_id=b"\x0a" * 16),
+        LogDoc(ts=10.5, service="payment", severity="WARN",
+               body="charge failed (paymentFailure active)"),
+    ]
+    payload = encode_logs_request(docs, t_ns=1_000_000_000_000)
+    back = decode_logs_request(payload)
+    assert {(d.service, d.severity, d.body) for d in back} == {
+        (d.service, d.severity, d.body) for d in docs
+    }
+    by_svc = {d.service: d for d in back}
+    assert by_svc["checkout"].attrs == {"user": "u1"}
+    assert by_svc["checkout"].trace_id == b"\x0a" * 16
+    assert by_svc["payment"].trace_id is None
+    # Relative ts ordering survives the wall-clock re-stamping.
+    assert by_svc["checkout"].ts < by_svc["payment"].ts
+
+    # JSON decode path (the collector's otlphttp json mode).
+    jdoc = {"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "ad"}}]},
+        "scopeLogs": [{"logRecords": [{
+            "timeUnixNano": "2000000000",
+            "severityText": "FATAL",
+            "body": {"stringValue": "gc storm"},
+            "traceId": "ab" * 16,
+        }]}],
+    }]}
+    jback = decode_logs_request_json(_json.dumps(jdoc).encode())
+    assert jback[0].service == "ad" and jback[0].severity == "FATAL"
+    assert jback[0].trace_id == bytes.fromhex("ab" * 16)
+
+    # Receiver route: POST /v1/logs lands in on_log_records.
+    got = []
+    rx = OtlpHttpReceiver(
+        lambda recs: None, host="127.0.0.1", port=0,
+        on_log_records=got.extend,
+    )
+    rx.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rx.port}/v1/logs", data=payload,
+            headers={"Content-Type": "application/x-protobuf"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        rx.stop()
+    assert {d.service for d in got} == {"checkout", "payment"}
+
+
+def test_logs_exporter_ships_to_receiver():
+    """OtlpHttpLogsExporter → /v1/logs over a real socket."""
+    from opentelemetry_demo_tpu.runtime.otlp import OtlpHttpReceiver
+    from opentelemetry_demo_tpu.runtime.otlp_export import OtlpHttpLogsExporter
+    from opentelemetry_demo_tpu.telemetry.logstore import LogDoc
+
+    got = []
+    rx = OtlpHttpReceiver(
+        lambda recs: None, host="127.0.0.1", port=0,
+        on_log_records=got.extend,
+    )
+    rx.start()
+    exporter = OtlpHttpLogsExporter(f"http://127.0.0.1:{rx.port}")
+    try:
+        exporter(0.0, [LogDoc(ts=1.0, service="email", severity="INFO",
+                              body="confirmation sent")])
+        assert exporter.flush(timeout_s=10.0)
+        assert exporter.sent == 1 and exporter.errors == 0
+    finally:
+        exporter.close()
+        rx.stop()
+    assert got and got[0].service == "email" and got[0].body == "confirmation sent"
+
+
+def test_severity_normalized_at_decode_boundary():
+    """Free-form SDK severityText decodes to the store's 5-level scale,
+    so any consumer can LogStore.add decoded docs without crashing."""
+    import json as _json
+
+    from opentelemetry_demo_tpu.runtime.otlp import decode_logs_request_json
+    from opentelemetry_demo_tpu.telemetry.logstore import (
+        LogStore,
+        normalize_severity,
+    )
+
+    assert normalize_severity("Information") == "INFO"
+    assert normalize_severity("warning") == "WARN"
+    assert normalize_severity("ERROR2") == "ERROR"
+    assert normalize_severity("Critical") == "FATAL"
+    assert normalize_severity("trace") == "DEBUG"
+    assert normalize_severity(None) == "INFO"
+
+    jdoc = {"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "cart"}}]},
+        "scopeLogs": [{"logRecords": [
+            {"severityText": "Information", "body": {"stringValue": "hi"}},
+        ]}],
+    }]}
+    docs = decode_logs_request_json(_json.dumps(jdoc).encode())
+    store = LogStore()
+    store.add(docs[0])  # must not raise
+    assert docs[0].severity == "INFO"

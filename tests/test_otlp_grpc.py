@@ -93,7 +93,7 @@ def test_unknown_method_unimplemented(receiver):
     recv, *_ = receiver
     channel = grpc.insecure_channel(f"127.0.0.1:{recv.port}")
     bogus = channel.unary_unary(
-        "/opentelemetry.proto.collector.logs.v1.LogsService/Export",
+        "/opentelemetry.proto.collector.profiles.v1.ProfilesService/Export",
         request_serializer=None,
         response_deserializer=None,
     )
@@ -150,3 +150,29 @@ def test_health_check_on_the_daemon_ingress(receiver):
     with pytest.raises(grpc.RpcError) as exc:
         check(wire.encode_len(1, b"nope.Service"), timeout=5)
     assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_logs_export_round_trip():
+    """The third signal over gRPC: LogsService/Export → on_log_records."""
+    from opentelemetry_demo_tpu.runtime.otlp_export import encode_logs_request
+    from opentelemetry_demo_tpu.runtime.otlp_grpc import LOGS_EXPORT
+    from opentelemetry_demo_tpu.telemetry.logstore import LogDoc
+
+    logs = []
+    recv = OtlpGrpcReceiver(
+        lambda recs: None, host="127.0.0.1", port=0,
+        on_log_records=logs.extend,
+    )
+    recv.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{recv.port}")
+        fn = channel.unary_unary(
+            LOGS_EXPORT, request_serializer=None, response_deserializer=None
+        )
+        fn(encode_logs_request([
+            LogDoc(ts=5.0, service="checkout", severity="ERROR", body="boom"),
+        ]), timeout=10)
+        channel.close()
+    finally:
+        recv.stop()
+    assert logs and logs[0].service == "checkout" and logs[0].severity == "ERROR"
