@@ -140,14 +140,35 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
     return records
 
 
+def _severity_from_number(num: int) -> str | None:
+    """OTLP SeverityNumber enum → the store's scale (None if unset).
+
+    Spec bands: 1-4 TRACE, 5-8 DEBUG, 9-12 INFO, 13-16 WARN,
+    17-20 ERROR, 21-24 FATAL."""
+    if num <= 0:
+        return None
+    if num <= 8:
+        return "DEBUG"
+    if num <= 12:
+        return "INFO"
+    if num <= 16:
+        return "WARN"
+    if num <= 20:
+        return "ERROR"
+    return "FATAL"
+
+
 def decode_logs_request(payload: bytes) -> list:
     """ExportLogsServiceRequest protobuf → LogDocs.
 
     The collector's third signal (otelcol-config.yml:128-131, logs →
     OpenSearch): ResourceLogs{resource=1, scope_logs=2},
     ScopeLogs{log_records=2}, LogRecord{time_unix_nano=1,
-    severity_text=3, body=5, attributes=6, trace_id=9} per the public
-    opentelemetry-proto logs/v1 field numbers.
+    severity_number=2, severity_text=3, body=5, attributes=6,
+    trace_id=9, observed_time_unix_nano=11} per the public
+    opentelemetry-proto logs/v1 field numbers. Spec fallbacks: severity
+    text is optional (severity_number alone is valid), and
+    time_unix_nano=0 means "use ObservedTimestamp".
     """
     from ..telemetry.logstore import LogDoc, normalize_severity
 
@@ -165,16 +186,24 @@ def decode_logs_request(payload: bytes) -> list:
             for lr_buf in sl.get(2, []):
                 lr = wire.scan_fields(lr_buf)
                 sev_raw = wire.first(lr, 3)
+                sev_text = (
+                    sev_raw.decode("utf-8", "replace")
+                    if isinstance(sev_raw, bytes) and sev_raw else None
+                )
+                if sev_text is None:  # text optional: number-only is valid
+                    sev_text = _severity_from_number(
+                        int(wire.first(lr, 2, 0) or 0)
+                    )
                 body_buf = wire.first(lr, 5)
                 body = _anyvalue_str(body_buf) if isinstance(body_buf, bytes) else None
                 trace_id = wire.first(lr, 9)
+                t_ns = int(wire.first(lr, 1, 0) or 0)
+                if t_ns == 0:  # spec: fall back to ObservedTimestamp
+                    t_ns = int(wire.first(lr, 11, 0) or 0)
                 docs.append(LogDoc(
-                    ts=int(wire.first(lr, 1, 0) or 0) / 1e9,
+                    ts=t_ns / 1e9,
                     service=service,
-                    severity=normalize_severity(
-                        sev_raw.decode("utf-8", "replace")
-                        if isinstance(sev_raw, bytes) else None
-                    ),
+                    severity=normalize_severity(sev_text),
                     body=body or "",
                     attrs=_attrs_to_dict(lr.get(6, [])),
                     trace_id=trace_id if isinstance(trace_id, bytes) and trace_id else None,
@@ -200,10 +229,16 @@ def decode_logs_request_json(payload: bytes) -> list:
                     for a in lr.get("attributes", [])
                 }
                 trace_hex = lr.get("traceId") or ""
+                sev_text = lr.get("severityText") or _severity_from_number(
+                    int(lr.get("severityNumber", 0) or 0)
+                )
+                t_ns = int(lr.get("timeUnixNano", 0) or 0)
+                if t_ns == 0:  # spec: fall back to ObservedTimestamp
+                    t_ns = int(lr.get("observedTimeUnixNano", 0) or 0)
                 docs.append(LogDoc(
-                    ts=int(lr.get("timeUnixNano", 0)) / 1e9,
+                    ts=t_ns / 1e9,
                     service=service,
-                    severity=normalize_severity(lr.get("severityText")),
+                    severity=normalize_severity(sev_text),
                     body=lr.get("body", {}).get("stringValue", ""),
                     attrs={k: v for k, v in attrs.items() if v is not None},
                     trace_id=bytes.fromhex(trace_hex) if trace_hex else None,

@@ -251,3 +251,27 @@ def test_severity_normalized_at_decode_boundary():
     store = LogStore()
     store.add(docs[0])  # must not raise
     assert docs[0].severity == "INFO"
+
+
+def test_logs_decode_spec_fallbacks():
+    """OTLP spec allowances: severity_number without text, and
+    time_unix_nano=0 with ObservedTimestamp populated."""
+    import json as _json
+
+    from opentelemetry_demo_tpu.runtime.otlp import decode_logs_request_json
+
+    jdoc = {"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "bridge"}}]},
+        "scopeLogs": [{"logRecords": [
+            {"severityNumber": 17, "observedTimeUnixNano": "3000000000",
+             "body": {"stringValue": "number-only error"}},
+            {"severityNumber": 22, "timeUnixNano": "0",
+             "observedTimeUnixNano": "4000000000",
+             "body": {"stringValue": "fatal"}},
+            {"severityNumber": 5, "body": {"stringValue": "debugish"}},
+        ]}],
+    }]}
+    docs = decode_logs_request_json(_json.dumps(jdoc).encode())
+    assert [d.severity for d in docs] == ["ERROR", "FATAL", "DEBUG"]
+    assert docs[0].ts == 3.0 and docs[1].ts == 4.0
