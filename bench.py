@@ -168,8 +168,8 @@ def measure_impl_matrix(rng) -> dict[str, float]:
     if jax.default_backend() != "tpu":
         return {}
     out: dict[str, float] = {}
-    # Both impls at both sides of the 8192 crossover
-    # (fused.IMPL_CROSSOVER_BATCH) plus the endpoints: 8192 is the
+    # Both impls at both sides of the reference-geometry 8192 crossover
+    # (the calibration table above fused.expected_rates): 8192 is the
     # dense kernel's last winning point, 16384 the first where the xla
     # path's MXU-histogram CMS engages and overtakes it. Compiles
     # dominate the cost, so the sweep stays at 8 entries.

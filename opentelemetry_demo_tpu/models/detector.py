@@ -341,7 +341,11 @@ def detector_step(
         cms_width=config.cms_width,
         impl=fused.resolve_impl(
             config.sketch_impl, batch=int(svc.shape[0]),
-            cms_depth=config.cms_depth, cms_width=config.cms_width,
+            # Shard-LOCAL geometry: the kernel sweeps this shard's
+            # cells (s_axis services, d_local CMS rows), and the rate
+            # model must price what actually runs.
+            cms_depth=int(cidx.shape[0]), cms_width=config.cms_width,
+            num_services=s_axis, hll_p=config.hll_p,
         ),
     )
     hll_delta = comm.pmax_batch(delta.hll)

