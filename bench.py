@@ -217,6 +217,14 @@ def main():
     fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
 
+    # ---- detection quality: per-fault TTD + false-positive rate ------
+    # Detector math is backend-independent; a CPU subprocess avoids
+    # paying the tunneled-TPU fetch RTT ~1900 times (one per stepped
+    # report) for numbers that would come out identical.
+    quality = {}
+    if os.environ.get("BENCH_QUALITY", "1") != "0":
+        quality = measure_quality_subprocess()
+
     # ---- stress config (BASELINE #4: 10× the Locust profile) ---------
     # Same methodology at 10× the rate with the async harvester (the
     # stress deployment shape); paired-RTT fields ride along.
@@ -259,6 +267,12 @@ def main():
                 "lag_stress_batches": stress.get("batches"),
                 "lag_stress_reports_skipped": stress.get("reports_skipped"),
                 "lag_stress_skip_rate": stress.get("skip_rate"),
+                "ttd_s": {
+                    name: entry.get("ttd_s")
+                    for name, entry in (quality.get("ttd") or {}).items()
+                },
+                "fp_rate": quality.get("fp_rate"),
+                "detection_quality": quality or None,
                 "fetch_rtt_ms": fetch_rtt_ms,
                 "host_ingest_spans_per_sec": (
                     round(ingest_rate, 1) if ingest_rate else None
@@ -278,6 +292,32 @@ def main():
             }
         )
     )
+
+
+def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
+    """Run runtime.qualbench in a pristine CPU interpreter; {} on failure
+    (the quality fields are additive — a broken CPU leg must not sink
+    the throughput/lag artifact)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # one tunnel holder at a time
+    here = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "opentelemetry_demo_tpu.runtime.qualbench"],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode != 0:
+            print(proc.stderr[-2000:], file=sys.stderr)
+            return {}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError):
+        return {}
 
 
 def measure_fetch_rtt() -> float:

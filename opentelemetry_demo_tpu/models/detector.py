@@ -76,6 +76,10 @@ class DetectorConfig(NamedTuple):
     z_threshold: float = 6.0
     card_alpha: float = 0.3  # EWMA weight per completed window
     warmup_batches: float = 20.0  # CUSUM suppressed until this many obs
+    # NOTE: new fields must append at the TUPLE END (after sketch_impl):
+    # checkpoints persist this config positionally (runtime.checkpoint
+    # saves list(config)), so a mid-tuple insertion silently shifts
+    # every later field on restore of an older snapshot.
     # Instant z needs a believable σ estimate, and tails take ~3x more
     # samples to learn than means — so single-batch z-scores stay gated
     # longer than the (drift-protected) CUSUM accumulators.
@@ -85,17 +89,37 @@ class DetectorConfig(NamedTuple):
     # Page's CUSUM on standardized scores: catches sustained small
     # shifts a single-batch z can't (sparse errors, gradual creep).
     cusum_k: float = 0.5  # per-batch drift toward zero
-    cusum_h: float = 5.0  # alarm threshold
+    cusum_h: float = 5.0  # alarm threshold (latency↑ / error↑ lanes)
     cusum_cap: float = 50.0  # bound accumulation (bounded recovery time)
     err_slack: float = 0.01  # tolerated error-rate above baseline
     # Batch→delta sketch implementation: None auto-selects (the fused
     # Pallas kernel on TPU, XLA scatters elsewhere); "xla" / "pallas" /
     # "interpret" force a path (see ops.fused).
     sketch_impl: str | None = None
+    # The rate↓ CUSUM lane runs a HIGHER threshold than lat/err.
+    # Measured (runtime.qualbench, 600 quiet batches at uniform load):
+    # every false alarm came from the rate-down accumulator —
+    # per-service counts are multinomial-noisy, and with S parallel
+    # CUSUMs h=5's per-lane ARL0 (~1k batches) fires every few minutes.
+    # h=8 zeroes the measured FP rate and real throughput collapses
+    # still detect in <1 s (kafkaQueueProblems TTD 0.75 s). lat/err
+    # keep h=5: their scores only integrate on batches where the
+    # service appears, so at the shop's sparse checkout cadence a
+    # higher bar lets the baseline EWMA adapt to the fault before the
+    # accumulator alarms (measured: paymentFailure never flags within
+    # 300 s at err-h=8). Appended at the tuple end — see the NOTE above.
+    cusum_h_rate: float = 8.0
 
     @property
     def num_windows(self) -> int:
         return len(self.windows_s)
+
+    @property
+    def cusum_thresholds(self) -> tuple[float, float, float]:
+        """Per-lane alarm thresholds in cusum column order
+        {lat↑, err↑, rate↓} — the single source both the device flag
+        computation and the pipeline's flagd re-derive path use."""
+        return (self.cusum_h, self.cusum_h, self.cusum_h_rate)
 
     @property
     def num_taus(self) -> int:
@@ -553,12 +577,15 @@ def detector_step(
 
     # ---- flags -------------------------------------------------------
     thr = config.z_threshold
+    # Per-lane CUSUM thresholds: {lat↑, err↑, rate↓} — the rate lane
+    # runs higher (see cusum_h_rate's rationale in DetectorConfig).
+    cusum_thr = jnp.asarray(config.cusum_thresholds, jnp.float32)
     flags = (
         jnp.any(jnp.abs(lat_z) > thr, axis=1)
         | jnp.any(jnp.abs(err_z) > thr, axis=1)
         | jnp.any(jnp.abs(rate_z) > thr, axis=1)
         | jnp.any(jnp.abs(card_z) > thr, axis=1)
-        | jnp.any(cusum > config.cusum_h, axis=1)
+        | jnp.any(cusum > cusum_thr[None, :], axis=1)
     )
 
     new_state = DetectorState(

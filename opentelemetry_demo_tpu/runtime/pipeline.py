@@ -410,7 +410,10 @@ class DetectorPipeline:
                     np.abs(report.card_z).max(axis=1),
                 ]
             )
-            cusum_alarm = report.cusum.max(axis=1) > self.detector.config.cusum_h
+            cusum_thr = np.asarray(
+                self.detector.config.cusum_thresholds, np.float32
+            )
+            cusum_alarm = (report.cusum > cusum_thr[None, :]).any(axis=1)
             flags_np = (z > threshold) | cusum_alarm
         if flags_np.any():
             self.stats.flag_events += 1

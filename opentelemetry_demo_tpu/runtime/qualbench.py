@@ -1,0 +1,162 @@
+"""Detection-quality measurement: per-fault time-to-detect + FP rate.
+
+The fault-matrix e2e tests (tests/test_shop_e2e.py,
+tests/test_e2e_detection.py) prove detection *happens*; this module
+measures how *well*, producing the ``ttd_s`` / ``fp_rate`` fields of
+the bench artifact. Each fault shape mirrors one of the reference's
+flagd failure scenarios (SURVEY.md §5 fault-injection inventory —
+demo.flagd.json:4-108) projected onto the synthetic span stream:
+
+- ``paymentFailure``            → error-rate burst on one service
+- ``adHighCpu`` / ``imageSlowLoad`` → step latency degradation
+- ``recommendationCacheFailure``  → gradual latency ramp (cache leak)
+- ``kafkaQueueProblems``        → throughput collapse (consumer stall)
+- ``errorTrickle``              → sustained small error shift, below
+  any single-batch threshold (the CUSUM-integration case)
+
+Time-to-detect is virtual seconds from fault onset to the first batch
+whose report flags the faulted service; the false-positive rate is
+flagged-batches / batches over a long clean run after warmup. Both are
+detector *math*, independent of which backend executes it — bench.py
+runs this in a CPU subprocess so per-step report fetches don't pay the
+tunneled-TPU round trip ~1000 times.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models import AnomalyDetector, DetectorConfig
+
+S = 8
+B = 256
+DT_S = 0.25  # virtual seconds per batch (~1k spans/s at B=256)
+WARM_STEPS = 120
+FAULT_WINDOW_STEPS = 120  # give-up horizon after onset
+QUIET_STEPS = 600
+
+
+def _quality_config() -> DetectorConfig:
+    """Reduced CMS width (fast compile), PRODUCTION thresholds/warmups
+    AND production HLL precision — quality numbers with detuned
+    thresholds would be fiction, and p=8's ~3% estimator noise alone
+    can graze the 6σ cardinality threshold (measured: one card_z=6.1
+    warmup spike that p=12's ~0.8% noise does not produce)."""
+    return DetectorConfig(num_services=S, hll_p=12, cms_width=512)
+
+
+def _batch(rng, tz, mutate=None, step: int = 0):
+    lat = rng.gamma(4.0, 250.0, size=B).astype(np.float32)
+    svc = rng.integers(0, S, size=B)
+    err = (rng.random(B) < 0.01).astype(np.float32)
+    keep = np.ones(B, bool)
+    if mutate is not None:
+        lat, err, keep = mutate(step, svc, lat, err, keep)
+    return tz.pack_arrays(
+        svc=svc[keep],
+        lat_us=lat[keep],
+        trace_id=rng.integers(0, 2**63, size=int(keep.sum()), dtype=np.uint64),
+        is_error=err[keep],
+        attr_key=rng.zipf(1.5, size=int(keep.sum())).astype(np.uint64),
+    )
+
+
+def fault_shapes(rng):
+    """name → (faulted service index, mutate(step, svc, lat, err, keep))."""
+
+    def burst(step, svc, lat, err, keep):
+        hit = (rng.random(B) < 0.25).astype(np.float32)
+        return lat, np.where(svc == 5, np.maximum(err, hit), err).astype(
+            np.float32
+        ), keep
+
+    def latency_step(step, svc, lat, err, keep):
+        return np.where(svc == 1, lat * 3.0, lat).astype(np.float32), err, keep
+
+    def cache_ramp(step, svc, lat, err, keep):
+        scale = 1.10 ** min(step, 60)  # unbounded cache growth shape
+        return np.where(svc == 2, lat * scale, lat).astype(np.float32), err, keep
+
+    def rate_drop(step, svc, lat, err, keep):
+        # Consumer stall: 90% of the service's spans stop arriving.
+        return lat, err, keep & ~((svc == 3) & (rng.random(B) < 0.9))
+
+    def trickle(step, svc, lat, err, keep):
+        hit = (rng.random(B) < 0.06).astype(np.float32)
+        return lat, np.where(svc == 4, np.maximum(err, hit), err).astype(
+            np.float32
+        ), keep
+
+    return {
+        "paymentFailure": (5, burst),
+        "adHighCpu": (1, latency_step),
+        "recommendationCacheFailure": (2, cache_ramp),
+        "kafkaQueueProblems": (3, rate_drop),
+        "errorTrickle": (4, trickle),
+    }
+
+
+def measure_time_to_detect(name: str, fault_svc: int, mutate, seed: int = 0):
+    """One fault scenario: clean warmup, onset, first correct flag."""
+    from .tensorize import SpanTensorizer
+
+    rng = np.random.default_rng(seed)
+    det = AnomalyDetector(_quality_config())
+    tz = SpanTensorizer(num_services=S, batch_size=B)
+    false_before = 0
+    for step in range(WARM_STEPS):
+        report = det.observe(_batch(rng, tz), step * DT_S)
+        if np.asarray(report.flags).any():
+            false_before += 1
+    for k in range(FAULT_WINDOW_STEPS):
+        step = WARM_STEPS + k
+        report = det.observe(
+            _batch(rng, tz, mutate=mutate, step=k), step * DT_S
+        )
+        flags = np.asarray(report.flags)
+        if flags[fault_svc]:
+            return {
+                "ttd_s": round((k + 1) * DT_S, 3),
+                "ttd_batches": k + 1,
+                "false_flags_warmup": false_before,
+            }
+    return {"ttd_s": None, "ttd_batches": None, "false_flags_warmup": false_before}
+
+
+def measure_fp_rate(seed: int = 1):
+    """Long clean run: flagged-batch fraction after warmup."""
+    from .tensorize import SpanTensorizer
+
+    rng = np.random.default_rng(seed)
+    det = AnomalyDetector(_quality_config())
+    tz = SpanTensorizer(num_services=S, batch_size=B)
+    flagged = 0
+    for step in range(WARM_STEPS + QUIET_STEPS):
+        report = det.observe(_batch(rng, tz), step * DT_S)
+        if step >= WARM_STEPS and np.asarray(report.flags).any():
+            flagged += 1
+    return {
+        "fp_rate": round(flagged / QUIET_STEPS, 5),
+        "fp_batches": flagged,
+        "quiet_batches": QUIET_STEPS,
+    }
+
+
+def measure_detection_quality(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    ttd = {}
+    for name, (svc, mutate) in fault_shapes(rng).items():
+        ttd[name] = measure_time_to_detect(name, svc, mutate, seed=seed)
+    out = {"dt_s": DT_S, "batch": B, "ttd": ttd}
+    out.update(measure_fp_rate(seed=seed + 1))
+    return out
+
+
+def main() -> None:
+    import json
+
+    print(json.dumps(measure_detection_quality()))
+
+
+if __name__ == "__main__":
+    main()

@@ -160,3 +160,24 @@ def test_fault_under_harvest_skip_pressure_still_alarms():
         % (final_flags, np.asarray(harvested[-1].cusum)[2])
     )
     assert final_flags.sum() == 1, final_flags
+
+
+def test_detection_quality_bench(monkeypatch):
+    """The bench's quality engine (runtime.qualbench), reduced horizons:
+    the burst fault detects promptly, and the quiet run stays clean —
+    the ttd_s/fp_rate artifact fields can't silently regress."""
+    from opentelemetry_demo_tpu.runtime import qualbench as qb
+
+    monkeypatch.setattr(qb, "WARM_STEPS", 40)
+    monkeypatch.setattr(qb, "FAULT_WINDOW_STEPS", 40)
+    monkeypatch.setattr(qb, "QUIET_STEPS", 120)
+
+    rng = np.random.default_rng(0)
+    shapes = qb.fault_shapes(rng)
+    svc, mutate = shapes["paymentFailure"]
+    out = qb.measure_time_to_detect("paymentFailure", svc, mutate)
+    assert out["ttd_s"] is not None and out["ttd_s"] <= 5.0, out
+    assert out["false_flags_warmup"] == 0, out
+
+    fp = qb.measure_fp_rate()
+    assert fp["fp_rate"] <= 0.02, fp

@@ -490,6 +490,42 @@ class TestCheckpoint:
             det2.observe(b, 1001.0)
         assert int(det2.state.step_idx) == int(det.state.step_idx) + 1
 
+    def test_old_checkpoint_without_trailing_fields_loads(self, rng, tmp_path):
+        """Config fields appended at the tuple end (the required growth
+        direction — DetectorConfig's NOTE) restore from OLDER snapshots
+        with their defaults; a mid-tuple insertion would instead shift
+        every later field silently."""
+        import json
+
+        det = AnomalyDetector(DetectorConfig(num_services=8))
+        tz = SpanTensorizer(num_services=8, batch_size=128)
+        recs = [
+            SpanRecord("a", float(rng.normal(100, 5)), int(rng.integers(0, 2**62)))
+            for _ in range(64)
+        ]
+        for b in tz.tensorize(recs):
+            det.observe(b, 1000.0)
+        path = str(tmp_path / "old")
+        checkpoint.save(path, det)
+        # Rewrite the snapshot as an older version would have written
+        # it: config list truncated before the newest trailing field.
+        with np.load(path + ".npz") as data:
+            arrays = {k: data[k] for k in data.files if k != "__meta__"}
+            meta = json.loads(str(data["__meta__"][()]))
+        assert meta["config"][-1] == DetectorConfig().cusum_h_rate
+        meta["config"] = meta["config"][:-1]
+        with open(path + ".npz", "wb") as f:
+            np.savez_compressed(
+                f, __meta__=np.asarray(json.dumps(meta)), **arrays
+            )
+
+        det2, _ = checkpoint.load(path)
+        assert det2.config.cusum_h_rate == DetectorConfig().cusum_h_rate
+        assert det2.config.num_services == 8
+        # And the fingerprint path accepts it too (daemon restart shape).
+        det3, _ = checkpoint.load(path, DetectorConfig(num_services=8))
+        assert det3.config.cusum_h_rate == DetectorConfig().cusum_h_rate
+
     def test_snapshot_is_one_file(self, tmp_path):
         # State and offsets must commit atomically: a single npz, no
         # sidecar that a crash could leave out of step with the arrays.
