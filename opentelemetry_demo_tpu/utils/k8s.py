@@ -49,7 +49,9 @@ def deployment(
     volume_mounts: list[dict] | None = None,
     volumes: list[dict] | None = None,
     readiness_http: tuple[str, int] | None = None,
+    liveness_http: tuple[str, int] | None = None,
     grpc_health_port: int | None = None,
+    tcp_probe_port: int | None = None,
     replicas: int = 1,
     strategy: str | None = None,
 ) -> dict:
@@ -67,6 +69,21 @@ def deployment(
         container["ports"] = [{"containerPort": p} for p in ports]
     if volume_mounts:
         container["volumeMounts"] = volume_mounts
+    # One probe FAMILY per deployment: grpc/tcp set BOTH readiness and
+    # liveness, so mixing them with each other or with the http pair
+    # would silently overwrite one of the probes.
+    probe_kinds = [
+        k for k, v in (
+            ("http", readiness_http or liveness_http),
+            ("grpc", grpc_health_port),
+            ("tcp", tcp_probe_port),
+        ) if v
+    ]
+    if len(probe_kinds) > 1:
+        raise ValueError(
+            f"multiple probe kinds {probe_kinds}: one would silently "
+            "replace the other — pick one family per deployment"
+        )
     if readiness_http:
         path, port = readiness_http
         container["readinessProbe"] = {
@@ -74,12 +91,16 @@ def deployment(
             "initialDelaySeconds": 5,
             "periodSeconds": 10,
         }
-    if readiness_http and grpc_health_port:
-        raise ValueError(
-            "readiness_http and grpc_health_port both set: the gRPC "
-            "probe would silently replace the HTTP readiness gate — "
-            "pick one per deployment"
-        )
+    if liveness_http:
+        # Liveness gets a longer grace than readiness: a slow boot must
+        # gate traffic, not trigger a restart loop.
+        path, port = liveness_http
+        container["livenessProbe"] = {
+            "httpGet": {"path": path, "port": port},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 20,
+            "failureThreshold": 3,
+        }
     if grpc_health_port:
         # Native kubelet gRPC probe (k8s ≥1.24): queries the same
         # grpc.health.v1 service the reference's containers register
@@ -95,12 +116,34 @@ def deployment(
             "periodSeconds": 20,
             "failureThreshold": 3,
         }
+    if tcp_probe_port:
+        # Raw socket-accept probes for wire-protocol servers with no
+        # HTTP/gRPC surface (the broker) — the shape the reference's
+        # kafka healthcheck takes (docker-compose.yml:681-687).
+        container["readinessProbe"] = {
+            "tcpSocket": {"port": tcp_probe_port},
+            "initialDelaySeconds": 5,
+            "periodSeconds": 10,
+        }
+        container["livenessProbe"] = {
+            "tcpSocket": {"port": tcp_probe_port},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 20,
+            "failureThreshold": 3,
+        }
     spec: dict = {
         "replicas": replicas,
         "selector": {"matchLabels": {APP_LABEL: name}},
         "template": {
             "metadata": {"labels": _labels(name)},
-            "spec": {"containers": [container]},
+            "spec": {
+                # RBAC posture (the reference manifest ships per-service
+                # ServiceAccounts): a dedicated identity per component,
+                # with API credentials NOT mounted — nothing in this
+                # stack talks to the kube API.
+                "serviceAccountName": name,
+                "containers": [container],
+            },
         },
     }
     if volumes:
@@ -112,6 +155,15 @@ def deployment(
         "kind": "Deployment",
         "metadata": {"name": name, "labels": _labels(name)},
         "spec": spec,
+    }
+
+
+def service_account(name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": name, "labels": _labels(name)},
+        "automountServiceAccountToken": False,
     }
 
 
@@ -175,6 +227,7 @@ def _detector_resources(kafka_addr: str | None) -> list[dict]:
     if kafka_addr:
         env["KAFKA_ADDR"] = kafka_addr
     return [
+        service_account("anomaly-detector"),
         deployment(
             "anomaly-detector",
             IMAGE_DETECTOR,
@@ -219,19 +272,58 @@ def _flagd_configmap() -> dict:
     return configmap("flagd-config", {"demo.flagd.json": flags})
 
 
-def standalone_stack() -> list[dict]:
-    """The whole framework stack as cluster resources."""
-    docs: list[dict] = [_flagd_configmap()]
-    docs += [
+def kafka_resources() -> list[dict]:
+    """The async tier as its own component, like the reference's kafka
+    container (docker-compose.yml kafka service): the in-repo broker
+    process with socket-accept probes and a drain budget."""
+    return [
+        service_account("kafka"),
+        deployment(
+            "kafka",
+            IMAGE_GATEWAY,
+            command=["python", "scripts/serve_kafka.py"],
+            env={"KAFKA_PORT": "9092"},
+            ports=[9092],
+            tcp_probe_port=9092,
+            memory="620Mi",  # the reference's kafka budget
+        ),
+        service("kafka", [9092]),
+        pod_disruption_budget("kafka"),
+    ]
+
+
+def shop_resources() -> list[dict]:
+    """Edge + shop tier: gateway (HTTP :8080 incl. /jaeger + /grafana
+    observability surfaces), gRPC edge :8443, wired to the broker and
+    exporting all three OTLP signals to the detector service."""
+    return [
+        service_account("shop-gateway"),
         deployment(
             "shop-gateway",
             IMAGE_GATEWAY,
-            env={"SHOP_PORT": "8080", "SHOP_USERS": "0"},
-            ports=[8080],
+            command=[
+                "python", "scripts/serve_shop.py",
+                "--kafka", "kafka:9092",
+                "--otlp-endpoint", "http://anomaly-detector:4318",
+            ],
+            env={
+                "SHOP_PORT": "8080",
+                "SHOP_GRPC_PORT": "8443",
+                "SHOP_USERS": "0",
+            },
+            ports=[8080, 8443],
             memory="500Mi",
             readiness_http=("/health", 8080),
+            liveness_http=("/health", 8080),
         ),
-        service("shop-gateway", [8080]),
+        service("shop-gateway", [8080, 8443]),
+        pod_disruption_budget("shop-gateway"),
+    ]
+
+
+def loadgen_resources() -> list[dict]:
+    return [
+        service_account("load-generator"),
         deployment(
             "load-generator",
             IMAGE_GATEWAY,
@@ -240,7 +332,25 @@ def standalone_stack() -> list[dict]:
             memory="1500Mi",
         ),
     ]
-    docs += _detector_resources(kafka_addr=None)
+
+
+def component_bundles() -> dict[str, list[dict]]:
+    """Per-component resource bundles — the reference manifest's
+    per-service breakout, generated instead of Helm-templated."""
+    return {
+        "kafka": kafka_resources(),
+        "shop-gateway": shop_resources(),
+        "load-generator": loadgen_resources(),
+        "anomaly-detector": [_flagd_configmap()]
+        + _detector_resources(kafka_addr="kafka:9092"),
+    }
+
+
+def standalone_stack() -> list[dict]:
+    """The whole framework stack as cluster resources."""
+    docs: list[dict] = []
+    for bundle in component_bundles().values():
+        docs.extend(bundle)
     return docs
 
 
@@ -257,11 +367,26 @@ def to_yaml(docs: list[dict]) -> str:
 
 def write_manifests(outdir: str) -> list[str]:
     os.makedirs(outdir, exist_ok=True)
-    written = []
-    for fname, docs in (
+    targets = [
         ("opentelemetry-demo-tpu.yaml", standalone_stack()),
         ("anomaly-detector-sidecar.yaml", sidecar_overlay()),
-    ):
+    ]
+    # Per-component breakout beside the aggregates (operate one tier at
+    # a time, the way the reference's per-service Helm values allow).
+    comp_dir = os.path.join(outdir, "components")
+    os.makedirs(comp_dir, exist_ok=True)
+    bundles = component_bundles()
+    # Prune stale generations: a renamed/removed component must not
+    # leave a "do not edit" file behind that `kubectl apply -f` would
+    # still create.
+    keep = {f"{name}.yaml" for name in bundles}
+    for fname in os.listdir(comp_dir):
+        if fname.endswith(".yaml") and fname not in keep:
+            os.remove(os.path.join(comp_dir, fname))
+    for name, docs in bundles.items():
+        targets.append((os.path.join("components", f"{name}.yaml"), docs))
+    written = []
+    for fname, docs in targets:
         path = os.path.join(outdir, fname)
         with open(path, "w") as f:
             f.write("# Generated by opentelemetry_demo_tpu.utils.k8s — do not edit.\n")

@@ -27,13 +27,62 @@ class TestManifests:
     def test_standalone_stack_resources(self):
         docs = k8s.standalone_stack()
         idx = _by_kind_name(docs)
-        assert ("Deployment", "shop-gateway") in idx
-        assert ("Deployment", "anomaly-detector") in idx
-        assert ("Deployment", "load-generator") in idx
+        for name in ("shop-gateway", "anomaly-detector", "load-generator", "kafka"):
+            assert ("Deployment", name) in idx, name
+            assert ("ServiceAccount", name) in idx, name
         assert ("Service", "anomaly-detector") in idx
+        assert ("Service", "kafka") in idx
         assert ("PersistentVolumeClaim", "anomaly-state") in idx
-        assert ("PodDisruptionBudget", "anomaly-detector") in idx
+        for name in ("anomaly-detector", "kafka", "shop-gateway"):
+            assert ("PodDisruptionBudget", name) in idx, name
         assert ("ConfigMap", "flagd-config") in idx
+
+    def test_every_pod_runs_a_credentialless_service_account(self):
+        """RBAC posture: each component gets its own identity, with API
+        credentials not mounted (nothing here talks to the kube API)."""
+        idx = _by_kind_name(k8s.standalone_stack())
+        for (kind, name), doc in idx.items():
+            if kind == "Deployment":
+                pod = doc["spec"]["template"]["spec"]
+                assert pod["serviceAccountName"] == name
+                sa = idx[("ServiceAccount", name)]
+                assert sa["automountServiceAccountToken"] is False
+
+    def test_component_probe_shapes(self):
+        """Per-component health gating mirrors the reference's
+        healthcheck styles: HTTP for the edge, raw socket-accept for
+        the broker (docker-compose.yml:681-687), kubelet gRPC for the
+        detector — each with readiness AND liveness."""
+        idx = _by_kind_name(k8s.standalone_stack())
+
+        shop = idx[("Deployment", "shop-gateway")]["spec"]["template"]["spec"]["containers"][0]
+        assert shop["readinessProbe"]["httpGet"]["path"] == "/health"
+        assert shop["livenessProbe"]["httpGet"]["path"] == "/health"
+        # Liveness grace exceeds readiness: slow boots gate traffic
+        # rather than restart-loop.
+        assert (shop["livenessProbe"]["initialDelaySeconds"]
+                > shop["readinessProbe"]["initialDelaySeconds"])
+
+        kafka = idx[("Deployment", "kafka")]["spec"]["template"]["spec"]["containers"][0]
+        assert kafka["readinessProbe"]["tcpSocket"]["port"] == 9092
+        assert kafka["livenessProbe"]["tcpSocket"]["port"] == 9092
+
+    def test_full_topology_wiring(self):
+        """The standalone stack is the THREE-process topology: shop →
+        broker (orders) and shop → detector (OTLP, all three signals)."""
+        idx = _by_kind_name(k8s.standalone_stack())
+        shop = idx[("Deployment", "shop-gateway")]["spec"]["template"]["spec"]["containers"][0]
+        assert "--kafka" in shop["command"]
+        assert shop["command"][shop["command"].index("--kafka") + 1] == "kafka:9092"
+        assert "--otlp-endpoint" in shop["command"]
+        assert "anomaly-detector:4318" in shop["command"][
+            shop["command"].index("--otlp-endpoint") + 1
+        ]
+        env = {e["name"]: e["value"] for e in shop["env"]}
+        assert env["SHOP_GRPC_PORT"] == "8443"
+        det = idx[("Deployment", "anomaly-detector")]["spec"]["template"]["spec"]["containers"][0]
+        det_env = {e["name"]: e["value"] for e in det["env"]}
+        assert det_env["KAFKA_ADDR"] == "kafka:9092"
 
     def test_detector_wiring(self):
         idx = _by_kind_name(k8s.sidecar_overlay(kafka_addr="kafka:9092"))
@@ -68,10 +117,14 @@ class TestManifests:
 
     def test_yaml_round_trip(self, tmp_path):
         paths = k8s.write_manifests(str(tmp_path))
-        assert len(paths) == 2
+        # 2 aggregates + one breakout file per component.
+        assert len(paths) == 2 + len(k8s.component_bundles())
         for p in paths:
             docs = list(yaml.safe_load_all(open(p)))
             assert all("apiVersion" in d and "kind" in d for d in docs)
+        names = {p.split("/")[-1] for p in paths}
+        assert {"kafka.yaml", "shop-gateway.yaml", "anomaly-detector.yaml",
+                "load-generator.yaml"} <= names
 
     def test_flagd_configmap_carries_real_flags(self):
         cm = k8s._flagd_configmap()
@@ -117,3 +170,21 @@ class TestServeScript:
         finally:
             proc.terminate()
             proc.wait(timeout=20)
+
+
+class TestGeneratorGuards:
+    def test_probe_families_are_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="probe kinds"):
+            k8s.deployment("x", "img", liveness_http=("/h", 1),
+                           tcp_probe_port=2)
+        with pytest.raises(ValueError, match="probe kinds"):
+            k8s.deployment("x", "img", readiness_http=("/h", 1),
+                           grpc_health_port=2)
+
+    def test_stale_component_files_pruned(self, tmp_path):
+        stale = tmp_path / "components" / "removed-tier.yaml"
+        stale.parent.mkdir()
+        stale.write_text("# Generated ...\n")
+        k8s.write_manifests(str(tmp_path))
+        assert not stale.exists()
+        assert (tmp_path / "components" / "kafka.yaml").exists()
