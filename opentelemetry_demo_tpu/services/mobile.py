@@ -23,10 +23,32 @@ from __future__ import annotations
 import json
 import urllib.request
 import uuid
+from dataclasses import dataclass
 
 from .checkout import placed_order_json
 from .frontend import Frontend
 from ..telemetry.tracer import TraceContext, Tracer
+
+
+@dataclass
+class CheckoutForm:
+    """The RN CheckoutForm's IFormData shape
+    (src/react-native-app/components/CheckoutForm/CheckoutForm.tsx,
+    consumed by cart.tsx onPlaceOrder): email + shipping address +
+    credit card, defaults matching the form's prefilled demo values.
+    The RN app hard-codes the currency to USD (cart.tsx comment)."""
+
+    email: str = "someone@example.com"
+    street_address: str = "1600 Amphitheatre Parkway"
+    city: str = "Mountain View"
+    state: str = "CA"
+    country: str = "United States"
+    zip_code: str = "94043"
+    credit_card_number: str = "4432-8015-6152-0454"
+    credit_card_cvv: str = "672"
+    credit_card_expiration_month: int = 1
+    credit_card_expiration_year: int = 2030
+    currency: str = "USD"
 
 
 class MobileSession:
@@ -67,6 +89,9 @@ class InProcTransport:
         items = self.frontend.api_cart_get(ctx, user_id)
         # Same wire shape the gateway's /api/cart returns.
         return [{"productId": p, "quantity": q} for p, q in items.items()]
+
+    def cart_empty(self, ctx, user_id):
+        self.frontend.api_cart_empty(ctx, user_id)
 
     def checkout(self, ctx, user_id, currency, email):
         order = self.frontend.api_checkout(ctx, user_id, currency, email)
@@ -111,6 +136,9 @@ class HttpTransport:
 
     def cart_get(self, ctx, user_id):
         return self._call(ctx, "GET", f"/api/cart?sessionId={user_id}")["items"]
+
+    def cart_empty(self, ctx, user_id):
+        self._call(ctx, "DELETE", f"/api/cart?sessionId={user_id}")
 
     def checkout(self, ctx, user_id, currency, email):
         return self._call(ctx, "POST", "/api/checkout", {
@@ -191,24 +219,75 @@ class MobileApp:
         )
 
     def cart_screen(self) -> dict:
-        """Tab ``cart``: current items."""
+        """Tab ``cart`` RENDERED (cart.tsx): the items resolved to full
+        product rows (each row is a ProductCard over the cart item),
+        the tab badge (total quantity), per-line and cart totals, and
+        the EmptyCart state when nothing is held."""
         ctx = self.session.new_context()
-        return self._screen(
-            "GET /api/cart", ctx,
-            lambda: self.transport.cart_get(ctx, self.session.session_id),
-        )
 
-    def checkout_flow(self, currency: str = "USD") -> dict:
-        """CheckoutForm submit."""
+        def go():
+            items = self.transport.cart_get(ctx, self.session.session_id)
+            rows = []
+            for item in items:
+                product = self.transport.product(ctx, item["productId"])
+                price = float(product.get("priceUsd", 0.0))
+                rows.append({
+                    "productId": item["productId"],
+                    "name": product.get("name"),
+                    "priceUsd": price,
+                    "quantity": item["quantity"],
+                    "lineTotalUsd": round(price * item["quantity"], 2),
+                })
+            return {
+                "empty": not rows,  # EmptyCart component state
+                "badge": sum(r["quantity"] for r in rows),
+                "rows": rows,
+                "subtotalUsd": round(sum(r["lineTotalUsd"] for r in rows), 2),
+            }
+
+        return self._screen("GET /api/cart", ctx, go)
+
+    def empty_cart(self) -> dict:
+        """cart.tsx onEmptyCart: DELETE then a success toast."""
+        ctx = self.session.new_context()
+        self._screen(
+            "DELETE /api/cart", ctx,
+            lambda: self.transport.cart_empty(ctx, self.session.session_id),
+        )
+        return {"toast": "Your cart was emptied"}
+
+    def checkout_flow(
+        self, currency: str | None = None, form: CheckoutForm | None = None
+    ) -> dict:
+        """cart.tsx onPlaceOrder: submit the CheckoutForm, render the
+        confirmation state (success toast + order fields + redirect
+        home), mirroring the RN flow's Toast.show + router.replace."""
+        form = form or CheckoutForm(email=self.email)
         ctx = self.session.new_context()
         order = self._screen(
             "POST /api/checkout", ctx,
             lambda: self.transport.checkout(
-                ctx, self.session.session_id, currency, self.email
+                ctx, self.session.session_id,
+                currency or form.currency, form.email,
             ),
         )
         self.orders.append(order)
-        return order
+        total = order.get("total", {})
+        return {
+            "toast": "Your order is Complete!",
+            "toastDetail": "We've sent you a confirmation email.",
+            "orderId": order["orderId"],
+            "shippingTrackingId": order["shippingTrackingId"],
+            "itemCount": sum(
+                line["item"]["quantity"] for line in order.get("items", [])
+            ),
+            "totalUsd": (
+                float(total.get("units", 0)) + total.get("nanos", 0) / 1e9
+            ),
+            "currencyCode": total.get("currencyCode"),
+            "order": order,
+            "redirect": "/",  # router.replace("/") after the toast
+        }
 
     # -- a full shopping journey (the RN demo's happy path) -----------
 
@@ -220,4 +299,5 @@ class MobileApp:
             self.product_detail_screen(pid)
             self.add_to_cart(pid, int(rng.integers(1, 4)))
         self.cart_screen()
-        return self.checkout_flow()
+        confirmation = self.checkout_flow()
+        return confirmation["order"]

@@ -184,7 +184,18 @@ class TestGeneratorGuards:
     def test_stale_component_files_pruned(self, tmp_path):
         stale = tmp_path / "components" / "removed-tier.yaml"
         stale.parent.mkdir()
-        stale.write_text("# Generated ...\n")
+        stale.write_text(k8s._GENERATED_MARKER + " — do not edit.\n")
+        # A hand-authored neighbour without the marker must survive.
+        byhand = tmp_path / "components" / "ingress.yaml"
+        byhand.write_text("kind: Ingress\n")
         k8s.write_manifests(str(tmp_path))
         assert not stale.exists()
+        assert byhand.exists()
         assert (tmp_path / "components" / "kafka.yaml").exists()
+
+    def test_kafka_recreate_strategy(self):
+        """A rolling update would run two independent in-memory brokers
+        behind one Service; the broker must Recreate like the detector."""
+        idx = _by_kind_name(k8s.kafka_resources())
+        dep = idx[("Deployment", "kafka")]
+        assert dep["spec"]["strategy"]["type"] == "Recreate"

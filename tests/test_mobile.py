@@ -52,12 +52,59 @@ class TestInProc:
         # Server-side spans share the trace (context propagated).
         assert "frontend" in traces[0].services
 
-    def test_cart_screen_shape(self, shop):
+    def test_cart_screen_renders_resolved_rows_and_badge(self, shop):
+        """cart.tsx state: rows are ProductCards over the cart items —
+        resolved name/price, per-line totals — with the tab badge
+        carrying the total quantity."""
         app = make_app(shop)
         products = app.product_list_screen()
         app.add_to_cart(products[0]["id"], 3)
-        items = app.cart_screen()
-        assert items == [{"productId": products[0]["id"], "quantity": 3}]
+        app.add_to_cart(products[1]["id"], 1)
+        screen = app.cart_screen()
+        assert not screen["empty"]
+        assert screen["badge"] == 4
+        rows = {r["productId"]: r for r in screen["rows"]}
+        row = rows[products[0]["id"]]
+        assert row["name"] == products[0]["name"]
+        assert row["quantity"] == 3
+        assert row["lineTotalUsd"] == pytest.approx(
+            products[0]["priceUsd"] * 3
+        )
+        assert screen["subtotalUsd"] == pytest.approx(
+            sum(r["lineTotalUsd"] for r in screen["rows"])
+        )
+
+    def test_empty_cart_flow(self, shop):
+        """cart.tsx onEmptyCart: DELETE + toast, then the EmptyCart
+        component state renders."""
+        app = make_app(shop)
+        products = app.product_list_screen()
+        app.add_to_cart(products[0]["id"], 2)
+        assert app.cart_screen()["badge"] == 2
+        state = app.empty_cart()
+        assert state["toast"] == "Your cart was emptied"
+        screen = app.cart_screen()
+        assert screen["empty"] and screen["badge"] == 0 and not screen["rows"]
+
+    def test_checkout_confirmation_fields(self, shop):
+        """cart.tsx onPlaceOrder: the confirmation state carries the
+        toast pair, the order identifiers, item count and the USD total
+        the form's hard-coded currency produces, then redirects home."""
+        from opentelemetry_demo_tpu.services.mobile import CheckoutForm
+
+        app = make_app(shop)
+        products = app.product_list_screen()
+        app.add_to_cart(products[0]["id"], 2)
+        conf = app.checkout_flow(form=CheckoutForm(email="rn@example.com"))
+        assert conf["toast"] == "Your order is Complete!"
+        assert conf["toastDetail"] == "We've sent you a confirmation email."
+        assert conf["orderId"] and conf["shippingTrackingId"]
+        assert conf["itemCount"] == 2
+        assert conf["currencyCode"] == "USD"
+        assert conf["totalUsd"] > products[0]["priceUsd"]  # 2 units + shipping
+        assert conf["redirect"] == "/"
+        # The cart emptied server-side as part of PlaceOrder.
+        assert app.cart_screen()["empty"]
 
     def test_checkout_failure_emits_error_span(self, shop):
         shop.set_flag("paymentFailure", 1.0)
@@ -83,5 +130,34 @@ class TestHttp:
             order = app.shopping_journey(rng, n_items=1)
             assert order["orderId"]
             assert order["total"]["currencyCode"] == "USD"
+        finally:
+            gw.stop()
+
+    def test_screen_states_over_live_gateway(self, shop):
+        """The same screen-state depth as the in-proc tests, through
+        real HTTP (the RN app's actual mode): badge/rows on the cart
+        tab, confirmation fields, DELETE-driven EmptyCart."""
+        gw = ShopGateway(shop, host="127.0.0.1", port=0)
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            app = MobileApp(HttpTransport(base))
+            products = app.product_list_screen()
+            app.add_to_cart(products[0]["id"], 3)
+            screen = app.cart_screen()
+            assert screen["badge"] == 3
+            assert screen["rows"][0]["name"] == products[0]["name"]
+            assert screen["rows"][0]["lineTotalUsd"] == pytest.approx(
+                products[0]["priceUsd"] * 3
+            )
+
+            conf = app.checkout_flow()
+            assert conf["orderId"] and conf["itemCount"] == 3
+            assert conf["currencyCode"] == "USD" and conf["totalUsd"] > 0
+
+            app.add_to_cart(products[1]["id"], 1)
+            assert app.cart_screen()["badge"] == 1
+            assert app.empty_cart()["toast"] == "Your cart was emptied"
+            assert app.cart_screen()["empty"]
         finally:
             gw.stop()
