@@ -86,14 +86,20 @@ def main() -> int:
     else:
         print("skip proto (no protoc)")
 
-    # k8s manifests parse
+    # k8s manifests parse (aggregates + the per-component breakout dir)
     kdir = os.path.join(ROOT, "deploy", "k8s")
     check(os.path.isdir(kdir), "deploy/k8s exists")
-    for fname in sorted(os.listdir(kdir)) if os.path.isdir(kdir) else []:
-        docs = list(yaml.safe_load_all(open(os.path.join(kdir, fname))))
+    manifest_paths = []
+    for dirpath, _dirs, files in os.walk(kdir):
+        manifest_paths += [
+            os.path.join(dirpath, f) for f in files if f.endswith(".yaml")
+        ]
+    for path in sorted(manifest_paths):
+        docs = list(yaml.safe_load_all(open(path)))
+        rel = os.path.relpath(path, ROOT)
         check(
             all(d and "apiVersion" in d and "kind" in d for d in docs),
-            f"deploy/k8s/{fname} is valid k8s YAML",
+            f"{rel} is valid k8s YAML",
         )
 
     # no imports from the read-only reference tree
