@@ -62,6 +62,9 @@ def main() -> int:
         "ad", "cart", "checkout", "currency", "email", "frontend",
         "payment", "product-catalog", "quote", "recommendation",
         "shipping", "fraud-detection", "accounting",
+        # Cross-cutting suites beyond the per-service set: the edge
+        # observability surfaces (/jaeger + /grafana).
+        "observability",
     }
     tdir = os.path.join(ROOT, "tracetesting")
     suites = sorted(os.listdir(tdir)) if os.path.isdir(tdir) else []
