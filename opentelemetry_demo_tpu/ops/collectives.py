@@ -21,16 +21,57 @@ class Comm(NamedTuple):
     ``batch_axis`` may be a TUPLE of names on hybrid multi-host meshes
     (``("dcn", "batch")``) — ``lax.psum``/``pmax`` reduce over all of
     them at once, merging deltas across hosts and chips in one
-    collective."""
+    collective.
+
+    ``merge_impl`` selects the delta-merge algorithm:
+
+    - ``"direct"`` (default): one-shot ``lax.psum``/``pmax`` — XLA
+      lowers these near-optimally onto ICI; the single-pod choice.
+    - ``"ring"``: the chunked ``ppermute`` ring all-reduce
+      (``parallel.ring``) on the LONG-HAUL axis — on a hybrid mesh the
+      outer ``dcn`` axis rides the ring (chunked + overlapped, the
+      bandwidth-scarce hop) while inner axes stay direct on ICI; on a
+      2-D mesh the whole batch axis rides the ring.
+    """
 
     batch_axis: str | tuple[str, ...] | None = None
     sketch_axis: str | None = None
+    merge_impl: str = "direct"
+
+    def _merge_batch(self, x: jnp.ndarray, direct_op, ring_name: str) -> jnp.ndarray:
+        if not self.batch_axis:
+            return x
+        if self.merge_impl not in ("direct", "ring"):
+            # Validate HERE, not only in make_sharded_step: a typo'd
+            # impl on a directly-built Comm must raise, not silently
+            # run direct and let ring-vs-direct comparisons pass
+            # without exercising the ring.
+            raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
+        # Chunked ring hops only pay off on the KB-scale sketch banks;
+        # scalars and tiny stats merges (fewer elements than ring
+        # chunks) would become 2(n-1) latency-bound hops replacing one
+        # collective — keep them direct.
+        if self.merge_impl != "ring" or x.size < 256:
+            return direct_op(x, self.batch_axis)
+        # Lazy import: parallel.ring only depends on jax, but importing
+        # it at module scope would cycle through the parallel package
+        # (parallel → spmd → models → ops). By the time a ring Comm
+        # traces, the package is fully loaded.
+        from ..parallel import ring as ring_mod
+
+        ring_op = getattr(ring_mod, ring_name)
+        if isinstance(self.batch_axis, tuple):
+            outer, inner = self.batch_axis[0], self.batch_axis[1:]
+            if inner:
+                x = direct_op(x, inner)
+            return ring_op(x, outer)
+        return ring_op(x, self.batch_axis)
 
     def psum_batch(self, x: jnp.ndarray) -> jnp.ndarray:
-        return lax.psum(x, self.batch_axis) if self.batch_axis else x
+        return self._merge_batch(x, lax.psum, "ring_merge_sum")
 
     def pmax_batch(self, x: jnp.ndarray) -> jnp.ndarray:
-        return lax.pmax(x, self.batch_axis) if self.batch_axis else x
+        return self._merge_batch(x, lax.pmax, "ring_merge_max")
 
     def pmin_sketch(self, x: jnp.ndarray) -> jnp.ndarray:
         return lax.pmin(x, self.sketch_axis) if self.sketch_axis else x

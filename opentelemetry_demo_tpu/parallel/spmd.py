@@ -79,7 +79,7 @@ def report_specs() -> DetectorReport:
 
 
 def make_sharded_step(
-    config: DetectorConfig, mesh: Mesh
+    config: DetectorConfig, mesh: Mesh, comm_impl: str = "direct"
 ) -> tuple[Callable, DetectorState]:
     """Build the jitted SPMD step and a correctly-placed initial state.
 
@@ -89,6 +89,11 @@ def make_sharded_step(
     sketch-axis size, and the batch size by the product of ALL
     batch-sharding axes — ``mesh.shape["batch"]`` on a 2-D mesh,
     ``mesh.shape["dcn"] * mesh.shape["batch"]`` on a hybrid mesh.
+
+    ``comm_impl`` selects the delta-merge algorithm (``Comm.merge_impl``):
+    ``"direct"`` one-shot psum/pmax (the ICI default), ``"ring"`` the
+    chunked ppermute ring on the long-haul axis — on a hybrid mesh the
+    ``dcn`` hop rides the ring while intra-pod merges stay direct.
     """
     n_sketch = mesh.shape["sketch"]
     if config.num_services % n_sketch:
@@ -104,7 +109,11 @@ def make_sharded_step(
     if "dcn" in mesh.axis_names:
         batch_axes = ("dcn", "batch")
 
-    comm = Comm(batch_axis=batch_axes, sketch_axis="sketch")
+    if comm_impl not in ("direct", "ring"):
+        raise ValueError(f"unknown comm_impl {comm_impl!r}")
+    comm = Comm(
+        batch_axis=batch_axes, sketch_axis="sketch", merge_impl=comm_impl
+    )
     local = partial(detector_step, config, comm=comm)
 
     state_specs = sharded_state_specs(config)
@@ -124,7 +133,12 @@ def make_sharded_step(
     # documented workaround is check_vma=False, scoped here to the
     # test-only interpret impl. The native Pallas and XLA paths keep
     # full vma checking (ops/fused.py propagates vma to its out_shape).
-    vma_check = config.sketch_impl != "interpret"
+    # Ring merges also need the relaxation: after the ring's all-gather
+    # phase every shard holds equal values (replication by ALGORITHM),
+    # but ppermute outputs stay "varying" to the vma system and this
+    # JAX has no claim-replicated primitive — bit-exactness vs the
+    # direct-collective step is pinned by test instead.
+    vma_check = config.sketch_impl != "interpret" and comm_impl != "ring"
     fn = shard_map(
         local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=vma_check,
