@@ -374,12 +374,14 @@ def detector_step(
     )
     hll_delta = comm.pmax_batch(delta.hll)
     cms_delta = comm.psum_batch(delta.cms)
-    stats = comm.psum_batch(delta.stats)
+    # Float merge: always direct (order-stable f32) — see
+    # Comm.psum_batch_f32; only integer monoids ride the ring.
+    stats = comm.psum_batch_f32(delta.stats)
     hll_bank = hll_bank.at[:, 0].set(
         jnp.maximum(hll_bank[:, 0], hll_delta[None])
     )
     cms_bank = cms_bank.at[:, 0].set(cms_bank[:, 0] + cms_delta[None])
-    n_valid = comm.psum_batch(jnp.sum(valid_f))
+    n_valid = comm.psum_batch_f32(jnp.sum(valid_f))
     span_total = span_total.at[:, 0].add(n_valid)
 
     # ---- 3b. count-aware detection heads -----------------------------

@@ -106,11 +106,13 @@ def cms_update_hist(
       the MXU into a [HI, 256] f32 count matrix
       (``count[hi, lo] = Σ_b 1[hi_b=hi]·1[lo_b=lo]``). Counts ≤ B ≪ 2²⁴
       so f32 accumulation is exact. Measured v5e-1, D=4 W=8192 B=512k:
-      **~3.9 ms vs 7.3 ms** for the sort engine (the XLA-level version
-      of the same trick stays at ~7.5 ms because its 32 MB one-hot
-      tiles round-trip HBM; VMEM residency is the win — the residual
-      gap to the ~0.7 ms MXU FLOP bound is one-hot construction and
-      the skinny [TB, HI] operand).
+      **~3.3 ms vs 7.9 ms** for the sort engine (was 3.9 ms before the
+      r4 sentinel fold removed the 129th hi row — a single row past an
+      MXU tile boundary pads the contraction to two row-tiles; the
+      XLA-level version of the same trick stays at ~7.5 ms because its
+      32 MB one-hot tiles round-trip HBM; VMEM residency is the win —
+      the residual gap to the ~0.7 ms MXU FLOP bound is one-hot
+      construction and the skinny [TB, HI] operand).
     - ``"sort"``: ``diff(searchsorted(sort(ids), edges))`` — the
       engine everywhere the kernel can't run (CPU tests, odd
       geometries), and itself ~2× over the scatter at large B.
@@ -124,7 +126,8 @@ def cms_update_hist(
     if valid is not None:
         # Invalid lanes take key d·w — one past the counted range: the
         # sort engine's edge sweep stops before it, and the mxu engine
-        # gives it a dedicated overflow row that is then dropped.
+        # folds it onto the last bin pre-kernel and subtracts the exact
+        # sentinel count afterwards (see _hist_mxu's sentinel-FOLD note).
         flat_idx = jnp.where(valid[None, :], flat_idx, d * w)
     flat = flat_idx.reshape(-1)
     if impl is None:
@@ -147,9 +150,10 @@ def mxu_hist_geometry_ok(n_bins: int, n_keys: int) -> bool:
     check — also used by ``fused.resolve_impl`` to predict whether the
     xla path will get the fast engine at a given batch size)."""
     return (
-        # (hi, lo) byte split: bins + the invalid-lane sentinel must
-        # fit 16-bit keys, and bins must fill whole 256-wide lo rows.
-        n_bins + 1 <= 65536
+        # (hi, lo) byte split: bins must fit 16-bit keys (sentinels are
+        # folded onto the last bin pre-kernel, so they need no slot of
+        # their own) and fill whole 256-wide lo rows.
+        n_bins <= 65536
         and n_bins % 256 == 0
         # the kernel tiles the key axis; a partial tile would need a
         # second masked pass — keys are D·B with B a power of two in
@@ -173,10 +177,11 @@ def _mxu_hist_usable(n_bins: int, n_keys: int) -> bool:
 
 def _hist_mxu_kernel(keys_ref, out_ref):
     """One grid step: [TB] keys → one-hot halves in VMEM → MXU
-    contraction accumulated into the [HI, 256] count block. (A
-    separate validity-mask input measured ~2× slower than letting the
-    sentinel key ride an extra hi row, so invalid lanes stay key
-    ``n_bins``, counted into a row the host slices off.)"""
+    contraction accumulated into the [HI, 256] count block. Keys arrive
+    pre-clamped to [0, n_bins): sentinels are folded onto the last bin
+    by the caller (see the sentinel-FOLD note in ``_hist_mxu``) and
+    corrected after — a separate validity-mask input measured ~2×
+    slower, and an extra sentinel hi row doubled the MXU passes."""
     from jax import lax
     from jax.experimental import pallas as pl
 
@@ -212,9 +217,16 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
             f"mxu histogram needs a key count that is a nonzero "
             f"multiple of {_HIST_TILE}; got {n} (use impl='sort')"
         )
-    # hi covers the sentinel row too: bins occupy hi < n_bins//256;
-    # the sentinel key n_bins lands at (n_bins >> 8, 0) one row past.
-    n_hi = n_bins // 256 + 1
+    # Sentinel FOLD (r4): the invalid-lane key ``n_bins`` used to ride
+    # its own hi row, making HI = n_bins//256 + 1 — 129 at the
+    # production table — and the MXU pads output rows to 128-row
+    # tiles, so that single extra row DOUBLED the contraction passes
+    # (measured: ~2x hist wall time). Clamp sentinels onto the last
+    # real bin and subtract their exact count afterwards: HI stays a
+    # whole number of MXU tiles and the result is bit-identical.
+    sentinel_count = jnp.sum((flat >= n_bins).astype(jnp.int32))
+    flat = jnp.minimum(flat, n_bins - 1)
+    n_hi = n_bins // 256
     vma = jax.typeof(flat).vma
 
     counts2d = pl.pallas_call(
@@ -236,7 +248,8 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
             (n_hi, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
         ),
     )(flat.reshape(n, 1))
-    return counts2d.reshape(-1)[:n_bins].astype(jnp.int32)
+    counts = counts2d.reshape(-1).astype(jnp.int32)
+    return counts.at[n_bins - 1].add(-sentinel_count)
 
 
 def cms_query(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:

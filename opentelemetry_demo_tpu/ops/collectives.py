@@ -39,14 +39,14 @@ class Comm(NamedTuple):
     merge_impl: str = "direct"
 
     def _merge_batch(self, x: jnp.ndarray, direct_op, ring_name: str) -> jnp.ndarray:
+        if self.merge_impl not in ("direct", "ring"):
+            # Validate HERE (before any early return), not only in
+            # make_sharded_step: a typo'd impl on a directly-built Comm
+            # must raise, not silently run direct and let ring-vs-direct
+            # comparisons pass without exercising the ring.
+            raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
         if not self.batch_axis:
             return x
-        if self.merge_impl not in ("direct", "ring"):
-            # Validate HERE, not only in make_sharded_step: a typo'd
-            # impl on a directly-built Comm must raise, not silently
-            # run direct and let ring-vs-direct comparisons pass
-            # without exercising the ring.
-            raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
         # Chunked ring hops only pay off on the KB-scale sketch banks;
         # scalars and tiny stats merges (fewer elements than ring
         # chunks) would become 2(n-1) latency-bound hops replacing one
@@ -69,6 +69,16 @@ class Comm(NamedTuple):
 
     def psum_batch(self, x: jnp.ndarray) -> jnp.ndarray:
         return self._merge_batch(x, lax.psum, "ring_merge_sum")
+
+    def psum_batch_f32(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Float sums stay DIRECT in every merge_impl: ring chunking
+        reorders the f32 reduction, so EWMA inputs (and every score
+        downstream) would differ between ring and direct runs. Integer
+        sketch monoids (exact in any order) are what rides the ring;
+        the float stats tensor is KB-scale anyway."""
+        if self.merge_impl not in ("direct", "ring"):
+            raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
+        return lax.psum(x, self.batch_axis) if self.batch_axis else x
 
     def pmax_batch(self, x: jnp.ndarray) -> jnp.ndarray:
         return self._merge_batch(x, lax.pmax, "ring_merge_max")

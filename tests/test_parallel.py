@@ -344,3 +344,46 @@ def test_comm_merge_impl_validation_and_small_merge_fallback():
             )
         )(jnp.tile(x, (4,) + (1,) * (x.ndim - 1)) if x.ndim > 1 else x)
         assert ("ppermute" in str(jaxpr)) == expect_ring, (x.shape, jaxpr)
+
+
+def test_hybrid_ring_structure_and_float_merges_stay_direct():
+    """Structural pins on the ring routing: (a) on a hybrid mesh the
+    ppermute ring runs ONLY the dcn hop (intra-pod merges stay direct
+    psum); (b) float stats merges never ride the ring at any size —
+    ring chunking reorders f32 sums, which would break ring-vs-direct
+    bit-exactness of every downstream score."""
+    from opentelemetry_demo_tpu.ops.collectives import Comm
+    from opentelemetry_demo_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(n_dcn=2, n_batch=2, n_sketch=2)
+    ring = Comm(batch_axis=("dcn", "batch"), merge_impl="ring")
+    big = jnp.zeros((2, 2, 64, 64), jnp.int32)
+
+    jaxpr = str(jax.make_jaxpr(
+        shard_map(
+            ring.psum_batch, mesh=mesh,
+            in_specs=P("dcn", "batch"), out_specs=P("dcn", "batch"),
+            check_vma=False,
+        )
+    )(big))
+    assert "ppermute" in jaxpr
+    # Every ppermute targets the dcn axis; the batch hop stays a psum.
+    import re
+    axes = re.findall(r"axis_name=\(?'?(\w+)'?", jaxpr)
+    assert "dcn" in jaxpr and "ppermute" in jaxpr
+    for m in re.finditer(r"ppermute\[[^\]]*\]", jaxpr):
+        assert "dcn" in m.group(0) and "batch" not in m.group(0), m.group(0)
+
+    # Float merges: direct regardless of size, even in ring mode.
+    stats = jnp.zeros((2, 2, 4, 64), jnp.float32)  # size >= ring gate
+    jaxpr_f = str(jax.make_jaxpr(
+        shard_map(
+            ring.psum_batch_f32, mesh=mesh,
+            in_specs=P("dcn", "batch"), out_specs=P("dcn", "batch"),
+            check_vma=False,
+        )
+    )(stats))
+    assert "ppermute" not in jaxpr_f and "psum" in jaxpr_f
+
+    with pytest.raises(ValueError, match="merge_impl"):
+        Comm(batch_axis=None, merge_impl="rign").psum_batch(big)
