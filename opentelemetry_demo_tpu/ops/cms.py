@@ -201,8 +201,12 @@ def _hist_mxu_kernel(keys_ref, out_ref):
 
 
 def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
-    """Exact histogram of int32 keys in [0, n_bins] → counts[n_bins]
-    (the sentinel bin n_bins is dropped). See cms_update_hist."""
+    """Exact histogram of int32 keys in [0, n_bins] → counts[n_bins].
+
+    Keys equal to ``n_bins`` (the invalid-lane sentinel) are clamped
+    onto the last bin before the kernel and their exact count is
+    subtracted afterwards — see the sentinel-FOLD note below. See
+    cms_update_hist for engine selection."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -216,6 +220,15 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
         raise ValueError(
             f"mxu histogram needs a key count that is a nonzero "
             f"multiple of {_HIST_TILE}; got {n} (use impl='sort')"
+        )
+    if n_bins % 256:
+        # Same must-be-an-error philosophy as the key-count guard: the
+        # fold keeps exactly n_bins//256 hi rows, so a partial lo row
+        # would silently drop keys past the last whole row (the pre-r4
+        # +1-row variant tolerated this; the fold does not).
+        raise ValueError(
+            f"mxu histogram needs a bin count that is a multiple of "
+            f"256; got {n_bins} (use impl='sort')"
         )
     # Sentinel FOLD (r4): the invalid-lane key ``n_bins`` used to ride
     # its own hi row, making HI = n_bins//256 + 1 — 129 at the

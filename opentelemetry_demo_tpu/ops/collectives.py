@@ -38,13 +38,16 @@ class Comm(NamedTuple):
     sketch_axis: str | None = None
     merge_impl: str = "direct"
 
-    def _merge_batch(self, x: jnp.ndarray, direct_op, ring_name: str) -> jnp.ndarray:
+    def _check_impl(self) -> None:
+        # Validate BEFORE any early return, not only in
+        # make_sharded_step: a typo'd impl on a directly-built Comm
+        # must raise, not silently run direct and let ring-vs-direct
+        # comparisons pass without exercising the ring.
         if self.merge_impl not in ("direct", "ring"):
-            # Validate HERE (before any early return), not only in
-            # make_sharded_step: a typo'd impl on a directly-built Comm
-            # must raise, not silently run direct and let ring-vs-direct
-            # comparisons pass without exercising the ring.
             raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
+
+    def _merge_batch(self, x: jnp.ndarray, direct_op, ring_name: str) -> jnp.ndarray:
+        self._check_impl()
         if not self.batch_axis:
             return x
         # Chunked ring hops only pay off on the KB-scale sketch banks;
@@ -76,8 +79,7 @@ class Comm(NamedTuple):
         downstream) would differ between ring and direct runs. Integer
         sketch monoids (exact in any order) are what rides the ring;
         the float stats tensor is KB-scale anyway."""
-        if self.merge_impl not in ("direct", "ring"):
-            raise ValueError(f"unknown merge_impl {self.merge_impl!r}")
+        self._check_impl()
         return lax.psum(x, self.batch_axis) if self.batch_axis else x
 
     def pmax_batch(self, x: jnp.ndarray) -> jnp.ndarray:

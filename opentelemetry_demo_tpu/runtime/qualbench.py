@@ -13,6 +13,9 @@ demo.flagd.json:4-108) projected onto the synthetic span stream:
 - ``kafkaQueueProblems``        → throughput collapse (consumer stall)
 - ``errorTrickle``              → sustained small error shift, below
   any single-batch threshold (the CUSUM-integration case)
+- ``traceCardinalityExplosion`` → session/trace-id churn at constant
+  span rate — only the HLL cardinality head can see it (the signal
+  family the other five shapes never exercise)
 
 Time-to-detect is virtual seconds from fault onset to the first batch
 whose report flags the faulted service; the false-positive rate is
@@ -50,42 +53,61 @@ def _batch(rng, tz, mutate=None, step: int = 0):
     svc = rng.integers(0, S, size=B)
     err = (rng.random(B) < 0.01).astype(np.float32)
     keep = np.ones(B, bool)
+    # Baseline trace-id pool: sessions REUSE ids (browse traffic fans
+    # several spans out of one trace), so per-window distinct counts sit
+    # well below span counts — the decoupling that lets a cardinality
+    # fault exist at constant throughput. 64 concurrent sessions across
+    # ~128 spans/svc/window puts baseline distinct ≈ 55 with tight
+    # variance; the explosion to ~128 unique ids is then an
+    # unmistakable HLL jump at unchanged span rate.
+    trace = rng.integers(0, 64, size=B, dtype=np.uint64) * 2654435761 + 1
     if mutate is not None:
-        lat, err, keep = mutate(step, svc, lat, err, keep)
+        lat, err, keep, trace = mutate(step, svc, lat, err, keep, trace)
     return tz.pack_arrays(
         svc=svc[keep],
         lat_us=lat[keep],
-        trace_id=rng.integers(0, 2**63, size=int(keep.sum()), dtype=np.uint64),
+        trace_id=trace[keep],
         is_error=err[keep],
         attr_key=rng.zipf(1.5, size=int(keep.sum())).astype(np.uint64),
     )
 
 
 def fault_shapes(rng):
-    """name → (faulted service index, mutate(step, svc, lat, err, keep))."""
+    """name → (faulted service index,
+    mutate(step, svc, lat, err, keep, trace))."""
 
-    def burst(step, svc, lat, err, keep):
+    def burst(step, svc, lat, err, keep, trace):
         hit = (rng.random(B) < 0.25).astype(np.float32)
         return lat, np.where(svc == 5, np.maximum(err, hit), err).astype(
             np.float32
-        ), keep
+        ), keep, trace
 
-    def latency_step(step, svc, lat, err, keep):
-        return np.where(svc == 1, lat * 3.0, lat).astype(np.float32), err, keep
+    def latency_step(step, svc, lat, err, keep, trace):
+        return (np.where(svc == 1, lat * 3.0, lat).astype(np.float32),
+                err, keep, trace)
 
-    def cache_ramp(step, svc, lat, err, keep):
+    def cache_ramp(step, svc, lat, err, keep, trace):
         scale = 1.10 ** min(step, 60)  # unbounded cache growth shape
-        return np.where(svc == 2, lat * scale, lat).astype(np.float32), err, keep
+        return (np.where(svc == 2, lat * scale, lat).astype(np.float32),
+                err, keep, trace)
 
-    def rate_drop(step, svc, lat, err, keep):
+    def rate_drop(step, svc, lat, err, keep, trace):
         # Consumer stall: 90% of the service's spans stop arriving.
-        return lat, err, keep & ~((svc == 3) & (rng.random(B) < 0.9))
+        return lat, err, keep & ~((svc == 3) & (rng.random(B) < 0.9)), trace
 
-    def trickle(step, svc, lat, err, keep):
+    def trickle(step, svc, lat, err, keep, trace):
         hit = (rng.random(B) < 0.06).astype(np.float32)
         return lat, np.where(svc == 4, np.maximum(err, hit), err).astype(
             np.float32
-        ), keep
+        ), keep, trace
+
+    def card_explosion(step, svc, lat, err, keep, trace):
+        # Session/trace-id churn at CONSTANT throughput: the faulted
+        # service's spans stop sharing the session pool and arrive with
+        # unique trace ids — span rate unchanged, per-window distinct
+        # count explodes. Only the HLL cardinality head can see this.
+        fresh = rng.integers(1 << 32, 1 << 62, size=B, dtype=np.uint64)
+        return lat, err, keep, np.where(svc == 6, fresh, trace)
 
     return {
         "paymentFailure": (5, burst),
@@ -93,6 +115,7 @@ def fault_shapes(rng):
         "recommendationCacheFailure": (2, cache_ramp),
         "kafkaQueueProblems": (3, rate_drop),
         "errorTrickle": (4, trickle),
+        "traceCardinalityExplosion": (6, card_explosion),
     }
 
 
