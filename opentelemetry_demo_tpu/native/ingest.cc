@@ -295,13 +295,18 @@ extern "C" {
 // resource had no service.name — distinct from a present-but-empty
 // name, which the record path interns as ""). Monitored attribute keys
 // come in priority order; the chosen value's CRC32 goes to attr_crc
-// with attr_present=1.
+// with attr_present=1. Span events (field 11; the reference services
+// narrate spans with them — checkout main.go:270-294) surface as a
+// per-span count plus a has_exception flag (event named "exception"
+// or "error" — tensorize.EXCEPTION_EVENT_NAMES), the error-cause
+// evidence the detector folds into its error lane.
 int otd_decode_otlp(const uint8_t* buf, size_t len,              //
                     const char* const* attr_keys, int n_keys,    //
                     int cap,                                     //
                     float* duration_us, uint64_t* trace_key,     //
                     uint8_t* is_error, uint32_t* attr_crc,       //
                     uint8_t* attr_present, int32_t* svc_idx,     //
+                    int32_t* event_count, uint8_t* has_exception,  //
                     char* svc_buf, size_t svc_buf_cap,           //
                     int32_t* svc_len, int rs_cap,                //
                     int32_t* n_services) {
@@ -375,6 +380,8 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
         bool start_claimed = false, end_claimed = false;
         bool err = false;
         bool status_claimed = false;
+        int32_t n_events = 0;
+        bool exc = false;
         Str attr_val[kMaxAttrKeys];
 
         Slice sp{sf.val, sf.len};
@@ -408,6 +415,40 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
               if (val.set)
                 for (int k = 0; k < n_keys; ++k)
                   if (str_eq(key, attr_keys[k])) attr_val[k] = val;
+              break;
+            }
+            case 11: {  // events: repeated Event{time_unix_nano=1,
+                        // name=2, attributes=3} (submessage-list).
+              if (!sub_list(pf, descend)) return -1;
+              Slice ev{pf.val, pf.len};
+              Field ef;
+              Str ev_name;
+              bool name_claimed = false;
+              bool t_claimed = false;
+              uint64_t t_ns = 0;
+              while (!ev.done()) {
+                if (!next_field(ev, ef)) return -1;
+                if (ef.no == 1) {  // time (numeric-first, empty-LEN ok)
+                  if (!numeric_first(ef, t_claimed, t_ns)) return -1;
+                } else if (ef.no == 2 && !name_claimed) {
+                  // Python: wire.first(ev, 2) then isinstance(bytes) —
+                  // a numeric first occurrence claims the slot with an
+                  // EMPTY name, never an error.
+                  name_claimed = true;
+                  if (ef.wt == kLen) {
+                    ev_name.p = ef.val;
+                    ev_name.n = ef.len;
+                    ev_name.set = true;
+                  }
+                } else if (ef.no == 3) {  // attributes (submessage-list)
+                  if (!sub_list(ef, descend)) return -1;
+                  Str key, val;
+                  if (!keyvalue(ef.val, ef.len, key, val)) return -1;
+                }
+              }
+              ++n_events;
+              if (str_eq(ev_name, "exception") || str_eq(ev_name, "error"))
+                exc = true;
               break;
             }
             case 15: {  // Status{code=3} (submessage-first)
@@ -446,6 +487,8 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
         attr_crc[n_rec] = crc;
         attr_present[n_rec] = present;
         svc_idx[n_rec] = n_svc - 1;
+        event_count[n_rec] = n_events;
+        has_exception[n_rec] = exc ? 1 : 0;
         ++n_rec;
       }
     }

@@ -50,6 +50,8 @@ class ColumnarSpans(NamedTuple):
     attr_crc: np.ndarray  # uint32[N] — CRC32 of the chosen attr value
     attr_present: np.ndarray  # uint8[N]
     svc_idx: np.ndarray  # int32[N]
+    event_count: np.ndarray  # int32[N] — span events on the span
+    has_exception: np.ndarray  # uint8[N] — exception/error event present
     services: list[str | None]
 
 
@@ -123,6 +125,7 @@ def _configure_ingest(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_void_p,           # duration, trace
         ctypes.c_void_p, ctypes.c_void_p,           # err, crc
         ctypes.c_void_p, ctypes.c_void_p,           # present, svc_idx
+        ctypes.c_void_p, ctypes.c_void_p,           # event_count, has_exc
         ctypes.c_char_p, ctypes.c_size_t,           # svc_buf, cap
         ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
         ctypes.POINTER(ctypes.c_int32),             # n_services
@@ -311,11 +314,14 @@ def decode_otlp(
         crc = np.empty(cap, np.uint32)
         present = np.empty(cap, np.uint8)
         svc_idx = np.empty(cap, np.int32)
+        event_count = np.empty(cap, np.int32)
+        has_exc = np.empty(cap, np.uint8)
         n = lib.otd_decode_otlp(
             payload, len(payload), keys, len(attr_keys), cap,
             duration.ctypes.data, trace.ctypes.data,
             err.ctypes.data, crc.ctypes.data,
             present.ctypes.data, svc_idx.ctypes.data,
+            event_count.ctypes.data, has_exc.ctypes.data,
             svc_buf, svc_cap,
             svc_len.ctypes.data, rs_cap,
             ctypes.byref(n_services),
@@ -339,6 +345,7 @@ def decode_otlp(
         return ColumnarSpans(
             duration[:n].copy(), trace[:n].copy(), err[:n].copy(),
             crc[:n].copy(), present[:n].copy(), svc_idx[:n].copy(),
+            event_count[:n].copy(), has_exc[:n].copy(),
             services,
         )
 

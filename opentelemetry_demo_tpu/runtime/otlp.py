@@ -14,7 +14,8 @@ trace/v1): ExportTraceServiceRequest{resource_spans=1},
 ResourceSpans{resource=1, scope_spans=2}, Resource{attributes=1},
 KeyValue{key=1, value=2}, AnyValue{string_value=1},
 ScopeSpans{spans=2}, Span{trace_id=1, name=5, start_time_unix_nano=7,
-end_time_unix_nano=8, attributes=9, status=15}, Status{code=3}.
+end_time_unix_nano=8, attributes=9, events=11, status=15},
+Span.Event{time_unix_nano=1, name=2, attributes=3}, Status{code=3}.
 """
 
 from __future__ import annotations
@@ -25,7 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from . import native, wire
-from .tensorize import SpanRecord
+from .tensorize import SpanEvent, SpanRecord
 
 _STATUS_ERROR = 2  # opentelemetry.proto.trace.v1.Status.StatusCode.ERROR
 
@@ -87,6 +88,22 @@ def decode_export_request(payload: bytes) -> list[SpanRecord]:
     return records
 
 
+def _decode_event(ev_buf: bytes, span_start_ns: int) -> SpanEvent:
+    ev = wire.scan_fields(ev_buf)
+    t_ns = int(wire.first(ev, 1, 0) or 0)
+    name_raw = wire.first(ev, 2)
+    name = (
+        name_raw.decode("utf-8", "replace")
+        if isinstance(name_raw, bytes) else ""
+    )
+    attrs = _attrs_to_dict(ev.get(3, []))
+    return SpanEvent(
+        name=name,
+        ts_offset_us=max(t_ns - span_start_ns, 0) / 1000.0,
+        attrs=tuple(attrs.items()),
+    )
+
+
 def _decode_span(span_buf: bytes, service: str) -> SpanRecord:
     sp = wire.scan_fields(span_buf)
     trace_id = wire.first(sp, 1, b"\0") or b"\0"
@@ -107,6 +124,9 @@ def _decode_span(span_buf: bytes, service: str) -> SpanRecord:
         is_error=is_error,
         attr=_pick_attr(attrs),
         name=name_raw.decode("utf-8", "replace") if isinstance(name_raw, bytes) else None,
+        events=tuple(
+            _decode_event(ev_buf, start) for ev_buf in sp.get(11, [])
+        ),
     )
 
 
@@ -127,6 +147,23 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
                 }
                 start = int(sp.get("startTimeUnixNano", 0))
                 end = int(sp.get("endTimeUnixNano", 0))
+                events = tuple(
+                    SpanEvent(
+                        # str() guard: an explicit null/non-string name
+                        # must not poison the store (obsui joins names).
+                        name=str(ev.get("name") or ""),
+                        ts_offset_us=max(
+                            int(ev.get("timeUnixNano", 0) or 0) - start, 0
+                        ) / 1000.0,
+                        attrs=tuple(
+                            (a.get("key"), a.get("value", {}).get("stringValue"))
+                            for a in ev.get("attributes", [])
+                            if a.get("key")
+                            and a.get("value", {}).get("stringValue") is not None
+                        ),
+                    )
+                    for ev in sp.get("events", [])
+                )
                 records.append(
                     SpanRecord(
                         service=service,
@@ -135,6 +172,7 @@ def decode_export_request_json(payload: bytes) -> list[SpanRecord]:
                         is_error=sp.get("status", {}).get("code") in (2, "STATUS_CODE_ERROR"),
                         attr=_pick_attr({k: v for k, v in attrs.items() if v}),
                         name=sp.get("name"),
+                        events=events,
                     )
                 )
     return records

@@ -169,6 +169,20 @@ def encode_export_request(
             )
             if rec.attr:
                 span += wire.encode_len(9, _kv_str("app.product.id", rec.attr))
+            # Span.events (field 11): Event{time_unix_nano=1, name=2,
+            # attributes=3} per opentelemetry-proto trace/v1. Offsets
+            # are span-start-relative in SpanRecord; the wire wants
+            # absolute nanos.
+            for ev in rec.events:
+                ev_body = (
+                    wire.encode_fixed64(
+                        1, start + int(max(ev.ts_offset_us, 0.0) * 1000.0)
+                    )
+                    + wire.encode_len(2, ev.name.encode())
+                )
+                for k, v in ev.attrs:
+                    ev_body += wire.encode_len(3, _kv_str(k, str(v)))
+                span += wire.encode_len(11, ev_body)
             if rec.is_error:
                 span += wire.encode_len(15, wire.encode_int(3, 2))  # ERROR
             spans += wire.encode_len(2, span)

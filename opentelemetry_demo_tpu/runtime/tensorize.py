@@ -41,6 +41,39 @@ import numpy as np
 from ..ops.hashing import split_hi_lo_np, splitmix64_np
 
 
+class SpanEvent(NamedTuple):
+    """One span event — the reference narrates spans with these
+    (checkout's prepared/charged/shipped,
+    /root/reference/src/checkout/main.go:270-294; product-catalog's
+    "Product Found", main.go:296-315; email's record_exception,
+    email_server.rb:32). ``ts_offset_us`` is the event time relative to
+    span START (SpanRecords carry duration, not absolute start; the
+    OTLP codecs convert to/from absolute time_unix_nano). ``attrs`` is
+    a tuple of (key, value) pairs so the record stays hashable.
+    """
+
+    name: str
+    ts_offset_us: float = 0.0
+    attrs: tuple = ()
+
+    @property
+    def attr_dict(self) -> dict:
+        return dict(self.attrs)
+
+
+# Event names that carry error-cause evidence: the OTel semconv
+# record_exception name plus the reference checkout's deferred "error"
+# event (main.go:257 — AddEvent("error", exception.message)). Spans
+# carrying one feed the detector's error lane even when their status
+# is unset (email's Sinatra handler records the exception; the span
+# status is whatever the framework set).
+EXCEPTION_EVENT_NAMES = ("exception", "error")
+
+
+def has_exception_event(events) -> bool:
+    return any(e.name in EXCEPTION_EVENT_NAMES for e in events)
+
+
 class SpanRecord(NamedTuple):
     """One ingested span (or order event projected onto span shape)."""
 
@@ -52,6 +85,10 @@ class SpanRecord(NamedTuple):
     # Operation name — carried for trace-based assertions (the tracetest
     # harness selects spans by it); the tensorizer ignores it.
     name: str | None = None
+    # Span events (SpanEvent tuple) — trace narration; the tensorizer
+    # folds exception-shaped events into the error lane and ignores the
+    # rest (strings die at the tensor boundary, evidence does not).
+    events: tuple = ()
 
 
 class SpanColumns(NamedTuple):
@@ -165,7 +202,9 @@ class SpanTensorizer:
         for i, r in enumerate(records):
             svc[i] = self.service_id(r.service)
             lat[i] = r.duration_us
-            err[i] = 1.0 if r.is_error else 0.0
+            # Exception events are error-cause evidence even on spans
+            # whose status was never set to ERROR (see SpanEvent doc).
+            err[i] = 1.0 if (r.is_error or has_exception_event(r.events)) else 0.0
             if isinstance(r.trace_id, (bytes, bytearray)):
                 raw = bytes(r.trace_id[:8]).ljust(8, b"\0")
                 tid[i] = np.frombuffer(raw, dtype=np.uint64)[0]
@@ -196,7 +235,11 @@ class SpanTensorizer:
         return SpanColumns(
             svc=ids[cols.svc_idx],
             lat_us=cols.duration_us.astype(np.float32, copy=False),
-            is_error=cols.is_error.astype(np.float32),
+            # Same exception-event fold as the record path: the native
+            # decoder surfaces a has_exception flag per span.
+            is_error=np.maximum(
+                cols.is_error, cols.has_exception
+            ).astype(np.float32),
             trace_key=cols.trace_key,
             attr_crc=cols.attr_crc.astype(np.uint64),
         )

@@ -33,6 +33,28 @@ class ServiceEnv:
     extra: dict = field(default_factory=dict)
 
 
+def _place_events(events: tuple, duration: float) -> tuple:
+    """Resolve negative (auto) event offsets — see ServiceBase.span."""
+    out = list(events)
+    i = 0
+    while i < len(out):
+        if out[i].ts_offset_us >= 0:
+            i += 1
+            continue
+        j = i  # [i, j) is a run of autos; find its explicit anchors
+        while j < len(out) and out[j].ts_offset_us < 0:
+            j += 1
+        lo = out[i - 1].ts_offset_us if i > 0 else 0.0
+        hi = out[j].ts_offset_us if j < len(out) else duration
+        hi = max(hi, lo)  # a decreasing explicit anchor clamps, not reverses
+        for k in range(i, j):
+            out[k] = out[k]._replace(
+                ts_offset_us=lo + (hi - lo) * (k - i + 1) / (j - i + 1)
+            )
+        i = j
+    return tuple(out)
+
+
 class ServiceBase:
     """A shop service: named span source with a latency profile."""
 
@@ -57,11 +79,25 @@ class ServiceBase:
         extra_us: float = 0.0,
         error: bool = False,
         attr: str | None = None,
+        events: tuple = (),
     ) -> float:
-        """Emit one server span with simulated duration; returns µs."""
+        """Emit one server span with simulated duration; returns µs.
+
+        ``events`` narrate the span the way the reference's AddEvent
+        calls do. Events with a negative ``ts_offset_us`` are auto-
+        placed inside the simulated duration (callers know the ORDER of
+        their milestones, not the simulated clock) — an event with an
+        explicit non-negative offset keeps it, and autos interpolate
+        evenly between their neighbouring explicit anchors (span start
+        and end when none), so timestamps stay monotone in milestone
+        order even when explicit and auto offsets mix.
+        """
         duration = self._latency(scale) + extra_us
+        if events:
+            events = _place_events(events, duration)
         self.env.tracer.emit(
-            self.name, op, ctx, duration, is_error=error, attr=attr
+            self.name, op, ctx, duration, is_error=error, attr=attr,
+            events=events,
         )
         return duration
 

@@ -19,6 +19,7 @@ import os
 
 from .base import ServiceBase, ServiceError
 from .money import Money
+from ..runtime.tensorize import SpanEvent
 from ..telemetry.tracer import TraceContext
 
 FLAG_CATALOG_FAILURE = "productCatalogFailure"
@@ -91,9 +92,23 @@ class ProductCatalog(ServiceBase):
             and product_id == self.failure_product_id
         )
         found = next((p for p in self._products if p["id"] == product_id), None)
+        # Span events narrate the outcome the way the reference does
+        # (main.go:294-315: error message as the event on both failure
+        # paths, "Product Found" on success).
+        if fail:
+            event = SpanEvent(
+                "Error: Product Catalog Fail Feature Flag Enabled", -1.0
+            )
+        elif found is None:
+            event = SpanEvent(f"Product Not Found: {product_id}", -1.0)
+        else:
+            event = SpanEvent("Product Found", -1.0)
         # Exactly one span per request — a second error span would halve
         # the error rate the detector sees for this service.
-        self.span("GetProduct", ctx, error=fail or found is None, attr=product_id)
+        self.span(
+            "GetProduct", ctx, error=fail or found is None,
+            attr=product_id, events=(event,),
+        )
         if fail:
             raise ServiceError(self.name, f"flagged failure for {product_id}")
         if found is None:

@@ -28,6 +28,7 @@ from .money import Money
 from .payment import PaymentService
 from .shipping import ShippingService
 from ..runtime.kafka_orders import encode_placed_order
+from ..runtime.tensorize import SpanEvent
 from ..telemetry.tracer import TraceContext
 
 FLAG_KAFKA_PROBLEMS = "kafkaQueueProblems"
@@ -117,6 +118,13 @@ class CheckoutService(ServiceBase):
         expiry_year: int = 2030,
         expiry_month: int = 1,
     ) -> PlacedOrder:
+        # PlaceOrder narrates its milestones as span events exactly like
+        # the reference (main.go:270 "prepared", :286-287 "charged" with
+        # the transaction id, :292-294 "shipped" with the tracking id;
+        # the deferred error event with exception.message at :255-259).
+        # Offsets are auto-placed by ServiceBase.span (negative = "in
+        # milestone order inside the simulated duration").
+        events: list[SpanEvent] = []
         try:
             items = self.cart.get_cart(ctx, user_id)
             if not items:
@@ -135,19 +143,43 @@ class CheckoutService(ServiceBase):
             ship_usd = self.shipping.get_quote(ctx, sum(items.values()))
             ship_cost = self.currency.convert(ctx, ship_usd, user_currency)
             total = total.add(ship_cost)
+            events.append(SpanEvent("prepared", -1.0))
 
-            self.payment.charge(ctx, total, card_number, expiry_year, expiry_month)
+            tx_id = self.payment.charge(
+                ctx, total, card_number, expiry_year, expiry_month
+            )
+            events.append(SpanEvent(
+                "charged", -1.0, (("app.payment.transaction.id", tx_id),)
+            ))
             tracking_id = self.shipping.ship_order(ctx)
+            events.append(SpanEvent(
+                "shipped", -1.0, (("app.shipping.tracking.id", tracking_id),)
+            ))
             self.cart.empty_cart(ctx, user_id)
 
             order_id = str(uuid.uuid5(uuid.NAMESPACE_DNS, ctx.trace_id.hex()))
-            self.email.send_order_confirmation(ctx, email, order_id)
+            # Email failure is non-fatal — the card is already charged
+            # and the shipment created, so the reference logs a warning
+            # and returns the order anyway (main.go:317-321). The email
+            # span still records the exception (detector evidence).
+            try:
+                self.email.send_order_confirmation(ctx, email, order_id)
+            except ServiceError as mail_err:
+                self.log(
+                    "WARN",
+                    f"failed to send order confirmation to {email!r}: {mail_err}",
+                    ctx,
+                )
 
             placed = PlacedOrder(
                 order_id, tracking_id, total, ship_cost, tuple(lines)
             )
             self._publish(ctx, placed)
-            self.span("PlaceOrder", ctx, attr=product_ids[0] if product_ids else None)
+            self.span(
+                "PlaceOrder", ctx,
+                attr=product_ids[0] if product_ids else None,
+                events=tuple(events),
+            )
             self.log(
                 "INFO", "order placed", ctx,
                 order_id=order_id, items=len(product_ids),
@@ -155,7 +187,14 @@ class CheckoutService(ServiceBase):
             )
             return placed
         except ServiceError as err:
-            self.span("PlaceOrder", ctx, scale=1.5, error=True)
+            # Deferred error event (main.go:255-259): milestones reached
+            # before the failure stay on the span, the error event ends
+            # it with the cause message.
+            events.append(SpanEvent(
+                "error", -1.0, (("exception.message", str(err)),)
+            ))
+            self.span("PlaceOrder", ctx, scale=1.5, error=True,
+                      events=tuple(events))
             self.log("ERROR", f"order failed: {err}", ctx, user=user_id)
             raise
 

@@ -3,13 +3,20 @@
 Mirrors the reference Ruby service
 (/root/reference/src/email/email_server.rb:18-53): one endpoint that
 renders a confirmation and "sends" it to a test sink, with a manual
-send_email child span.
+send_email child span. A delivery failure records the exception on the
+current span — the Sinatra ``error do ... record_exception`` handler at
+email_server.rb:31-33 — so the trace carries the CAUSE, not just an
+error status.
 """
 
 from __future__ import annotations
 
-from .base import ServiceBase
-from ..telemetry.tracer import TraceContext
+from .base import ServiceBase, ServiceError
+from ..telemetry.tracer import TraceContext, exception_event
+
+
+class InvalidRecipientError(ValueError):
+    """The mail library's reject (Pony raises on a bad address)."""
 
 
 class EmailService(ServiceBase):
@@ -23,11 +30,26 @@ class EmailService(ServiceBase):
     def send_order_confirmation(
         self, ctx: TraceContext, email: str, order_id: str
     ) -> str:
-        body = (
-            f"To: {email}\nSubject: Your order {order_id}\n\n"
-            "Clear skies! Your astronomy gear is on its way."
-        )
-        self.sent += 1
+        try:
+            body = self._send(email, order_id)
+        except InvalidRecipientError as exc:
+            # record_exception analogue: error span + exception event
+            # (email_server.rb:31-33), then propagate as the service
+            # failure checkout observes.
+            self.span(
+                "send_order_confirmation", ctx, error=True,
+                events=(exception_event(exc),),
+            )
+            raise ServiceError(self.name, str(exc)) from exc
         self.span("send_order_confirmation", ctx)
         self.span("send_email", ctx, scale=0.5, attr=order_id)
         return body
+
+    def _send(self, email: str, order_id: str) -> str:
+        if "@" not in email:
+            raise InvalidRecipientError(f"invalid recipient {email!r}")
+        self.sent += 1
+        return (
+            f"To: {email}\nSubject: Your order {order_id}\n\n"
+            "Clear skies! Your astronomy gear is on its way."
+        )

@@ -118,6 +118,22 @@ def _trace_json(trace: Trace) -> dict:
         # timestamp; render start = ingest - duration so waterfalls and
         # sort orders behave (ingest happens at span end in the shop).
         start_us = max(stored.ts * 1e6 - r.duration_us, 0.0)
+        # Span events in Jaeger's shape: span.logs, each log a
+        # timestamp + fields list whose first field is {key: "event"}
+        # (exactly how Jaeger renders OTel span events).
+        logs = [
+            {
+                "timestamp": int(start_us + ev.ts_offset_us),
+                "fields": [
+                    {"key": "event", "type": "string", "value": ev.name},
+                    *(
+                        {"key": k, "type": "string", "value": v}
+                        for k, v in ev.attrs
+                    ),
+                ],
+            }
+            for ev in r.events
+        ]
         spans.append({
             "traceID": hex_id,
             "spanID": f"{i:016x}",
@@ -126,6 +142,7 @@ def _trace_json(trace: Trace) -> dict:
             "duration": int(r.duration_us),
             "processID": pid,
             "tags": tags,
+            "logs": logs,
         })
     return {"traceID": hex_id, "spans": spans, "processes": processes}
 
@@ -246,10 +263,24 @@ class JaegerUI:
                 f'<text x="4" y="{i * row_h + row_h - 5}" fill="#aaa" '
                 f'font-size="10">{_esc(svc)}: {_esc(s["operationName"])}</text>'
             )
+            # Event ticks: one vertical marker per span event at its
+            # timestamp (the Jaeger waterfall's log markers).
+            for log in s.get("logs", []):
+                ex = (log["timestamp"] - t0) / span_total * width
+                bars.append(
+                    f'<rect fill="#e8c547" x="{ex:.1f}" '
+                    f'y="{i * row_h + 2}" width="2" height="{row_h - 4}"/>'
+                )
+            ev_names = ", ".join(
+                f["value"]
+                for log in s.get("logs", [])
+                for f in log["fields"][:1]  # first field is the name
+            )
             rows.append(
                 f"<tr><td>{_esc(svc)}</td><td>{_esc(s['operationName'])}</td>"
                 f"<td>{s['duration'] / 1e3:.3f} ms</td>"
-                f"<td>{'<span class=err>error</span>' if is_err else 'ok'}</td></tr>"
+                f"<td>{'<span class=err>error</span>' if is_err else 'ok'}</td>"
+                f"<td class='muted'>{_esc(ev_names)}</td></tr>"
             )
         svg = (
             f'<svg width="{width}" height="{len(spans) * row_h + 4}">'
@@ -260,7 +291,7 @@ class JaegerUI:
             f"| {len(spans)} spans | {trace.duration_us / 1e3:.2f} ms critical span</p>"
             + svg
             + "<table><tr><th>service</th><th>operation</th><th>duration</th>"
-            "<th>status</th></tr>" + "".join(rows) + "</table>"
+            "<th>status</th><th>events</th></tr>" + "".join(rows) + "</table>"
         )
         return 200, _HTML, _page(f"trace {hex_id[:8]}", body)
 

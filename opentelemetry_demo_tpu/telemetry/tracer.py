@@ -23,7 +23,21 @@ import secrets
 from dataclasses import dataclass, field
 from typing import Callable
 
-from ..runtime.tensorize import SpanRecord
+from ..runtime.tensorize import SpanEvent, SpanRecord
+
+
+def exception_event(exc: BaseException, ts_offset_us: float = 0.0) -> SpanEvent:
+    """record_exception analogue (OTel semconv): the event shape the
+    reference's email service attaches on failure
+    (/root/reference/src/email/email_server.rb:32)."""
+    return SpanEvent(
+        name="exception",
+        ts_offset_us=ts_offset_us,
+        attrs=(
+            ("exception.type", type(exc).__name__),
+            ("exception.message", str(exc)),
+        ),
+    )
 
 Baggage = dict  # key → str value; propagated verbatim
 
@@ -77,6 +91,7 @@ class Tracer:
         duration_us: float,
         is_error: bool = False,
         attr: str | None = None,
+        events: tuple = (),
     ) -> None:
         # Monotonic-enough ops counter: emit() runs concurrently under
         # the gRPC edge's shared lock, and += is a read-modify-write —
@@ -91,5 +106,6 @@ class Tracer:
                 is_error=is_error,
                 attr=attr,
                 name=name,
+                events=tuple(events),
             )
         )

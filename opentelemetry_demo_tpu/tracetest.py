@@ -128,6 +128,15 @@ def _check_assertion(spec: dict, spans: list[SpanRecord], response) -> tuple[boo
         actual = len(spans)
     elif metric == "error_count":
         actual = sum(1 for s in spans if s.is_error)
+    elif metric == "event_count":
+        # Span events over the selected set; `event:` narrows to one
+        # event name (the reference asserts e.g. checkout's "charged"
+        # narration — main.go:286).
+        want = spec.get("event")
+        actual = sum(
+            1 for s in spans for e in s.events
+            if want is None or e.name == want
+        )
     elif metric == "duration_us_max":
         actual = max((s.duration_us for s in spans), default=0.0)
     elif metric == "duration_us_min":

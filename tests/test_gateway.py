@@ -129,6 +129,36 @@ def test_flagged_failure_maps_to_500_and_error_span(rig):
     assert any(s.service == "frontend-proxy" for s in errors)
 
 
+def test_email_failure_records_exception_event(rig):
+    # record_exception analogue (email_server.rb:31-33): an invalid
+    # recipient fails the CONFIRMATION but not the order — the card is
+    # already charged, so the reference logs a warning and returns the
+    # order (main.go:317-321). The email span carries an "exception"
+    # event with the cause — error-lane evidence for the detector
+    # (tensorize folds exception events).
+    shop, gw, sink = rig
+    _post(gw, "/api/cart", {
+        "userId": "bad-mail", "item": {"productId": "TEL-DOB-10", "quantity": 1},
+    })
+    status, body = _post(gw, "/api/checkout", {
+        "userId": "bad-mail", "currencyCode": "USD",
+        "email": "not-an-address",
+    })
+    assert status == 200 and json.loads(body)["orderId"]
+    with gw._lock:
+        gw._pump_locked()
+    email_errs = [s for s in sink if s.service == "email" and s.is_error]
+    assert email_errs, "email failure should emit an error span"
+    ev = email_errs[0].events[0]
+    assert ev.name == "exception"
+    assert ev.attr_dict["exception.type"] == "InvalidRecipientError"
+    # The order itself completed: PlaceOrder is clean, milestones intact.
+    co = next(s for s in sink
+              if s.service == "checkout" and s.name == "PlaceOrder")
+    assert not co.is_error
+    assert [e.name for e in co.events] == ["prepared", "charged", "shipped"]
+
+
 def test_malformed_input_is_4xx_not_error_span(rig):
     shop, gw, sink = rig
     req = urllib.request.Request(
@@ -430,6 +460,27 @@ def test_jaeger_api_finds_checkout_trace(rig):
     status, ctype, body = _get(gw, f"/jaeger/trace/{trace['traceID']}")
     assert status == 200 and "text/html" in ctype
     assert b"PlaceOrder" in body and b"<svg" in body
+
+    # Span events through the query API: PlaceOrder narrates its
+    # milestones (reference main.go:270-294) and Jaeger surfaces them
+    # as span.logs — the "charged" event must carry the transaction id.
+    place = next(s for s in trace["spans"] if s["operationName"] == "PlaceOrder")
+    event_names = [log["fields"][0]["value"] for log in place["logs"]]
+    assert event_names[:3] == ["prepared", "charged", "shipped"]
+    charged = place["logs"][1]
+    assert any(
+        f["key"] == "app.payment.transaction.id" and f["value"]
+        for f in charged["fields"]
+    )
+    # Event offsets are inside the span and monotone (auto-placement).
+    times = [log["timestamp"] for log in place["logs"]]
+    assert times == sorted(times)
+    assert all(
+        place["startTime"] <= t <= place["startTime"] + place["duration"]
+        for t in times
+    )
+    # The waterfall view renders the narration too.
+    assert b"charged" in body
 
 
 def test_jaeger_search_page_and_filters(rig):

@@ -207,6 +207,69 @@ class TestOtlpParity:
         got = _parity(ok)
         assert got.rows == 1
 
+    def test_span_events_parity_and_exception_fold(self):
+        # Span events (field 11): the native decoder surfaces a count +
+        # has_exception flag; the record path carries full SpanEvents.
+        # Both must agree, and the exception fold must reach the error
+        # lane identically (tensorize.EXCEPTION_EVENT_NAMES).
+        def _event(t_ns, name, attrs=()):
+            body = wire.encode_fixed64(1, t_ns) + wire.encode_len(2, name)
+            for k, v in attrs:
+                body += wire.encode_len(3, _kv(k, v))
+            return wire.encode_len(11, body)
+
+        payload = _rs("checkout", [
+            _span(b"\x21" * 16, 0, 5_000_000, extra=(
+                _event(1_000_000, b"prepared")
+                + _event(2_000_000, b"charged",
+                         [("app.payment.transaction.id", "tx")])
+                + _event(3_000_000, b"shipped")
+            )),
+            # status OK + exception event: error evidence via the event.
+            _span(b"\x22" * 16, 0, 1_000_000, extra=_event(
+                500_000, b"exception", [("exception.message", "boom")]
+            )),
+            # deferred "error" event (checkout main.go:257) counts too.
+            _span(b"\x23" * 16, 0, 1_000_000, extra=_event(0, b"error")),
+            _span(b"\x24" * 16, 0, 1_000_000),
+        ])
+        got = _parity(payload)  # includes the is_error lane comparison
+        assert got.is_error.tolist() == [0.0, 1.0, 1.0, 0.0]
+        cols = native.decode_otlp(payload, MONITORED_ATTR_KEYS)
+        records = decode_export_request(payload)
+        assert cols.event_count.tolist() == [len(r.events) for r in records]
+        assert cols.has_exception.tolist() == [0, 1, 1, 0]
+        assert [e.name for e in records[0].events] == [
+            "prepared", "charged", "shipped"]
+
+    def test_span_event_edge_verdicts_match(self):
+        # events as varint → error both ways (submessage-list); numeric
+        # event name → claims the slot with an EMPTY name, no error;
+        # empty-LEN event time → default 0, no error.
+        bad = _rs("s", [_span(b"\x25" * 16, 0, 10,
+                              extra=wire.encode_int(11, 3))])
+        with pytest.raises(Exception):
+            decode_export_request(bad)
+        with pytest.raises(ValueError):
+            native.decode_otlp(bad, MONITORED_ATTR_KEYS)
+        ok = _rs("s", [_span(b"\x26" * 16, 0, 10, extra=wire.encode_len(
+            11, wire.encode_int(2, 7) + wire.encode_len(1, b"")
+        ))])
+        got = _parity(ok)
+        assert got.rows == 1
+        cols = native.decode_otlp(ok, MONITORED_ATTR_KEYS)
+        records = decode_export_request(ok)
+        assert cols.event_count.tolist() == [1]
+        assert records[0].events[0].name == ""
+        # malformed event ATTRS (varint where KeyValue expected) → error
+        bad_attr = _rs("s", [_span(b"\x27" * 16, 0, 10, extra=wire.encode_len(
+            11, wire.encode_len(2, b"ev") + wire.encode_int(3, 1)
+        ))])
+        with pytest.raises(Exception):
+            decode_export_request(bad_attr)
+        with pytest.raises(ValueError):
+            native.decode_otlp(bad_attr, MONITORED_ATTR_KEYS)
+
     def test_large_request_many_services(self):
         rng = np.random.default_rng(3)
         payload = b""

@@ -23,13 +23,66 @@ from opentelemetry_demo_tpu.runtime.otlp_export import (
     OtlpHttpSpanExporter,
     encode_export_request,
 )
-from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
+from opentelemetry_demo_tpu.runtime.tensorize import (
+    SpanEvent,
+    SpanRecord,
+    SpanTensorizer,
+)
 
 RECORDS = [
     SpanRecord("payment", 1500.0, b"\x01" * 16, True, "X1", "Charge"),
     SpanRecord("payment", 900.0, b"\x02" * 16, False, None, "ok"),
     SpanRecord("cart", 50.5, 7, False, None, None),
 ]
+
+# Span events (reference narration shapes: checkout main.go:270-294,
+# email's record_exception) — round-tripped through both decoders.
+EVENT_RECORDS = [
+    SpanRecord(
+        "checkout", 5000.0, b"\x03" * 16, False, None, "PlaceOrder",
+        (
+            SpanEvent("prepared", 1000.0),
+            SpanEvent("charged", 2500.0,
+                      (("app.payment.transaction.id", "tx-9"),)),
+            SpanEvent("shipped", 4000.0,
+                      (("app.shipping.tracking.id", "trk-9"),)),
+        ),
+    ),
+    SpanRecord(
+        "email", 700.0, b"\x04" * 16, False, None, "send_order_confirmation",
+        (SpanEvent("exception", 0.0,
+                   (("exception.type", "InvalidRecipientError"),
+                    ("exception.message", "invalid recipient"))),),
+    ),
+]
+
+
+def test_events_roundtrip_through_python_decoder():
+    out = decode_export_request(
+        encode_export_request(EVENT_RECORDS, t_ns=10**18)
+    )
+    place, email = out
+    assert [(e.name, round(e.ts_offset_us, 1)) for e in place.events] == [
+        ("prepared", 1000.0), ("charged", 2500.0), ("shipped", 4000.0),
+    ]
+    assert place.events[1].attr_dict == {"app.payment.transaction.id": "tx-9"}
+    assert email.events[0].name == "exception"
+    assert email.events[0].attr_dict["exception.type"] == "InvalidRecipientError"
+
+
+@pytest.mark.skipif(not native.available(), reason="native ingest unavailable")
+def test_events_native_columns_and_error_lane_parity():
+    """Native decode surfaces event_count/has_exception; both tensorizer
+    paths fold the exception event into the error lane identically."""
+    payload = encode_export_request(EVENT_RECORDS, t_ns=10**18)
+    cols = decode_export_request_columnar(payload)
+    assert cols.event_count.tolist() == [3, 1]
+    assert cols.has_exception.tolist() == [0, 1]
+    ref = SpanTensorizer().columns_from_records(decode_export_request(payload))
+    got = SpanTensorizer().columns_from_columnar(cols)
+    # email's status is OK but its exception event is error evidence.
+    assert ref.is_error.tolist() == [0.0, 1.0]
+    assert got.is_error.tolist() == ref.is_error.tolist()
 
 
 def test_roundtrip_through_python_decoder():
