@@ -230,6 +230,16 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
             f"mxu histogram needs a bin count that is a multiple of "
             f"256; got {n_bins} (use impl='sort')"
         )
+    if n >= 1 << 24:
+        # f32 accumulation is exact only below 2^24 (counts are bounded
+        # by the key count) — a forced impl="mxu" past that must be an
+        # error, not silently inexact counts, same philosophy as the
+        # tile/bin guards above. Auto-select gates on this condition
+        # too (mxu_hist_geometry_ok).
+        raise ValueError(
+            f"mxu histogram is f32-exact only below 2^24 keys; got {n} "
+            f"(use impl='sort')"
+        )
     # Sentinel FOLD (r4): the invalid-lane key ``n_bins`` used to ride
     # its own hi row, making HI = n_bins//256 + 1 — 129 at the
     # production table — and the MXU pads output rows to 128-row

@@ -303,8 +303,8 @@ def encode_logs_request(docs, t_ns: int | None = None) -> bytes:
     The inverse of ``otlp.decode_logs_request`` over the fields the
     framework's log pipeline carries (otelcol-config.yml:128-131 is the
     reference leg this crosses): one ResourceLogs block per service,
-    LogRecord{time_unix_nano=1, severity_text=3, body=5, attributes=6,
-    trace_id=9}. ``doc.ts`` is virtual-clock seconds; the wire wants
+    LogRecord{time_unix_nano=1, severity_number=2, severity_text=3,
+    body=5, attributes=6, trace_id=9}. ``doc.ts`` is virtual-clock seconds; the wire wants
     wall nanos, so ``t_ns`` (default now) stamps the batch and per-doc
     ts rides as the relative offset from the newest doc.
     """
@@ -317,14 +317,20 @@ def encode_logs_request(docs, t_ns: int | None = None) -> bytes:
     # doc maps to t_ns and every other doc keeps its relative offset,
     # so cross-service ordering survives the wall-clock re-stamping.
     newest = max((d.ts for d in docs), default=0.0)
+    # SeverityNumber (field 2) is the spec's PRIMARY severity field —
+    # a backend keying on it must not see UNSPECIFIED; the store's
+    # 5-level scale maps to the canonical band floors.
+    sev_num = {"DEBUG": 5, "INFO": 9, "WARN": 13, "ERROR": 17, "FATAL": 21}
     out = b""
     for service, items in by_service.items():
         resource = wire.encode_len(1, _kv_str("service.name", service))
         records = b""
         for doc in items:
+            sev = doc.severity or "INFO"
             rec = (
                 wire.encode_fixed64(1, max(t_ns + int((doc.ts - newest) * 1e9), 0))
-                + wire.encode_len(3, (doc.severity or "INFO").encode())
+                + wire.encode_int(2, sev_num.get(sev, 9))
+                + wire.encode_len(3, sev.encode())
                 + wire.encode_len(
                     5, wire.encode_len(1, (doc.body or "").encode())
                 )

@@ -62,9 +62,14 @@ class LogStore:
             raise ValueError(
                 f"severity {doc.severity!r} not one of {SEVERITIES}"
             )
-        ring = self._indices.get(index)
-        if ring is None:
-            ring = self._indices[index] = deque(maxlen=self.max_docs_per_index)
+        # setdefault: the daemon's _on_logs runs concurrently from the
+        # HTTP and gRPC receiver threads — a get-then-set here let two
+        # first-doc racers on a new index each create a ring, silently
+        # dropping one document. setdefault is a single GIL-atomic
+        # dict op; appends on the shared deque are GIL-atomic too.
+        ring = self._indices.setdefault(
+            index, deque(maxlen=self.max_docs_per_index)
+        )
         ring.append(doc)
 
     def indices(self) -> list[str]:

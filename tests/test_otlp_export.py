@@ -275,6 +275,33 @@ def test_logs_exporter_ships_to_receiver():
     assert got and got[0].service == "email" and got[0].body == "confirmation sent"
 
 
+def test_logs_encode_severity_number_primary_field():
+    """encode_logs_request must emit SeverityNumber (field 2) — the
+    spec's PRIMARY severity field — not just severityText, or a real
+    backend keying on it sees every record as UNSPECIFIED. Pinned by
+    decoding with the text field's fallback: our decoder prefers text,
+    so strip it structurally by checking the wire directly."""
+    from opentelemetry_demo_tpu.runtime import wire
+    from opentelemetry_demo_tpu.telemetry.logstore import LogDoc
+    from opentelemetry_demo_tpu.runtime.otlp_export import encode_logs_request
+
+    body = encode_logs_request([
+        LogDoc(ts=1.0, service="s", severity=sev, body="x", attrs=None,
+               trace_id=None)
+        for sev in ("DEBUG", "INFO", "WARN", "ERROR", "FATAL")
+    ], t_ns=10**18)
+    req = wire.scan_fields(body)
+    nums = []
+    for rl_buf in req[1]:
+        rl = wire.scan_fields(rl_buf)
+        for sl_buf in rl[2]:
+            for lr_buf in wire.scan_fields(sl_buf)[2]:
+                lr = wire.scan_fields(lr_buf)
+                nums.append(int(wire.first(lr, 2, 0)))
+    # Canonical band floors, in doc order within the single service.
+    assert nums == [5, 9, 13, 17, 21]
+
+
 def test_severity_normalized_at_decode_boundary():
     """Free-form SDK severityText decodes to the store's 5-level scale,
     so any consumer can LogStore.add decoded docs without crashing."""
