@@ -6,10 +6,13 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-kafka start-load test tracetest bench gen-k8s gen-proto gen-dashboards build-native check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench gen-k8s gen-proto gen-dashboards build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
+
+start-minimal:  ## reduced profile (reference make start-minimal): no async tier, no flag-editor UI
+	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5 --minimal
 
 start-kafka:    ## shop with the async tier over a REAL broker socket
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5 --kafka auto
@@ -22,6 +25,9 @@ test:           ## unit + integration suite (CPU mesh)
 
 tracetest:      ## trace-based suites over a live gateway (SURVEY.md §4)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.tracetest tracetesting
+
+kafka-interop:  ## wire-client suite vs a real broker (KAFKA_ADDR=host:9092; unset = in-repo broker)
+	$(CPU_ENV) $(PY) -m pytest tests/test_kafka_interop.py -v
 
 bench:          ## flagship benchmark (ONE json line; real TPU if present)
 	$(PY) bench.py

@@ -26,7 +26,10 @@ broker would reject the Metadata/ListOffsets/FindCoordinator calls
 even though the record path (Produce v3 / Fetch v4) would still speak.
 The in-repo broker (``kafka_broker``) speaks the same subset, so client
 and broker are interoperable test doubles for the compose topology's
-real broker.
+real broker. The interop scope is FALSIFIABLE: ``make kafka-interop``
+(tests/test_kafka_interop.py) runs the client-level suite against
+whatever ``KAFKA_ADDR`` points at — green against the in-repo broker
+here, runnable unchanged against a real Kafka 3.x.
 """
 
 from __future__ import annotations

@@ -202,7 +202,13 @@ class CheckoutService(ServiceBase):
         """Async post-processing boundary (main.go:549-614). The Kafka
         payload goes through the same OrderResult encoder as the gRPC
         PlaceOrder response — real quantities and per-line costs, never
-        a diverging second encoding of the same proto message."""
+        a diverging second encoding of the same proto message.
+
+        No bus = the minimal profile: the reference checkout publishes
+        only `if cs.kafkaBrokerSvcAddr != ""` (main.go:324-327), so no
+        publish span is emitted either."""
+        if self.bus is None:
+            return
         topic = self.bus.topic(ORDERS_TOPIC)
         value = encode_placed_order(placed)
         headers = ctx.to_headers()  # context over the async boundary

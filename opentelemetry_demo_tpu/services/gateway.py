@@ -54,6 +54,16 @@ from ..telemetry.tracer import TraceContext
 
 MAX_FAULT_DELAY_S = 10.0  # cap on header-triggered fault delays
 
+# HTTP/2 prior-knowledge connection preface — what a gRPC client sends
+# first on an h2c (cleartext) channel. The reference exposes the flag
+# gRPC service through the single :8080 entry ("/flagservice/" →
+# flagd :8013, envoy.tmpl.yaml:50-51); this edge is an HTTP/1 server,
+# so gRPC rides a TCP splice instead: a connection opening with this
+# preface is piped verbatim to the gRPC edge (which serves
+# flagd.evaluation.v1 AND the oteldemo services — a superset of the
+# reference's /flagservice/ upstream).
+_H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
 
 def _product_image_svg(product_id: str) -> bytes:
     """Deterministic placeholder artwork, one color per product id."""
@@ -93,6 +103,11 @@ class ShopGateway:
         # with handle(method, path, body) -> (status, content_type, bytes).
         self.feature_ui = None
         self.loadgen_ui = None  # LoadControl, mounted at /loadgen
+        # ("host", port) of a GrpcShopEdge over the SAME shop: enables
+        # the h2c passthrough (the /flagservice/-at-the-edge analogue).
+        # None = h2 connections are refused, like Envoy with the route
+        # absent.
+        self.grpc_target = None
         # Observability backends at the edge — the reference's Envoy
         # routes /jaeger and /grafana to the query UIs
         # (envoy.tmpl.yaml:44-47); here the analogues are served over
@@ -185,6 +200,36 @@ class ShopGateway:
                 )
                 self._respond(status, payload, ctype, extra)
 
+            def handle(self):
+                # h2c prior-knowledge sniff BEFORE the HTTP/1 parser:
+                # nothing has read from the socket yet (setup() only
+                # wraps it), so MSG_PEEK is safe. A gRPC client's first
+                # bytes are always the full 24-byte preface; loop while
+                # we hold a strict prefix (TCP may fragment).
+                import socket as _socket
+
+                deadline = time.monotonic() + 2.0
+                while True:
+                    try:
+                        head = self.connection.recv(
+                            len(_H2_PREFACE), _socket.MSG_PEEK
+                        )
+                    except OSError:
+                        head = b""
+                    if head == _H2_PREFACE:
+                        gateway._splice_h2(self.connection)
+                        self.close_connection = True
+                        return
+                    if (head and _H2_PREFACE.startswith(head)
+                            and time.monotonic() < deadline):
+                        # Strict prefix: the rest of the preface is in
+                        # flight. MSG_PEEK returns the same bytes
+                        # immediately, so pace the re-peek.
+                        time.sleep(0.005)
+                        continue
+                    break  # plain HTTP (or EOF): the normal parser
+                super().handle()
+
             def do_GET(self):  # noqa: N802 (http.server API)
                 self._handle("GET")
 
@@ -219,6 +264,57 @@ class ShopGateway:
         self._server.server_close()
 
     # -- plumbing ------------------------------------------------------
+
+    def _splice_h2(self, client_sock) -> None:
+        """Bidirectional TCP splice: gRPC-over-h2c at the HTTP edge.
+
+        The Envoy-route analogue of /flagservice/ (envoy.tmpl.yaml:50-51)
+        — the whole connection is piped to the gRPC edge, so any
+        flagd.evaluation.v1 / oteldemo call works against the single
+        :8080 entry. No h2 frames are parsed here: prior-knowledge h2c
+        means the preface identifies the protocol and the edge's job is
+        transport, exactly what Envoy's TCP-proxying does for h2c
+        upstreams. Runs on the handler's own thread (one per
+        connection under ThreadingHTTPServer) plus one pump thread for
+        the upstream→client direction.
+        """
+        import socket as _socket
+
+        if self.grpc_target is None:
+            client_sock.close()  # connection refused: route absent
+            return
+        try:
+            upstream = _socket.create_connection(self.grpc_target, timeout=5)
+        except OSError:
+            client_sock.close()
+            return
+        upstream.settimeout(None)
+        client_sock.settimeout(None)
+
+        def pump(src, dst):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                # Half-close so the peer's pump sees EOF and drains.
+                try:
+                    dst.shutdown(_socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        back = threading.Thread(
+            target=pump, args=(upstream, client_sock),
+            name="h2c-splice", daemon=True,
+        )
+        back.start()
+        pump(client_sock, upstream)
+        back.join(timeout=30)
+        upstream.close()
 
     def _access_log(self, method, route, ctx, status, duration_us):
         """Edge span per request — Envoy's access-log/upstream span."""

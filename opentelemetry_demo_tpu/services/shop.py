@@ -46,12 +46,18 @@ class ShopConfig:
     users: int = 5
     seed: int = 0
     pump_interval_s: float = 0.25  # how often spans flush downstream
-    # Network broker address ("host:port"). Unset = in-proc Bus (the
-    # minimal-compose analogue, which also drops kafka); set = orders
-    # cross a real TCP broker exactly like the reference's full compose
-    # (checkout → Produce v3 with trace headers → accounting /
+    # Network broker address ("host:port"). Unset = in-proc Bus; set =
+    # orders cross a real TCP broker exactly like the reference's full
+    # compose (checkout → Produce v3 with trace headers → accounting /
     # fraud-detection consumer groups polling over the socket).
     kafka_bootstrap: str | None = None
+    # Minimal profile (/root/reference/docker-compose.minimal.yml:16):
+    # drops accounting, fraud-detection and the async tier entirely —
+    # checkout skips the Kafka publish the way the reference's
+    # `if cs.kafkaBrokerSvcAddr != ""` does (main.go:324-327). The
+    # flagd tier stays (the reference minimal keeps flagd, dropping
+    # only flagd-UI — the serving layer handles that).
+    minimal: bool = False
 
 
 class Shop:
@@ -98,7 +104,14 @@ class Shop:
         )
         self.env = env
 
-        if self.config.kafka_bootstrap:
+        if self.config.minimal:
+            if self.config.kafka_bootstrap:
+                raise ValueError(
+                    "minimal profile drops the async tier; kafka_bootstrap "
+                    "and minimal are mutually exclusive"
+                )
+            self.bus = None
+        elif self.config.kafka_bootstrap:
             from .kafka_bus import KafkaBus
 
             self.bus = KafkaBus(self.config.kafka_bootstrap)
@@ -121,8 +134,12 @@ class Shop:
             env, self.catalog, self.cart, self.checkout, self.currency,
             self.recommendation, self.ad, self.shipping,
         )
-        self.accounting = AccountingService(env, self.bus)
-        self.fraud = FraudDetectionService(env, self.bus)
+        if self.bus is not None:
+            self.accounting = AccountingService(env, self.bus)
+            self.fraud = FraudDetectionService(env, self.bus)
+        else:  # minimal: no consumers to attach (and nothing publishes)
+            self.accounting = None
+            self.fraud = None
         self.loadgen = LoadGenerator(self.frontend, rng, users=self.config.users)
 
         # Pull receivers on the scrape cadence (SURVEY.md §5 Profiling):
@@ -186,7 +203,8 @@ class Shop:
         """
         if t_now > self._t:
             self._t = t_now
-        self.bus.pump()
+        if self.bus is not None:
+            self.bus.pump()
         if self._span_buffer:
             # Copy-and-clear, never rebind: the tracer holds a reference
             # to this exact list's append method.

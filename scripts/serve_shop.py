@@ -53,8 +53,12 @@ def serve(args) -> None:
     elif args.kafka:
         kafka_bootstrap = args.kafka
 
+    if args.minimal and kafka_bootstrap:
+        parser_error = "--minimal drops the async tier; it conflicts with --kafka"
+        raise SystemExit(parser_error)
     shop = Shop(ShopConfig(
         users=0, seed=args.seed, kafka_bootstrap=kafka_bootstrap,
+        minimal=args.minimal,
     ))
 
     pipeline = None
@@ -104,10 +108,14 @@ def serve(args) -> None:
             pipeline.pump(t)
 
     gw = ShopGateway(shop, host=args.host, port=args.port, on_spans=on_spans)
-    gw.feature_ui = FlagEditorUI(shop.flags)
+    if not args.minimal:
+        # Minimal profile drops flagd-UI (the reference's minimal
+        # compose keeps flagd itself — OFREP evaluation stays served).
+        gw.feature_ui = FlagEditorUI(shop.flags)
     gw.start()
     print(f"shop gateway on http://{args.host}:{gw.port}  "
-          f"(flag editor at /feature, metrics at /metrics)", flush=True)
+          + ("(minimal profile; metrics at /metrics)" if args.minimal else
+             "(flag editor at /feature, metrics at /metrics)"), flush=True)
 
     grpc_edge = None
     if args.grpc_port >= 0:
@@ -120,7 +128,11 @@ def serve(args) -> None:
             shop, host=args.host, port=args.grpc_port, lock=gw._lock
         )
         grpc_edge.start()
-        print(f"gRPC edge on {args.host}:{grpc_edge.port}", flush=True)
+        # Single-entry gRPC (the reference's /flagservice/ Envoy route):
+        # h2c connections hitting the HTTP port splice to this edge.
+        gw.grpc_target = ("127.0.0.1", grpc_edge.port)
+        print(f"gRPC edge on {args.host}:{grpc_edge.port} "
+              f"(also tunnelled through :{gw.port})", flush=True)
 
     # Loadgen control plane at /loadgen (the Locust web UI behind the
     # edge, envoy.tmpl.yaml:46): --users is the autostart default
@@ -189,6 +201,13 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--batch", type=int, default=512)
     parser.add_argument("--load-only", action="store_true")
+    parser.add_argument(
+        "--minimal", action="store_true",
+        default=os.getenv("SHOP_MINIMAL", "") not in ("", "0", "false"),
+        help="minimal profile (docker-compose.minimal.yml analogue): "
+        "drops accounting, fraud-detection, the orders bus and the "
+        "flag-editor UI; flagd evaluation (OFREP) stays",
+    )
     parser.add_argument("--target", default="http://127.0.0.1:8080")
     parser.add_argument(
         "--grpc-port", type=int,

@@ -115,10 +115,43 @@ class TestManifests:
                 if svc:
                     assert set(svc["spec"]["selector"].items()) <= set(pod_labels.items())
 
+    def test_minimal_stack_resources(self):
+        """The reduced profile mirrors the reference's minimal compose
+        (docker-compose.minimal.yml:16): no kafka tier, no consumer
+        wiring — shop runs --minimal, detector has no KAFKA_ADDR."""
+        idx = _by_kind_name(k8s.minimal_stack())
+        assert ("Deployment", "kafka") not in idx
+        for name in ("shop-gateway", "anomaly-detector", "load-generator"):
+            assert ("Deployment", name) in idx, name
+        shop = idx[("Deployment", "shop-gateway")]["spec"]["template"]["spec"]["containers"][0]
+        assert "--minimal" in shop["command"]
+        assert "--kafka" not in shop["command"]
+        assert "--otlp-endpoint" in shop["command"]
+        det = idx[("Deployment", "anomaly-detector")]["spec"]["template"]["spec"]["containers"][0]
+        det_env = {e["name"]: e["value"] for e in det["env"]}
+        assert "KAFKA_ADDR" not in det_env
+
+    def test_minimal_compose_profile(self):
+        """deploy/docker-compose.minimal.yml pins the same reduction
+        for compose: two services, no kafka, no consumer leg."""
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "deploy", "docker-compose.minimal.yml",
+        )
+        doc = yaml.safe_load(open(path))
+        assert set(doc["services"]) == {"shop", "anomaly-detector"}
+        shop = doc["services"]["shop"]
+        assert "--minimal" in shop["command"]
+        assert not any("--kafka" == part for part in shop["command"])
+        det_env = doc["services"]["anomaly-detector"]["environment"]
+        assert not any(e.startswith("KAFKA_ADDR") for e in det_env)
+
     def test_yaml_round_trip(self, tmp_path):
         paths = k8s.write_manifests(str(tmp_path))
-        # 2 aggregates + one breakout file per component.
-        assert len(paths) == 2 + len(k8s.component_bundles())
+        # 3 aggregates (full, minimal, sidecar) + one file per component.
+        assert len(paths) == 3 + len(k8s.component_bundles())
         for p in paths:
             docs = list(yaml.safe_load_all(open(p)))
             assert all("apiVersion" in d and "kind" in d for d in docs)
@@ -167,6 +200,69 @@ class TestServeScript:
             assert status == 200
             status, body = get("/metrics")
             assert status == 200
+        finally:
+            proc.terminate()
+            proc.wait(timeout=20)
+
+
+class TestServeScriptMinimal:
+    def test_serve_shop_minimal_profile(self, tmp_path):
+        """--minimal boots the reduced stack: storefront + checkout
+        work (no async leg), the flag-editor UI is gone, OFREP stays."""
+        proc = subprocess.Popen(
+            [sys.executable, "scripts/serve_shop.py", "--port", "0",
+             "--users", "0", "--minimal"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env={
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "JAX_PLATFORMS": "cpu",
+                "HOME": str(tmp_path),
+            },
+            cwd=".",
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "shop gateway on" in line and "minimal" in line, line
+            port = int(line.split(":")[2].split()[0].rstrip("/").split("/")[0])
+            base = f"http://127.0.0.1:{port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=30) as r:
+                    return r.status, r.read()
+
+            status, body = get("/api/products")
+            assert status == 200 and b"products" in body
+            # Checkout end-to-end without the async tier: add to cart,
+            # place the order — the publish leg is skipped, not broken.
+            import json as _json
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=_json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, r.read()
+
+            post("/api/cart", {"userId": "m", "item": {
+                "productId": "TEL-DOB-10", "quantity": 1}})
+            status, body = post("/api/checkout", {
+                "userId": "m", "currencyCode": "USD", "email": "m@x.io"})
+            assert status == 200 and _json.loads(body)["orderId"]
+            # flagd-ui is dropped (the route answers 503 like Envoy
+            # with a dead upstream); flagd evaluation (OFREP) stays.
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                get("/feature/")
+            assert exc.value.code == 503
+            # An undefined flag answers OFREP's FLAG_NOT_FOUND envelope
+            # (not a bare route-404) — proof the flagd surface is live.
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                post("/ofrep/v1/evaluate/flags/noSuchFlag", {})
+            assert exc.value.code == 404
+            assert b"FLAG_NOT_FOUND" in exc.value.read()
         finally:
             proc.terminate()
             proc.wait(timeout=20)

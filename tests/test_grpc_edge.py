@@ -593,3 +593,51 @@ def test_flagd_resolve_all_and_event_stream(edge, flagd_pb2):
     resp2 = ra(flagd_pb2.ResolveAllRequest(), timeout=5)
     assert resp2.flags["allBool"].WhichOneof("value") == "bool_value"
     assert resp2.flags["allBool"].bool_value is False
+
+
+# --- single-entry gRPC: the /flagservice/-at-the-edge analogue --------------
+# The reference routes the flag gRPC service through the ONE :8080 entry
+# (/root/reference/src/frontend-proxy/envoy.tmpl.yaml:50-51). The HTTP
+# gateway splices h2c prior-knowledge connections to the gRPC edge, so
+# gRPC (flagd and oteldemo alike) works against the HTTP port.
+
+
+def test_grpc_through_http_edge_h2c_splice(flagd_pb2, pb2):
+    from opentelemetry_demo_tpu.services.gateway import ShopGateway
+
+    shop = Shop(ShopConfig(users=0, seed=13))
+    gw = ShopGateway(shop, host="127.0.0.1", port=0)
+    e = GrpcShopEdge(shop, host="127.0.0.1", port=0, lock=gw._lock)
+    gw.grpc_target = ("127.0.0.1", e.port)
+    gw.start()
+    e.start()
+    try:
+        shop.set_flag("edgeFlag", True)
+        channel = grpc.insecure_channel(f"127.0.0.1:{gw.port}")
+        rb = channel.unary_unary(
+            "/flagd.evaluation.v1.Service/ResolveBoolean",
+            request_serializer=flagd_pb2.ResolveBooleanRequest.SerializeToString,
+            response_deserializer=flagd_pb2.ResolveBooleanResponse.FromString,
+        )
+        resp = rb(flagd_pb2.ResolveBooleanRequest(flag_key="edgeFlag"),
+                  timeout=10)
+        assert resp.value is True
+        # The oteldemo surface rides the same tunnel (superset of the
+        # reference's /flagservice/ upstream).
+        lp = channel.unary_unary(
+            "/oteldemo.ProductCatalogService/ListProducts",
+            request_serializer=pb2.Empty.SerializeToString,
+            response_deserializer=pb2.ListProductsResponse.FromString,
+        )
+        assert len(lp(pb2.Empty(), timeout=10).products) >= 5
+        channel.close()
+        # Plain HTTP on the same port is unaffected by the sniff.
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.port}/api/products", timeout=10
+        ) as r:
+            assert r.status == 200
+    finally:
+        e.stop()
+        gw.stop()
