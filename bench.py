@@ -235,8 +235,35 @@ def main():
         )
 
         stress = run_lag(
-            rate=20_000.0, seconds=6.0, batch=1024, harvest_async=True
+            rate=20_000.0, seconds=8.0, batch=1024, harvest_async=True,
+            # Adaptive width: under readback-RTT-bound harvest the
+            # controller widens batches until dispatch rate ≤ harvest
+            # rate — bounding the report skip rate the stress gate
+            # checks (r4 shipped 0.5 here; the gate wants <0.1).
+            adaptive=True,
         )
+
+    # ---- SLO verdicts (BASELINE.md:20-21) ----------------------------
+    # Explicit pass/fail so a reader never reconstructs the argument:
+    # throughput against the 200k/s star; lag on the NET basis (each
+    # sample's paired tunnel RTT subtracted — the locally-attached-chip
+    # number; the gross p99 sits on a ~130 ms topology floor this
+    # environment cannot remove, see lag_note); stress skip rate gated
+    # <0.1 (reports the operator actually sees under 10× load).
+    lag_net = lag.get("p99_net_ms")
+    stress_skip = stress.get("skip_rate")
+    slo = {
+        "north_star_throughput_ok": bool(
+            spans_per_sec >= BASELINE_SPANS_PER_SEC
+        ),
+        "north_star_lag_ok": (
+            bool(lag_net < BASELINE_LAG_MS) if lag_net is not None else None
+        ),
+        "north_star_lag_basis": "net_of_paired_rtt",
+        "stress_skip_rate_ok": (
+            bool(stress_skip < 0.1) if stress_skip is not None else None
+        ),
+    }
 
     print(
         json.dumps(
@@ -267,11 +294,16 @@ def main():
                 "lag_stress_batches": stress.get("batches"),
                 "lag_stress_reports_skipped": stress.get("reports_skipped"),
                 "lag_stress_skip_rate": stress.get("skip_rate"),
+                "lag_stress_final_batch_width": stress.get("final_batch_width"),
+                **slo,
                 "ttd_s": {
                     name: entry.get("ttd_s")
                     for name, entry in (quality.get("ttd") or {}).items()
                 },
                 "fp_rate": quality.get("fp_rate"),
+                "paymentFailure_ttd_by_rate": quality.get(
+                    "paymentFailure_ttd_by_rate"
+                ),
                 "detection_quality": quality or None,
                 "fetch_rtt_ms": fetch_rtt_ms,
                 "host_ingest_spans_per_sec": (

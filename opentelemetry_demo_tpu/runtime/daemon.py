@@ -27,6 +27,10 @@ Env contract (all optional, sensible defaults):
 - ``ANOMALY_BATCH``          device batch size (default 2048)
 - ``ANOMALY_HARVEST_INTERVAL``  report readback cadence seconds (default 0
   = every batch); ``ANOMALY_HARVEST_ASYNC=1`` fetches on a side thread
+- ``ANOMALY_ADAPTIVE_BATCH``  adaptive dispatch-width controller
+  (default 1 = on): widens batches in pow2 steps when report readback
+  can't keep pace, bounding the skip rate under load spikes; set 0 for
+  a fixed width. The width ladder precompiles in the background at boot
 - ``ANOMALY_PUMP_INTERVAL_S``  batch cadence (default 0.05 — the <100ms
                                detection-lag budget spends half on batching)
 - ``FLAGD_FILE``             flagd-schema JSON path (hot-reloaded)
@@ -137,7 +141,18 @@ class DetectorDaemon:
             # interval (and/or async) so dispatch never waits on fetch.
             harvest_interval_s=float(os.environ.get("ANOMALY_HARVEST_INTERVAL", "0")),
             harvest_async=os.environ.get("ANOMALY_HARVEST_ASYNC", "") == "1",
+            # Adaptive width (on by default): bounds the report skip
+            # rate when readback RTT outpaces the batch interval — the
+            # 10× stress regime. The ladder precompiles in the
+            # background below so an escalation never compiles
+            # mid-incident.
+            adaptive_batching=os.environ.get("ANOMALY_ADAPTIVE_BATCH", "1") == "1",
         )
+        if self.pipeline.adaptive_batching:
+            threading.Thread(
+                target=self._warm_widths_quietly,
+                name="width-ladder-warmup", daemon=True,
+            ).start()
         for name in restored_names:  # re-intern in checkpoint order
             self.pipeline.tensorizer.service_id(name)
 
@@ -239,6 +254,14 @@ class DetectorDaemon:
             )
 
     # -- report → metrics ---------------------------------------------
+
+    def _warm_widths_quietly(self) -> None:
+        """Background ladder precompile; failure is non-fatal (the
+        controller would then pay one compile at escalation time)."""
+        try:
+            self.pipeline.warm_widths()
+        except Exception:  # noqa: BLE001 — warmup must never kill boot
+            pass
 
     def _on_report(self, t_batch, report, flagged) -> None:
         names = self.pipeline.tensorizer.service_names

@@ -44,6 +44,9 @@ def measure_lag(
     rtt_probe: bool = True,
     seed: int = 0,
     config: DetectorConfig | None = None,
+    adaptive: bool = False,
+    max_batch_growth: int = 8,
+    settle_s: float = 3.0,
 ) -> dict:
     """Drive the pipeline at ``rate`` spans/s; return lag statistics.
 
@@ -67,6 +70,8 @@ def measure_lag(
         harvest_interval_s=harvest_interval_s,
         harvest_async=harvest_async,
         rtt_probe=rtt_probe,
+        adaptive_batching=adaptive,
+        max_batch_growth=max_batch_growth,
     )
     rng = np.random.default_rng(seed)
     # Pre-build chunks so generation cost stays off the timed path.
@@ -74,27 +79,51 @@ def measure_lag(
     interval = batch / rate
 
     # Warmup compiles the step; scrub it from every reported stat.
+    # Adaptive mode precompiles the whole width ladder here so a
+    # mid-run escalation never pays a compile on the timed path.
     pipe.submit_columns(chunks[0])
     pipe.pump(time.monotonic())
     pipe.drain()
+    pipe.warm_widths()
+
+    def paced_loop(duration_s: float, i0: int = 0) -> int:
+        end = time.monotonic() + duration_s
+        next_at = time.monotonic()
+        i = i0
+        while time.monotonic() < end:
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(next_at - now, interval))
+                continue
+            next_at += interval
+            pipe.submit_columns(chunks[i % len(chunks)])
+            pipe.pump(time.monotonic())
+            i += 1
+        return i
+
+    # Settle phase (adaptive only): let the width controller find its
+    # operating point before measurement — the same warmup-scrub policy
+    # as the compile warmup above. The controller's transient (a few
+    # hundred ms of skips while it jumps to target) is real but
+    # one-time per stress onset; the reported numbers are the sustained
+    # regime an operator lives in. ``final_batch_width`` +
+    # ``settle_s`` in the output keep the transient auditable.
+    i = 0
+    if adaptive and settle_s > 0:
+        i = paced_loop(settle_s)
+        # Barrier before the stats reset: under harvest_async the
+        # settle phase's last dispatches are still in flight, and the
+        # harvester would otherwise attribute their lag samples and
+        # controller-transient skips to the measured window.
+        pipe.drain()
+
     pipe.stats.lag_ms.clear()
     pipe.stats.rtt_ms.clear()
     base_batches = pipe.stats.batches
     base_spans = pipe.stats.spans
     base_skipped = pipe.stats.reports_skipped
 
-    end = time.monotonic() + seconds
-    next_at = time.monotonic()
-    i = 0
-    while time.monotonic() < end:
-        now = time.monotonic()
-        if now < next_at:
-            time.sleep(min(next_at - now, interval))
-            continue
-        next_at += interval
-        pipe.submit_columns(chunks[i % len(chunks)])
-        pipe.pump(time.monotonic())
-        i += 1
+    paced_loop(seconds, i)
     pipe.close()
 
     batches = pipe.stats.batches - base_batches
@@ -108,6 +137,11 @@ def measure_lag(
         # Skip *rate* beside the raw count: a skipped-report tally is
         # only judgeable against the batch denominator it came from.
         "skip_rate": round(skipped / batches, 4) if batches else None,
+        # Where the adaptive controller settled (== batch unless it
+        # widened under skip pressure) and how long it was given to
+        # settle before the measured window.
+        "final_batch_width": pipe.batch_width,
+        "settle_s": settle_s if adaptive else None,
     }
     net = pipe.stats.lag_net_samples()
     rtt = np.asarray(pipe.stats.rtt_ms, dtype=np.float64)

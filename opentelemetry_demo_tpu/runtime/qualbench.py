@@ -7,15 +7,25 @@ the bench artifact. Each fault shape mirrors one of the reference's
 flagd failure scenarios (SURVEY.md §5 fault-injection inventory —
 demo.flagd.json:4-108) projected onto the synthetic span stream:
 
-- ``paymentFailure``            → error-rate burst on one service
+- ``paymentFailure``            → error-rate burst on one service,
+  PLUS a percentage sweep over the reference's variant ladder
+  (demo.flagd.json: 10/25/50/75/90/100%) — TTD as a function of rate
+- ``cartFailure``               → total error burst (every op fails —
+  the bad-store swap, CartService.cs:83-90)
+- ``productCatalogFailure``     → partial error burst (only requests
+  for the one flagged product fail, main.go:339-349)
+- ``adFailure``                 → 1-in-10 error burst (AdService.java)
+- ``paymentUnreachable``        → service vanishes (full rate collapse)
 - ``adHighCpu`` / ``imageSlowLoad`` → step latency degradation
 - ``recommendationCacheFailure``  → gradual latency ramp (cache leak)
 - ``kafkaQueueProblems``        → throughput collapse (consumer stall)
+- ``loadGeneratorFloodHomepage``  → traffic redistribution: the flood
+  multiplies one service's span rate while starving the rest
 - ``errorTrickle``              → sustained small error shift, below
   any single-batch threshold (the CUSUM-integration case)
 - ``traceCardinalityExplosion`` → session/trace-id churn at constant
   span rate — only the HLL cardinality head can see it (the signal
-  family the other five shapes never exercise)
+  family the other shapes never exercise)
 
 Time-to-detect is virtual seconds from fault onset to the first batch
 whose report flags the faulted service; the false-positive rate is
@@ -62,7 +72,7 @@ def _batch(rng, tz, mutate=None, step: int = 0):
     # unmistakable HLL jump at unchanged span rate.
     trace = rng.integers(0, 64, size=B, dtype=np.uint64) * 2654435761 + 1
     if mutate is not None:
-        lat, err, keep, trace = mutate(step, svc, lat, err, keep, trace)
+        svc, lat, err, keep, trace = mutate(step, svc, lat, err, keep, trace)
     return tz.pack_arrays(
         svc=svc[keep],
         lat_us=lat[keep],
@@ -72,32 +82,48 @@ def _batch(rng, tz, mutate=None, step: int = 0):
     )
 
 
+def error_burst(rng, target: int, p: float):
+    """Error-rate burst shape: fraction ``p`` of the target service's
+    requests fail — paymentFailure's variant ladder, cartFailure at
+    p=1.0 (the bad-store swap fails every op), productCatalogFailure
+    at the flagged product's traffic share, adFailure at 1-in-10."""
+
+    def mutate(step, svc, lat, err, keep, trace):
+        hit = (rng.random(B) < p).astype(np.float32)
+        return svc, lat, np.where(
+            svc == target, np.maximum(err, hit), err
+        ).astype(np.float32), keep, trace
+
+    return mutate
+
+
 def fault_shapes(rng):
     """name → (faulted service index,
-    mutate(step, svc, lat, err, keep, trace))."""
-
-    def burst(step, svc, lat, err, keep, trace):
-        hit = (rng.random(B) < 0.25).astype(np.float32)
-        return lat, np.where(svc == 5, np.maximum(err, hit), err).astype(
-            np.float32
-        ), keep, trace
+    mutate(step, svc, lat, err, keep, trace) → same tuple)."""
 
     def latency_step(step, svc, lat, err, keep, trace):
-        return (np.where(svc == 1, lat * 3.0, lat).astype(np.float32),
+        return (svc, np.where(svc == 1, lat * 3.0, lat).astype(np.float32),
                 err, keep, trace)
 
     def cache_ramp(step, svc, lat, err, keep, trace):
         scale = 1.10 ** min(step, 60)  # unbounded cache growth shape
-        return (np.where(svc == 2, lat * scale, lat).astype(np.float32),
+        return (svc, np.where(svc == 2, lat * scale, lat).astype(np.float32),
                 err, keep, trace)
 
     def rate_drop(step, svc, lat, err, keep, trace):
         # Consumer stall: 90% of the service's spans stop arriving.
-        return lat, err, keep & ~((svc == 3) & (rng.random(B) < 0.9)), trace
+        return (svc, lat, err,
+                keep & ~((svc == 3) & (rng.random(B) < 0.9)), trace)
+
+    def unreachable(step, svc, lat, err, keep, trace):
+        # paymentUnreachable: the service VANISHES — checkout reroutes
+        # to a dead address (main.go:475-479), so the payment span
+        # stream stops entirely (full rate collapse, not errors).
+        return svc, lat, err, keep & (svc != 7), trace
 
     def trickle(step, svc, lat, err, keep, trace):
         hit = (rng.random(B) < 0.06).astype(np.float32)
-        return lat, np.where(svc == 4, np.maximum(err, hit), err).astype(
+        return svc, lat, np.where(svc == 4, np.maximum(err, hit), err).astype(
             np.float32
         ), keep, trace
 
@@ -107,13 +133,28 @@ def fault_shapes(rng):
         # unique trace ids — span rate unchanged, per-window distinct
         # count explodes. Only the HLL cardinality head can see this.
         fresh = rng.integers(1 << 32, 1 << 62, size=B, dtype=np.uint64)
-        return lat, err, keep, np.where(svc == 6, fresh, trace)
+        return svc, lat, err, keep, np.where(svc == 6, fresh, trace)
+
+    def flood(step, svc, lat, err, keep, trace):
+        # loadGeneratorFloodHomepage: the flood multiplies the
+        # frontend's request rate; within a fixed-width batch that is a
+        # traffic REDISTRIBUTION — most spans become frontend spans
+        # (svc 0), its per-dt rate jumping ~5× while the rest starve.
+        return (np.where(rng.random(B) < 0.6, 0, svc),
+                lat, err, keep, trace)
 
     return {
-        "paymentFailure": (5, burst),
+        "paymentFailure": (5, error_burst(rng, 5, 0.25)),
+        "cartFailure": (0, error_burst(rng, 0, 1.0)),
+        # The reference fails exactly one product id; the featured
+        # product draws ~1/8 of GetProduct traffic in the shop's mix.
+        "productCatalogFailure": (2, error_burst(rng, 2, 0.125)),
+        "adFailure": (1, error_burst(rng, 1, 0.10)),
+        "paymentUnreachable": (7, unreachable),
         "adHighCpu": (1, latency_step),
         "recommendationCacheFailure": (2, cache_ramp),
         "kafkaQueueProblems": (3, rate_drop),
+        "loadGeneratorFloodHomepage": (0, flood),
         "errorTrickle": (4, trickle),
         "traceCardinalityExplosion": (6, card_explosion),
     }
@@ -165,12 +206,31 @@ def measure_fp_rate(seed: int = 1):
     }
 
 
+# The reference paymentFailure flag's variant ladder
+# (demo.flagd.json: '10%' … '100%') — TTD is measured per rate.
+PAYMENT_SWEEP = (0.10, 0.25, 0.50, 0.75, 0.90, 1.00)
+
+
+def measure_payment_sweep(seed: int = 0) -> dict:
+    """TTD as a function of the paymentFailure rate: the detector's
+    sensitivity curve over the flag's own variant ladder."""
+    out = {}
+    for p in PAYMENT_SWEEP:
+        rng = np.random.default_rng(seed)
+        res = measure_time_to_detect(
+            f"paymentFailure@{p:.0%}", 5, error_burst(rng, 5, p), seed=seed
+        )
+        out[f"{p:.0%}"] = res["ttd_s"]
+    return out
+
+
 def measure_detection_quality(seed: int = 0) -> dict:
     rng = np.random.default_rng(seed)
     ttd = {}
     for name, (svc, mutate) in fault_shapes(rng).items():
         ttd[name] = measure_time_to_detect(name, svc, mutate, seed=seed)
     out = {"dt_s": DT_S, "batch": B, "ttd": ttd}
+    out["paymentFailure_ttd_by_rate"] = measure_payment_sweep(seed=seed)
     out.update(measure_fp_rate(seed=seed + 1))
     return out
 

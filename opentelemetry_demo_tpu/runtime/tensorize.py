@@ -244,7 +244,9 @@ class SpanTensorizer:
             attr_crc=cols.attr_crc.astype(np.uint64),
         )
 
-    def pack_columns(self, cols: SpanColumns) -> TensorBatch:
+    def pack_columns(
+        self, cols: SpanColumns, width: int | None = None
+    ) -> TensorBatch:
         """Columns → one padded, hashed, device-ready batch."""
         return self.pack_arrays(
             cols.svc,
@@ -252,6 +254,7 @@ class SpanTensorizer:
             cols.trace_key,
             cols.is_error,
             cols.attr_crc,
+            width=width,
         )
 
     def pack_arrays(
@@ -261,16 +264,18 @@ class SpanTensorizer:
         trace_id: np.ndarray,
         is_error: np.ndarray | None = None,
         attr_key: np.ndarray | None = None,
+        width: int | None = None,
     ) -> TensorBatch:
         """Vectorised packing for callers that already hold columnar data
         (the simulator, the C++ decoder, benchmark generators). ``svc``
         must already be int ids; ``trace_id``/``attr_key`` uint64 keys.
-        Pads (or rejects overflow beyond) ``batch_size``.
+        Pads (or rejects overflow beyond) ``width`` (default
+        ``batch_size`` — the adaptive pipeline passes its grown width).
         """
         n = svc.shape[0]
-        if n > self.batch_size:
-            raise ValueError(f"chunk of {n} exceeds batch_size {self.batch_size}")
-        b = self.batch_size
+        b = width if width is not None else self.batch_size
+        if n > b:
+            raise ValueError(f"chunk of {n} exceeds batch width {b}")
 
         def pad(x, dtype):
             out = np.zeros(b, dtype)

@@ -130,7 +130,12 @@ def serve(args) -> None:
         grpc_edge.start()
         # Single-entry gRPC (the reference's /flagservice/ Envoy route):
         # h2c connections hitting the HTTP port splice to this edge.
-        gw.grpc_target = ("127.0.0.1", grpc_edge.port)
+        # Dial the edge on the address it actually BOUND — loopback only
+        # when it listens on a wildcard.
+        splice_host = (
+            "127.0.0.1" if args.host in ("0.0.0.0", "::", "") else args.host
+        )
+        gw.grpc_target = (splice_host, grpc_edge.port)
         print(f"gRPC edge on {args.host}:{grpc_edge.port} "
               f"(also tunnelled through :{gw.port})", flush=True)
 
