@@ -168,13 +168,13 @@ def measure_impl_matrix(rng) -> dict[str, float]:
     if jax.default_backend() != "tpu":
         return {}
     out: dict[str, float] = {}
-    # Both impls at both sides of the reference-geometry 8192 crossover
-    # (the calibration table above fused.expected_rates): 8192 is the
-    # dense kernel's last winning point, 16384 the first where the xla
-    # path's MXU-histogram CMS engages and overtakes it. Compiles
-    # dominate the cost, so the sweep stays at 8 entries.
+    # Both impls at both sides of the reference-geometry ~24k crossover
+    # (the r5 calibration table above fused.expected_rates): 16384 is
+    # the dense kernel's last winning point, 65536 deep in the xla
+    # path's MXU-histogram regime. Compiles dominate the cost, so the
+    # sweep stays at 8 entries.
     for impl in ("pallas", "xla"):
-        for batch in (2048, 8192, 16384, 524288):
+        for batch in (2048, 16384, 65536, 524288):
             config = DetectorConfig(sketch_impl=impl)
             try:
                 rate = measure_throughput(
@@ -188,11 +188,12 @@ def measure_impl_matrix(rng) -> dict[str, float]:
 
 
 def main():
-    # 512k: the XLA path (auto-selected for large batches; CMS counting
-    # via the MXU one-hot outer-product histogram, cms.cms_update_hist)
-    # saturates ~66M spans/s at B=512k on v5e-1; 512k keeps the timed
-    # regions long relative to any fixed overheads.
-    batch_size = int(os.environ.get("BENCH_BATCH", 524288))
+    # 2M: the XLA path (auto-selected for large batches; CMS counting
+    # via the transposed-int8 MXU histogram, cms.cms_update_hist)
+    # plateaus ~123M spans/s at B=2M single-chip (r5 sweep: 97M@512k,
+    # 115M@1M, 123M@2M, flat to 8M — the r4 f32 engine's 2^24 key cap
+    # that blocked >4M-key batches is gone with int32 accumulation).
+    batch_size = int(os.environ.get("BENCH_BATCH", 2097152))
     rng = np.random.default_rng(0)
     spans_per_sec = measure_throughput(DetectorConfig(), batch_size, rng)
 

@@ -24,21 +24,23 @@ kernel"):
 An ``impl="xla"`` reference path (the scatter formulation built from
 ``ops.hll`` / ``ops.cms`` / ``ops.ewma``) defines the semantics; the
 Pallas path is property-tested against it (interpret mode on CPU, native
-on TPU). Honest fetch-synchronized timing on v5e-1 (S=32, p=12, 4×8192
-CMS), after the r3 wide-chunk retune (see ``_cell_chunk`` and the
-calibration table above ``expected_rates``): the dense kernel owns
-the small-batch low-latency regime through B=8192 (3.3M vs 1.7M
-full-step at 8192; the isolated delta op runs at its ~7.6M VPU
-dense-compare roofline — the step's other stages account for the
-difference); the XLA path
-wins from 16k up (42.7M at 16384, 67M at 512k) — its CMS count rides
-the scatter-free histogram engines in ``cms.cms_update_hist`` (the
-MXU one-hot outer-product Pallas kernel at tile-divisible geometries,
-sort+searchsorted elsewhere; TPU scatters serialize on duplicate
-indices, and a CMS batch is nothing but duplicates). ``resolve_impl``
-auto-selects by batch size. The kernel's further wins are determinism
-(fixed VPU/MXU schedule, no batch-order dependence) and keeping the
-whole delta VMEM-resident.
+on TPU). Honest fetch-synchronized timing single-chip (S=32, p=12,
+4×8192 CMS; r5, see the calibration table above ``expected_rates``):
+the dense kernel owns the small-batch low-latency regime through
+B≈16k (5.8M vs ~2.3M full-step at 8192); the XLA path wins from ~24k
+up (47M at 65536, 105M at 512k, 123M plateau at 2M) — its CMS count
+rides the scatter-free histogram engines in ``cms.cms_update_hist``
+(the transposed-int8 MXU outer-product Pallas kernel at
+tile-divisible geometries, sort+searchsorted elsewhere; TPU scatters
+serialize on duplicate indices, and a CMS batch is nothing but
+duplicates). ``resolve_impl`` auto-selects by batch size; the HYBRID
+is deliberate — the dense formulation's O(B·cells) sweep is a ceiling
+no layout removes (see the bound argument in the calibration comment
+and PARITY.md), so BASELINE config #4's "fused kernel" answer at
+large B is the histogram formulation, whose hot engine is itself a
+Pallas kernel. The dense kernel's further wins are determinism (fixed
+VPU/MXU schedule, no batch-order dependence) and keeping the whole
+delta VMEM-resident.
 """
 
 from __future__ import annotations
@@ -353,28 +355,42 @@ def sketch_batch_delta(
 
 # --- impl auto-select: geometry-derived rate model -----------------------
 #
-# Calibration anchors, measured on v5e-1 at the REFERENCE geometry
+# Calibration anchors, measured single-chip at the REFERENCE geometry
 # (S=32, p=12, D=4, W=8192; fetch-synchronized slope timing of the FULL
-# detector step, r3 after the MXU-histogram CMS engine landed):
+# detector step, r5 after the transposed-int8 MXU histogram landed —
+# its geometry gate now engages from B=2048, n_keys multiple of 8192):
 #
-#     B        pallas      xla        engine (xla CMS count)
-#     2048     1.8M/s      0.6M/s     sort   ← pallas (narrow chunks)
-#     4096     1.6M/s      1.2M/s     sort   ← pallas
-#     8192     3.3M/s      1.7M/s     mxu    ← pallas (wide chunks)
-#     16384    6.1M/s     42.7M/s     mxu    ← xla (hist fully pipelined)
-#     65536    6.5M/s     40.3M/s     mxu    ← xla
-#     524288   7.2M/s     67.0M/s     mxu    ← xla
-#     32768    6.7M/s      7.0M/s     sort (pre-MXU r2 tie measurement)
+#     B        pallas      xla        winner
+#     2048     1.1M/s      0.6M/s     pallas (narrow chunks)
+#     8192     5.8M/s      ~2.3M/s    pallas (wide chunks)
+#     16384    6.2M/s      ~4.2M/s    pallas
+#     32768    6.7M/s     ~12M/s      xla
+#     65536    6.6M/s     47.2M/s     xla
+#     524288   7.2M/s    104.8M/s     xla
+#     2097152     —       123.1M/s    xla (plateau)
+#
+# Mid-size xla numbers (8k-32k) carry real run-to-run variance on the
+# tunneled topology (32768 measured 8-23M across trials — per-step
+# FIXED costs dominate that band and RTT jitter leaks into short
+# regions); anchors are tight-floor medians, and _TIE_MARGIN absorbs
+# the slack. The r4 table's 42.7M@16384 did not reproduce after the
+# r5 rework (today's tight-floor runs put 16384 at ~4M either round).
 #
 # The router must not hard-code the conclusions of that table (r3 did:
 # fixed crossovers at 8192/32768, stale the moment cms_width or hll_p
 # changed). Instead it scales both sides by geometry:
 #
 # - The dense kernel's work is O(B·cells) compares BY CONSTRUCTION
-#   (every batch tile sweeps every sketch cell tile), so its rate is
-#   K/cells, flat in B per chunk regime — the one scaling law in this
-#   file that is exact, not fitted. K is calibrated from the table
-#   (wide plateau 7.2M/s and narrow 1.8M/s at cells_ref).
+#   (every batch tile sweeps every sketch cell tile), so its rate
+#   scales as 1/cells — the one scaling law in this file that is
+#   exact, not fitted; the B-shape comes from the measured curve
+#   (_PALLAS_CURVE: narrow-chunk ramp through 4096, wide plateau
+#   7.2M/s at cells_ref). That law is
+#   also the kernel's CEILING: at the reference geometry no layout can
+#   push the dense formulation past ~12M spans/s (B·cells compares at
+#   the VPU's element rate), which is why the large-B regime belongs
+#   to the histogram formulation by construction, not by tuning — see
+#   PARITY.md "config #4".
 # - The xla path's rate comes from the measured curves above
 #   (log-interpolated in B, engine chosen by the REAL geometry gate),
 #   derated by bins growth: its large-B cost is the CMS histogram,
@@ -383,11 +399,20 @@ def sketch_batch_delta(
 
 _REF_CELLS = 32 * (1 << 12) + 4 * 8192  # 163840
 _REF_BINS = 4 * 8192
-_K_PALLAS_WIDE = 7.2e6 * _REF_CELLS  # VPU dense-compare roofline
-_K_PALLAS_NARROW = 1.8e6 * _REF_CELLS  # small-B chunk regime derate
-_WIDE_BATCH = 8192  # where the wide-chunk regime starts (_cell_chunk)
+# Dense-kernel full-step curve at the reference geometry (the narrow→
+# wide chunk transition sits at 8192, see _cell_chunk); rates scale as
+# 1/cells, the kernel's exact O(B·cells) law. A flat narrow anchor
+# (the r5-initial model) misrouted 4096-6144 to the slower xla path —
+# the measured curve keeps routing monotone through the ramp.
+_PALLAS_CURVE = (
+    (2048, 1.14e6), (4096, 1.54e6), (8192, 5.8e6), (16384, 6.2e6),
+    (65536, 6.6e6), (524288, 7.2e6),
+)
 # (batch, spans/s) at the reference geometry, per histogram engine.
-_XLA_MXU_CURVE = ((8192, 1.7e6), (16384, 42.7e6), (65536, 40.3e6), (524288, 67.0e6))
+_XLA_MXU_CURVE = (
+    (2048, 0.62e6), (8192, 2.3e6), (16384, 4.2e6), (32768, 12.0e6),
+    (65536, 47.2e6), (524288, 104.8e6), (2097152, 123.1e6),
+)
 _XLA_SORT_CURVE = ((2048, 0.63e6), (4096, 1.2e6), (8192, 1.7e6), (32768, 7.0e6))
 # Prefer xla inside this band: the pallas side is its best-case plateau
 # K, while the sort numbers are full-step measurements — at the pre-MXU
@@ -420,8 +445,9 @@ def expected_rates(
     """(pallas, xla) expected spans/s at this batch AND geometry."""
     cells = num_services * (1 << hll_p) + cms_depth * cms_width
     bins = cms_depth * cms_width
-    k = _K_PALLAS_WIDE if batch >= _WIDE_BATCH else _K_PALLAS_NARROW
-    pallas_rate = k / max(cells, 1)
+    pallas_rate = _interp_rate(_PALLAS_CURVE, batch) * (
+        _REF_CELLS / max(cells, 1)
+    )
     mxu = cms.mxu_hist_geometry_ok(bins, cms_depth * batch)
     if mxu:
         # Bins growth derates the MXU estimate only: the one-hot

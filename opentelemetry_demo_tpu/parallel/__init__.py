@@ -18,7 +18,7 @@ All collectives ride ICI inside a pod; the ``ring`` module provides the
 """
 
 from ..ops.collectives import Comm, NO_COMM
-from .spmd import make_sharded_step, sharded_state_specs
+from .spmd import make_sharded_step, place_state, sharded_state_specs
 from .mesh import make_hybrid_mesh, make_mesh
 from .ring import ring_merge_max, ring_merge_sum
 
@@ -28,6 +28,7 @@ __all__ = [
     "make_hybrid_mesh",
     "make_mesh",
     "make_sharded_step",
+    "place_state",
     "sharded_state_specs",
     "ring_merge_max",
     "ring_merge_sum",

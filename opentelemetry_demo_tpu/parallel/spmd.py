@@ -36,14 +36,16 @@ from ..models.detector import (
 from ..ops.collectives import Comm
 
 
-def sharded_state_specs(config: DetectorConfig) -> DetectorState:
+def sharded_state_specs(config: DetectorConfig | None = None) -> DetectorState:
     """PartitionSpecs for DetectorState on a ("batch","sketch") mesh.
 
     Replicated over ``batch`` (the batch axis merges through collectives,
     so every batch shard holds the same state); service/depth axes live
-    on ``sketch``.
+    on ``sketch``. ``config`` is accepted for call-site symmetry but
+    unused BY DESIGN: the specs are shape-independent (must stay so —
+    ``place_state`` relies on it for config-free placement).
     """
-    del config  # specs are shape-independent
+    del config
     per_service = P("sketch", None)
     return DetectorState(
         hll_bank=P(None, None, "sketch", None),
@@ -145,11 +147,23 @@ def make_sharded_step(
     )
     step = jax.jit(fn, donate_argnums=0)
 
-    state = detector_init(config)
+    state = place_state(detector_init(config), mesh)
+    return step, state
+
+
+def place_state(state: DetectorState, mesh: Mesh) -> DetectorState:
+    """Place a (host or single-device) DetectorState onto ``mesh``.
+
+    The elastic-checkpoint primitive: global state shapes carry no
+    device count, so moving a snapshot between topologies is exactly
+    this placement (runtime.checkpoint.load_onto_mesh builds on it).
+    """
     # PartitionSpec is a tuple subclass, so a naive tree_map would recurse
     # into it; DetectorState is a NamedTuple, so map its fields directly.
     shardings = DetectorState(
-        *(NamedSharding(mesh, spec) for spec in state_specs)
+        *(
+            NamedSharding(mesh, spec)
+            for spec in sharded_state_specs()
+        )
     )
-    state = jax.device_put(state, shardings)
-    return step, state
+    return jax.device_put(state, shardings)

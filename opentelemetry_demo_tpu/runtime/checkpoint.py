@@ -40,17 +40,42 @@ def save(
     service_names: list[str] | None = None,
     metrics_feed=None,
 ) -> None:
-    state_np = {
-        k: np.asarray(v) for k, v in detector.state._asdict().items()
-    }
+    save_state(
+        path, detector.state, detector.config,
+        offsets=offsets, service_names=service_names,
+        clock_t_prev=detector.clock._t_prev, metrics_feed=metrics_feed,
+    )
+
+
+def save_state(
+    path: str,
+    state: DetectorState,
+    config: DetectorConfig,
+    offsets: dict[str, Any] | None = None,
+    service_names: list[str] | None = None,
+    clock_t_prev: float | None = None,
+    metrics_feed=None,
+) -> None:
+    """Snapshot any DetectorState — single-chip or MESH-SHARDED.
+
+    ``np.asarray`` on a sharded ``jax.Array`` gathers the GLOBAL value
+    (all shards are process-addressable in this deployment), so the
+    on-disk format is topology-free: global shapes carry no device
+    count, and the same snapshot restores onto one chip (:func:`load`)
+    or any mesh (:func:`load_onto_mesh`). Monoid state is what makes
+    this a placement problem rather than a retrain — HLL registers,
+    CMS counters and EWMA heads mean the same thing wherever the
+    service/depth axes land.
+    """
+    state_np = {k: np.asarray(v) for k, v in state._asdict().items()}
     # sketch_impl is an execution-backend knob, not state: a snapshot
     # written on TPU (pallas) must restore on a CPU box (xla) and vice
     # versa, so it is excluded from the persisted config fingerprint.
     meta = {
         "offsets": offsets or {},
         "service_names": service_names or [],
-        "config": list(detector.config._replace(sketch_impl=None)),
-        "clock_t_prev": detector.clock._t_prev,
+        "config": list(config._replace(sketch_impl=None)),
+        "clock_t_prev": clock_t_prev,
     }
     if metrics_feed is not None:
         # The metrics-leg head warms in minutes, but a restart must not
@@ -77,8 +102,10 @@ def save(
         pass
 
 
-def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetector, dict]:
-    """Restore a detector (state + clock) and return (detector, meta)."""
+def _load_arrays(
+    path: str, config: DetectorConfig | None
+) -> tuple[dict, dict, DetectorConfig]:
+    """Shared npz read + config validation → (arrays, meta, saved_cfg)."""
     with np.load(path + ".npz") as data:
         if "__meta__" not in data.files:
             raise ValueError(
@@ -109,12 +136,46 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
                 f"checkpoint config {saved_cfg} does not match "
                 f"requested {config}"
             )
+    return arrays, meta, saved_cfg
+
+
+def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetector, dict]:
+    """Restore a detector (state + clock) and return (detector, meta).
+
+    Topology-elastic by format: the snapshot may have been written from
+    a MESH-SHARDED run (save_state gathers global values) — restoring
+    here places it on the process's default single device.
+    """
+    arrays, meta, saved_cfg = _load_arrays(path, config)
     detector = AnomalyDetector(saved_cfg)
     detector.state = DetectorState(
         **{k: jax.device_put(v) for k, v in arrays.items()}
     )
     detector.clock._t_prev = meta.get("clock_t_prev")
     return detector, meta
+
+
+def load_onto_mesh(
+    path: str,
+    config: DetectorConfig | None,
+    mesh,
+) -> tuple[DetectorState, dict]:
+    """Elastic restore: place a snapshot onto a device mesh.
+
+    The inverse move of :func:`save_state`'s gather — a 1-chip snapshot
+    resumes on an 8-device mesh (or 8→1, or 2-D→hybrid) because the
+    on-disk state is global and monoid: ``device_put`` with the mesh's
+    NamedShardings IS the whole migration (the offsets in ``meta`` then
+    seek the consumers exactly as in the same-topology path — the
+    Consumer.cs:79-80 resume semantics, now independent of topology).
+    Pair with ``parallel.make_sharded_step(config, mesh)`` and replace
+    its initial state with the returned one.
+    """
+    from ..parallel.spmd import place_state
+
+    arrays, meta, _saved_cfg = _load_arrays(path, config)
+    state = DetectorState(**arrays)
+    return place_state(state, mesh), meta
 
 
 def exists(path: str) -> bool:

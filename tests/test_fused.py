@@ -87,19 +87,26 @@ class TestSketchBatchDelta:
 
     def test_resolve_impl_batch_crossover(self, monkeypatch):
         """Auto-selection routes small batches to the dense kernel and
-        the rest to the xla path, reproducing the r3 v5e FULL-STEP
-        measurements at the reference geometry (calibration table above
-        fused.expected_rates: pallas 3.3M vs xla 1.7M at 8192; xla
-        42.7M vs 6.1M at 16384 once the MXU histogram engages)."""
+        the rest to the xla path, reproducing the r5 single-chip
+        FULL-STEP measurements at the reference geometry (calibration
+        table above fused.expected_rates: pallas 5.8M vs ~2.3M at
+        8192 and 6.2M vs ~4.2M at 16384; xla from the ~24k crossover
+        up, 47M at 65536)."""
         monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
         assert fused.resolve_impl(None, batch=2048) == "pallas"
+        # The narrow-chunk ramp (4096-6144) must not misroute to xla:
+        # routing stays monotone through the dense kernel's regime.
+        assert fused.resolve_impl(None, batch=4096) == "pallas"
+        assert fused.resolve_impl(None, batch=6144) == "pallas"
         assert fused.resolve_impl(None, batch=8192) == "pallas"
-        assert fused.resolve_impl(None, batch=16384) == "xla"
+        assert fused.resolve_impl(None, batch=16384) == "pallas"
+        assert fused.resolve_impl(None, batch=32768) == "xla"
         assert fused.resolve_impl(None, batch=65536) == "xla"
-        # The 8192-crossover only holds where the MXU histogram's
-        # geometry gate passes (batch a multiple of 8192 at D=4): a
-        # non-multiple batch would drop the xla path onto the SLOWER
-        # sort engine, so it stays pallas until the pre-MXU ~32k tie.
+        assert fused.resolve_impl(None, batch=524288) == "xla"
+        # Below the ~24k crossover the winner is the dense kernel
+        # regardless of the histogram geometry gate; past it a
+        # non-multiple batch drops the xla path onto the SLOWER sort
+        # engine, whose ~32k tie the router still respects.
         assert fused.resolve_impl(None, batch=12000) == "pallas"
         assert fused.resolve_impl(None, batch=24576) == "xla"  # 3×8192
         assert fused.resolve_impl(None, batch=40000) == "xla"  # >32k tie
@@ -226,13 +233,17 @@ class TestGeometryAwareCrossover:
         assert x_wide == pytest.approx(x_ref / 1.5)
         assert x_narrow == x_ref
         # Bins past the 16-bit key gate flip the engine itself: the
-        # estimate drops to the sort curve (UNderated — sort cost barely
-        # depends on bins), well below the MXU estimate.
+        # estimate becomes the sort curve (UNderated — sort cost barely
+        # depends on bins). At 65536, where the MXU curve towers over
+        # sort, the flip is a big visible drop; at mid sizes (r5: the
+        # fixed-cost-dominated band) the two curves run close.
         _, x_huge = fused.expected_rates(16384, cms_width=32768)
-        assert x_huge < x_wide / 2
         assert x_huge == pytest.approx(
             fused._interp_rate(fused._XLA_SORT_CURVE, 16384)
         )
+        _, x_mxu_64k = fused.expected_rates(65536, cms_width=12288)
+        _, x_sort_64k = fused.expected_rates(65536, cms_width=32768)
+        assert x_sort_64k < x_mxu_64k / 2
 
     def test_wide_cms_sort_config_routes_to_xla(self, monkeypatch):
         """Wide-CMS configs whose bins fail the MXU gate still route to
