@@ -190,9 +190,11 @@ def measure_impl_matrix(rng) -> dict[str, float]:
 def main():
     # 2M: the XLA path (auto-selected for large batches; CMS counting
     # via the transposed-int8 MXU histogram, cms.cms_update_hist)
-    # plateaus ~123M spans/s at B=2M single-chip (r5 sweep: 97M@512k,
-    # 115M@1M, 123M@2M, flat to 8M — the r4 f32 engine's 2^24 key cap
-    # that blocked >4M-key batches is gone with int32 accumulation).
+    # plateaus ~123M spans/s at B=2M single-chip (r5: 105M@512k with
+    # this function's tight floors — a loose-floor sweep sampled 97M
+    # there, within the tunnel's run-to-run variance — then 115M@1M,
+    # 123M@2M, flat to 8M; the r4 f32 engine's 2^24 key cap that
+    # blocked >4M-key batches is gone with int32 accumulation).
     batch_size = int(os.environ.get("BENCH_BATCH", 2097152))
     rng = np.random.default_rng(0)
     spans_per_sec = measure_throughput(DetectorConfig(), batch_size, rng)

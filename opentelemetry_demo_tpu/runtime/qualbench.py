@@ -16,7 +16,11 @@ demo.flagd.json:4-108) projected onto the synthetic span stream:
   for the one flagged product fail, main.go:339-349)
 - ``adFailure``                 → 1-in-10 error burst (AdService.java)
 - ``paymentUnreachable``        → service vanishes (full rate collapse)
-- ``adHighCpu`` / ``imageSlowLoad`` → step latency degradation
+- ``adHighCpu``                 → step latency degradation
+- ``imageSlowLoad``             → step latency degradation on the
+  image-serving tier (the flag's 5/10-second variants dwarf the base)
+- ``adManualGc``                → PERIODIC latency spikes (full GC
+  pauses every few seconds, normal between them)
 - ``recommendationCacheFailure``  → gradual latency ramp (cache leak)
 - ``kafkaQueueProblems``        → throughput collapse (consumer stall)
 - ``loadGeneratorFloodHomepage``  → traffic redistribution: the flood
@@ -105,6 +109,19 @@ def fault_shapes(rng):
         return (svc, np.where(svc == 1, lat * 3.0, lat).astype(np.float32),
                 err, keep, trace)
 
+    def image_slow_load(step, svc, lat, err, keep, trace):
+        # imageSlowLoad's variants are 5000/10000 ms flat adds — vs a
+        # ~1 ms base that is a ~10x latency step on the image tier.
+        return (svc, np.where(svc == 7, lat * 10.0, lat).astype(np.float32),
+                err, keep, trace)
+
+    def manual_gc(step, svc, lat, err, keep, trace):
+        # adManualGc: full collections every ~2s (8 batches at dt=0.25)
+        # freeze the service for the batch; between pauses it is normal.
+        if step % 8 < 2:
+            lat = np.where(svc == 1, lat * 8.0, lat).astype(np.float32)
+        return svc, lat, err, keep, trace
+
     def cache_ramp(step, svc, lat, err, keep, trace):
         scale = 1.10 ** min(step, 60)  # unbounded cache growth shape
         return (svc, np.where(svc == 2, lat * scale, lat).astype(np.float32),
@@ -152,6 +169,8 @@ def fault_shapes(rng):
         "adFailure": (1, error_burst(rng, 1, 0.10)),
         "paymentUnreachable": (7, unreachable),
         "adHighCpu": (1, latency_step),
+        "adManualGc": (1, manual_gc),
+        "imageSlowLoad": (7, image_slow_load),
         "recommendationCacheFailure": (2, cache_ramp),
         "kafkaQueueProblems": (3, rate_drop),
         "loadGeneratorFloodHomepage": (0, flood),

@@ -11,6 +11,7 @@ fallback; session-id baggage drives targeting (AdService.java:160-168);
 from __future__ import annotations
 
 from .base import ServiceBase, ServiceError
+from ..runtime.tensorize import SpanEvent
 from ..telemetry.tracer import TraceContext
 
 FLAG_AD_FAILURE = "adFailure"
@@ -43,7 +44,11 @@ class AdService(ServiceBase):
         # Fault flags, in the order the reference applies them.
         if bool(self.flag(FLAG_AD_FAILURE, False, ctx)):
             if self.env.rng.random() < 0.1:  # 1-in-10, AdService.java:172
-                self.span("GetAds", ctx, error=True)
+                # "Error" event with the cause (AdService.java:219-220).
+                self.span("GetAds", ctx, error=True, events=(SpanEvent(
+                    "Error", -1.0,
+                    (("exception.message", "flagged ad failure"),),
+                ),))
                 raise ServiceError(self.name, "flagged ad failure")
         extra_us = 0.0
         if bool(self.flag(FLAG_AD_HIGH_CPU, False, ctx)):

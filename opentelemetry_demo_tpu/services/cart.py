@@ -11,6 +11,7 @@ custom ``app.cart.*.latency`` metrics (ValkeyCartStore.cs:30-43).
 from __future__ import annotations
 
 from .base import ServiceBase, ServiceError
+from ..runtime.tensorize import SpanEvent
 from ..telemetry.tracer import TraceContext
 
 FLAG_CART_FAILURE = "cartFailure"
@@ -94,7 +95,10 @@ class CartService(ServiceBase):
         self._observe("add_item", self.span("AddItem", ctx, attr=product_id))
 
     def get_cart(self, ctx: TraceContext, user_id: str) -> dict[str, int]:
-        self._observe("get_cart", self.span("GetCart", ctx))
+        # "Fetch cart" narration (CartService.cs:53).
+        self._observe("get_cart", self.span(
+            "GetCart", ctx, events=(SpanEvent("Fetch cart", -1.0),)
+        ))
         return self._active_store(ctx).get(user_id)
 
     def empty_cart(self, ctx: TraceContext, user_id: str) -> None:
@@ -104,4 +108,5 @@ class CartService(ServiceBase):
         except ServiceError:
             self.span("EmptyCart", ctx, scale=2.0, error=True)
             raise
-        self.span("EmptyCart", ctx)
+        # "Empty cart" narration (CartService.cs:79).
+        self.span("EmptyCart", ctx, events=(SpanEvent("Empty cart", -1.0),))

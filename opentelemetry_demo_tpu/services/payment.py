@@ -16,7 +16,7 @@ import uuid
 
 from .base import ServiceBase, ServiceError
 from .money import Money
-from ..telemetry.tracer import TraceContext
+from ..telemetry.tracer import TraceContext, exception_event
 
 FLAG_PAYMENT_FAILURE = "paymentFailure"
 FLAG_PAYMENT_UNREACHABLE = "paymentUnreachable"
@@ -66,22 +66,32 @@ class PaymentService(ServiceBase):
             self.span("Charge", ctx, scale=5.0, error=True)
             raise ServiceError(self.name, "payment service unreachable")
         if fail_rate > 0 and self.env.rng.random() < fail_rate:
-            self.span("Charge", ctx, scale=1.5, error=True)
+            err = ServiceError(self.name, "charge failed (paymentFailure active)")
+            self.span("Charge", ctx, scale=1.5, error=True,
+                      events=(exception_event(err),))
             self.log("WARN", "charge failed (paymentFailure active)", ctx)
-            raise ServiceError(self.name, "charge failed (paymentFailure active)")
+            raise err
 
         ctype = card_type(card_number)
+        # Card rejects record the exception on the span (index.js:27's
+        # recordException in the charge error handler).
         if not luhn_valid(card_number):
-            self.span("Charge", ctx, error=True)
-            raise ServiceError(self.name, "invalid card number")
+            err = ServiceError(self.name, "invalid card number")
+            self.span("Charge", ctx, error=True,
+                      events=(exception_event(err),))
+            raise err
         if ctype not in ("visa", "mastercard"):
-            self.span("Charge", ctx, error=True)
-            raise ServiceError(self.name, f"{ctype} not accepted")
+            err = ServiceError(self.name, f"{ctype} not accepted")
+            self.span("Charge", ctx, error=True,
+                      events=(exception_event(err),))
+            raise err
         if (expiry_year, expiry_month) < (now_year, now_month):
-            self.span("Charge", ctx, error=True)
-            raise ServiceError(
+            err = ServiceError(
                 self.name, f"card expired {expiry_month}/{expiry_year}"
             )
+            self.span("Charge", ctx, error=True,
+                      events=(exception_event(err),))
+            raise err
 
         charged = ctx.baggage.get("synthetic_request") != "true"
         if self.env.metrics is not None:

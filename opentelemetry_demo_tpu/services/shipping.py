@@ -23,6 +23,7 @@ import uuid
 from .base import ServiceBase
 from .money import Money
 from ..runtime import native
+from ..runtime.tensorize import SpanEvent
 from ..telemetry.tracer import TraceContext
 
 
@@ -50,7 +51,11 @@ class QuoteService(ServiceBase):
     base_latency_us = 600.0
 
     def get_quote(self, ctx: TraceContext, item_count: int) -> Money:
-        self.span("getquote", ctx)
+        # The PHP quote span narrates both phases (routes.php:22,35).
+        self.span("getquote", ctx, events=(
+            SpanEvent("Calculating quote", -1.0),
+            SpanEvent("Quote calculated, returning its value", -1.0),
+        ))
         if self.env.metrics is not None:
             self.env.metrics.counter_add("app_quotes_total", 1.0)
         if item_count <= 0:

@@ -397,16 +397,19 @@ def test_hybrid_ring_structure_and_float_merges_stay_direct():
 
 
 def _assert_states_match(state_a, state_b):
-    # Integer sketch banks: bit-exact under any topology move.
-    np.testing.assert_array_equal(
-        np.asarray(state_a.hll_bank), np.asarray(state_b.hll_bank)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(state_a.cms_bank), np.asarray(state_b.cms_bank)
-    )
-    # Float heads: reduction order differs across layouts.
-    for name in ("lat_mean", "lat_var", "err_mean", "rate_mean",
-                 "card_mean", "cusum"):
+    # Integer-exact fields: sketch banks, counters, the step index —
+    # bit-exact under any topology move.
+    for name in ("hll_bank", "cms_bank", "step_idx"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state_a, name)),
+            np.asarray(getattr(state_b, name)),
+            err_msg=name,
+        )
+    # EVERY float field (reduction order differs across layouts): an
+    # unchecked field is exactly where a mis-sharding would hide.
+    for name in ("span_total", "lat_mean", "lat_var", "err_mean",
+                 "rate_mean", "rate_var", "card_mean", "card_var",
+                 "obs_batches", "obs_windows", "cusum"):
         np.testing.assert_allclose(
             np.asarray(getattr(state_a, name)),
             np.asarray(getattr(state_b, name)),
