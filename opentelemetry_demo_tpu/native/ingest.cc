@@ -447,7 +447,10 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
                 }
               }
               ++n_events;
-              if (str_eq(ev_name, "exception") || str_eq(ev_name, "error"))
+              // tensorize.EXCEPTION_EVENT_NAMES, exact literals: the
+              // semconv name, checkout's "error", ad's "Error".
+              if (str_eq(ev_name, "exception") || str_eq(ev_name, "error") ||
+                  str_eq(ev_name, "Error"))
                 exc = true;
               break;
             }

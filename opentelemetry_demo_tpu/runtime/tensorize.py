@@ -62,12 +62,14 @@ class SpanEvent(NamedTuple):
 
 
 # Event names that carry error-cause evidence: the OTel semconv
-# record_exception name plus the reference checkout's deferred "error"
-# event (main.go:257 — AddEvent("error", exception.message)). Spans
+# record_exception name, the reference checkout's deferred "error"
+# event (main.go:257 — AddEvent("error", exception.message)), and the
+# ad service's capitalized "Error" (AdService.java:219). Spans
 # carrying one feed the detector's error lane even when their status
 # is unset (email's Sinatra handler records the exception; the span
-# status is whatever the framework set).
-EXCEPTION_EVENT_NAMES = ("exception", "error")
+# status is whatever the framework set). Kept as an exact-name tuple —
+# the native decoder (ingest.cc) matches the same three literals.
+EXCEPTION_EVENT_NAMES = ("exception", "error", "Error")
 
 
 def has_exception_event(events) -> bool:

@@ -103,10 +103,14 @@ class CartService(ServiceBase):
 
     def empty_cart(self, ctx: TraceContext, user_id: str) -> None:
         store = self._active_store(ctx)
+        # "Empty cart" narration rides BOTH outcomes: the reference
+        # adds the event before the store call (CartService.cs:79), so
+        # a failing span carries it too.
+        narration = (SpanEvent("Empty cart", -1.0),)
         try:
             store.empty(user_id)
         except ServiceError:
-            self.span("EmptyCart", ctx, scale=2.0, error=True)
+            self.span("EmptyCart", ctx, scale=2.0, error=True,
+                      events=narration)
             raise
-        # "Empty cart" narration (CartService.cs:79).
-        self.span("EmptyCart", ctx, events=(SpanEvent("Empty cart", -1.0),))
+        self.span("EmptyCart", ctx, events=narration)

@@ -229,16 +229,20 @@ class TestOtlpParity:
             _span(b"\x22" * 16, 0, 1_000_000, extra=_event(
                 500_000, b"exception", [("exception.message", "boom")]
             )),
-            # deferred "error" event (checkout main.go:257) counts too.
+            # deferred "error" event (checkout main.go:257) counts too,
+            # and the ad service's capitalized "Error" (AdService.java:219).
             _span(b"\x23" * 16, 0, 1_000_000, extra=_event(0, b"error")),
+            _span(b"\x28" * 16, 0, 1_000_000, extra=_event(
+                0, b"Error", [("exception.message", "ad fail")]
+            )),
             _span(b"\x24" * 16, 0, 1_000_000),
         ])
         got = _parity(payload)  # includes the is_error lane comparison
-        assert got.is_error.tolist() == [0.0, 1.0, 1.0, 0.0]
+        assert got.is_error.tolist() == [0.0, 1.0, 1.0, 1.0, 0.0]
         cols = native.decode_otlp(payload, MONITORED_ATTR_KEYS)
         records = decode_export_request(payload)
         assert cols.event_count.tolist() == [len(r.events) for r in records]
-        assert cols.has_exception.tolist() == [0, 1, 1, 0]
+        assert cols.has_exception.tolist() == [0, 1, 1, 1, 0]
         assert [e.name for e in records[0].events] == [
             "prepared", "charged", "shipped"]
 

@@ -397,24 +397,21 @@ def test_hybrid_ring_structure_and_float_merges_stay_direct():
 
 
 def _assert_states_match(state_a, state_b):
-    # Integer-exact fields: sketch banks, counters, the step index —
-    # bit-exact under any topology move.
-    for name in ("hll_bank", "cms_bank", "step_idx"):
-        np.testing.assert_array_equal(
-            np.asarray(getattr(state_a, name)),
-            np.asarray(getattr(state_b, name)),
-            err_msg=name,
-        )
-    # EVERY float field (reduction order differs across layouts): an
-    # unchecked field is exactly where a mis-sharding would hide.
-    for name in ("span_total", "lat_mean", "lat_var", "err_mean",
-                 "rate_mean", "rate_var", "card_mean", "card_var",
-                 "obs_batches", "obs_windows", "cusum"):
-        np.testing.assert_allclose(
-            np.asarray(getattr(state_a, name)),
-            np.asarray(getattr(state_b, name)),
-            rtol=1e-4, atol=1e-4, err_msg=name,
-        )
+    # Exhaustive by construction: iterate the NamedTuple's own fields
+    # so a future DetectorState addition can never be silently
+    # unchecked (an unchecked field is exactly where a mis-sharding
+    # would hide). Integer fields (sketch banks, counters, step index)
+    # must be bit-exact under any topology move; float fields tolerate
+    # cross-layout reduction order.
+    for name in state_a._fields:
+        a = np.asarray(getattr(state_a, name))
+        b = np.asarray(getattr(state_b, name))
+        if np.issubdtype(a.dtype, np.integer):
+            np.testing.assert_array_equal(a, b, err_msg=name)
+        else:
+            np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-4, err_msg=name
+            )
 
 
 def test_checkpoint_1chip_resumes_on_8device_mesh(rng, tmp_path):
