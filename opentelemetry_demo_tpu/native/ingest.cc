@@ -297,9 +297,11 @@ extern "C" {
 // come in priority order; the chosen value's CRC32 goes to attr_crc
 // with attr_present=1. Span events (field 11; the reference services
 // narrate spans with them — checkout main.go:270-294) surface as a
-// per-span count plus a has_exception flag (event named "exception"
-// or "error" — tensorize.EXCEPTION_EVENT_NAMES), the error-cause
-// evidence the detector folds into its error lane.
+// per-span count plus a has_exception flag (event named "exception",
+// "error", or "Error" — all three literals of
+// tensorize.EXCEPTION_EVENT_NAMES: the OTel semconv name, checkout's
+// lowercase variant, and the ad service's capitalized one), the
+// error-cause evidence the detector folds into its error lane.
 int otd_decode_otlp(const uint8_t* buf, size_t len,              //
                     const char* const* attr_keys, int n_keys,    //
                     int cap,                                     //

@@ -22,8 +22,13 @@ crash between two writes. The write goes through a temp file +
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
+import struct
+import zipfile
+import zlib
 from typing import Any
 
 import numpy as np
@@ -31,6 +36,33 @@ import numpy as np
 import jax
 
 from ..models.detector import AnomalyDetector, DetectorConfig, DetectorState
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointCorrupt(Exception):
+    """A snapshot file that cannot be trusted: truncated, unreadable,
+    or failing its content digest. Distinct from a *config mismatch*
+    (``ValueError``), which is an operator error that must refuse boot
+    — corruption is an environment fault the boot path degrades
+    through (cold start) instead of crashing on."""
+
+
+def _content_digest(state_np: dict, meta_json: str) -> str:
+    """sha256 over the meta JSON + every array's bytes (name-sorted).
+
+    The zip container catches truncation; the digest catches what the
+    container can't — bit rot inside a still-valid archive, or a
+    partially-flushed entry on filesystems that reorder writes."""
+    h = hashlib.sha256()
+    h.update(meta_json.encode())
+    for name in sorted(state_np):
+        arr = np.ascontiguousarray(state_np[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def save(
@@ -90,9 +122,22 @@ def save_state(
     # Metadata rides inside the npz (as a unicode scalar) so snapshot
     # and offsets commit in ONE os.replace — a crash can only ever leave
     # the previous complete (state, offsets) pair, never a mixed one.
+    # The digest rides beside it so a boot can verify content, and
+    # fsync-before-rename makes the replace itself crash-safe: without
+    # it a power cut can leave the *renamed* file with zero-filled
+    # pages on journaled filesystems.
+    meta_json = json.dumps(meta)
+    digest = _content_digest(state_np, meta_json)
     tmp = path + ".tmp.npz"
     with open(tmp, "wb") as f:
-        np.savez_compressed(f, __meta__=np.asarray(json.dumps(meta)), **state_np)
+        np.savez_compressed(
+            f,
+            __meta__=np.asarray(meta_json),
+            __digest__=np.asarray(digest),
+            **state_np,
+        )
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path + ".npz")
     # Clean up a sidecar left by the old two-file format so it can't
     # shadow or confuse a later inspection of the snapshot directory.
@@ -105,24 +150,65 @@ def save_state(
 def _load_arrays(
     path: str, config: DetectorConfig | None
 ) -> tuple[dict, dict, DetectorConfig]:
-    """Shared npz read + config validation → (arrays, meta, saved_cfg)."""
-    with np.load(path + ".npz") as data:
-        if "__meta__" not in data.files:
-            raise ValueError(
-                f"{path}.npz is not a self-contained checkpoint (missing "
-                "__meta__); it was written by an incompatible version"
+    """Shared npz read + config validation → (arrays, meta, saved_cfg).
+
+    Anything the *file* can do wrong — truncation, a torn zip, an
+    unreadable entry, digest mismatch — raises
+    :class:`CheckpointCorrupt`; only the post-read *semantic* checks
+    (incompatible version, config mismatch) raise ``ValueError``.
+    """
+    class _IncompatibleVersion(Exception):
+        pass
+
+    try:
+        with np.load(path + ".npz") as data:
+            if "__meta__" not in data.files:
+                raise _IncompatibleVersion
+            meta_json = str(data["__meta__"][()])
+            meta = json.loads(meta_json)
+            stored_digest = (
+                str(data["__digest__"][()])
+                if "__digest__" in data.files else None
             )
-        meta = json.loads(str(data["__meta__"][()]))
-        arrays = {
-            k: data[k]
-            for k in data.files
-            if k != "__meta__" and not k.startswith("metrics_")
-        }
-        metrics_arrays = {
-            k[len("metrics_"):]: data[k]
-            for k in data.files
-            if k.startswith("metrics_")
-        }
+            arrays = {
+                k: data[k]
+                for k in data.files
+                if k not in ("__meta__", "__digest__")
+                and not k.startswith("metrics_")
+            }
+            metrics_arrays = {
+                k[len("metrics_"):]: data[k]
+                for k in data.files
+                if k.startswith("metrics_")
+            }
+    except _IncompatibleVersion:
+        raise ValueError(
+            f"{path}.npz is not a self-contained checkpoint (missing "
+            "__meta__); it was written by an incompatible version"
+        ) from None
+    except (
+        zipfile.BadZipFile,  # truncated/garbage container
+        zlib.error,          # corrupt deflate stream inside an entry
+        EOFError,            # entry shorter than its header claims
+        struct.error,        # torn zip/npy structural fields
+        ValueError,          # bad npy magic/header, bad meta JSON
+        KeyError,            # central directory references a lost entry
+        IndexError,
+    ) as e:
+        # File-content faults only: transient ENVIRONMENT errors
+        # (PermissionError, EIO, MemoryError) propagate — a retry could
+        # succeed, and mislabeling them corrupt would make
+        # load_resilient move a perfectly good snapshot aside.
+        raise CheckpointCorrupt(f"{path}.npz unreadable: {e}") from e
+    if stored_digest is not None:
+        all_arrays = dict(arrays)
+        all_arrays.update({f"metrics_{k}": v for k, v in metrics_arrays.items()})
+        actual = _content_digest(all_arrays, meta_json)
+        if actual != stored_digest:
+            raise CheckpointCorrupt(
+                f"{path}.npz content digest mismatch "
+                f"(stored {stored_digest[:12]}…, computed {actual[:12]}…)"
+            )
     meta["_metrics_arrays"] = metrics_arrays
     saved_cfg = DetectorConfig(
         *[tuple(v) if isinstance(v, list) else v for v in meta["config"]]
@@ -155,6 +241,34 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
     return detector, meta
 
 
+def load_resilient(
+    path: str, config: DetectorConfig | None = None
+) -> tuple[AnomalyDetector | None, dict | None, bool]:
+    """Boot-path load: ``(detector, meta, corrupt)``.
+
+    A truncated or bit-rotted snapshot degrades to a cold start
+    (``(None, None, True)``) instead of crashing the daemon at boot —
+    the snapshot is an *optimization* (skip topic replay / re-warmup),
+    never a boot dependency. The bad file is moved aside to
+    ``<path>.npz.corrupt`` so the evidence survives for inspection AND
+    the next restart doesn't trip on it again. Config mismatch still
+    raises (operator error, mustMapEnv discipline); a missing file is
+    ``(None, None, False)`` — a plain cold start.
+    """
+    if not exists(path):
+        return None, None, False
+    try:
+        detector, meta = load(path, config)
+        return detector, meta, False
+    except CheckpointCorrupt as e:
+        log.error("checkpoint corrupt, falling back to cold start: %s", e)
+        try:
+            os.replace(path + ".npz", path + ".npz.corrupt")
+        except OSError:
+            pass
+        return None, None, True
+
+
 def load_onto_mesh(
     path: str,
     config: DetectorConfig | None,
@@ -170,10 +284,19 @@ def load_onto_mesh(
     Consumer.cs:79-80 resume semantics, now independent of topology).
     Pair with ``parallel.make_sharded_step(config, mesh)`` and replace
     its initial state with the returned one.
+
+    Window-clock continuity: the sharded step has no host-side
+    ``AnomalyDetector`` to hydrate, so the clock comes back through
+    ``meta["clock_t_prev"]`` (always present, None for a pre-clock
+    snapshot) — seed ``models.windows.WindowClock._t_prev`` with it
+    before the first sharded tick, exactly what :func:`load` does for
+    the single-chip path. Skipping this restarts the window phase and
+    the first post-resume rotation fires at the wrong boundary.
     """
     from ..parallel.spmd import place_state
 
     arrays, meta, _saved_cfg = _load_arrays(path, config)
+    meta.setdefault("clock_t_prev", None)
     state = DetectorState(**arrays)
     return place_state(state, mesh), meta
 

@@ -39,6 +39,19 @@ Env contract (all optional, sensible defaults):
                              (requires a Kafka client in the image)
 - ``ANOMALY_CHECKPOINT``       snapshot path prefix (enables resume)
 - ``ANOMALY_CHECKPOINT_INTERVAL_S``  snapshot cadence (default 30)
+- ``ANOMALY_OTLP_MAX_BODY``    ingest body-size cap in bytes (default
+                               16 MiB; oversized exports answer
+                               413/RESOURCE_EXHAUSTED)
+
+Fault tolerance (runtime.supervision; proven by tests/test_chaos.py):
+every ingest leg is supervised — a crashed receiver restarts with
+bounded backoff+jitter, poison ``orders`` records are quarantined (not
+fatal), a truncated OTLP body answers 4xx, and a corrupt checkpoint at
+boot degrades to a cold start. Component state is visible as
+``anomaly_component_up{component=...}`` /
+``anomaly_component_restarts_total`` / ``anomaly_degraded`` on
+``/metrics`` and per-component on the gRPC health service
+(``runtime.health_probe --component <name>``).
 """
 
 from __future__ import annotations
@@ -54,6 +67,7 @@ from . import checkpoint
 from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
+from .supervision import Supervisor
 
 
 def _env_int(name: str, default: int) -> int:
@@ -111,8 +125,16 @@ class DetectorDaemon:
             )
         restored_offsets: dict = {}
         meta: dict | None = None
-        if self.ckpt_path and checkpoint.exists(self.ckpt_path):
-            self.detector, meta = checkpoint.load(self.ckpt_path, config)
+        ckpt_corrupt = False
+        if self.ckpt_path:
+            # Resilient boot: a truncated/bit-rotted snapshot means
+            # cold start + a counter, never a boot crash — the snapshot
+            # is an optimization, not a dependency (checkpoint module
+            # docstring). Config mismatch still refuses to boot.
+            self.detector, meta, ckpt_corrupt = checkpoint.load_resilient(
+                self.ckpt_path, config
+            )
+        if meta is not None:
             restored_names = meta.get("service_names", [])
             # JSON round-trips partition keys as strings; offsets are
             # keyed by int partition everywhere else.
@@ -131,6 +153,42 @@ class DetectorDaemon:
         self.registry.describe(
             tele_metrics.ANOMALY_Z_SCORE,
             "Current |z| per service and signal",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_COMPONENT_RESTARTS,
+            "Supervised component restarts, by component",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_COMPONENT_UP,
+            "1 while the supervised component is up, 0 in backoff/degraded",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_DEGRADED,
+            "1 while any supervised component is crash-looping",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUARANTINE_TOTAL,
+            "Poison records quarantined instead of crashing the consumer",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_REJECTED,
+            "Malformed/truncated/oversized ingest bodies answered 4xx",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_CHECKPOINT_CORRUPT,
+            "Corrupt snapshots found at boot (each = one cold start)",
+        )
+        if ckpt_corrupt:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_CHECKPOINT_CORRUPT, 1.0
+            )
+        # The supervision tree: restart hooks + probes are registered
+        # for each ingest leg; passive (run_step-guarded) components
+        # register here, thread/server-backed ones in start().
+        self._supervisor = Supervisor(registry=self.registry)
+        self._supervisor.register(
+            "pump", base_backoff_s=0.1, max_backoff_s=5.0,
+            restart_budget=10, budget_window_s=60.0,
         )
         self.pipeline = DetectorPipeline(
             self.detector,
@@ -178,34 +236,22 @@ class DetectorDaemon:
         from ..telemetry.logstore import LogStore
 
         self.log_store = LogStore()
-        self.receiver = OtlpHttpReceiver(
-            self.pipeline.submit,
-            port=self.otlp_port,
-            on_columnar=self.pipeline.submit_columnar,
-            on_metric_records=self.metrics_feed.submit,
-            on_log_records=self._on_logs,
-        )
+        self.max_body_bytes = _env_int("ANOMALY_OTLP_MAX_BODY", 16 << 20)
+        self.receiver = self._make_http_receiver(self.otlp_port)
         # OTLP/gRPC :4317 — the reference collector's primary ingress
         # (otelcol-config.yml:5-8); every SDK defaults to gRPC export.
         self.grpc_receiver = None
         grpc_port = _env_int("ANOMALY_OTLP_GRPC_PORT", 4317)
         if grpc_port >= 0:
             try:
-                from .otlp_grpc import OtlpGrpcReceiver
-
-                self.grpc_receiver = OtlpGrpcReceiver(
-                    self.pipeline.submit,
-                    port=grpc_port,
-                    on_columnar=self.pipeline.submit_columnar,
-                    on_metric_records=self.metrics_feed.submit,
-                    on_log_records=self._on_logs,
-                )
+                self.grpc_receiver = self._make_grpc_receiver(grpc_port)
             except ImportError:  # grpcio absent: HTTP leg still serves
                 self.grpc_receiver = None
         self.exporter = tele_metrics.PrometheusExporter(
             self.registry, port=self.metrics_port
         )
         self._orders = None
+        self._quarantine_seen = 0
         kafka_addr = os.environ.get("KAFKA_ADDR")
         if kafka_addr:
             from .kafka_orders import OrdersSource  # gated import
@@ -216,9 +262,79 @@ class DetectorDaemon:
                 # sketch state corresponds to THEM (checkpoint.py module
                 # docstring — replay past the snapshot double-counts).
                 self._orders.seek(restored_offsets)
+            self._supervisor.register(
+                "kafka-orders", base_backoff_s=0.5, max_backoff_s=15.0,
+            )
+        if self.ckpt_path:
+            self._supervisor.register(
+                "checkpoint", base_backoff_s=1.0, max_backoff_s=60.0,
+            )
         self._offsets: dict = dict(restored_offsets)
         self._stop = threading.Event()
         self._last_ckpt = time.monotonic()
+
+    # -- supervised construction ---------------------------------------
+
+    def _on_ingest_reject(self, transport: str):
+        def bump(reason: str) -> None:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_INGEST_REJECTED, 1.0,
+                transport=transport, reason=reason,
+            )
+
+        return bump
+
+    def _make_http_receiver(self, port: int) -> OtlpHttpReceiver:
+        return OtlpHttpReceiver(
+            self.pipeline.submit,
+            port=port,
+            on_columnar=self.pipeline.submit_columnar,
+            on_metric_records=self.metrics_feed.submit,
+            on_log_records=self._on_logs,
+            on_reject=self._on_ingest_reject("http"),
+            max_body_bytes=self.max_body_bytes,
+        )
+
+    def _make_grpc_receiver(self, port: int):
+        from .otlp_grpc import OtlpGrpcReceiver
+
+        return OtlpGrpcReceiver(
+            self.pipeline.submit,
+            port=port,
+            on_columnar=self.pipeline.submit_columnar,
+            on_metric_records=self.metrics_feed.submit,
+            on_log_records=self._on_logs,
+            on_reject=self._on_ingest_reject("grpc"),
+            max_body_bytes=self.max_body_bytes,
+            component_status=self._supervisor.health_status,
+        )
+
+    def _restart_http_receiver(self) -> None:
+        # Rebind on the RESOLVED port: env may have requested :0, and
+        # the collector's exporter keeps pointing at the first bind.
+        port = self.receiver.port
+        try:
+            self.receiver.stop()
+        except Exception:  # noqa: BLE001 — a dead server may half-stop
+            pass
+        self.receiver = self._make_http_receiver(port)
+        self.receiver.start()
+
+    def _restart_grpc_receiver(self) -> None:
+        if self.grpc_receiver is None:
+            return
+        port = self.grpc_receiver.port
+        try:
+            self.grpc_receiver.stop(grace=0.5)
+        except Exception:  # noqa: BLE001
+            pass
+        self.grpc_receiver = self._make_grpc_receiver(port)
+        self.grpc_receiver.start()
+
+    def _probe_grpc(self) -> bool:
+        from .health_probe import probe
+
+        return probe(f"127.0.0.1:{self.grpc_receiver.port}", timeout_s=2.0)
 
     # -- logs ingress ---------------------------------------------------
 
@@ -299,6 +415,32 @@ class DetectorDaemon:
         if self.grpc_receiver is not None:
             self.grpc_receiver.start()
         self.exporter.start()
+        # Thread/server-backed components join the supervision tree
+        # once they are actually up (registering before start() would
+        # probe a receiver that hasn't bound yet).
+        self._supervisor.register(
+            "otlp-http",
+            restart=self._restart_http_receiver,
+            # Late-bound: a restart swaps self.receiver for a new
+            # object, and the probe must follow it.
+            probe=lambda: self.receiver.alive(),
+        )
+        if self.grpc_receiver is not None:
+            self._supervisor.register(
+                "otlp-grpc",
+                restart=self._restart_grpc_receiver,
+                # A real health-check RPC on a slow cadence: the grpc
+                # core owns its threads, so thread-liveness can't see a
+                # wedged server — only the wire can.
+                probe=self._probe_grpc,
+                probe_interval_s=10.0,
+            )
+        if self.pipeline.harvest_async:
+            self._supervisor.register(
+                "harvester",
+                restart=self.pipeline.restart_harvester,
+                probe=self.pipeline.harvester_alive,
+            )
 
     def step(self, t_now: float | None = None) -> None:
         """One pump + housekeeping tick (public for tests/sims)."""
@@ -330,17 +472,39 @@ class DetectorDaemon:
                 "app_anomaly_log_docs_stored", float(self.log_store.count())
             )
         if self._orders is not None:
-            for offsets, record in self._orders.poll(0.0):
-                self._offsets.update(offsets)
-                if record is not None:  # tombstone / skipped poison pill
-                    self.pipeline.submit([record])
+            # Guarded: an exception escaping the poll/submit loop (a
+            # transport state no one anticipated) backs the pump off
+            # and retries instead of killing the daemon loop.
+            self._supervisor.run_step("kafka-orders", self._pump_orders)
         self.pipeline.pump(t_now)
         self.metrics_feed.pump(time.monotonic() if t_now is None else t_now)
+        self._supervisor.tick()
         if (
             self.ckpt_path
             and time.monotonic() - self._last_ckpt >= self.ckpt_interval_s
         ):
-            self._checkpoint()
+            # Guarded: a full disk is a degraded snapshot cadence, not
+            # a dead detector.
+            self._supervisor.run_step("checkpoint", self._checkpoint)
+
+    def _pump_orders(self) -> None:
+        for offsets, record in self._orders.poll(0.0):
+            self._offsets.update(offsets)
+            if record is not None:  # tombstone / quarantined poison pill
+                self.pipeline.submit([record])
+        quarantined = self._orders.decode_failures
+        if quarantined != self._quarantine_seen:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_QUARANTINE_TOTAL,
+                float(quarantined - self._quarantine_seen),
+                source="orders",
+            )
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_QUARANTINE_LAST_ERROR_TS,
+                self._orders.last_error_ts,
+                source="orders",
+            )
+            self._quarantine_seen = quarantined
 
     def _checkpoint(self) -> None:
         checkpoint.save(
@@ -362,7 +526,18 @@ class DetectorDaemon:
             on_ready(self)
         try:
             while not self._stop.wait(self.pump_interval_s):
-                self.step()
+                # Guarded: one bad step (a transient JAX/transport/
+                # filesystem fault) backs off and retries — the serve
+                # loop of an always-on sidecar must not be one
+                # exception away from exit. A genuine crash loop
+                # surfaces as anomaly_degraded + component "pump".
+                self._supervisor.run_step("pump", self.step)
+                # Tick again OUTSIDE the guarded step: step() ticks on
+                # the happy path, but a persistently-failing pump must
+                # not also starve every other component of its probes
+                # and restarts — multi-fault incidents are exactly when
+                # the supervision tree earns its keep.
+                self._supervisor.tick()
         finally:
             self.shutdown()
 

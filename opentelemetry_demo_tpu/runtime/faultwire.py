@@ -1,0 +1,204 @@
+"""Fault-injecting TCP proxy: the chaos harness's wire layer.
+
+The reference injects every failure through flagd flags — network
+misbehaviour included (``kafkaQueueProblems`` starves the consumer from
+inside the broker path). This proxy injects the failures a *flag
+cannot*: the transport faults between the detector and its
+dependencies. Park it between the daemon and the in-repo Kafka broker
+(or an OTLP receiver) and it can, per the chaos plan:
+
+- **delay** every forwarded chunk (``delay_s``) — congested link;
+- **truncate mid-frame** (``truncate_after`` bytes client→upstream,
+  then a hard RST) — a peer dying mid-protocol-frame, the case length-
+  prefixed protocols like Kafka's are most sensitive to;
+- **RST new connections** (``rst_connects``) — a listener that accepts
+  and immediately resets, the half-crashed-broker shape;
+- **blackhole** (``blackhole``) — accept and read but forward nothing:
+  the half-open connection that pins naive clients forever;
+- **kill live connections** (:meth:`kill_connections`) — RST both
+  sides of every in-flight session, the broker-restart shape.
+
+Faults are plain attributes, togglable at runtime (tests flip them
+mid-stream), and env-seedable in the spirit of the reference's
+flag-driven failures: ``FAULTWIRE_DELAY_MS``,
+``FAULTWIRE_TRUNCATE_AFTER``, ``FAULTWIRE_RST=1``,
+``FAULTWIRE_BLACKHOLE=1``.
+
+This is a test/chaos tool with a real socket surface — the daemon under
+test cannot tell it from a misbehaving network, which is the point.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+
+def _rst_close(sock: socket.socket) -> None:
+    """Close with SO_LINGER(1, 0): the kernel sends RST, not FIN — the
+    abortive teardown a crashed process produces."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class FaultWire:
+    """TCP fault proxy: listen on ``host:port``, forward to upstream."""
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.upstream = (upstream_host, upstream_port)
+        # Fault plan (mutable at runtime; env-seeded like a fault flag).
+        self.delay_s = float(os.environ.get("FAULTWIRE_DELAY_MS", "0")) / 1e3
+        trunc = os.environ.get("FAULTWIRE_TRUNCATE_AFTER", "")
+        self.truncate_after: int | None = int(trunc) if trunc else None
+        self.rst_connects = os.environ.get("FAULTWIRE_RST", "") == "1"
+        self.blackhole = os.environ.get("FAULTWIRE_BLACKHOLE", "") == "1"
+        # Stats (observability for assertions and operators).
+        self.conns_total = 0
+        self.conns_killed = 0
+        self.bytes_forwarded = 0
+        self._lock = threading.Lock()
+        self._pairs: list[tuple[socket.socket, socket.socket]] = []
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name="faultwire-accept", daemon=True
+        )
+
+    # -- control --------------------------------------------------------
+
+    def clear(self) -> None:
+        """Drop every fault back to clean forwarding."""
+        self.delay_s = 0.0
+        self.truncate_after = None
+        self.rst_connects = False
+        self.blackhole = False
+
+    def kill_connections(self) -> None:
+        """RST both legs of every live session (broker-restart shape)."""
+        with self._lock:
+            pairs, self._pairs = self._pairs, []
+            self.conns_killed += len(pairs)
+        for client, up in pairs:
+            _rst_close(client)
+            _rst_close(up)
+
+    def start(self) -> None:
+        self._acceptor.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._acceptor.join(timeout=2.0)
+        self.kill_connections()
+
+    # -- data path ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                client, _addr = self._sock.accept()
+            except OSError:
+                return
+            self.conns_total += 1
+            if self.rst_connects:
+                _rst_close(client)
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                # Upstream down: the client sees exactly what it would
+                # against the dead upstream — a refused/reset connect.
+                _rst_close(client)
+                continue
+            with self._lock:
+                self._pairs.append((client, up))
+            # Budget shared across both pump directions so "truncate
+            # after N bytes" means N bytes of *protocol*, whichever
+            # side is mid-frame when it runs out.
+            budget = (
+                [self.truncate_after]
+                if self.truncate_after is not None else None
+            )
+            for src, dst, c2u in ((client, up, True), (up, client, False)):
+                threading.Thread(
+                    target=self._pump, args=(src, dst, c2u, client, up, budget),
+                    name="faultwire-pump", daemon=True,
+                ).start()
+
+    def _pump(self, src, dst, c2u, client, up, budget) -> None:
+        import time as _time
+
+        try:
+            while not self._stop:
+                try:
+                    chunk = src.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                if self.blackhole and c2u:
+                    continue  # swallow the request; never answer
+                if self.delay_s > 0:
+                    _time.sleep(self.delay_s)
+                if budget is not None:
+                    with self._lock:
+                        take = max(min(budget[0], len(chunk)), 0)
+                        budget[0] -= take
+                        spent = budget[0] <= 0
+                    chunk = chunk[:take]
+                    if chunk:
+                        try:
+                            dst.sendall(chunk)
+                        except OSError:
+                            break
+                        self.bytes_forwarded += len(chunk)
+                    if spent:
+                        # Mid-frame cut: RST both legs so neither side
+                        # can mistake this for a graceful close.
+                        _rst_close(client)
+                        _rst_close(up)
+                        break
+                    continue
+                try:
+                    dst.sendall(chunk)
+                except OSError:
+                    break
+                self.bytes_forwarded += len(chunk)
+        finally:
+            # Half-close propagation: EOF on one side ends the session.
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._lock:
+                self._pairs = [
+                    p for p in self._pairs if p != (client, up)
+                ]

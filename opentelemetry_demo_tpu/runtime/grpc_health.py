@@ -40,16 +40,27 @@ class HealthService:
         known_services: Iterable[str],
         stop_event: threading.Event,
         watcher_slots: int = 2,
+        component_status=None,
     ):
         self.known = set(known_services)
         self.stop_event = stop_event
         self._watchers = threading.Semaphore(max(watcher_slots, 0))
+        # Optional per-service status hook (supervision.Supervisor.
+        # health_status): consulted BEFORE the known-set rule so
+        # supervised components answer their own SERVING/NOT_SERVING
+        # (service names "anomaly.component.<name>"); it returns None
+        # for names it doesn't own, falling back to server-wide status.
+        self.component_status = component_status
 
     def _status_response(self, request: bytes) -> bytes | None:
         """Response bytes, or None for an unknown service name."""
         f = wire.scan_fields(request)
         raw = wire.first(f, 1, b"")
         service = raw.decode("utf-8", "replace") if isinstance(raw, bytes) else ""
+        if service and self.component_status is not None:
+            status = self.component_status(service)
+            if status is not None:
+                return wire.encode_int(1, status)
         if service and service not in self.known:
             return None
         status = NOT_SERVING if self.stop_event.is_set() else SERVING

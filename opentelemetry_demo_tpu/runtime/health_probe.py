@@ -11,6 +11,12 @@ that probe for this framework's images:
 
 Raw-bytes unary call (no stubs): request = HealthCheckRequest{service},
 response field 1 must equal SERVING (1).
+
+Per-component probing (the supervised runtime, runtime.supervision):
+``--component kafka-orders`` is shorthand for
+``--service anomaly.component.kafka-orders`` — exit 0 only while that
+supervised component is UP (not in backoff or crash-looping), the
+k8s-liveness handle on a single degraded ingest leg.
 """
 
 from __future__ import annotations
@@ -45,9 +51,19 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--addr", default="127.0.0.1:4317")
     parser.add_argument("--service", default="")
+    parser.add_argument(
+        "--component", default="",
+        help="supervised component name (shorthand for "
+        "--service anomaly.component.<name>)",
+    )
     parser.add_argument("--timeout", type=float, default=3.0)
     args = parser.parse_args()
-    sys.exit(0 if probe(args.addr, args.service, args.timeout) else 1)
+    service = args.service
+    if args.component:
+        from .supervision import HEALTH_PREFIX
+
+        service = HEALTH_PREFIX + args.component
+    sys.exit(0 if probe(args.addr, service, args.timeout) else 1)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ every poll so ``checkpoint`` can key sketch snapshots to them
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Iterator, NamedTuple, Sequence
 
 import numpy as np
@@ -225,11 +226,21 @@ class OrdersSource:
     TOPIC = "orders"
     RECONNECT_BACKOFF_S = 1.0
 
+    QUARANTINE_KEEP = 32  # most-recent poison records retained for triage
+
     def __init__(self, bootstrap: str, group_id: str = "anomaly-detector"):
         self._bootstrap = bootstrap
         self._group_id = group_id
         self._pending_seek: dict[int, int] = {}
         self.decode_failures = 0  # poison pills skipped (not crashed on)
+        # Consumer-side quarantine, mirroring the producer-side
+        # dead-letter discipline in services.kafka_bus: the poison
+        # record's coordinates + error + payload head are kept (bounded)
+        # so an operator can triage the bad producer, and last_error
+        # feeds the daemon's last-error metric.
+        self.quarantine: deque = deque(maxlen=self.QUARANTINE_KEEP)
+        self.last_error: str | None = None
+        self.last_error_ts: float = 0.0
         self._wire = None
         self._next_connect = 0.0  # wire-transport reconnect backoff
         try:
@@ -380,12 +391,19 @@ class OrdersSource:
             # (scan_fields returns an int where bytes were expected),
             # not WireError — and ANY decode failure is the same poison
             # pill from the consumer's point of view.
+            import time as _time
+
             self.decode_failures += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.last_error_ts = _time.time()
+            self.quarantine.append(
+                (partition, offset, type(e).__name__, bytes(value[:64]))
+            )
             import logging
 
             logging.getLogger(__name__).warning(
-                "orders[%s@%s]: undecodable payload skipped (%s: %s); "
-                "%d total", partition, offset, type(e).__name__, e,
+                "orders[%s@%s]: undecodable payload quarantined (%s); "
+                "%d total", partition, offset, self.last_error,
                 self.decode_failures,
             )
             return None

@@ -315,7 +315,21 @@ class OtlpHttpReceiver:
     (otelcol-config.yml:128-131). Absent the respective callback,
     exports are acknowledged and dropped (an ingest-side null sink,
     matching a collector with that pipeline unconfigured).
+
+    Ingest hardening (the fault-tolerant-runtime contract, proven by
+    tests/test_chaos.py): a malformed body answers 400, a truncated
+    body (client died mid-upload) 400, an oversized body 413 — each
+    tallied in ``rejects[reason]`` and reported through ``on_reject`` —
+    and an abrupt client disconnect (half-open socket, reset mid-
+    response) releases the handler thread via the per-connection
+    ``timeout`` instead of pinning it. None of these ever kill the
+    server: the next well-formed export proceeds normally.
     """
+
+    # Half-open-socket bound: StreamRequestHandler applies this to the
+    # connection in setup(), so a client that stops sending mid-request
+    # frees the thread instead of pinning it forever.
+    CONNECTION_TIMEOUT_S = 10.0
 
     def __init__(
         self,
@@ -325,13 +339,48 @@ class OtlpHttpReceiver:
         on_columnar: Callable | None = None,
         on_metric_records: Callable | None = None,
         on_log_records: Callable | None = None,
+        on_reject: Callable[[str], None] | None = None,
+        max_body_bytes: int = 16 << 20,
     ):
         receiver = self
 
         class Handler(BaseHTTPRequestHandler):
+            timeout = receiver.CONNECTION_TIMEOUT_S
+
             def do_POST(self):  # noqa: N802 (http.server API)
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    receiver._reject("bad_length")
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                if length > receiver.max_body_bytes:
+                    # Oversized: refuse WITHOUT reading — draining a
+                    # multi-GB body to politely answer 413 is itself a
+                    # resource fault. Close so the pipelined remainder
+                    # can't be parsed as a next request.
+                    receiver._reject("oversized")
+                    self.send_response(413)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.close_connection = True
+                    return
+                try:
+                    body = self.rfile.read(length)
+                except OSError:
+                    # Timeout or reset mid-body: the client is gone —
+                    # nothing to answer, just release the thread.
+                    receiver._reject("disconnect")
+                    self.close_connection = True
+                    return
+                if len(body) < length:
+                    # Truncated frame: the client promised more bytes
+                    # than it sent (died mid-upload). 4xx, not a crash.
+                    receiver._reject("truncated")
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 is_json = "json" in (self.headers.get("Content-Type") or "")
                 path = self.path.split("?", 1)[0]
                 columnar = None
@@ -371,6 +420,7 @@ class OtlpHttpReceiver:
                     # Only decoding is in scope — a failure in the ingest
                     # callback below is a server bug and must surface,
                     # not masquerade as a client error.
+                    receiver._reject("malformed")
                     self.send_response(400)
                     self.end_headers()
                     return
@@ -384,10 +434,19 @@ class OtlpHttpReceiver:
                     receiver.on_columnar(columnar)
                 else:
                     receiver.on_records(records)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/x-protobuf")
-                self.end_headers()
-                self.wfile.write(b"")  # empty Export*ServiceResponse
+                try:
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-protobuf"
+                    )
+                    self.end_headers()
+                    self.wfile.write(b"")  # empty Export*ServiceResponse
+                except OSError:
+                    # Client reset between upload and ack: the data is
+                    # already ingested (at-least-once), only the ack was
+                    # lost — count it, release the thread.
+                    receiver._reject("disconnect")
+                    self.close_connection = True
 
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
@@ -396,14 +455,31 @@ class OtlpHttpReceiver:
         self.on_columnar = on_columnar
         self.on_metric_records = on_metric_records
         self.on_log_records = on_log_records
+        self.on_reject = on_reject
+        self.max_body_bytes = max_body_bytes
+        # reason → count; the daemon mirrors these into
+        # anomaly_ingest_rejected_total{transport="http",reason=...}.
+        self.rejects: dict[str, int] = {}
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="otlp-receiver", daemon=True
         )
 
+    def _reject(self, reason: str) -> None:
+        self.rejects[reason] = self.rejects.get(reason, 0) + 1
+        if self.on_reject is not None:
+            try:
+                self.on_reject(reason)
+            except Exception:  # noqa: BLE001 — metrics must not kill ingest
+                pass
+
     @property
     def port(self) -> int:
         return self._server.server_address[1]
+
+    def alive(self) -> bool:
+        """Liveness for the supervisor: the serve thread is running."""
+        return self._thread.is_alive()
 
     def start(self) -> None:
         self._thread.start()

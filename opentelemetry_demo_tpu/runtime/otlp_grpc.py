@@ -36,8 +36,17 @@ class OtlpGrpcReceiver:
     ``on_columnar`` (with the native decoder available) takes the C++
     columnar fast path; ``on_metric_records`` receives MetricRecords
     from the MetricsService. Malformed payloads answer
-    ``INVALID_ARGUMENT`` (the client's fault); callback failures
-    propagate as ``INTERNAL`` — server bugs must surface.
+    ``INVALID_ARGUMENT`` (the client's fault) and are tallied in
+    ``rejects``/``on_reject``; oversized messages are bounced by grpc
+    itself (``RESOURCE_EXHAUSTED`` via ``max_receive_message_length``)
+    before our handler runs; abrupt disconnects are absorbed by the
+    transport. Callback failures propagate as ``INTERNAL`` — server
+    bugs must surface.
+
+    ``component_status`` (optional, from
+    ``supervision.Supervisor.health_status``) lets the attached
+    grpc.health.v1 service answer per-component Check requests
+    (``anomaly.component.<name>``) beside the server-wide status.
     """
 
     def __init__(
@@ -49,6 +58,9 @@ class OtlpGrpcReceiver:
         on_metric_records: Callable | None = None,
         on_log_records: Callable | None = None,
         max_workers: int = 4,
+        on_reject: Callable[[str], None] | None = None,
+        max_body_bytes: int = 16 << 20,
+        component_status: Callable[[str], int | None] | None = None,
     ):
         import grpc
         from concurrent import futures
@@ -57,7 +69,17 @@ class OtlpGrpcReceiver:
         self.on_columnar = on_columnar
         self.on_metric_records = on_metric_records
         self.on_log_records = on_log_records
+        self.on_reject = on_reject
+        self.rejects: dict[str, int] = {}
         receiver = self
+
+        def _reject(reason: str) -> None:
+            receiver.rejects[reason] = receiver.rejects.get(reason, 0) + 1
+            if receiver.on_reject is not None:
+                try:
+                    receiver.on_reject(reason)
+                except Exception:  # noqa: BLE001 — metrics must not kill ingest
+                    pass
 
         def export_traces(request: bytes, context) -> bytes:
             columnar = None
@@ -67,6 +89,7 @@ class OtlpGrpcReceiver:
                 if columnar is None:
                     records = otlp.decode_export_request(request)
             except Exception:
+                _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
                 )
@@ -80,6 +103,7 @@ class OtlpGrpcReceiver:
             try:
                 records = otlp_metrics.decode_metrics_request(request)
             except Exception:
+                _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
                 )
@@ -91,6 +115,7 @@ class OtlpGrpcReceiver:
             try:
                 docs = otlp.decode_logs_request(request)
             except Exception:
+                _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
                 )
@@ -112,6 +137,7 @@ class OtlpGrpcReceiver:
             {m.split("/")[1] for m in (TRACE_EXPORT, METRICS_EXPORT, LOGS_EXPORT)},
             self._stop_event,
             watcher_slots=1,
+            component_status=component_status,
         )
 
         handlers = {
@@ -137,7 +163,13 @@ class OtlpGrpcReceiver:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
                 max_workers=max_workers, thread_name_prefix="otlp-grpc"
-            )
+            ),
+            options=[
+                # Oversized exports bounce at the transport with
+                # RESOURCE_EXHAUSTED (the HTTP leg's 413 analogue)
+                # instead of ballooning the decoder's heap.
+                ("grpc.max_receive_message_length", max_body_bytes),
+            ],
         )
         self._server.add_generic_rpc_handlers((Handler(),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
@@ -149,6 +181,13 @@ class OtlpGrpcReceiver:
 
     def start(self) -> None:
         self._server.start()
+
+    def alive(self) -> bool:
+        """Liveness for the supervisor: started and not stopped. The
+        grpc core owns its own threads (no Python thread to watch), so
+        this reflects lifecycle state; the supervisor's deeper probe is
+        a real health-check RPC on its own cadence."""
+        return not self._stop_event.is_set()
 
     def stop(self, grace: float = 1.0) -> None:
         # NOT_SERVING reaches health watchers before the teardown.

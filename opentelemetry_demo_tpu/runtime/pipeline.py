@@ -231,13 +231,17 @@ class DetectorPipeline:
         width = self._width if self.adaptive_batching else self.tensorizer.batch_size
         with self._pending_lock:
             rows_avail = self._pending_rows
-        # The accumulate-hold scales with the growth factor: at base
-        # width it is max_wait_s (negligible added latency), at 8× it is
-        # 8×max_wait_s — exactly the regime where a report every ~0.4 s
-        # beats skipping half of them. A decayed width shrinks it back.
+        # The accumulate-hold scales with the growth factor (at 8× it
+        # is 8×max_wait_s — exactly the regime where a report every
+        # ~0.4 s beats skipping half of them) and engages ONLY once the
+        # controller has escalated: at base width a hold would spend up
+        # to max_wait_s of the <100 ms lag budget for nothing — the
+        # batch that would have dispatched now is the same batch either
+        # way, just later.
         hold_s = self.max_wait_s * (width / self.tensorizer.batch_size)
         if (
             self.adaptive_batching
+            and width > self.tensorizer.batch_size  # escalated regime only
             and not self._harvest_flush  # drain() must always dispatch
             and 0 < rows_avail < width
             and time.monotonic() - self._last_dispatch < hold_s
@@ -366,6 +370,29 @@ class DetectorPipeline:
             self._harvest_thread.join(timeout=5.0)
             self._harvest_thread = None
 
+    # -- supervision hooks --------------------------------------------
+
+    def harvester_alive(self) -> bool:
+        """True while the async harvester runs (or isn't configured).
+        The supervisor's probe: a dead harvester means in-flight
+        reports pile up to the skip cap and nothing reaches
+        ``on_report`` — silent from the outside."""
+        if not self.harvest_async:
+            return True
+        return self._harvest_thread is not None and self._harvest_thread.is_alive()
+
+    def restart_harvester(self) -> None:
+        """Respawn a dead async harvester (the supervisor's restart).
+        Safe to call when it's healthy (no-op) or after close()."""
+        if not self.harvest_async or self.harvester_alive():
+            return
+        self._harvest_stop = False
+        self._harvest_idle.set()
+        self._harvest_thread = threading.Thread(
+            target=self._harvest_loop, name="report-harvester", daemon=True
+        )
+        self._harvest_thread.start()
+
     # -- adaptive width controller ------------------------------------
 
     @property
@@ -456,6 +483,20 @@ class DetectorPipeline:
             events = self._adapt_events
             self._adapt_events = 0
             self._adapt_skips = 0
+            if (
+                skips == 0
+                and self._adapt_clean_needed > 2
+                and time.monotonic() - self._last_decay >= 10.0
+            ):
+                # The last decay survived its 10 s re-escalation window
+                # (or pressure cleared long ago): earn the hysteresis
+                # back down toward the initial requirement, so a
+                # transient oscillation doesn't leave a long-running
+                # daemon permanently width-elevated behind a 32-window
+                # decay price.
+                self._adapt_clean_needed = max(
+                    self._adapt_clean_needed // 2, 2
+                )
             if skips > events // 4:
                 self._adapt_clean = 0
                 if time.monotonic() - self._last_decay < 10.0:

@@ -205,29 +205,49 @@ class ShopGateway:
                 # nothing has read from the socket yet (setup() only
                 # wraps it), so MSG_PEEK is safe. A gRPC client's first
                 # bytes are always the full 24-byte preface; loop while
-                # we hold a strict prefix (TCP may fragment).
+                # we hold a strict prefix (TCP may fragment). The sniff
+                # runs under a SHORT socket timeout: a blocking
+                # MSG_PEEK against a half-open connection that never
+                # sends a byte would otherwise pin this handler thread
+                # forever. The previous timeout is restored before
+                # either handoff — the h2 splice and the HTTP/1 parser
+                # own their own read policies.
                 import socket as _socket
 
-                deadline = time.monotonic() + 2.0
-                while True:
+                prev_timeout = self.connection.gettimeout()
+                self.connection.settimeout(2.0)
+                try:
+                    deadline = time.monotonic() + 2.0
+                    while True:
+                        try:
+                            head = self.connection.recv(
+                                len(_H2_PREFACE), _socket.MSG_PEEK
+                            )
+                        except OSError:
+                            # Timeout (half-open peer) or reset: either
+                            # way no preface is coming.
+                            head = b""
+                        if head == _H2_PREFACE:
+                            self.connection.settimeout(prev_timeout)
+                            gateway._splice_h2(self.connection)
+                            self.close_connection = True
+                            return
+                        if (head and _H2_PREFACE.startswith(head)
+                                and time.monotonic() < deadline):
+                            # Strict prefix: the rest of the preface is
+                            # in flight. MSG_PEEK returns the same
+                            # bytes immediately, so pace the re-peek.
+                            time.sleep(0.005)
+                            continue
+                        break  # plain HTTP (or EOF): the normal parser
+                finally:
+                    # The splice path may have CLOSED the socket (e.g.
+                    # no upstream): restoring a timeout on a closed fd
+                    # raises EBADF, which must not escape handle().
                     try:
-                        head = self.connection.recv(
-                            len(_H2_PREFACE), _socket.MSG_PEEK
-                        )
+                        self.connection.settimeout(prev_timeout)
                     except OSError:
-                        head = b""
-                    if head == _H2_PREFACE:
-                        gateway._splice_h2(self.connection)
-                        self.close_connection = True
-                        return
-                    if (head and _H2_PREFACE.startswith(head)
-                            and time.monotonic() < deadline):
-                        # Strict prefix: the rest of the preface is in
-                        # flight. MSG_PEEK returns the same bytes
-                        # immediately, so pace the re-peek.
-                        time.sleep(0.005)
-                        continue
-                    break  # plain HTTP (or EOF): the normal parser
+                        pass
                 super().handle()
 
             def do_GET(self):  # noqa: N802 (http.server API)

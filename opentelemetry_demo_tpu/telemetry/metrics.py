@@ -170,6 +170,16 @@ ANOMALY_METRIC_Z = "app_anomaly_metric_z_score"
 ANOMALY_METRIC_FLAG_TOTAL = "app_anomaly_metric_flags_total"
 ANOMALY_METRIC_POINTS_TOTAL = "app_anomaly_metric_points_processed_total"
 ANOMALY_LOG_RECORDS_TOTAL = "app_anomaly_log_records_processed_total"
+# The fault-tolerant runtime's own health family (runtime.supervision):
+# the sidecar's job is to stay up while everything around it misbehaves,
+# so its component restarts/degradation are first-class metrics.
+ANOMALY_COMPONENT_RESTARTS = "anomaly_component_restarts_total"
+ANOMALY_COMPONENT_UP = "anomaly_component_up"
+ANOMALY_DEGRADED = "anomaly_degraded"
+ANOMALY_QUARANTINE_TOTAL = "anomaly_quarantined_records_total"
+ANOMALY_QUARANTINE_LAST_ERROR_TS = "anomaly_quarantine_last_error_ts_seconds"
+ANOMALY_INGEST_REJECTED = "anomaly_ingest_rejected_total"
+ANOMALY_CHECKPOINT_CORRUPT = "anomaly_checkpoint_corrupt_total"
 
 
 def export_metrics_report(

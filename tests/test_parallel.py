@@ -433,6 +433,7 @@ def test_checkpoint_1chip_resumes_on_8device_mesh(rng, tmp_path):
     checkpoint.save_state(
         path, state, config,
         offsets={"0": 1234, "1": 77}, service_names=["checkout", "cart"],
+        clock_t_prev=0.75,  # 3 ticks at dt=0.25: the window-clock phase
     )
 
     # Phase 2a: resume on the 8-device mesh and continue the stream.
@@ -441,6 +442,10 @@ def test_checkpoint_1chip_resumes_on_8device_mesh(rng, tmp_path):
     state_sh, meta = checkpoint.load_onto_mesh(path, config, mesh)
     assert meta["offsets"] == {"0": 1234, "1": 77}
     assert meta["service_names"] == ["checkout", "cart"]
+    # Window-clock continuity crosses topology too: the sharded path
+    # has no AnomalyDetector to hydrate, so the clock rides meta —
+    # seed WindowClock._t_prev with it (same semantics as load()).
+    assert meta["clock_t_prev"] == 0.75
     # Phase 2b: the reference continues single-chip on the same stream.
     state_ref = state
     for k in range(3, 6):

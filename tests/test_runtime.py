@@ -565,9 +565,15 @@ class TestCheckpoint:
         path = str(tmp_path / "old")
         checkpoint.save(path, det)
         # Rewrite the snapshot as an older version would have written
-        # it: config list truncated before the newest trailing field.
+        # it: config list truncated before the newest trailing field,
+        # and no __digest__ entry (pre-digest formats verify by the zip
+        # container alone — the loader must accept their absence).
         with np.load(path + ".npz") as data:
-            arrays = {k: data[k] for k in data.files if k != "__meta__"}
+            arrays = {
+                k: data[k]
+                for k in data.files
+                if k not in ("__meta__", "__digest__")
+            }
             meta = json.loads(str(data["__meta__"][()]))
         assert meta["config"][-1] == DetectorConfig().cusum_h_rate
         meta["config"] = meta["config"][:-1]
