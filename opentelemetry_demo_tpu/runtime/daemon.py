@@ -42,6 +42,21 @@ Env contract (all optional, sensible defaults):
 - ``ANOMALY_OTLP_MAX_BODY``    ingest body-size cap in bytes (default
                                16 MiB; oversized exports answer
                                413/RESOURCE_EXHAUSTED)
+- Overload knobs (one registry: ``utils.config.OVERLOAD_KNOBS``):
+  ``ANOMALY_QUEUE_MAX_ROWS`` (pending-queue row budget, default 65536,
+  0 = unbounded), ``ANOMALY_QUEUE_HIGH_WATERMARK`` /
+  ``ANOMALY_QUEUE_LOW_WATERMARK`` (saturation hysteresis, defaults
+  0.85/0.5), ``ANOMALY_BROWNOUT_HOLD_S`` / ``ANOMALY_BROWNOUT_MAX_LEVEL``
+  (head-sampling ladder, defaults 2.0 s / 4), ``ANOMALY_RETRY_AFTER_S``
+  (the 429/RESOURCE_EXHAUSTED retry hint, default 1.0)
+
+Overload protection (tests/test_overload.py): above the high watermark
+the pending queue sheds oldest OK-lane rows (never error-lane), trace
+exports answer retryable 429 + ``Retry-After`` (HTTP) /
+``RESOURCE_EXHAUSTED`` + retry hint (gRPC), the Kafka pump pauses
+fetching (offsets hold, the broker buffers), and sustained pressure
+engages a deterministic brownout head-sampling ladder. ``/healthz`` on
+the metrics port reports ``saturated`` distinct from ``degraded``.
 
 Fault tolerance (runtime.supervision; proven by tests/test_chaos.py):
 every ingest leg is supervised — a crashed receiver restarts with
@@ -62,6 +77,7 @@ import time
 
 from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
+from ..utils.config import ConfigError, overload_config
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
 from . import checkpoint
 from .metrics_feed import MetricsFeed
@@ -178,6 +194,30 @@ class DetectorDaemon:
             tele_metrics.ANOMALY_CHECKPOINT_CORRUPT,
             "Corrupt snapshots found at boot (each = one cold start)",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SHED_ROWS,
+            "Pending-queue rows dropped under overload, by lane and cause",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUEUE_ROWS,
+            "Pending-queue depth in rows (bounded by the row budget)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUEUE_WATERMARK,
+            "Configured saturation watermarks in rows, by mark",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_BROWNOUT_LEVEL,
+            "Brownout head-sampling level (keep 1/2^level of OK-lane spans)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SATURATED,
+            "1 while admission is saturated (429/RESOURCE_EXHAUSTED to producers)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KAFKA_PAUSED,
+            "1 while the orders pump holds fetching under saturation",
+        )
         if ckpt_corrupt:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_CHECKPOINT_CORRUPT, 1.0
@@ -190,6 +230,10 @@ class DetectorDaemon:
             "pump", base_backoff_s=0.1, max_backoff_s=5.0,
             restart_budget=10, budget_window_s=60.0,
         )
+        try:
+            ov = overload_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -205,7 +249,41 @@ class DetectorDaemon:
             # background below so an escalation never compiles
             # mid-incident.
             adaptive_batching=os.environ.get("ANOMALY_ADAPTIVE_BATCH", "1") == "1",
+            # Bounded admission + brownout (the overload half of the
+            # fault matrix; knob registry: utils.config.OVERLOAD_KNOBS).
+            queue_max_rows=ov["ANOMALY_QUEUE_MAX_ROWS"],
+            high_watermark=ov["ANOMALY_QUEUE_HIGH_WATERMARK"],
+            low_watermark=ov["ANOMALY_QUEUE_LOW_WATERMARK"],
+            brownout_hold_s=ov["ANOMALY_BROWNOUT_HOLD_S"],
+            brownout_max_level=ov["ANOMALY_BROWNOUT_MAX_LEVEL"],
+            retry_after_s=ov["ANOMALY_RETRY_AFTER_S"],
         )
+        # Watermark gauges are static config — export once so every
+        # scrape can judge anomaly_queue_rows against them; and mint the
+        # per-lane shed series at zero so the error-lane invariant
+        # ("this number never moves") is a visible 0, not a missing row.
+        if self.pipeline.queue_max_rows:
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_QUEUE_WATERMARK,
+                float(self.pipeline._high_rows), mark="high",
+            )
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_QUEUE_WATERMARK,
+                float(self.pipeline._low_rows), mark="low",
+            )
+        for lane in ("ok", "error"):
+            for cause in ("overflow", "brownout"):
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_SHED_ROWS, 0.0,
+                    lane=lane, cause=cause,
+                )
+        self._shed_seen = {"ok": 0, "error": 0}
+        self._brownout_seen = 0
+        self._kafka_paused = False
+        # SATURATED surfaces beside (and ordered below) DEGRADED: the
+        # supervisor reports it on overall_state()/anomaly_saturated,
+        # /healthz (below) serves it to probes.
+        self._supervisor.set_saturation_probe(lambda: self.pipeline.saturated)
         if self.pipeline.adaptive_batching:
             threading.Thread(
                 target=self._warm_widths_quietly,
@@ -248,7 +326,7 @@ class DetectorDaemon:
             except ImportError:  # grpcio absent: HTTP leg still serves
                 self.grpc_receiver = None
         self.exporter = tele_metrics.PrometheusExporter(
-            self.registry, port=self.metrics_port
+            self.registry, port=self.metrics_port, health=self._healthz
         )
         self._orders = None
         self._quarantine_seen = 0
@@ -293,6 +371,9 @@ class DetectorDaemon:
             on_log_records=self._on_logs,
             on_reject=self._on_ingest_reject("http"),
             max_body_bytes=self.max_body_bytes,
+            # Backpressure: the pipeline's single admission question —
+            # late-bound through self so a restarted receiver follows.
+            retry_after=lambda: self.pipeline.admission_retry_after(),
         )
 
     def _make_grpc_receiver(self, port: int):
@@ -307,6 +388,7 @@ class DetectorDaemon:
             on_reject=self._on_ingest_reject("grpc"),
             max_body_bytes=self.max_body_bytes,
             component_status=self._supervisor.health_status,
+            retry_after=lambda: self.pipeline.admission_retry_after(),
         )
 
     def _restart_http_receiver(self) -> None:
@@ -368,6 +450,26 @@ class DetectorDaemon:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_LOG_RECORDS_TOTAL, float(n)
             )
+
+    # -- health surface -------------------------------------------------
+
+    def _healthz(self):
+        """/healthz payload: overall state + the overload/supervision
+        numbers an operator triages with. ``saturated`` is distinct
+        from ``degraded`` (and loses to it — supervision.SATURATED):
+        a shedding daemon is healthy-but-browning-out, a crash-looping
+        one is not."""
+        from .supervision import UP
+
+        state = self._supervisor.overall_state()
+        detail = {
+            "components": self._supervisor.states(),
+            "queue_rows": self.pipeline.pending_rows(),
+            "queue_max_rows": self.pipeline.queue_max_rows,
+            "brownout_level": self.pipeline.brownout_level,
+            "shed_rows": dict(self.pipeline.stats.shed_rows),
+        }
+        return ("ok" if state == UP else state), detail
 
     # -- report → metrics ---------------------------------------------
 
@@ -471,6 +573,34 @@ class DetectorDaemon:
             self.registry.gauge_set(
                 "app_anomaly_log_docs_stored", float(self.log_store.count())
             )
+        # Overload gauges/counters every step (not the 1 s cadence):
+        # saturation flips sub-second and the chaos tests scrape between
+        # steps — a few dict writes, nothing device-side.
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_QUEUE_ROWS,
+            float(self.pipeline.pending_rows()),
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_BROWNOUT_LEVEL,
+            float(self.pipeline.brownout_level),
+        )
+        shed = self.pipeline.stats.shed_rows
+        for lane in ("ok", "error"):
+            delta = shed[lane] - self._shed_seen[lane]
+            if delta:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_SHED_ROWS, float(delta),
+                    lane=lane, cause="overflow",
+                )
+                self._shed_seen[lane] = shed[lane]
+        brownout = self.pipeline.stats.brownout_rows
+        if brownout != self._brownout_seen:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_SHED_ROWS,
+                float(brownout - self._brownout_seen),
+                lane="ok", cause="brownout",
+            )
+            self._brownout_seen = brownout
         if self._orders is not None:
             # Guarded: an exception escaping the poll/submit loop (a
             # transport state no one anticipated) backs the pump off
@@ -488,6 +618,19 @@ class DetectorDaemon:
             self._supervisor.run_step("checkpoint", self._checkpoint)
 
     def _pump_orders(self) -> None:
+        # Saturation pause: Kafka is the one ingest leg with a durable
+        # upstream buffer, so backpressure here is simply NOT polling —
+        # offsets hold, the broker keeps the log, nothing is shed, and
+        # the consumer resumes exactly where it paused once the queue
+        # drains below the low watermark (at-least-once preserved).
+        paused = self.pipeline.saturated
+        if paused != self._kafka_paused:
+            self._kafka_paused = paused
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_KAFKA_PAUSED, 1.0 if paused else 0.0
+            )
+        if paused:
+            return
         for offsets, record in self._orders.poll(0.0):
             self._offsets.update(offsets)
             if record is not None:  # tombstone / quarantined poison pill

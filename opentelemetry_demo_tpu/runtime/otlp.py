@@ -324,6 +324,17 @@ class OtlpHttpReceiver:
     response) releases the handler thread via the per-connection
     ``timeout`` instead of pinning it. None of these ever kill the
     server: the next well-formed export proceeds normally.
+
+    Backpressure (``retry_after``, tests/test_overload.py): while the
+    pipeline sits above its high watermark, trace exports answer the
+    OTLP retryable-error contract — ``429`` with an integer
+    ``Retry-After`` (delta-seconds, rounded up — real SDKs parse it as
+    an int), tallied as ``rejects["saturated"]``. The body is drained
+    (bounded by the oversized check) but never decoded: a 429 sent
+    over unread bytes would RST the client mid-send and the exporter
+    would see a connection error instead of the retryable status.
+    Metrics/logs exports stay admitted: they arrive at scrape cadence,
+    orders of magnitude below the span path the budget protects.
     """
 
     # Half-open-socket bound: StreamRequestHandler applies this to the
@@ -341,6 +352,7 @@ class OtlpHttpReceiver:
         on_log_records: Callable | None = None,
         on_reject: Callable[[str], None] | None = None,
         max_body_bytes: int = 16 << 20,
+        retry_after: Callable[[], float | None] | None = None,
     ):
         receiver = self
 
@@ -348,6 +360,7 @@ class OtlpHttpReceiver:
             timeout = receiver.CONNECTION_TIMEOUT_S
 
             def do_POST(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except ValueError:
@@ -366,6 +379,37 @@ class OtlpHttpReceiver:
                     self.end_headers()
                     self.close_connection = True
                     return
+                if retry_after is not None and not (
+                    path.endswith("/v1/metrics") or path.endswith("/v1/logs")
+                ):
+                    hint = retry_after()
+                    if hint is not None:
+                        # Saturated: retryable refusal. The body IS
+                        # drained first (it's already bounded by the
+                        # oversized check above): answering 429 with
+                        # unread bytes queued would RST a client still
+                        # blocked in send(), and an exporter that sees
+                        # a reset instead of the 429 never learns to
+                        # back off — the exact failure this gate
+                        # exists to prevent. Decode is skipped; the
+                        # drain is the whole price of admission
+                        # control. Retry-After is integer
+                        # delta-seconds (RFC 7231 — real OTLP SDKs
+                        # parse it as an int), rounded UP so the hint
+                        # never undershoots the configured pace.
+                        try:
+                            self.rfile.read(length)
+                        except OSError:
+                            receiver._reject("disconnect")
+                            self.close_connection = True
+                            return
+                        receiver._reject("saturated")
+                        self.send_response(429)
+                        self.send_header(
+                            "Retry-After", str(max(int(-(-hint // 1)), 1))
+                        )
+                        self.end_headers()
+                        return
                 try:
                     body = self.rfile.read(length)
                 except OSError:
@@ -382,7 +426,6 @@ class OtlpHttpReceiver:
                     self.end_headers()
                     return
                 is_json = "json" in (self.headers.get("Content-Type") or "")
-                path = self.path.split("?", 1)[0]
                 columnar = None
                 metric_records = None
                 log_records = None
@@ -457,6 +500,7 @@ class OtlpHttpReceiver:
         self.on_log_records = on_log_records
         self.on_reject = on_reject
         self.max_body_bytes = max_body_bytes
+        self.retry_after = retry_after
         # reason → count; the daemon mirrors these into
         # anomaly_ingest_rejected_total{transport="http",reason=...}.
         self.rejects: dict[str, int] = {}

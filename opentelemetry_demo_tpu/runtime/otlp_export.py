@@ -14,12 +14,42 @@ request lock and the network must never stall it (the same rule as
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from . import wire
 from .tensorize import SpanRecord
+
+
+class RetryLater(Exception):
+    """The sink said "not now" — a RETRYABLE refusal, not an error.
+
+    Raised by send hooks on HTTP 429 / gRPC ``RESOURCE_EXHAUSTED`` (the
+    saturated receiver's refusal). The poster keeps the body, backs off
+    (honoring ``retry_after_s`` when the server sent one), and retries —
+    instead of counting an error and hammering a peer that just asked
+    for air.
+    """
+
+    def __init__(self, retry_after_s: float | None = None):
+        super().__init__(
+            f"sink saturated (retry after {retry_after_s or 'unspecified'}s)"
+        )
+        self.retry_after_s = retry_after_s
+
+
+def _parse_retry_after(value: str | None) -> float | None:
+    """Retry-After header → seconds (delta-seconds form only; an
+    HTTP-date from a saturated peer isn't worth a date parser here)."""
+    if not value:
+        return None
+    try:
+        return max(float(value), 0.0)
+    except ValueError:
+        return None
 
 
 class BackgroundPoster:
@@ -30,20 +60,34 @@ class BackgroundPoster:
     span batches are telemetry, where freshness beats completeness when
     the sink cannot keep up (the reference collector's sending_queue
     drops the same way).
+
+    A sink that answers 429/``RESOURCE_EXHAUSTED`` (see
+    :class:`RetryLater`) is NOT an error: the body goes back to the
+    queue head and the sender backs off — capped exponential with full
+    jitter, floored at the server's Retry-After hint — while the
+    bounded queue keeps absorbing (and drop-oldest keeps bounding)
+    producer traffic. ``retries`` counts the refusals;
+    ``queue_high_water`` records the deepest backlog since last read
+    (``take_high_water``).
     """
+
+    BACKOFF_BASE_S = 0.1
+    BACKOFF_CAP_S = 5.0
 
     def __init__(self, endpoint: str, content_type: str,
                  timeout_s: float = 2.0, queue_max: int = 16,
                  send=None):
         """``send(body)`` overrides the default HTTP POST (e.g. a gRPC
         unary call); it runs on the sender thread and signals failure by
-        raising."""
+        raising (``RetryLater`` for a saturated sink)."""
         self.endpoint = endpoint
         self.content_type = content_type
         self.timeout_s = timeout_s
         self.sent = 0
         self.errors = 0
         self.dropped = 0
+        self.retries = 0  # retryable refusals (429/RESOURCE_EXHAUSTED)
+        self.queue_high_water = 0
         self._send = send or self._http_send
         self._queue: "collections.deque[bytes]" = collections.deque()
         self._queue_max = queue_max
@@ -52,6 +96,10 @@ class BackgroundPoster:
         self._idle = threading.Event()
         self._idle.set()
         self._stop = False
+        # Backoff sleeps wait on THIS event (set by close()) so a
+        # saturated sink never pins shutdown for a full backoff window.
+        self._stop_event = threading.Event()
+        self._consecutive_retries = 0
         self._thread: threading.Thread | None = None
 
     def _http_send(self, body: bytes) -> None:
@@ -61,8 +109,15 @@ class BackgroundPoster:
             headers={"Content-Type": self.content_type},
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s):
-            pass
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise RetryLater(
+                    _parse_retry_after(e.headers.get("Retry-After"))
+                ) from e
+            raise
 
     def submit(self, body: bytes) -> None:
         with self._lock:
@@ -77,12 +132,37 @@ class BackgroundPoster:
             while len(self._queue) > self._queue_max:
                 self._queue.popleft()
                 self.dropped += 1
+            self.queue_high_water = max(
+                self.queue_high_water, len(self._queue)
+            )
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._send_loop, name="otlp-export", daemon=True
                 )
                 self._thread.start()
         self._wake.set()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def take_high_water(self) -> int:
+        """Deepest backlog since the last call (window-reset read)."""
+        with self._lock:
+            peak = self.queue_high_water
+            self.queue_high_water = len(self._queue)
+            return peak
+
+    def _retry_delay(self, hint: float | None) -> float:
+        """Capped exponential with full jitter, floored at the server's
+        Retry-After hint — never shorter than asked, never unbounded."""
+        n = self._consecutive_retries
+        self._consecutive_retries += 1
+        base = min(self.BACKOFF_BASE_S * (2.0 ** min(n, 8)), self.BACKOFF_CAP_S)
+        delay = base * (0.5 + random.random())  # jitter in [0.5, 1.5)
+        if hint:
+            delay = max(delay, min(hint, self.BACKOFF_CAP_S))
+        return delay
 
     def _send_loop(self) -> None:
         while True:
@@ -100,6 +180,22 @@ class BackgroundPoster:
                 try:
                     self._send(body)
                     self.sent += 1
+                    self._consecutive_retries = 0
+                except RetryLater as e:
+                    self.retries += 1
+                    with self._lock:
+                        stop = self._stop
+                        if stop or len(self._queue) >= self._queue_max:
+                            # Shutting down, or the queue refilled while
+                            # we were refused: the body has nowhere to
+                            # wait — same drop-oldest outcome.
+                            self.dropped += 1
+                        else:
+                            self._queue.appendleft(body)
+                    if not stop:
+                        self._stop_event.wait(
+                            self._retry_delay(e.retry_after_s)
+                        )
                 except Exception:
                     self.errors += 1
 
@@ -119,6 +215,7 @@ class BackgroundPoster:
             self._stop = True
             thread = self._thread
         self._wake.set()
+        self._stop_event.set()  # abort any in-progress backoff sleep
         if thread is not None:
             thread.join(timeout=self.timeout_s + 1.0)
         closer = getattr(self._send, "close", None)
@@ -210,9 +307,9 @@ class grpc_send:
         self._fn = None
 
     def __call__(self, body: bytes) -> None:
-        if self._fn is None:
-            import grpc
+        import grpc
 
+        if self._fn is None:
             from .otlp_grpc import LOGS_EXPORT, METRICS_EXPORT, TRACE_EXPORT
 
             self._channel = grpc.insecure_channel(self._target)
@@ -224,7 +321,20 @@ class grpc_send:
             self._fn = self._channel.unary_unary(
                 path, request_serializer=None, response_deserializer=None
             )
-        self._fn(body, timeout=self._timeout_s)
+        try:
+            self._fn(body, timeout=self._timeout_s)
+        except grpc.RpcError as e:
+            code = e.code() if callable(getattr(e, "code", None)) else None
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # The receiver's saturation refusal (otlp_grpc puts the
+                # hint in trailing metadata): retryable, back off.
+                hint = None
+                md = getattr(e, "trailing_metadata", None)
+                for key, value in (md() if callable(md) else ()) or ():
+                    if key == "retry-after-s":
+                        hint = _parse_retry_after(value)
+                raise RetryLater(hint) from e
+            raise
 
     def close(self) -> None:
         if self._channel is not None:
@@ -263,6 +373,36 @@ class _ExporterBase:
     @property
     def dropped(self) -> int:
         return self._poster.dropped
+
+    @property
+    def retries(self) -> int:
+        return self._poster.retries
+
+    def queue_depth(self) -> int:
+        return self._poster.queue_depth()
+
+    def publish_stats(self, registry, signal: str = "traces") -> None:
+        """Mirror the sender-queue counters into a MetricRegistry:
+        ``anomaly_export_dropped_total{signal=}`` (drop-oldest losses —
+        the path PR 1 documented but left invisible) and
+        ``anomaly_export_queue_depth{signal=}`` (the high-water mark of
+        the backlog since the last publish, so a between-scrapes burst
+        still shows). Call on any periodic cadence — delta tracking is
+        internal, double publishing never double counts."""
+        from ..telemetry import metrics as tm
+
+        dropped = self._poster.dropped
+        delta = dropped - getattr(self, "_dropped_published", 0)
+        if delta:
+            registry.counter_add(
+                tm.ANOMALY_EXPORT_DROPPED, float(delta), signal=signal
+            )
+        self._dropped_published = dropped
+        registry.gauge_set(
+            tm.ANOMALY_EXPORT_QUEUE_DEPTH,
+            float(self._poster.take_high_water()),
+            signal=signal,
+        )
 
     def flush(self, timeout_s: float = 5.0) -> bool:
         return self._poster.flush(timeout_s)

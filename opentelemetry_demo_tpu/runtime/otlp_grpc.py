@@ -47,6 +47,13 @@ class OtlpGrpcReceiver:
     ``supervision.Supervisor.health_status``) lets the attached
     grpc.health.v1 service answer per-component Check requests
     (``anomaly.component.<name>``) beside the server-wide status.
+
+    Backpressure (``retry_after``, the HTTP leg's 429 twin): while the
+    pipeline is saturated, trace Exports abort with
+    ``RESOURCE_EXHAUSTED`` — the OTLP spec's retryable status — with a
+    ``retry-after-s`` trailing-metadata hint, tallied as
+    ``rejects["saturated"]``. Metrics/logs Exports stay admitted (scrape
+    cadence, not the flood the budget protects against).
     """
 
     def __init__(
@@ -61,6 +68,7 @@ class OtlpGrpcReceiver:
         on_reject: Callable[[str], None] | None = None,
         max_body_bytes: int = 16 << 20,
         component_status: Callable[[str], int | None] | None = None,
+        retry_after: Callable[[], float | None] | None = None,
     ):
         import grpc
         from concurrent import futures
@@ -70,6 +78,7 @@ class OtlpGrpcReceiver:
         self.on_metric_records = on_metric_records
         self.on_log_records = on_log_records
         self.on_reject = on_reject
+        self.retry_after = retry_after
         self.rejects: dict[str, int] = {}
         receiver = self
 
@@ -82,6 +91,21 @@ class OtlpGrpcReceiver:
                     pass
 
         def export_traces(request: bytes, context) -> bytes:
+            if receiver.retry_after is not None:
+                hint = receiver.retry_after()
+                if hint is not None:
+                    _reject("saturated")
+                    # The OTLP/gRPC retryable contract: clients treat
+                    # RESOURCE_EXHAUSTED as retry-with-backoff; the
+                    # trailing metadata carries the server's hint
+                    # (grpc_send honors it on the exporter side).
+                    context.set_trailing_metadata(
+                        (("retry-after-s", f"{hint:g}"),)
+                    )
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"pipeline saturated; retry after {hint:g}s",
+                    )
             columnar = None
             try:
                 if receiver.on_columnar is not None:

@@ -13,6 +13,17 @@ whole device path off (records are drained and dropped);
 ``anomalyDetectorZThreshold`` adjusts flagging at report time without
 recompiling (the jitted step's threshold only feeds the report's
 ``flags`` bool — the z-scores themselves are always emitted).
+
+Overload protection (``queue_max_rows`` > 0): the pending queue is
+row-budgeted with high/low watermarks — the reference collector's
+``memory_limiter`` + ``sending_queue`` discipline rebuilt at the
+pipeline seam. Over budget, the OLDEST OK-lane rows are shed first and
+error/exception-lane rows are never shed (``SHED_LANES``); between the
+watermarks a saturation flag (hysteresis) tells the OTLP receivers to
+answer retryable 429/``RESOURCE_EXHAUSTED``; and under SUSTAINED
+saturation a deterministic brownout ladder head-samples OK-lane rows
+(1/2, 1/4, …) so detection stays live — degraded and counted — instead
+of lagging unboundedly. tests/test_overload.py is the proof.
 """
 
 from __future__ import annotations
@@ -27,11 +38,20 @@ import jax
 import numpy as np
 
 from ..models.detector import AnomalyDetector, DetectorReport, report_unpack
+from ..ops.hashing import splitmix64_np
 from ..utils.flags import FlagEvaluator
 from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
 FLAG_ENABLED = "anomalyDetectorEnabled"
 FLAG_THRESHOLD = "anomalyDetectorZThreshold"
+
+# Admission contract: the lanes the shed policy is ALLOWED to drop.
+# The error/exception lane is deliberately absent — under any overload
+# the rows that explain an incident are the last ones a detector may
+# throw away. scripts/sanitycheck.py pins this constant (and the tests
+# assert the error-lane counter stays 0 under a 5x flood), so a future
+# edit that widens it is a visible contract change, not a drive-by.
+SHED_LANES = ("ok",)
 
 
 def _pow2_ceil(n: int) -> int:
@@ -61,6 +81,17 @@ class PipelineStats:
     # lag−rtt is an elementwise pairing under identical congestion, not
     # a subtraction of two unrelated medians.
     rtt_ms: deque = field(default_factory=lambda: deque(maxlen=2048))
+    # Overload accounting (bounded admission): rows dropped by the
+    # overflow shed, per lane. The "error" key exists so the
+    # zero-error-lane-loss invariant is an asserted number, not an
+    # absence — it must stay 0 (SHED_LANES).
+    shed_rows: dict = field(default_factory=lambda: {"ok": 0, "error": 0})
+    # OK-lane rows dropped by the brownout head-sampler (deliberate,
+    # deterministic degradation — distinct from the overflow shed).
+    brownout_rows: int = 0
+    # Times the queue crossed the high watermark (one event per
+    # saturation episode, not per refused request).
+    saturation_events: int = 0
 
     def lag_p99_ms(self) -> float:
         if not self.lag_ms:
@@ -99,6 +130,12 @@ class DetectorPipeline:
         rtt_probe: bool = False,
         adaptive_batching: bool = False,
         max_batch_growth: int = 8,
+        queue_max_rows: int = 0,
+        high_watermark: float = 0.85,
+        low_watermark: float = 0.5,
+        brownout_hold_s: float = 2.0,
+        brownout_max_level: int = 4,
+        retry_after_s: float = 1.0,
     ):
         self.detector = detector
         self.flags = flags or FlagEvaluator()
@@ -183,6 +220,41 @@ class DetectorPipeline:
         self._pending: deque = deque()
         self._pending_rows = 0
         self._pending_lock = threading.Lock()
+        # Bounded admission (queue_max_rows > 0): the pending queue is
+        # row-budgeted — the memory_limiter analogue for THIS process.
+        # Above the budget the overflow shed drops the OLDEST OK-lane
+        # rows (freshness beats completeness for telemetry, the
+        # reference sending_queue's discipline) and NEVER error-lane
+        # rows (SHED_LANES). Watermark hysteresis drives the saturation
+        # signal the receivers propagate as 429/RESOURCE_EXHAUSTED:
+        # saturated at >= high, admitting again only at <= low — so a
+        # producer retrying on Retry-After doesn't flap the gate.
+        if queue_max_rows:
+            if not 0.0 < low_watermark < high_watermark <= 1.0:
+                raise ValueError(
+                    "watermarks must satisfy 0 < low < high <= 1 "
+                    f"(got low={low_watermark}, high={high_watermark})"
+                )
+            if queue_max_rows < batch_size:
+                raise ValueError(
+                    f"queue_max_rows={queue_max_rows} below one batch "
+                    f"({batch_size}): the pipeline could never dispatch"
+                )
+        self.queue_max_rows = int(queue_max_rows)
+        self._high_rows = int(queue_max_rows * high_watermark)
+        self._low_rows = int(queue_max_rows * low_watermark)
+        self.brownout_hold_s = brownout_hold_s
+        self.brownout_max_level = int(brownout_max_level)
+        self.retry_after_s = retry_after_s
+        self._saturated = False
+        self._brownout_level = 0
+        self._sat_since = 0.0
+        self._unsat_since = time.monotonic()
+        self._level_changed_at = 0.0
+        # Guards the watermark/ladder read-modify-writes: updates come
+        # from every receiver thread AND the pump; an unguarded race
+        # could double-step the ladder inside one hold window.
+        self._admission_lock = threading.Lock()
         self._inflight: deque = deque()  # (t_batch, dispatch_clock, report)
         self._inflight_lock = threading.Lock()
         # Serializes detector-state advancement: observe_packed is a
@@ -204,10 +276,146 @@ class DetectorPipeline:
         self.submit_columns(self.tensorizer.columns_from_columnar(columnar))
 
     def submit_columns(self, cols: SpanColumns) -> None:
-        if cols.rows:
-            with self._pending_lock:
-                self._pending.append((cols, time.monotonic()))
-                self._pending_rows += cols.rows
+        if not cols.rows:
+            return
+        level = self._brownout_level
+        if level:
+            cols = self._brownout_sample(cols, level)
+            if not cols.rows:
+                return
+        with self._pending_lock:
+            self._pending.append((cols, time.monotonic()))
+            self._pending_rows += cols.rows
+            if self.queue_max_rows and self._pending_rows > self.queue_max_rows:
+                self._shed_locked()
+            rows = self._pending_rows
+        self._admission_update(rows)
+
+    # -- bounded admission / brownout ----------------------------------
+
+    def _brownout_sample(self, cols: SpanColumns, level: int) -> SpanColumns:
+        """Deterministic head sampling: keep 1/2^level of OK-lane rows.
+
+        The keep decision hashes the trace key (splitmix64) rather than
+        using its raw low bits — Kafka-order keys are ASCII order ids
+        whose low byte is constant, and a sampler biased by encoding
+        would black-hole a whole source instead of thinning it. Hashing
+        makes the decision uniform AND deterministic: the same trace is
+        kept or dropped at every level crossing (head sampling, so a
+        kept trace stays internally consistent), and two replicas
+        sampling the same stream agree. Error-lane rows always pass —
+        brownout degrades the OK lane only.
+        """
+        mask = np.uint64((1 << level) - 1)
+        keep = (cols.is_error > 0.0) | (
+            (splitmix64_np(cols.trace_key) & mask) == np.uint64(0)
+        )
+        dropped = int(cols.rows - keep.sum())
+        if dropped == 0:
+            return cols
+        with self._admission_lock:  # += races across receiver threads
+            self.stats.brownout_rows += dropped
+        return cols.compress(keep)
+
+    def _shed_locked(self) -> None:
+        """Drop the oldest OK-lane rows until the queue fits its budget.
+
+        Called under ``_pending_lock``. Error-lane rows are NEVER shed
+        (SHED_LANES): a chunk keeps its error rows (and its original
+        enqueue clock — partially-shed chunks still report honest lag)
+        even when every OK row around them is dropped. If error rows
+        alone exceed the budget (a pathological all-error flood) the
+        queue holds them anyway — the bound is a promise about
+        droppable telemetry, not a license to lose incident evidence —
+        and the depth gauge makes the excursion visible.
+        """
+        need = self._pending_rows - self.queue_max_rows
+        idx = 0
+        shed = 0
+        while need > 0 and idx < len(self._pending):
+            cols, t_enq = self._pending[idx]
+            err = cols.is_error > 0.0
+            n_ok = int(cols.rows - err.sum())
+            if n_ok == 0:
+                idx += 1  # pure error-lane chunk: untouchable
+                continue
+            if n_ok <= need:
+                kept = cols.compress(err)
+                dropped = n_ok
+            else:
+                # Drop only the oldest `need` OK rows of this chunk
+                # (rows are enqueue-ordered within a chunk).
+                ok_rank = np.cumsum(~err)
+                kept = cols.compress(err | (ok_rank > need))
+                dropped = need
+            if kept.rows:
+                self._pending[idx] = (kept, t_enq)
+                idx += 1
+            else:
+                del self._pending[idx]
+            self._pending_rows -= dropped
+            need -= dropped
+            shed += dropped
+        if shed:
+            self.stats.shed_rows["ok"] += shed
+
+    def _admission_update(self, rows: int, now: float | None = None) -> None:
+        """Watermark hysteresis + brownout ladder (host wall clock).
+
+        Saturation flips at the high watermark and clears only at the
+        low one. The ladder moves one level per ``brownout_hold_s`` of
+        SUSTAINED saturation (transient spikes never engage it) and
+        relaxes one level per hold of sustained clearance — the same
+        hysteresis in both directions, so an operating point near the
+        boundary oscillates the gauge, not the sampling rate.
+        """
+        if not self.queue_max_rows:
+            return
+        now = time.monotonic() if now is None else now
+        with self._admission_lock:
+            if not self._saturated:
+                if rows >= self._high_rows:
+                    self._saturated = True
+                    self._sat_since = now
+                    self.stats.saturation_events += 1
+            elif rows <= self._low_rows:
+                self._saturated = False
+                self._unsat_since = now
+            if self._saturated:
+                if (
+                    self._brownout_level < self.brownout_max_level
+                    and now - max(self._sat_since, self._level_changed_at)
+                    >= self.brownout_hold_s
+                ):
+                    self._brownout_level += 1
+                    self._level_changed_at = now
+            elif self._brownout_level and (
+                now - max(self._unsat_since, self._level_changed_at)
+                >= self.brownout_hold_s
+            ):
+                self._brownout_level -= 1
+                self._level_changed_at = now
+
+    @property
+    def saturated(self) -> bool:
+        """True between the high-watermark crossing and the low one —
+        what the OTLP receivers consult before admitting a request."""
+        return self._saturated
+
+    @property
+    def brownout_level(self) -> int:
+        """Current head-sampling level (0 = keep everything; level L
+        keeps 1/2^L of OK-lane rows)."""
+        return self._brownout_level
+
+    def admission_retry_after(self) -> float | None:
+        """None while admitting; a Retry-After hint (seconds) while
+        saturated — the receivers' single admission-control question."""
+        return self.retry_after_s if self._saturated else None
+
+    def pending_rows(self) -> int:
+        with self._pending_lock:
+            return self._pending_rows
 
     def pump(self, t_now: float | None = None) -> None:
         """Form at most one batch and dispatch it (non-blocking).
@@ -225,12 +433,17 @@ class DetectorPipeline:
                 self.stats.dropped_disabled += self._pending_rows
                 self._pending.clear()
                 self._pending_rows = 0
+            self._admission_update(0)
             return
         # Assemble up to one batch of rows from the columnar queue;
         # an oversized head chunk is split and its tail re-queued.
         width = self._width if self.adaptive_batching else self.tensorizer.batch_size
         with self._pending_lock:
             rows_avail = self._pending_rows
+        # The consumer side of the admission loop: draining below the
+        # low watermark reopens the gate, and an idle/paced pump is
+        # what ticks the brownout ladder's relaxation clock.
+        self._admission_update(rows_avail)
         # The accumulate-hold scales with the growth factor (at 8× it
         # is 8×max_wait_s — exactly the regime where a report every
         # ~0.4 s beats skipping half of them) and engages ONLY once the
@@ -270,6 +483,10 @@ class DetectorPipeline:
                     parts.append(head)
                     budget -= head.rows
             self._pending_rows -= sum(p.rows for p in parts)
+            rows_after = self._pending_rows
+        # Re-check with the batch removed: a drain that just crossed
+        # the low watermark must reopen the gate THIS pump, not next.
+        self._admission_update(rows_after)
         if not parts:
             # Nothing to dispatch — but an idle pump must still fetch
             # due in-flight reports (outside the pending lock: the

@@ -43,6 +43,12 @@ log = logging.getLogger(__name__)
 UP = "up"
 BACKOFF = "backoff"  # crashed; a restart attempt is scheduled
 DEGRADED = "degraded"  # crash loop: restart budget exhausted in-window
+# Daemon-level state (not a per-component one): the pipeline is
+# deliberately shedding/throttling under overload. Distinct from
+# DEGRADED — nothing is crashing, the runtime is executing its overload
+# plan — and ORDERED below it: a crash loop is always the worse news,
+# so overall_state() reports DEGRADED even while also saturated.
+SATURATED = "saturated"
 
 # gRPC health service-name prefix for per-component status.
 HEALTH_PREFIX = "anomaly.component."
@@ -93,6 +99,8 @@ class Supervisor:
         self._rng = rng or random.Random(0xC0FFEE)
         self._components: dict[str, _Component] = {}
         self._lock = threading.RLock()
+        self._saturation_probe: Callable[[], bool] | None = None
+        self._last_saturated: bool | None = None
 
     # -- registration ---------------------------------------------------
 
@@ -227,6 +235,15 @@ class Supervisor:
         wrong (a dict scan and a few clock reads).
         """
         now = self._time() if now is None else now
+        if self._registry is not None and self._saturation_probe is not None:
+            sat = self.saturated()
+            if sat != self._last_saturated:  # edge-triggered gauge write
+                self._last_saturated = sat
+                from ..telemetry import metrics as tm
+
+                self._registry.gauge_set(
+                    tm.ANOMALY_SATURATED, 1.0 if sat else 0.0
+                )
         with self._lock:
             comps = list(self._components.values())
         for c in comps:
@@ -258,6 +275,33 @@ class Supervisor:
                 else:
                     with self._lock:
                         self._recovered(c)
+
+    # -- saturation (overload, not crashes) -----------------------------
+
+    def set_saturation_probe(self, probe: Callable[[], bool]) -> None:
+        """Register the overload signal (``pipeline.saturated``): the
+        supervisor doesn't own backpressure, it REPORTS it — on
+        ``overall_state()``, the /healthz surface, and the
+        ``anomaly_saturated`` gauge exported from :meth:`tick`."""
+        self._saturation_probe = probe
+
+    def saturated(self) -> bool:
+        if self._saturation_probe is None:
+            return False
+        try:
+            return bool(self._saturation_probe())
+        except Exception:  # noqa: BLE001 — a broken probe must not kill tick
+            return False
+
+    def overall_state(self) -> str:
+        """One word for the whole daemon: DEGRADED beats SATURATED
+        beats UP (a crash loop is strictly worse news than deliberate
+        load shedding — see the state constants)."""
+        if self.degraded():
+            return DEGRADED
+        if self.saturated():
+            return SATURATED
+        return UP
 
     # -- introspection --------------------------------------------------
 

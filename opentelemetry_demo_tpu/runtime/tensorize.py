@@ -117,6 +117,11 @@ class SpanColumns(NamedTuple):
     def slice(self, start: int, stop: int) -> "SpanColumns":
         return SpanColumns(*(a[start:stop] for a in self))
 
+    def compress(self, keep: np.ndarray) -> "SpanColumns":
+        """Rows where ``keep`` (bool mask) is True, order preserved —
+        the shed/brownout paths' row-selection primitive."""
+        return SpanColumns(*(a[keep] for a in self))
+
     @staticmethod
     def concat(parts: list["SpanColumns"]) -> "SpanColumns":
         if len(parts) == 1:

@@ -65,6 +65,11 @@ class Shop:
         self.config = config or ShopConfig()
         self._t = 0.0
         self._span_buffer: list[SpanRecord] = []
+        # memory_limiter backoff (telemetry.collector.SpanAdmission):
+        # while the collector refuses spans, the buffer holds and
+        # re-sends after the hint instead of hammering a full pipeline.
+        self._export_resume_at = 0.0
+        self.spans_dropped_backpressure = 0
         self.flags = FlagEvaluator({"flags": {}})
         self.metrics = MetricRegistry()
         self.tracer = Tracer(self._span_buffer.append)
@@ -205,13 +210,37 @@ class Shop:
             self._t = t_now
         if self.bus is not None:
             self.bus.pump()
-        if self._span_buffer:
+        if self._span_buffer and self._t >= self._export_resume_at:
             # Copy-and-clear, never rebind: the tracer holds a reference
             # to this exact list's append method.
             spans = list(self._span_buffer)
             self._span_buffer.clear()
-            self.collector.receive_spans(spans)
-            if on_spans is not None:
+            adm = self.collector.receive_spans(spans)
+            if adm.refused:
+                # The in-proc SDK honors the memory_limiter's retryable
+                # refusal: the refused TAIL (refusal is suffix-aligned,
+                # see SpanAdmission) goes back to the buffer and export
+                # holds for the hint — no re-sending into a full
+                # collector. The held backlog stays bounded by the same
+                # budget: beyond it, oldest held spans are dropped and
+                # counted (the SDK-side sending_queue discipline).
+                kept = spans[len(spans) - adm.refused:]
+                self._span_buffer[:0] = kept
+                overflow = (
+                    len(self._span_buffer)
+                    - self.collector.config.memory_limit_spans
+                )
+                if overflow > 0:
+                    del self._span_buffer[:overflow]
+                    self.spans_dropped_backpressure += overflow
+                self._export_resume_at = self._t + (
+                    adm.retry_after_s
+                    or self.collector.config.batch_timeout_s
+                )
+                spans = spans[: len(spans) - adm.refused]
+            if on_spans is not None and spans:
+                # Downstream subscribers see the ADMITTED spans only —
+                # the refused tail will reach them on its retry.
                 on_spans(self._t, spans)
         self.collector.pump(self._t)
 

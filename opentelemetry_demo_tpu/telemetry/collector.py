@@ -77,6 +77,25 @@ def normalize_span_name(name: str) -> str:
 
 
 @dataclass
+class SpanAdmission:
+    """What the memory_limiter did with one receive_spans call.
+
+    The reference's memory_limiter REFUSES data (it doesn't drop it
+    silently) and the OTLP contract makes that refusal retryable —
+    this is the in-proc edition of the same signal, so SDK-side
+    exporters can hold their batch and back off instead of re-sending
+    into a full collector. Refusal is suffix-aligned: within one call
+    the pending buffer only grows, so the refused records are exactly
+    the LAST ``refused`` of the submitted list — a caller re-buffers
+    ``records[-refused:]`` and retries after ``retry_after_s``.
+    """
+
+    accepted: int
+    refused: int
+    retry_after_s: float | None = None
+
+
+@dataclass
 class CollectorConfig:
     batch_max_spans: int = 512          # batch processor send_batch_size
     batch_timeout_s: float = 0.2        # batch processor timeout
@@ -125,6 +144,12 @@ class Collector:
         self._pending_logs: list[LogDoc] = []
         self._last_batch_flush: float | None = None
         self._last_self_report: float | None = None
+        # Per-ATTEMPT memory_limiter refusals (the reference's
+        # otelcol_processor_refused_spans semantics): a span the SDK
+        # retries into a still-full collector counts again, and a span
+        # eventually admitted stays counted. This is refusal pressure,
+        # NOT terminal loss — SDK-side loss is the sender's own ledger
+        # (services.shop.Shop.spans_dropped_backpressure).
         self.dropped_spans = 0
 
     # -- receivers ----------------------------------------------------
@@ -142,15 +167,23 @@ class Collector:
         self.add_scrape_target("hostmetrics", receiver.registry, before=receiver.scrape)
         return receiver
 
-    def receive_spans(self, records: list[SpanRecord]) -> None:
-        """OTLP trace receiver → memory_limiter → transform → batch."""
+    def receive_spans(self, records: list[SpanRecord]) -> "SpanAdmission":
+        """OTLP trace receiver → memory_limiter → transform → batch.
+
+        Returns a :class:`SpanAdmission`: a refusal carries a
+        retryable hint (one batch-flush interval — the soonest the
+        budget can free) so in-proc SDK exporters back off the way a
+        remote one honors 429/Retry-After.
+        """
         now = self.clock()
         accepted = 0
+        refused = 0
         for record in records:
             # memory_limiter: above the budget the collector refuses
             # data rather than OOMing (otelcol-config.yml:100-104).
             if len(self._pending_spans) >= self.config.memory_limit_spans:
                 self.dropped_spans += 1
+                refused += 1
                 self.self_metrics.counter_add(
                     "otelcol_processor_refused_spans", 1.0, processor="memory_limiter"
                 )
@@ -167,6 +200,11 @@ class Collector:
             )
         if len(self._pending_spans) >= self.config.batch_max_spans:
             self._flush_spans(now)
+        return SpanAdmission(
+            accepted=accepted,
+            refused=refused,
+            retry_after_s=self.config.batch_timeout_s if refused else None,
+        )
 
     def receive_log(
         self,

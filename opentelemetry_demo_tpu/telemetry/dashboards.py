@@ -136,6 +136,22 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Metric-stream flags",
                       Query("rate", "app_anomaly_metric_flags_total",
                             by=("service",)), "flags/s"),
+                # Overload protection: judge queue depth against the
+                # watermark gauges; shed/brownout/export-drop counters
+                # prove (or indict) the graceful-degradation story.
+                Panel("Pending queue vs watermarks",
+                      Query("instant", "anomaly_queue_rows"), "rows"),
+                Panel("Shed rows by lane/cause",
+                      Query("rate", "anomaly_shed_rows_total",
+                            by=("lane", "cause")), "rows/s"),
+                Panel("Brownout level",
+                      Query("instant", "anomaly_brownout_level"), "level"),
+                Panel("Exporter drops (sender queue)",
+                      Query("rate", "anomaly_export_dropped_total",
+                            by=("signal",)), "batches/s"),
+                Panel("Exporter queue depth (high-water)",
+                      Query("instant", "anomaly_export_queue_depth",
+                            by=("signal",)), "batches"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

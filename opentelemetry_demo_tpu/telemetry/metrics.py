@@ -116,13 +116,37 @@ class MetricRegistry:
 
 
 class PrometheusExporter:
-    """Serves a registry at ``/metrics`` (the scrape surface)."""
+    """Serves a registry at ``/metrics`` (the scrape surface).
 
-    def __init__(self, registry: MetricRegistry, host: str = "0.0.0.0", port: int = 9464):
+    With ``health`` (a callable returning ``(status, detail)``), also
+    serves ``GET /healthz``: JSON ``{"status": ..., **detail}``, HTTP
+    200 for ``ok``/``saturated`` (a deliberately-shedding daemon is
+    ALIVE — k8s must not restart its way out of overload) and 503 for
+    ``degraded`` (a crash-looping component is a real readiness fail).
+    """
+
+    def __init__(self, registry: MetricRegistry, host: str = "0.0.0.0",
+                 port: int = 9464, health=None):
         reg = registry
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/healthz" and health is not None:
+                    import json as _json
+
+                    try:
+                        status, detail = health()
+                    except Exception:  # noqa: BLE001 — health must answer
+                        status, detail = "degraded", {"error": "health probe raised"}
+                    body = _json.dumps(
+                        {"status": status, **detail}
+                    ).encode()
+                    self.send_response(503 if status == "degraded" else 200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/metrics":
                     self.send_response(404)
                     self.end_headers()
@@ -180,6 +204,20 @@ ANOMALY_QUARANTINE_TOTAL = "anomaly_quarantined_records_total"
 ANOMALY_QUARANTINE_LAST_ERROR_TS = "anomaly_quarantine_last_error_ts_seconds"
 ANOMALY_INGEST_REJECTED = "anomaly_ingest_rejected_total"
 ANOMALY_CHECKPOINT_CORRUPT = "anomaly_checkpoint_corrupt_total"
+# Overload-protection family (bounded admission / brownout — the
+# memory_limiter + sending_queue analogue; runtime.pipeline): the
+# flow-control loop is only trustworthy if every shed/throttle/backoff
+# decision leaves a number behind.
+ANOMALY_SHED_ROWS = "anomaly_shed_rows_total"  # {lane=, cause=}
+ANOMALY_QUEUE_ROWS = "anomaly_queue_rows"
+ANOMALY_QUEUE_WATERMARK = "anomaly_queue_watermark_rows"  # {mark=high|low}
+ANOMALY_BROWNOUT_LEVEL = "anomaly_brownout_level"
+ANOMALY_SATURATED = "anomaly_saturated"
+ANOMALY_KAFKA_PAUSED = "anomaly_kafka_paused"
+# Sender-queue visibility for the OTLP exporters (otlp_export.py):
+# the drop-oldest path and its backlog, per signal.
+ANOMALY_EXPORT_DROPPED = "anomaly_export_dropped_total"  # {signal=}
+ANOMALY_EXPORT_QUEUE_DEPTH = "anomaly_export_queue_depth"  # {signal=}
 
 
 def export_metrics_report(

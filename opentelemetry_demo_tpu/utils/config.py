@@ -58,3 +58,57 @@ def env_float(env_name: str, default: float | None = None) -> float:
         return float(raw)
     except ValueError as e:
         raise ConfigError(f"{env_name}={raw!r} is not a number") from e
+
+
+# Overload-protection knobs (runtime.pipeline bounded admission /
+# brownout; runtime/daemon.py threads them into the pipeline). ONE
+# declarative registry — env name → (type, default, meaning) — so the
+# daemon, the compose overlay, the k8s generator and sanitycheck.py can
+# never disagree about the knob set: scripts/sanitycheck.py asserts
+# every key here appears in deploy/docker-compose.anomaly.yml,
+# utils/k8s.py and runtime/daemon.py. Values must stay literals
+# (sanitycheck reads this dict via ast.literal_eval, without importing
+# jax).
+OVERLOAD_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_QUEUE_MAX_ROWS": (
+        "int", 65536,
+        "pending-queue row budget (0 = unbounded; the memory_limiter "
+        "analogue for the span pipeline)",
+    ),
+    "ANOMALY_QUEUE_HIGH_WATERMARK": (
+        "float", 0.85,
+        "fraction of the row budget at which admission saturates "
+        "(OTLP answers 429/RESOURCE_EXHAUSTED)",
+    ),
+    "ANOMALY_QUEUE_LOW_WATERMARK": (
+        "float", 0.5,
+        "fraction of the row budget at which admission resumes "
+        "(hysteresis: must be below the high watermark)",
+    ),
+    "ANOMALY_BROWNOUT_HOLD_S": (
+        "float", 2.0,
+        "sustained-saturation seconds before the brownout ladder moves "
+        "one level (and sustained-clear seconds before it relaxes one)",
+    ),
+    "ANOMALY_BROWNOUT_MAX_LEVEL": (
+        "int", 4,
+        "deepest head-sampling level: level L keeps 1/2^L of OK-lane "
+        "spans (error-lane spans always pass)",
+    ),
+    "ANOMALY_RETRY_AFTER_S": (
+        "float", 1.0,
+        "Retry-After hint (seconds) handed to throttled OTLP producers",
+    ),
+}
+
+
+def overload_config() -> dict[str, int | float]:
+    """Resolve every OVERLOAD_KNOBS entry from the environment (typed,
+    defaulted, hard-fail on malformed values — mustMapEnv discipline)."""
+    out: dict[str, int | float] = {}
+    for env_name, (kind, default, _help) in OVERLOAD_KNOBS.items():
+        out[env_name] = (
+            env_int(env_name, default) if kind == "int"
+            else env_float(env_name, default)
+        )
+    return out

@@ -11,6 +11,11 @@ on, without importing jax (fast, no device):
   - every tracetesting suite parses and targets a known service dir;
   - proto/demo.proto compiles if protoc is available;
   - deploy/k8s manifests parse as YAML k8s objects;
+  - overload-protection invariants hold statically: the pipeline's
+    shed-lane contract excludes the error lane, the bounded-admission
+    suite asserts the budget and zero-error-lane-shed invariants, and
+    every OVERLOAD_KNOBS env knob is threaded through the daemon, the
+    compose overlay and the k8s generator;
   - no Python file accidentally imports from /root/reference.
 
 Run via `make check`.
@@ -104,6 +109,78 @@ def main() -> int:
             all(d and "apiVersion" in d and "kind" in d for d in docs),
             f"{rel} is valid k8s YAML",
         )
+
+    # overload-protection invariants (all static — no jax import):
+    # 1) the shed-lane contract in runtime/pipeline.py must exclude the
+    #    error lane (SHED_LANES is the pinned constant);
+    pipeline_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "pipeline.py"
+    )
+    shed_lanes = None
+    for node in ast.walk(ast.parse(open(pipeline_py).read())):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "SHED_LANES"
+            for t in node.targets
+        ):
+            shed_lanes = ast.literal_eval(node.value)
+    check(shed_lanes is not None, "pipeline.py declares SHED_LANES")
+    check(
+        shed_lanes is not None and "error" not in shed_lanes,
+        "shed policy never touches the error lane (SHED_LANES)",
+    )
+    check(
+        "queue_max_rows" in open(pipeline_py).read(),
+        "pipeline.py implements the bounded pending-queue budget",
+    )
+    # 2) the overload suite asserts the budget bound and the
+    #    zero-error-lane-shed counters (the runtime proof of #1);
+    overload_tests = os.path.join(ROOT, "tests", "test_overload.py")
+    check(os.path.exists(overload_tests), "tests/test_overload.py exists")
+    if os.path.exists(overload_tests):
+        tsrc = open(overload_tests).read()
+        check(
+            "pending_rows() <= pipe.queue_max_rows" in tsrc,
+            "overload suite asserts the pending-queue bound",
+        )
+        check(
+            'shed_rows["error"] == 0' in tsrc,
+            "overload suite asserts zero error-lane shed",
+        )
+    # 3) every overload knob (utils/config.py OVERLOAD_KNOBS — read via
+    #    AST, importing would pull jax) reaches the daemon, the compose
+    #    overlay and the k8s generator: one registry, no drift.
+    config_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "utils", "config.py"
+    )
+    knobs = None
+    for node in ast.walk(ast.parse(open(config_py).read())):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if any(
+                isinstance(t, ast.Name) and t.id == "OVERLOAD_KNOBS"
+                for t in targets
+            ) and node.value is not None:
+                knobs = ast.literal_eval(node.value)
+    check(bool(knobs), "utils/config.py declares OVERLOAD_KNOBS")
+    for consumer in (
+        os.path.join("opentelemetry_demo_tpu", "runtime", "daemon.py"),
+        os.path.join("deploy", "docker-compose.anomaly.yml"),
+        os.path.join("opentelemetry_demo_tpu", "utils", "k8s.py"),
+    ):
+        text = open(os.path.join(ROOT, consumer)).read()
+        if consumer.endswith("k8s.py"):
+            # k8s.py consumes the registry itself — the reference must
+            # be the import, not six copied strings.
+            check(
+                "OVERLOAD_KNOBS" in text,
+                f"{consumer} consumes the OVERLOAD_KNOBS registry",
+            )
+            continue
+        for knob in knobs or ():
+            check(knob in text, f"{consumer} threads {knob}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -80,12 +80,30 @@ def serve(args) -> None:
 
         span_exporter = OtlpHttpSpanExporter(args.otlp_endpoint)
         metrics_exporter = OtlpHttpMetricsExporter(args.otlp_endpoint)
-        shop.collector.metrics_exporters.append(metrics_exporter)
         # Third signal (otelcol-config.yml:128-131): shop logs cross to
         # the sidecar's /v1/logs so a cross-process deployment carries
         # all three signals, not two.
         logs_exporter = OtlpHttpLogsExporter(args.otlp_endpoint)
         shop.collector.log_exporters.append(logs_exporter)
+        exporters_by_signal = (
+            ("traces", span_exporter),
+            ("metrics", metrics_exporter),
+            ("logs", logs_exporter),
+        )
+
+        def export_metrics_and_stats(now, jobs):
+            metrics_exporter(now, jobs)
+            # Sender-queue visibility (anomaly_export_dropped_total /
+            # anomaly_export_queue_depth) on the SCRAPE cadence — not
+            # the span-flush path, which goes quiet exactly when the
+            # queues are most interesting (idle shop, or span export
+            # held back by admission backpressure): the drop-oldest
+            # path lands in the shop's own scraped registry, so a
+            # saturated sidecar shows on the anomaly dashboard.
+            for signal, exporter in exporters_by_signal:
+                exporter.publish_stats(shop.metrics, signal=signal)
+
+        shop.collector.metrics_exporters.append(export_metrics_and_stats)
         on_spans = span_exporter
     else:
         # Single-process mode: in-proc detector pipeline.
