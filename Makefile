@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench gen-k8s gen-proto gen-dashboards build-native check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench gen-k8s gen-proto gen-dashboards build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -34,6 +34,9 @@ bench:          ## flagship benchmark (ONE json line; real TPU if present)
 
 overloadbench:  ## overload saturation driver (ONE json line: bounded queue, zero error-lane shed, brownout, recovery)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.overloadbench
+
+ingestbench:    ## host-ingest engines + decode-pool worker sweep (same methodology as bench.py's host_ingest_*)
+	$(CPU_ENV) $(PY) scripts/bench_ingest.py --workers 1,2,4
 
 gen-k8s:        ## regenerate deploy/k8s manifests
 	$(PY) -m opentelemetry_demo_tpu.utils.k8s --out deploy/k8s

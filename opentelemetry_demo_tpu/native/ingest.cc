@@ -282,40 +282,30 @@ constexpr int kMaxAttrKeys = 16;
 
 }  // namespace
 
-extern "C" {
+namespace {
 
-// Error codes (negative returns).
-// -1 malformed wire data; -2 record capacity exceeded; -3 service-name
-// buffer exceeded; -4 too many monitored keys.
-
-// Decode an ExportTraceServiceRequest into columns. One output row per
-// span, in document order. `svc_idx[i]` indexes the i-th record's
-// resource-spans entry; service names are written back-to-back into
-// `svc_buf` with per-entry byte lengths in `svc_len` (length -1 ⇒ the
-// resource had no service.name — distinct from a present-but-empty
-// name, which the record path interns as ""). Monitored attribute keys
-// come in priority order; the chosen value's CRC32 goes to attr_crc
-// with attr_present=1. Span events (field 11; the reference services
-// narrate spans with them — checkout main.go:270-294) surface as a
-// per-span count plus a has_exception flag (event named "exception",
-// "error", or "Error" — all three literals of
-// tensorize.EXCEPTION_EVENT_NAMES: the OTel semconv name, checkout's
-// lowercase variant, and the ad service's capitalized one), the
-// error-cause evidence the detector folds into its error lane.
-int otd_decode_otlp(const uint8_t* buf, size_t len,              //
-                    const char* const* attr_keys, int n_keys,    //
-                    int cap,                                     //
-                    float* duration_us, uint64_t* trace_key,     //
-                    uint8_t* is_error, uint32_t* attr_crc,       //
-                    uint8_t* attr_present, int32_t* svc_idx,     //
-                    int32_t* event_count, uint8_t* has_exception,  //
-                    char* svc_buf, size_t svc_buf_cap,           //
-                    int32_t* svc_len, int rs_cap,                //
-                    int32_t* n_services) {
-  if (n_keys > kMaxAttrKeys) return -4;
-  int n_rec = 0;
-  int n_svc = 0;
-  size_t svc_pos = 0;
+// Decode one ExportTraceServiceRequest, APPENDING to the output
+// columns: records from `n_rec` up, resource-spans entries from
+// `*n_svc_io` / name bytes from `*svc_pos_io`. Returns the new total
+// record count, or a negative error code. Shared by the single-request
+// entry point and the batched `otd_decode_otlp_many` (which amortizes
+// one Python→C round trip over a whole coalesced flush).
+int decode_request(const uint8_t* buf, size_t len,               //
+                   const char* const* attr_keys, int n_keys,     //
+                   int cap,                                      //
+                   float* duration_us, uint64_t* trace_key,      //
+                   uint8_t* is_error, uint32_t* attr_crc,        //
+                   uint8_t* attr_present, int32_t* svc_idx,      //
+                   int32_t* event_count, uint8_t* has_exception, //
+                   char* svc_buf, size_t svc_buf_cap,            //
+                   int32_t* svc_len, int rs_cap,                 //
+                   int* n_svc_io, size_t* svc_pos_io, int n_rec) {
+  int n_svc = *n_svc_io;
+  size_t svc_pos = *svc_pos_io;
+  // Hoisted out of the span loop: default-initializing all
+  // kMaxAttrKeys Str slots per span cost more memory traffic than
+  // scanning the span itself; only the first n_keys slots are live.
+  Str attr_val[kMaxAttrKeys];
   Slice top{buf, len};
   Field rs_f;
   bool descend;
@@ -384,7 +374,7 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
         bool status_claimed = false;
         int32_t n_events = 0;
         bool exc = false;
-        Str attr_val[kMaxAttrKeys];
+        for (int k = 0; k < n_keys; ++k) attr_val[k] = Str{};
 
         Slice sp{sf.val, sf.len};
         Field pf;
@@ -496,6 +486,104 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
         has_exception[n_rec] = exc ? 1 : 0;
         ++n_rec;
       }
+    }
+  }
+  *n_svc_io = n_svc;
+  *svc_pos_io = svc_pos;
+  return n_rec;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes (negative returns).
+// -1 malformed wire data; -2 record capacity exceeded; -3 service-name
+// buffer exceeded; -4 too many monitored keys.
+
+// Decode an ExportTraceServiceRequest into columns. One output row per
+// span, in document order. `svc_idx[i]` indexes the i-th record's
+// resource-spans entry; service names are written back-to-back into
+// `svc_buf` with per-entry byte lengths in `svc_len` (length -1 ⇒ the
+// resource had no service.name — distinct from a present-but-empty
+// name, which the record path interns as ""). Monitored attribute keys
+// come in priority order; the chosen value's CRC32 goes to attr_crc
+// with attr_present=1. Span events (field 11; the reference services
+// narrate spans with them — checkout main.go:270-294) surface as a
+// per-span count plus a has_exception flag (event named "exception",
+// "error", or "Error" — all three literals of
+// tensorize.EXCEPTION_EVENT_NAMES: the OTel semconv name, checkout's
+// lowercase variant, and the ad service's capitalized one), the
+// error-cause evidence the detector folds into its error lane.
+int otd_decode_otlp(const uint8_t* buf, size_t len,              //
+                    const char* const* attr_keys, int n_keys,    //
+                    int cap,                                     //
+                    float* duration_us, uint64_t* trace_key,     //
+                    uint8_t* is_error, uint32_t* attr_crc,       //
+                    uint8_t* attr_present, int32_t* svc_idx,     //
+                    int32_t* event_count, uint8_t* has_exception,  //
+                    char* svc_buf, size_t svc_buf_cap,           //
+                    int32_t* svc_len, int rs_cap,                //
+                    int32_t* n_services) {
+  if (n_keys > kMaxAttrKeys) return -4;
+  int n_svc = 0;
+  size_t svc_pos = 0;
+  int n_rec = decode_request(
+      buf, len, attr_keys, n_keys, cap, duration_us, trace_key, is_error,
+      attr_crc, attr_present, svc_idx, event_count, has_exception, svc_buf,
+      svc_buf_cap, svc_len, rs_cap, &n_svc, &svc_pos, 0);
+  if (n_rec < 0) return n_rec;
+  *n_services = n_svc;
+  return n_rec;
+}
+
+// Batched decode: `n_payloads` independent ExportTraceServiceRequests
+// into ONE set of output columns (rows append across payloads in
+// argument order; `svc_idx` indexes the shared, batch-wide
+// resource-spans list). One ctypes round trip — during which ctypes
+// has dropped the GIL — amortizes over the whole coalesced flush,
+// which is the ingest pool's (runtime/ingest_pool.py) per-flush cost
+// model. Per-payload verdicts land in `payload_rows`: the row count
+// this payload contributed, or -1 when IT was malformed — a poison
+// request rolls back its partial rows and never fails its batchmates
+// (each receiver still answers 400 for exactly the bad request, the
+// serial path's verdict). Capacity exhaustion (-2/-3) aborts the whole
+// call: the caller regrows its pooled buffers and retries everything.
+int otd_decode_otlp_many(const uint8_t* const* bufs, const size_t* lens,
+                         int n_payloads,                          //
+                         const char* const* attr_keys, int n_keys,  //
+                         int cap,                                  //
+                         float* duration_us, uint64_t* trace_key,  //
+                         uint8_t* is_error, uint32_t* attr_crc,    //
+                         uint8_t* attr_present, int32_t* svc_idx,  //
+                         int32_t* event_count, uint8_t* has_exception,  //
+                         char* svc_buf, size_t svc_buf_cap,        //
+                         int32_t* svc_len, int rs_cap,             //
+                         int32_t* n_services, int32_t* payload_rows) {
+  if (n_keys > kMaxAttrKeys) return -4;
+  int n_rec = 0;
+  int n_svc = 0;
+  size_t svc_pos = 0;
+  for (int i = 0; i < n_payloads; ++i) {
+    int save_rec = n_rec;
+    int save_svc = n_svc;
+    size_t save_pos = svc_pos;
+    int r = decode_request(
+        bufs[i], lens[i], attr_keys, n_keys, cap, duration_us, trace_key,
+        is_error, attr_crc, attr_present, svc_idx, event_count,
+        has_exception, svc_buf, svc_buf_cap, svc_len, rs_cap, &n_svc,
+        &svc_pos, n_rec);
+    if (r == -2 || r == -3) return r;  // shared-buffer capacity: retry all
+    if (r < 0) {
+      // Malformed payload: roll back its partial appends (all writes
+      // are append-only, so restoring the counters IS the rollback).
+      payload_rows[i] = -1;
+      n_rec = save_rec;
+      n_svc = save_svc;
+      svc_pos = save_pos;
+    } else {
+      payload_rows[i] = r - save_rec;
+      n_rec = r;
     }
   }
   *n_services = n_svc;

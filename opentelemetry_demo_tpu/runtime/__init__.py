@@ -7,8 +7,10 @@ and (b) the OTel collector's OTLP export pipeline
 (/root/reference/src/otel-collector/otelcol-config.yml:120-131). Both
 ultimately deliver *span-shaped records*; this package turns them into
 fixed-width tensor batches (``tensorize``), feeds the device without
-host syncs (``pipeline``), and snapshots sketch state keyed to stream
-offsets for resume (``checkpoint``).
+host syncs (``pipeline``), decodes at line rate through the parallel
+ingest engine (``ingest_pool``: sharded decode workers, pooled
+buffers, coalesced tensorize), and snapshots sketch state keyed to
+stream offsets for resume (``checkpoint``).
 """
 
 from .tensorize import SpanRecord, SpanTensorizer, TensorBatch

@@ -49,6 +49,12 @@ Env contract (all optional, sensible defaults):
   0.85/0.5), ``ANOMALY_BROWNOUT_HOLD_S`` / ``ANOMALY_BROWNOUT_MAX_LEVEL``
   (head-sampling ladder, defaults 2.0 s / 4), ``ANOMALY_RETRY_AFTER_S``
   (the 429/RESOURCE_EXHAUSTED retry hint, default 1.0)
+- Ingest-pool knobs (one registry: ``utils.config.INGEST_KNOBS``;
+  engine: ``runtime.ingest_pool``): ``ANOMALY_INGEST_WORKERS`` (decode
+  workers, default 2; 0 disables the pool — serial in-thread decode),
+  ``ANOMALY_INGEST_COALESCE`` (max requests per batched decode+flush,
+  default 64), ``ANOMALY_INGEST_MAX_PENDING`` (bounded request queue
+  ahead of the pool, default 512; full = retryable 429)
 
 Overload protection (tests/test_overload.py): above the high watermark
 the pending queue sheds oldest OK-lane rows (never error-lane), trace
@@ -77,7 +83,7 @@ import time
 
 from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
-from ..utils.config import ConfigError, overload_config
+from ..utils.config import ConfigError, ingest_config, overload_config
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
 from . import checkpoint
 from .metrics_feed import MetricsFeed
@@ -218,6 +224,28 @@ class DetectorDaemon:
             tele_metrics.ANOMALY_KAFKA_PAUSED,
             "1 while the orders pump holds fetching under saturation",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_POOL_DEPTH,
+            "Requests queued ahead of the decode pool (bounded)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_POOL_FLUSHES,
+            "Coalesced decode+tensorize flushes merged into the pipeline",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_POOL_SPANS,
+            "Spans decoded through the parallel ingest pool",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_POOL_REQUESTS,
+            "Export requests folded into pool flushes (requests/flush = "
+            "the live coalescing factor)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_INGEST_POOL_UTILIZATION,
+            "Decode-worker busy fraction over the last scrape window "
+            "(1.0 = the pool itself is the bottleneck: add workers)",
+        )
         if ckpt_corrupt:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_CHECKPOINT_CORRUPT, 1.0
@@ -291,6 +319,44 @@ class DetectorDaemon:
             ).start()
         for name in restored_names:  # re-intern in checkpoint order
             self.pipeline.tensorizer.service_id(name)
+
+        # Parallel host-ingest engine (runtime.ingest_pool): N decode
+        # workers between the receivers and the pipeline — batched
+        # native decode, pooled buffers, one tensorize+merge per flush.
+        # Workers=0 keeps the serial in-thread decode (the receivers'
+        # on_columnar path). Knob registry: utils.config.INGEST_KNOBS.
+        try:
+            ing = ingest_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.ingest_pool = None
+        if ing["ANOMALY_INGEST_WORKERS"] > 0:
+            from .ingest_pool import IngestPool
+
+            self.ingest_pool = IngestPool(
+                submit_columns=self.pipeline.submit_columns,
+                tensorizer=self.pipeline.tensorizer,
+                workers=ing["ANOMALY_INGEST_WORKERS"],
+                coalesce_max=ing["ANOMALY_INGEST_COALESCE"],
+                max_pending=ing["ANOMALY_INGEST_MAX_PENDING"],
+            )
+            self._supervisor.register(
+                "ingest-pool", base_backoff_s=0.1, max_backoff_s=5.0,
+                restart=self.ingest_pool.restart_workers,
+                probe=lambda: (
+                    self.ingest_pool is None or self.ingest_pool.alive()
+                ),
+            )
+        self._pool_seen = {
+            "flushes": 0, "flushed_spans": 0, "coalesced_requests": 0,
+            "busy_s": 0.0, "wall_t": time.monotonic(),
+        }
+        # Orders flushes whose pool ticket hadn't resolved within the
+        # pump's wait: offsets are withheld until the flush confirms,
+        # so a checkpoint can never persist offsets for records that
+        # never reached the pipeline (at-least-once: a crash before
+        # confirmation replays them from the broker on resume).
+        self._pending_order_flushes: list = []
 
         # The OTLP metrics leg: /v1/metrics → feed → metrics head. The
         # feed keeps its OWN service table: results join on service NAME
@@ -367,6 +433,12 @@ class DetectorDaemon:
             self.pipeline.submit,
             port=port,
             on_columnar=self.pipeline.submit_columnar,
+            # Parallel ingest: raw protobuf trace bodies go to the
+            # decode pool (late-bound so a restarted pool is followed).
+            on_payload=(
+                (lambda body: self.ingest_pool.submit(body))
+                if self.ingest_pool is not None else None
+            ),
             on_metric_records=self.metrics_feed.submit,
             on_log_records=self._on_logs,
             on_reject=self._on_ingest_reject("http"),
@@ -383,6 +455,10 @@ class DetectorDaemon:
             self.pipeline.submit,
             port=port,
             on_columnar=self.pipeline.submit_columnar,
+            on_payload=(
+                (lambda body: self.ingest_pool.submit(body))
+                if self.ingest_pool is not None else None
+            ),
             on_metric_records=self.metrics_feed.submit,
             on_log_records=self._on_logs,
             on_reject=self._on_ingest_reject("grpc"),
@@ -601,6 +677,8 @@ class DetectorDaemon:
                 lane="ok", cause="brownout",
             )
             self._brownout_seen = brownout
+        if self.ingest_pool is not None:
+            self._export_pool_stats()
         if self._orders is not None:
             # Guarded: an exception escaping the poll/submit loop (a
             # transport state no one anticipated) backs the pump off
@@ -617,6 +695,36 @@ class DetectorDaemon:
             # a dead detector.
             self._supervisor.run_step("checkpoint", self._checkpoint)
 
+    def _export_pool_stats(self) -> None:
+        """anomaly_ingest_pool_* gauges/counters from the pool's
+        counters (delta-based, like the shed/quarantine exports)."""
+        st = self.ingest_pool.stats()
+        seen = self._pool_seen
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_INGEST_POOL_DEPTH, float(st["depth"])
+        )
+        for key, metric in (
+            ("flushes", tele_metrics.ANOMALY_INGEST_POOL_FLUSHES),
+            ("flushed_spans", tele_metrics.ANOMALY_INGEST_POOL_SPANS),
+            ("coalesced_requests", tele_metrics.ANOMALY_INGEST_POOL_REQUESTS),
+        ):
+            delta = st[key] - seen[key]
+            if delta:
+                self.registry.counter_add(metric, float(delta))
+                seen[key] = st[key]
+        # Windowed utilization: busy-seconds delta over wall delta,
+        # normalized by worker count — the "is the pool the
+        # bottleneck" gauge.
+        now = time.monotonic()
+        wall = max(now - seen["wall_t"], 1e-9)
+        busy = st["busy_s"] - seen["busy_s"]
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_INGEST_POOL_UTILIZATION,
+            min(busy / (wall * st["workers"]), 1.0),
+        )
+        seen["busy_s"] = st["busy_s"]
+        seen["wall_t"] = now
+
     def _pump_orders(self) -> None:
         # Saturation pause: Kafka is the one ingest leg with a durable
         # upstream buffer, so backpressure here is simply NOT polling —
@@ -631,10 +739,58 @@ class DetectorDaemon:
             )
         if paused:
             return
-        for offsets, record in self._orders.poll(0.0):
+        # Deferred flush confirmations first: merge the offsets of any
+        # earlier pool flush that has since resolved CLEANLY (a failed
+        # flush keeps its offsets out of the checkpoint, so a restart
+        # replays those records — at-least-once, never silent loss).
+        if self._pending_order_flushes:
+            unresolved = []
+            for ticket, offs in self._pending_order_flushes:
+                if not ticket._done:
+                    unresolved.append((ticket, offs))
+                elif ticket._error is None:
+                    self._offsets.update(offs)
+            self._pending_order_flushes = unresolved
+        # One poll = one batch: records coalesce into a single
+        # tensorize pass (through the ingest pool when enabled, so the
+        # Kafka leg shares the pool's flush amortization) instead of a
+        # per-record submit that took the pipeline lock per message.
+        # Offsets merge into the checkpointable map only AFTER the
+        # records reach the pipeline — "checkpoint offsets correspond
+        # to submitted sketch rows" is the resume invariant.
+        offsets, batch = self._orders.poll_batch(0.0)
+        if not batch:
+            # Tombstones / quarantined poison pills: their offsets
+            # still advance, or a pill at the partition tail replays
+            # (and re-logs) on every restart.
             self._offsets.update(offsets)
-            if record is not None:  # tombstone / quarantined poison pill
-                self.pipeline.submit([record])
+            return
+        if self.ingest_pool is not None:
+            from .ingest_pool import IngestPoolSaturated
+
+            try:
+                ticket = self.ingest_pool.submit_records(batch)
+                # Wait for the flush (one coalesced flush, not a round
+                # trip per record); on timeout the confirmation — and
+                # the offset merge — is deferred to a later pump.
+                ticket.result(timeout=10.0)
+                self._offsets.update(offsets)
+            except IngestPoolSaturated:
+                # The pool queue is full: fall back to the direct path
+                # rather than dropping.
+                self.pipeline.submit(batch)
+                self._offsets.update(offsets)
+            except TimeoutError:
+                # Flush still pending (wedged worker — the
+                # supervisor's probe/restart handles it); records sit
+                # in the pool queue, offsets withheld until confirmed.
+                self._pending_order_flushes.append((ticket, offsets))
+            # An IngestWorkerError resolution means the flush died
+            # server-side: offsets are NOT merged (the records never
+            # reached the pipeline), so a restart replays them.
+        else:
+            self.pipeline.submit(batch)
+            self._offsets.update(offsets)
         quarantined = self._orders.decode_failures
         if quarantined != self._quarantine_seen:
             self.registry.counter_add(
@@ -693,6 +849,11 @@ class DetectorDaemon:
             self.grpc_receiver.stop()
         if self._orders is not None:
             self._orders.close()
+        if self.ingest_pool is not None:
+            # Receivers are stopped, so no new jobs: flush the decode
+            # queue into the pipeline, then stop the workers — BEFORE
+            # the pipeline drains, so nothing in flight is lost.
+            self.ingest_pool.close()
         self.pipeline.close()  # drain + stop the harvester thread if any
         if self.ckpt_path:
             self._checkpoint()

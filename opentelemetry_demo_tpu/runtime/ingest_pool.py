@@ -1,0 +1,481 @@
+"""Parallel host-ingest engine: decode pool + pooled buffers + coalesce.
+
+The device side of the detector sustains ~119M spans/s (bench.py); the
+r5 host-ingest path topped out at ~2.26M spans/s — 53× below the rate
+it must feed (SURVEY.md §7 hard part (a)). Profiling put the gap almost
+entirely on the HOST glue around the native decoder, not in it: the C
+scan runs ~7M spans/s single-threaded on the CI box, but every request
+paid one ctypes round trip, eight fresh ``np.empty`` output arrays,
+eight ``.copy()`` slices, an intern pass, and one pipeline-lock
+acquisition — all serial on the receiver thread. This module removes
+each of those per-REQUEST costs by making them per-FLUSH:
+
+- **Sharded decode pool** — N worker threads pull raw payloads off one
+  bounded queue. ``ctypes.CDLL`` drops the GIL for the duration of the
+  native call (runtime/native.py module doc), so workers decode in
+  true parallel and scale with cores.
+- **Coalesced batch decode** — each worker drains up to
+  ``coalesce_max`` queued requests and decodes them with ONE
+  ``native.decode_otlp_many`` call: one GIL round trip amortized over
+  the whole batch. Per-payload verdicts ride back in ``payload_rows``,
+  so a malformed request still answers 400 for exactly that request
+  while its batchmates proceed.
+- **Pooled zero-copy output buffers** — decode writes into a
+  :class:`ScratchPool` freelist of column arrays sized by
+  high-watermark: steady-state decode performs zero numpy allocations.
+  The coalesce step copies rows out (``columns_from_columnar(...,
+  copy=True)``) before the scratch is released, so a recycled buffer
+  can never alias rows still queued in the pipeline
+  (tests/test_ingest_pool.py pins this).
+- **One tensorize + one merge per flush** — a single intern pass over
+  the batch-wide service list and a single
+  ``SpanColumns``/``submit_columns`` call per flush, so the pipeline
+  lock and the interner are touched once per thousands of spans, not
+  once per request.
+
+Overload semantics are PRESERVED: admission control still lives in
+``pipeline.submit_columns`` (shed/brownout/429 watermarks fire exactly
+as before — the pool sits in front of the same gate), and the pool's
+own queue is bounded — a full queue raises
+:class:`IngestPoolSaturated`, which the receivers answer as the same
+retryable 429/``RESOURCE_EXHAUSTED`` they use for pipeline saturation.
+No unbounded buffer ever forms ahead of the pool. Receivers resolve a
+request's ticket only AFTER its rows hit ``submit_columns``, so a 200
+still means "enqueued", exactly the serial path's contract.
+
+Latency: coalescing is opportunistic, not timed — a worker drains
+whatever is queued RIGHT NOW and decodes immediately, so an idle
+deployment sees single-request latency (no flush-interval tax) while a
+loaded one sees deep batches automatically (the queue fills while
+workers are busy — the same self-clocking the reference collector's
+batch processor exhibits under load).
+
+Knob registry: ``utils.config.INGEST_KNOBS`` (workers / coalesce /
+max-pending), threaded through the daemon env, the compose overlay and
+the k8s generator; scripts/sanitycheck.py pins the correspondence.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Sequence
+
+from . import native
+from .otlp import MONITORED_ATTR_KEYS, decode_export_request
+from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
+
+
+class IngestPoolSaturated(RuntimeError):
+    """The bounded request queue ahead of the pool is full — the
+    receivers' cue to answer retryable 429/RESOURCE_EXHAUSTED."""
+
+
+class IngestWorkerError(RuntimeError):
+    """A flush failed SERVER-side (e.g. the pipeline sink raised) after
+    decode — distinct from a per-payload decode verdict so the
+    receivers answer 5xx/INTERNAL for our bugs and 400 only for the
+    client's bad bytes (the serial path's 'server bugs must surface,
+    not masquerade as a client error' contract)."""
+
+
+class DecodeTicket:
+    """Per-request decode verdict: the receiver blocks on ``result()``
+    to answer 400 (malformed) vs 200 (decoded AND enqueued).
+
+    The Event is allocated LAZILY, only when a waiter arrives before
+    the verdict: fire-and-forget submitters (the Kafka pump, benches)
+    then pay one flag write instead of a kernel-object allocation per
+    request. The ``_done``-before-``_event`` publication order below
+    makes the lock-free handshake safe under the GIL: whichever of
+    {resolver reads ``_event``, waiter re-reads ``_done``} happens
+    second sees the other side's write.
+    """
+
+    __slots__ = ("_done", "_error", "_event")
+
+    def __init__(self) -> None:
+        self._done = False
+        self._error: BaseException | None = None
+        self._event: threading.Event | None = None
+
+    def _resolve(self, error: BaseException | None = None) -> None:
+        self._error = error
+        self._done = True  # publish BEFORE checking for a waiter
+        ev = self._event
+        if ev is not None:
+            ev.set()
+
+    def result(self, timeout: float = 30.0) -> None:
+        """Block until the request's flush lands; re-raise its decode
+        error (``ValueError`` for malformed wire data) if any."""
+        if not self._done:
+            ev = self._event
+            if ev is None:
+                ev = threading.Event()
+                self._event = ev
+                if self._done:  # resolver ran before our store landed
+                    ev.set()
+            if not ev.wait(timeout):
+                raise TimeoutError("ingest pool did not resolve the request")
+        if self._error is not None:
+            raise self._error
+
+
+class ScratchPool:
+    """Freelist of :class:`native.DecodeScratch` buffer sets, sized by
+    high-watermark: the first few flushes grow the dims, after which
+    every acquire is a pop — zero allocator churn on the hot path. At
+    most ``keep`` sets are retained (one per worker is enough; an
+    occasional burst allocates and is dropped on release)."""
+
+    def __init__(self, keep: int = 4):
+        self._free: list = []
+        self._lock = threading.Lock()
+        self._keep = keep
+        self._hw = (0, 0, 0)
+        self.allocations = 0  # how often acquire had to allocate
+
+    def acquire(self, cap: int, svc_cap: int, rs_cap: int):
+        with self._lock:
+            self._hw = (
+                max(self._hw[0], cap),
+                max(self._hw[1], svc_cap),
+                max(self._hw[2], rs_cap),
+            )
+            for i, s in enumerate(self._free):
+                if s.cap >= cap and s.svc_cap >= svc_cap and s.rs_cap >= rs_cap:
+                    return self._free.pop(i)
+            hw = self._hw
+            self.allocations += 1
+        return native.alloc_scratch(*hw)
+
+    def release(self, scratch) -> None:
+        with self._lock:
+            if len(self._free) < self._keep:
+                self._free.append(scratch)
+
+
+_STOP = object()
+
+
+class _JobQueue:
+    """Bounded MPMC queue with BATCHED consume.
+
+    ``queue.Queue`` costs one lock round trip per ``get`` — 64 of them
+    per coalesced flush. ``get_batch`` pops the whole coalesce window
+    under ONE lock acquisition, which is where the pool's per-request
+    overhead has to live for the flush amortization to mean anything.
+    ``put`` blocks up to ``timeout`` for space and then raises
+    ``queue.Full`` (the bounded-admission contract).
+    """
+
+    def __init__(self, maxsize: int):
+        self._d: deque = deque()
+        self._max = int(maxsize)
+        lock = threading.Lock()
+        self._not_empty = threading.Condition(lock)
+        self._not_full = threading.Condition(lock)
+
+    def put(self, item, timeout: float) -> None:
+        with self._not_full:
+            if len(self._d) >= self._max:
+                deadline = time.monotonic() + timeout
+                while len(self._d) >= self._max:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Full
+                    self._not_full.wait(remaining)
+            self._d.append(item)
+            self._not_empty.notify()
+
+    def put_unbounded(self, item) -> None:
+        """Bypass the bound (shutdown sentinels only)."""
+        with self._not_empty:
+            self._d.append(item)
+            self._not_empty.notify()
+
+    def get_batch(self, max_n: int) -> list:
+        with self._not_empty:
+            while not self._d:
+                self._not_empty.wait()
+            n = min(len(self._d), max_n)
+            batch = [self._d.popleft() for _ in range(n)]
+            self._not_full.notify(n)
+            return batch
+
+    def qsize(self) -> int:
+        return len(self._d)
+
+
+class IngestPool:
+    """N decode workers between the receivers and the pipeline.
+
+    ``submit(payload)`` (OTLP/HTTP + OTLP/gRPC protobuf bodies) returns
+    a :class:`DecodeTicket`; ``submit_records(records)`` (the Kafka
+    pump and any already-decoded source) folds record batches into the
+    same coalesced flushes. The off switch lives at the call site: the
+    daemon simply doesn't construct a pool when
+    ``ANOMALY_INGEST_WORKERS=0`` (receivers then keep the serial
+    in-thread decode path), so a constructed pool always has ≥1 worker.
+    """
+
+    SUBMIT_TIMEOUT_S = 1.0  # bounded wait for queue space before 429
+
+    def __init__(
+        self,
+        submit_columns: Callable[[SpanColumns], None],
+        tensorizer: SpanTensorizer,
+        workers: int = 2,
+        coalesce_max: int = 64,
+        max_pending: int = 512,
+        attr_keys: Sequence[str] = MONITORED_ATTR_KEYS,
+    ):
+        if workers <= 0:
+            raise ValueError("IngestPool needs workers >= 1 (0 = no pool)")
+        self.submit_columns = submit_columns
+        self.tensorizer = tensorizer
+        self.workers = int(workers)
+        self.coalesce_max = max(int(coalesce_max), 1)
+        self.attr_keys = tuple(attr_keys)
+        self._q = _JobQueue(max_pending)
+        self._scratch = ScratchPool(keep=self.workers + 1)
+        # Stats (guarded by _stats_lock; read by the daemon's scrape).
+        self._stats_lock = threading.Lock()
+        self.submitted = 0
+        self.flushes = 0
+        self.flushed_spans = 0
+        self.coalesced_requests = 0
+        self.decode_errors = 0
+        self.worker_failures = 0  # server-side flush failures (per flush)
+        self.busy_s = 0.0  # summed across workers
+        self._started = time.monotonic()
+        # Drain accounting: jobs submitted but not yet fully processed.
+        self._inflight = 0
+        self._idle = threading.Condition(self._stats_lock)
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        for i in range(self.workers):
+            self._spawn(i)
+
+    def _spawn(self, idx: int) -> None:
+        t = threading.Thread(
+            target=self._run, name=f"ingest-pool-{idx}", daemon=True
+        )
+        t.start()
+        if idx < len(self._threads):
+            self._threads[idx] = t
+        else:
+            self._threads.append(t)
+
+    # -- producer side -------------------------------------------------
+
+    def submit(self, payload: bytes) -> DecodeTicket:
+        """Enqueue one protobuf ExportTraceServiceRequest body.
+
+        Blocks briefly for queue space; a still-full queue raises
+        :class:`IngestPoolSaturated` — the bounded-admission contract
+        (never an unbounded buffer ahead of the pool).
+        """
+        ticket = DecodeTicket()
+        self._enqueue(("payload", payload, ticket))
+        return ticket
+
+    def submit_records(
+        self, records: list[SpanRecord]
+    ) -> DecodeTicket | None:
+        """Enqueue already-decoded records (Kafka pump etc.) for the
+        same coalesced tensorize+merge pass. Returns a ticket that
+        resolves once the batch's flush reached the pipeline (the
+        pump's at-least-once bookkeeping waits on it), or None for an
+        empty batch. The ticket's Event is lazy, so fire-and-forget
+        callers pay nothing for ignoring it."""
+        if not records:
+            return None
+        ticket = DecodeTicket()
+        self._enqueue(("records", records, ticket))
+        return ticket
+
+    def _enqueue(self, item) -> None:
+        with self._stats_lock:
+            self.submitted += 1
+            self._inflight += 1
+        try:
+            self._q.put(item, timeout=self.SUBMIT_TIMEOUT_S)
+        except queue.Full:
+            with self._stats_lock:
+                self.submitted -= 1
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._idle.notify_all()
+            raise IngestPoolSaturated(
+                f"ingest queue full ({self._q._max} pending requests)"
+            ) from None
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    # -- worker side ---------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._q.get_batch(self.coalesce_max)
+            jobs = [b for b in batch if b is not _STOP]
+            n_stop = len(batch) - len(jobs)
+            # A batched pop can swallow sentinels meant for sibling
+            # workers: hand the extras back before exiting.
+            for _ in range(n_stop - 1):
+                self._q.put_unbounded(_STOP)
+            if jobs:
+                t0 = time.perf_counter()
+                try:
+                    self._process(jobs)
+                except Exception as e:  # noqa: BLE001 — worker survives
+                    # Unexpected (non-decode) failure: resolve every
+                    # ticket with a SERVER-fault wrapper so no receiver
+                    # hangs and none of them mistakes our bug for a
+                    # malformed payload; counted as a worker failure
+                    # (per flush), NOT as decode_errors — that counter
+                    # means "client sent wire garbage" and must stay
+                    # honest for triage.
+                    err = IngestWorkerError(f"{type(e).__name__}: {e}")
+                    err.__cause__ = e
+                    for _kind, _data, ticket in jobs:
+                        if ticket is not None and not ticket._done:
+                            ticket._resolve(err)
+                    with self._stats_lock:
+                        self.worker_failures += 1
+                finally:
+                    dt = time.perf_counter() - t0
+                    with self._stats_lock:
+                        self.busy_s += dt
+                        self._inflight -= len(jobs)
+                        if self._inflight == 0:
+                            self._idle.notify_all()
+            if n_stop:
+                return
+
+    def _process(self, batch: list) -> None:
+        payload_jobs = [(d, t) for kind, d, t in batch if kind == "payload"]
+        record_jobs = [(d, t) for kind, d, t in batch if kind == "records"]
+        parts: list[SpanColumns] = []
+        errors: dict[int, BaseException] = {}  # job index → decode error
+        if payload_jobs:
+            if native.available():
+                parts += self._decode_native(payload_jobs, errors)
+            else:
+                parts += self._decode_python(payload_jobs, errors)
+        if record_jobs:
+            merged: list[SpanRecord] = []
+            for records, _t in record_jobs:
+                merged.extend(records)
+            parts.append(self.tensorizer.columns_from_records(merged))
+        cols = SpanColumns.concat(parts) if parts else None
+        if cols is not None and cols.rows:
+            self.submit_columns(cols)
+        with self._stats_lock:
+            self.flushes += 1
+            self.coalesced_requests += len(batch)
+            self.flushed_spans += cols.rows if cols is not None else 0
+            self.decode_errors += len(errors)
+        # Tickets resolve AFTER submit_columns: a 200 means the rows
+        # are enqueued (the serial path's contract), and error-lane
+        # rows can never reorder past their own flush boundary.
+        for i, (_payload, ticket) in enumerate(payload_jobs):
+            if ticket is not None:
+                ticket._resolve(errors.get(i))
+        for _records, ticket in record_jobs:
+            if ticket is not None:
+                ticket._resolve(None)
+
+    def _decode_native(self, payload_jobs, errors) -> list[SpanColumns]:
+        payloads = [p for p, _t in payload_jobs]
+        total = sum(len(p) for p in payloads)
+        scratch = self._scratch.acquire(
+            *native.scratch_dims(total, len(payloads))
+        )
+        try:
+            cols, payload_rows = native.decode_otlp_many(
+                payloads, self.attr_keys, scratch
+            )
+            for i, rows in enumerate(payload_rows):
+                if rows < 0:
+                    errors[i] = ValueError("malformed OTLP payload")
+            if not cols.duration_us.shape[0]:
+                return []
+            # copy=True: the outputs are views into the pooled scratch,
+            # which the NEXT flush will overwrite — rows handed to the
+            # pipeline must own their memory.
+            return [self.tensorizer.columns_from_columnar(cols, copy=True)]
+        finally:
+            self._scratch.release(scratch)
+
+    def _decode_python(self, payload_jobs, errors) -> list[SpanColumns]:
+        """No-compiler fallback: per-request wire decode, still ONE
+        coalesced tensorize pass per flush."""
+        merged: list[SpanRecord] = []
+        for i, (payload, _t) in enumerate(payload_jobs):
+            try:
+                merged.extend(decode_export_request(payload))
+            except Exception as e:  # noqa: BLE001 — per-request verdict
+                errors[i] = e
+        if not merged:
+            return []
+        return [self.tensorizer.columns_from_records(merged)]
+
+    # -- lifecycle / supervision --------------------------------------
+
+    def alive(self) -> bool:
+        """Supervisor probe: every worker thread is running."""
+        return not self._stop and all(t.is_alive() for t in self._threads)
+
+    def restart_workers(self) -> None:
+        """Respawn dead workers (the supervisor's restart hook)."""
+        if self._stop:
+            return
+        for i, t in enumerate(self._threads):
+            if not t.is_alive():
+                self._spawn(i)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted job has been processed."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True
+
+    def close(self) -> None:
+        """Flush everything, then stop the workers."""
+        self.drain()
+        self._stop = True
+        for _ in self._threads:
+            self._q.put_unbounded(_STOP)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- telemetry -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Point-in-time counters for the daemon's metrics scrape."""
+        with self._stats_lock:
+            wall = max(time.monotonic() - self._started, 1e-9)
+            return {
+                "depth": self._q.qsize(),
+                "submitted": self.submitted,
+                "flushes": self.flushes,
+                "flushed_spans": self.flushed_spans,
+                "coalesced_requests": self.coalesced_requests,
+                "decode_errors": self.decode_errors,
+                "worker_failures": self.worker_failures,
+                "busy_s": self.busy_s,
+                "workers": self.workers,
+                # Lifetime busy fraction; the daemon exports a windowed
+                # delta-based gauge on top of busy_s/wall.
+                "utilization": min(self.busy_s / (wall * self.workers), 1.0),
+            }

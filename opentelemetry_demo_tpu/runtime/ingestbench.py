@@ -4,8 +4,19 @@ The device side does tens of millions of spans/sec (bench.py); this
 measures the other half of the ≥200k spans/sec budget (SURVEY.md §7
 hard part (a)) — wire decode + attribute hashing + interning — so the
 artifact can show the host keeps the chip fed. One methodology, two
-callers: ``scripts/bench_ingest.py`` (the standalone CLI, both decode
-paths) and ``bench.py`` (the driver artifact, native path only).
+callers: ``scripts/bench_ingest.py`` (the standalone CLI, all decode
+paths + the worker-count sweep) and ``bench.py`` (the driver artifact).
+
+Three engines, same bytes → ``SpanColumns`` work:
+
+- ``measure_python``  — pure-Python record path (no compiler needed).
+- ``measure_native``  — the r5 serial path: one ctypes decode + one
+  tensorize per request, on one thread. Kept as the BEFORE number.
+- ``measure_pooled``  — the parallel ingest engine
+  (``runtime.ingest_pool``): batched ``decode_many``, pooled scratch
+  buffers, coalesced tensorize, N workers. ``measure_scaling`` sweeps
+  the worker count into the ``host_ingest_scaling`` curve bench.py
+  prints.
 """
 
 from __future__ import annotations
@@ -38,7 +49,9 @@ def make_payloads(n_requests: int = 64, spans_per_request: int = 128,
     payloads = []
     for _ in range(n_requests):
         svc = services[int(rng.integers(0, len(services)))]
-        spans = b""
+        # Joined once per request — += over a growing bytes would make
+        # big-request generation quadratic (60k spans took minutes).
+        span_bufs = []
         for _ in range(spans_per_request):
             start = int(rng.integers(10**18, 2 * 10**18))
             span = (
@@ -51,9 +64,9 @@ def make_payloads(n_requests: int = 64, spans_per_request: int = 128,
             )
             if rng.random() < 0.02:
                 span += wire.encode_len(15, wire.encode_int(3, 2))
-            spans += wire.encode_len(2, span)
+            span_bufs.append(wire.encode_len(2, span))
         resource = wire.encode_len(1, kv("service.name", svc))
-        rs = wire.encode_len(1, resource) + wire.encode_len(2, spans)
+        rs = wire.encode_len(1, resource) + wire.encode_len(2, b"".join(span_bufs))
         payloads.append(wire.encode_len(1, rs))
     return payloads
 
@@ -105,3 +118,66 @@ def measure_python(n_requests: int = 64, spans_per_request: int = 128,
         n_requests * spans_per_request,
         repeat=repeat,
     )
+
+
+def measure_pooled(workers: int = 2, n_requests: int = 64,
+                   spans_per_request: int = 128, repeat: int = 4,
+                   passes: int = 16, coalesce: int = 256,
+                   payloads: list[bytes] | None = None) -> float | None:
+    """Parallel-ingest-engine rate (spans/s), or None without native.
+
+    End-to-end through the REAL :class:`~.ingest_pool.IngestPool` —
+    submit tickets, bounded queue, batched decode into pooled buffers,
+    coalesced tensorize — into a null pipeline sink, so the number is
+    the engine's, not a stripped-down proxy. ``passes`` replays the
+    payload set per timed region so the queue stays deep enough for
+    coalescing to engage (the production regime the pool exists for).
+    """
+    if not native.available():
+        return None
+    from .ingest_pool import IngestPool
+
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    n_spans = n_requests * spans_per_request * passes
+    tz = SpanTensorizer(num_services=32)
+    sink = lambda cols: None  # noqa: E731 — decode+tensorize is the cost
+    pool = IngestPool(
+        sink, tz, workers=workers, coalesce_max=coalesce,
+        max_pending=n_requests * passes + 8,
+    )
+    try:
+        for p in payloads:  # warmup: compile nothing, size the scratch
+            pool.submit(p)
+        pool.drain()
+        best = float("inf")
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            for _ in range(passes):
+                for p in payloads:
+                    pool.submit(p)
+            pool.drain()
+            best = min(best, time.perf_counter() - t0)
+    finally:
+        pool.close()
+    return n_spans / best
+
+
+def measure_scaling(workers_list=(1, 2, 3, 4), n_requests: int = 64,
+                    spans_per_request: int = 128, repeat: int = 3,
+                    payloads: list[bytes] | None = None) -> dict[str, float]:
+    """Worker-count → spans/s curve (the bench artifact's
+    ``host_ingest_scaling``); {} when native is unavailable."""
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    out: dict[str, float] = {}
+    for w in workers_list:
+        rate = measure_pooled(
+            workers=w, n_requests=n_requests,
+            spans_per_request=spans_per_request, repeat=repeat,
+            payloads=payloads,
+        )
+        if rate is None:
+            return {}
+        out[str(w)] = round(rate, 1)
+    return out

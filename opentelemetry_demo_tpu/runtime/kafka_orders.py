@@ -373,6 +373,26 @@ class OrdersSource:
         )
         yield {msg.partition(): msg.offset() + 1}, record
 
+    def poll_batch(
+        self, timeout_s: float = 0.1
+    ) -> tuple[dict, list[SpanRecord]]:
+        """One poll → (merged next-offsets, decoded records).
+
+        The batch shape the parallel ingest engine wants: the daemon's
+        pump hands the whole poll to ``IngestPool.submit_records`` so
+        the Kafka leg shares the pool's one-tensorize-per-flush
+        amortization instead of a per-record pipeline submit (which
+        took the pipeline lock once per message). Tombstones and
+        quarantined poison pills still advance their offsets.
+        """
+        offsets: dict = {}
+        records: list[SpanRecord] = []
+        for off, rec in self.poll(timeout_s):
+            offsets.update(off)
+            if rec is not None:
+                records.append(rec)
+        return offsets, records
+
     def _decode(self, value: bytes, partition: int, offset: int):
         """Decode one message, treating a malformed payload as a skip.
 

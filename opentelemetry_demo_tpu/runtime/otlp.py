@@ -308,6 +308,18 @@ class OtlpHttpReceiver:
     protobuf bodies skip Python record objects entirely: C++ wire decode
     → columnar arrays → ``on_columnar`` (the pipeline's fast path).
 
+    When ``on_payload`` is provided (the parallel ingest engine,
+    ``runtime.ingest_pool``), protobuf trace bodies take the fastest
+    path of all: the RAW body is handed to the decode pool and the
+    handler blocks only on the request's :class:`DecodeTicket` —
+    batched C++ decode, pooled buffers, coalesced tensorize all happen
+    on the pool's workers. The verdicts are unchanged: malformed still
+    answers 400 (the ticket carries the per-request decode error, even
+    when the request was decoded in a batch), success still means the
+    rows are enqueued, and a full pool queue answers the same
+    retryable 429 as pipeline saturation — the bounded-admission
+    contract has no unbounded buffer ahead of the pool.
+
     ``POST /v1/metrics`` decodes OTLP metrics/v1 (runtime.otlp_metrics)
     into ``on_metric_records`` — the collector's metrics-pipeline leg
     (otelcol-config.yml:124-126). ``POST /v1/logs`` decodes OTLP
@@ -353,6 +365,7 @@ class OtlpHttpReceiver:
         on_reject: Callable[[str], None] | None = None,
         max_body_bytes: int = 16 << 20,
         retry_after: Callable[[], float | None] | None = None,
+        on_payload: Callable | None = None,
     ):
         receiver = self
 
@@ -426,6 +439,72 @@ class OtlpHttpReceiver:
                     self.end_headers()
                     return
                 is_json = "json" in (self.headers.get("Content-Type") or "")
+                is_traces = not (
+                    path.endswith("/v1/metrics") or path.endswith("/v1/logs")
+                )
+                if (
+                    is_traces
+                    and not is_json
+                    and receiver.on_payload is not None
+                ):
+                    # Parallel ingest engine: hand the raw body to the
+                    # decode pool; block only on THIS request's ticket.
+                    from .ingest_pool import (
+                        IngestPoolSaturated,
+                        IngestWorkerError,
+                    )
+
+                    try:
+                        ticket = receiver.on_payload(body)
+                    except IngestPoolSaturated:
+                        # Same retryable refusal as pipeline
+                        # saturation: the pool queue is bounded by
+                        # design, and a full one means "come back".
+                        receiver._reject("saturated")
+                        self.send_response(429)
+                        self.send_header("Retry-After", "1")
+                        self.end_headers()
+                        return
+                    try:
+                        ticket.result()
+                    except TimeoutError:
+                        # Wedged flush (supervisor territory): the
+                        # request MAY still land, but the client must
+                        # not treat it as accepted — 503 is the OTLP
+                        # retryable status, never a 4xx that would
+                        # make an exporter discard the batch.
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.end_headers()
+                        return
+                    except IngestWorkerError:
+                        # Server-side flush failure: our bug, not the
+                        # client's bytes — must surface as 5xx, never
+                        # masquerade as "malformed".
+                        self.send_response(500)
+                        self.end_headers()
+                        return
+                    except Exception:
+                        # The pool's per-request DECODE verdict (any
+                        # exception the payload raised while being
+                        # picked apart): malformed wire data is the
+                        # client's fault — 400, the serial path's
+                        # answer.
+                        receiver._reject("malformed")
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    try:
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/x-protobuf"
+                        )
+                        self.end_headers()
+                        self.wfile.write(b"")
+                    except OSError:
+                        receiver._reject("disconnect")
+                        self.close_connection = True
+                    return
                 columnar = None
                 metric_records = None
                 log_records = None
@@ -496,6 +575,7 @@ class OtlpHttpReceiver:
 
         self.on_records = on_records
         self.on_columnar = on_columnar
+        self.on_payload = on_payload
         self.on_metric_records = on_metric_records
         self.on_log_records = on_log_records
         self.on_reject = on_reject

@@ -34,7 +34,12 @@ class OtlpGrpcReceiver:
 
     ``on_records`` receives decoded SpanRecords per Export call;
     ``on_columnar`` (with the native decoder available) takes the C++
-    columnar fast path; ``on_metric_records`` receives MetricRecords
+    columnar fast path; ``on_payload`` (the parallel ingest engine,
+    ``runtime.ingest_pool``) hands the RAW request bytes to the decode
+    pool and blocks only on the per-RPC ticket — malformed still
+    answers ``INVALID_ARGUMENT``, a full pool queue the same retryable
+    ``RESOURCE_EXHAUSTED`` as pipeline saturation;
+    ``on_metric_records`` receives MetricRecords
     from the MetricsService. Malformed payloads answer
     ``INVALID_ARGUMENT`` (the client's fault) and are tallied in
     ``rejects``/``on_reject``; oversized messages are bounced by grpc
@@ -69,12 +74,14 @@ class OtlpGrpcReceiver:
         max_body_bytes: int = 16 << 20,
         component_status: Callable[[str], int | None] | None = None,
         retry_after: Callable[[], float | None] | None = None,
+        on_payload: Callable | None = None,
     ):
         import grpc
         from concurrent import futures
 
         self.on_records = on_records
         self.on_columnar = on_columnar
+        self.on_payload = on_payload
         self.on_metric_records = on_metric_records
         self.on_log_records = on_log_records
         self.on_reject = on_reject
@@ -106,6 +113,44 @@ class OtlpGrpcReceiver:
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
                         f"pipeline saturated; retry after {hint:g}s",
                     )
+            if receiver.on_payload is not None:
+                # Parallel ingest engine (runtime.ingest_pool): raw
+                # body to the decode pool, block on this RPC's ticket.
+                from .ingest_pool import (
+                    IngestPoolSaturated,
+                    IngestWorkerError,
+                )
+
+                try:
+                    ticket = receiver.on_payload(request)
+                except IngestPoolSaturated:
+                    _reject("saturated")
+                    context.set_trailing_metadata((("retry-after-s", "1"),))
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        "ingest pool saturated; retry",
+                    )
+                try:
+                    ticket.result()
+                except TimeoutError:
+                    # Wedged flush: retryable, never a client fault.
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "ingest flush timed out; retry",
+                    )
+                except IngestWorkerError:
+                    # Server-side flush failure — surface as INTERNAL
+                    # exactly like a raising callback on the serial
+                    # path, never as INVALID_ARGUMENT.
+                    raise
+                except Exception:
+                    # Per-request DECODE verdict: the client's bytes.
+                    _reject("malformed")
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "malformed OTLP payload",
+                    )
+                return b""  # empty ExportTraceServiceResponse
             columnar = None
             try:
                 if receiver.on_columnar is not None:

@@ -14,6 +14,14 @@ whole device path off (records are drained and dropped);
 recompiling (the jitted step's threshold only feeds the report's
 ``flags`` bool — the z-scores themselves are always emitted).
 
+Ingest seam: ``submit_columns`` is the ONE admission gate — the serial
+receiver paths (``submit``/``submit_columnar``) and the parallel
+ingest engine (``runtime.ingest_pool``, which coalesces many requests
+into one columns batch per flush) all merge through it, so shed/
+brownout/saturation semantics are identical regardless of which decode
+architecture fed the queue, and the pipeline lock is taken once per
+flush instead of once per request on the pooled path.
+
 Overload protection (``queue_max_rows`` > 0): the pending queue is
 row-budgeted with high/low watermarks — the reference collector's
 ``memory_limiter`` + ``sending_queue`` discipline rebuilt at the

@@ -152,6 +152,20 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Exporter queue depth (high-water)",
                       Query("instant", "anomaly_export_queue_depth",
                             by=("signal",)), "batches"),
+                # Parallel ingest engine: depth vs the bounded queue,
+                # worker saturation, and the live coalescing rate —
+                # the "is decode or the device feed the bottleneck"
+                # triage panels.
+                Panel("Ingest-pool queue depth",
+                      Query("instant", "anomaly_ingest_pool_depth"),
+                      "requests"),
+                Panel("Ingest-pool worker utilization",
+                      Query("instant",
+                            "anomaly_ingest_pool_worker_utilization"),
+                      "busy fraction"),
+                Panel("Ingest-pool decoded spans",
+                      Query("rate", "anomaly_ingest_pool_spans_total"),
+                      "spans/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

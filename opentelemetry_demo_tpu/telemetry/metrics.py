@@ -214,6 +214,15 @@ ANOMALY_QUEUE_WATERMARK = "anomaly_queue_watermark_rows"  # {mark=high|low}
 ANOMALY_BROWNOUT_LEVEL = "anomaly_brownout_level"
 ANOMALY_SATURATED = "anomaly_saturated"
 ANOMALY_KAFKA_PAUSED = "anomaly_kafka_paused"
+# Parallel host-ingest engine (runtime.ingest_pool): queue depth,
+# flush/coalesce counters and worker utilization — how an operator
+# sees whether the decode pool, the pipeline, or neither is the
+# bottleneck at the current offered load.
+ANOMALY_INGEST_POOL_DEPTH = "anomaly_ingest_pool_depth"
+ANOMALY_INGEST_POOL_FLUSHES = "anomaly_ingest_pool_flushes_total"
+ANOMALY_INGEST_POOL_SPANS = "anomaly_ingest_pool_spans_total"
+ANOMALY_INGEST_POOL_REQUESTS = "anomaly_ingest_pool_requests_total"
+ANOMALY_INGEST_POOL_UTILIZATION = "anomaly_ingest_pool_worker_utilization"
 # Sender-queue visibility for the OTLP exporters (otlp_export.py):
 # the drop-oldest path and its backlog, per signal.
 ANOMALY_EXPORT_DROPPED = "anomaly_export_dropped_total"  # {signal=}

@@ -102,13 +102,52 @@ OVERLOAD_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
-def overload_config() -> dict[str, int | float]:
-    """Resolve every OVERLOAD_KNOBS entry from the environment (typed,
-    defaulted, hard-fail on malformed values — mustMapEnv discipline)."""
+# Parallel host-ingest knobs (runtime.ingest_pool: the sharded decode
+# pool between the OTLP/Kafka receivers and the pipeline). Same ONE-
+# registry discipline as OVERLOAD_KNOBS — the daemon, the compose
+# overlay, the k8s generator and sanitycheck.py all consume this dict,
+# so the knob set can never drift between them. Values must stay
+# literals (sanitycheck reads via ast.literal_eval, without importing
+# jax).
+INGEST_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_INGEST_WORKERS": (
+        "int", 2,
+        "decode-pool worker threads (0 = no pool: serial in-thread "
+        "decode on the receiver threads, the pre-pool path)",
+    ),
+    "ANOMALY_INGEST_COALESCE": (
+        "int", 64,
+        "max export requests folded into ONE native batch decode + "
+        "tensorize + pipeline merge (a worker drains up to this many "
+        "queued requests per flush; coalescing is opportunistic, so an "
+        "idle stream still sees single-request latency)",
+    ),
+    "ANOMALY_INGEST_MAX_PENDING": (
+        "int", 512,
+        "bounded request queue ahead of the decode pool; a full queue "
+        "answers retryable 429/RESOURCE_EXHAUSTED (no unbounded buffer "
+        "ever forms before the pipeline's row-budgeted admission)",
+    ),
+}
+
+
+def _resolve(registry: dict) -> dict[str, int | float]:
     out: dict[str, int | float] = {}
-    for env_name, (kind, default, _help) in OVERLOAD_KNOBS.items():
+    for env_name, (kind, default, _help) in registry.items():
         out[env_name] = (
             env_int(env_name, default) if kind == "int"
             else env_float(env_name, default)
         )
     return out
+
+
+def overload_config() -> dict[str, int | float]:
+    """Resolve every OVERLOAD_KNOBS entry from the environment (typed,
+    defaulted, hard-fail on malformed values — mustMapEnv discipline)."""
+    return _resolve(OVERLOAD_KNOBS)
+
+
+def ingest_config() -> dict[str, int | float]:
+    """Resolve every INGEST_KNOBS entry from the environment (same
+    contract as :func:`overload_config`)."""
+    return _resolve(INGEST_KNOBS)

@@ -2,14 +2,23 @@
 
 The device side does tens of millions of spans/sec (bench.py); this
 measures the other half of the ≥200k spans/sec budget (SURVEY.md §7
-hard part (a)) — wire decode + attribute hashing + interning — for the
-pure-Python record path vs the native C++ columnar path. Methodology
-lives in ``runtime.ingestbench`` (shared with bench.py's artifact
-field).
+hard part (a)) — wire decode + attribute hashing + interning — for
+three engines over the same bytes:
 
-Run: python scripts/bench_ingest.py   (CPU only, no TPU needed)
+- pure-Python record path (no compiler needed),
+- the serial native path (one C++ decode + tensorize per request — the
+  r5 architecture, kept as the BEFORE number),
+- the parallel ingest engine (runtime.ingest_pool: batched decode,
+  pooled buffers, coalesced tensorize) swept over ``--workers``.
+
+Methodology lives in ``runtime.ingestbench`` (shared with bench.py's
+``host_ingest_*`` artifact fields), so CI and operators run the SAME
+numbers: ``make ingestbench`` is this script with the default sweep.
+
+Run: python scripts/bench_ingest.py [--workers 1,2,4]   (CPU only)
 """
 
+import argparse
 import os
 import sys
 
@@ -20,14 +29,28 @@ from opentelemetry_demo_tpu.runtime import ingestbench, native  # noqa: E402
 
 
 def main():
-    payloads = ingestbench.make_payloads()  # built once, shared by both
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--workers", default="1,2,4",
+        help="comma-separated decode-pool worker counts to sweep",
+    )
+    args = parser.parse_args()
+    workers = [int(w) for w in args.workers.split(",") if w.strip()]
+
+    payloads = ingestbench.make_payloads()  # built once, shared by all
     py = ingestbench.measure_python(payloads=payloads)
-    print(f"python-records: {py/1e3:10.1f} k spans/s")
+    print(f"python-records:        {py/1e3:10.1f} k spans/s")
     nat = ingestbench.measure_native(payloads=payloads)
     if nat is None:
         print(f"native unavailable: {native.load_error()}")
-    else:
-        print(f"native-columns: {nat/1e3:10.1f} k spans/s")
+        return
+    print(f"native-serial:         {nat/1e3:10.1f} k spans/s  (r5 path)")
+    for w in workers:
+        rate = ingestbench.measure_pooled(workers=w, payloads=payloads)
+        print(
+            f"pool workers={w}:        {rate/1e3:10.1f} k spans/s"
+            f"  ({rate/nat:4.2f}x serial)"
+        )
 
 
 if __name__ == "__main__":

@@ -146,41 +146,78 @@ def main() -> int:
             'shed_rows["error"] == 0' in tsrc,
             "overload suite asserts zero error-lane shed",
         )
-    # 3) every overload knob (utils/config.py OVERLOAD_KNOBS — read via
-    #    AST, importing would pull jax) reaches the daemon, the compose
-    #    overlay and the k8s generator: one registry, no drift.
+    # 3) every overload AND ingest-pool knob (utils/config.py
+    #    OVERLOAD_KNOBS / INGEST_KNOBS — read via AST, importing would
+    #    pull jax) reaches the daemon, the compose overlay and the k8s
+    #    generator: one registry per knob family, no drift.
     config_py = os.path.join(
         ROOT, "opentelemetry_demo_tpu", "utils", "config.py"
     )
-    knobs = None
+    registries: dict[str, dict] = {}
     for node in ast.walk(ast.parse(open(config_py).read())):
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             targets = (
                 node.targets if isinstance(node, ast.Assign)
                 else [node.target]
             )
-            if any(
-                isinstance(t, ast.Name) and t.id == "OVERLOAD_KNOBS"
-                for t in targets
-            ) and node.value is not None:
-                knobs = ast.literal_eval(node.value)
-    check(bool(knobs), "utils/config.py declares OVERLOAD_KNOBS")
-    for consumer in (
-        os.path.join("opentelemetry_demo_tpu", "runtime", "daemon.py"),
-        os.path.join("deploy", "docker-compose.anomaly.yml"),
-        os.path.join("opentelemetry_demo_tpu", "utils", "k8s.py"),
-    ):
-        text = open(os.path.join(ROOT, consumer)).read()
-        if consumer.endswith("k8s.py"):
-            # k8s.py consumes the registry itself — the reference must
-            # be the import, not six copied strings.
-            check(
-                "OVERLOAD_KNOBS" in text,
-                f"{consumer} consumes the OVERLOAD_KNOBS registry",
-            )
-            continue
-        for knob in knobs or ():
-            check(knob in text, f"{consumer} threads {knob}")
+            for t in targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id in ("OVERLOAD_KNOBS", "INGEST_KNOBS")
+                    and node.value is not None
+                ):
+                    registries[t.id] = ast.literal_eval(node.value)
+    for reg_name in ("OVERLOAD_KNOBS", "INGEST_KNOBS"):
+        knobs = registries.get(reg_name)
+        check(bool(knobs), f"utils/config.py declares {reg_name}")
+        for consumer in (
+            os.path.join("opentelemetry_demo_tpu", "runtime", "daemon.py"),
+            os.path.join("deploy", "docker-compose.anomaly.yml"),
+            os.path.join("opentelemetry_demo_tpu", "utils", "k8s.py"),
+        ):
+            text = open(os.path.join(ROOT, consumer)).read()
+            if consumer.endswith("k8s.py"):
+                # k8s.py consumes the registry itself — the reference
+                # must be the import, not copied strings.
+                check(
+                    reg_name in text,
+                    f"{consumer} consumes the {reg_name} registry",
+                )
+                continue
+            for knob in knobs or ():
+                check(knob in text, f"{consumer} threads {knob}")
+    # The generated manifests actually carry the knob env (the
+    # generator could consume the registry and still drop the env
+    # block): spot-check the sidecar bundle.
+    sidecar = os.path.join(ROOT, "deploy", "k8s", "anomaly-detector-sidecar.yaml")
+    if os.path.exists(sidecar):
+        stext = open(sidecar).read()
+        for knobs in registries.values():
+            for knob in knobs:
+                check(knob in stext, f"deploy/k8s sidecar carries {knob}")
+    # 4) ingest-pool invariants: the pool queue is bounded (no
+    #    unbounded buffer ahead of the pipeline's admission), and the
+    #    pooled path proves bit-exactness + no-aliasing in tests.
+    pool_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "ingest_pool.py"
+    )
+    check(os.path.exists(pool_py), "runtime/ingest_pool.py exists")
+    if os.path.exists(pool_py):
+        ptext = open(pool_py).read()
+        check(
+            "IngestPoolSaturated" in ptext and "max_pending" in ptext,
+            "ingest pool bounds its request queue (IngestPoolSaturated)",
+        )
+    pool_tests = os.path.join(ROOT, "tests", "test_ingest_pool.py")
+    check(os.path.exists(pool_tests), "tests/test_ingest_pool.py exists")
+    if os.path.exists(pool_tests):
+        ttext = open(pool_tests).read()
+        for marker in (
+            "test_pooled_bit_exact",
+            "test_scratch_reuse_no_aliasing",
+            "test_native_decode_releases_gil",
+        ):
+            check(marker in ttext, f"ingest-pool suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

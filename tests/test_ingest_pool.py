@@ -1,0 +1,566 @@
+"""Parallel host-ingest engine (runtime.ingest_pool) contracts.
+
+The pool exists for throughput, but these tests pin CORRECTNESS: the
+pooled/coalesced path must be bit-exact with the serial path (same
+``SpanColumns`` including intern ids under deterministic merge order),
+per-request error verdicts must survive batching, recycled decode
+buffers must never alias rows already handed to the pipeline, the
+interner must stay consistent under thread stress, and the GIL must
+actually drop during native decode calls (the whole scaling story).
+"""
+
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime import ingest_pool as ip_mod
+from opentelemetry_demo_tpu.runtime import ingestbench, native, wire
+from opentelemetry_demo_tpu.runtime.ingest_pool import (
+    DecodeTicket,
+    IngestPool,
+    IngestPoolSaturated,
+)
+from opentelemetry_demo_tpu.runtime.otlp import (
+    MONITORED_ATTR_KEYS,
+    decode_export_request,
+)
+from opentelemetry_demo_tpu.runtime.tensorize import (
+    SpanColumns,
+    SpanEvent,
+    SpanRecord,
+    SpanTensorizer,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native ingest unavailable: {native.load_error()}",
+)
+
+
+def _payloads(n_requests=24, spans_per_request=32, seed=7):
+    return ingestbench.make_payloads(n_requests, spans_per_request, seed=seed)
+
+
+def _serial_columns(payloads, tz):
+    """The r5 serial reference: one decode + one tensorize per request,
+    in submission order."""
+    parts = []
+    for p in payloads:
+        if native.available():
+            parts.append(
+                tz.columns_from_columnar(
+                    native.decode_otlp(p, MONITORED_ATTR_KEYS)
+                )
+            )
+        else:
+            parts.append(tz.columns_from_records(decode_export_request(p)))
+    return SpanColumns.concat(parts)
+
+
+def _run_pool(payloads, tz, **kw):
+    """Feed payloads through a pool into a capture sink; returns the
+    concatenated columns and the resolved tickets."""
+    got: list[SpanColumns] = []
+    pool = IngestPool(got.append, tz, **kw)
+    try:
+        tickets = [pool.submit(p) for p in payloads]
+        for t in tickets:
+            t.result()
+        assert pool.drain()
+    finally:
+        pool.close()
+    return SpanColumns.concat(got) if got else None, pool
+
+
+def _assert_columns_equal(a: SpanColumns, b: SpanColumns):
+    for name, x, y in zip(SpanColumns._fields, a, b):
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+class TestPooledBitExactness:
+    @needs_native
+    def test_pooled_bit_exact_vs_serial(self):
+        # ONE worker = deterministic merge order: the pooled flush must
+        # reproduce the serial path's columns exactly — same rows, same
+        # order, same intern ids, same hashes.
+        payloads = _payloads()
+        tz_serial = SpanTensorizer(num_services=32)
+        tz_pool = SpanTensorizer(num_services=32)
+        ref = _serial_columns(payloads, tz_serial)
+        got, _pool = _run_pool(payloads, tz_pool, workers=1)
+        assert tz_serial.service_names == tz_pool.service_names
+        _assert_columns_equal(ref, got)
+
+    @needs_native
+    def test_error_lane_order_preserved_within_flush(self):
+        # Error rows must ride in document position inside their flush,
+        # never reordered past the flush boundary — the shed policy's
+        # oldest-first reasoning depends on enqueue order being real.
+        payloads = _payloads(seed=11)
+        tz = SpanTensorizer(num_services=32)
+        got, _pool = _run_pool(payloads, tz, workers=1)
+        ref = _serial_columns(payloads, SpanTensorizer(num_services=32))
+        np.testing.assert_array_equal(ref.is_error, got.is_error)
+
+    def test_pooled_python_fallback_bit_exact(self, monkeypatch):
+        # No-compiler path: the pool coalesces record decodes instead;
+        # columns must still match the serial record path exactly.
+        monkeypatch.setattr(ip_mod.native, "available", lambda: False)
+        payloads = _payloads(n_requests=12)
+        tz_serial = SpanTensorizer(num_services=32)
+        ref = SpanColumns.concat(
+            [
+                tz_serial.columns_from_records(decode_export_request(p))
+                for p in payloads
+            ]
+        )
+        tz_pool = SpanTensorizer(num_services=32)
+        got, _pool = _run_pool(payloads, tz_pool, workers=1)
+        assert tz_serial.service_names == tz_pool.service_names
+        _assert_columns_equal(ref, got)
+
+    @needs_native
+    def test_multiworker_same_row_set(self):
+        # Across N workers the merge order is nondeterministic but the
+        # ROW SET must be identical — sort both sides by trace key and
+        # compare the order-independent lanes.
+        payloads = _payloads(n_requests=48)
+        ref = _serial_columns(payloads, SpanTensorizer(num_services=32))
+        got, _pool = _run_pool(
+            payloads, SpanTensorizer(num_services=32), workers=3,
+            coalesce_max=4,
+        )
+        assert got.rows == ref.rows
+        for cols in (ref, got):
+            assert cols.trace_key.shape[0] == cols.rows
+        order_a = np.argsort(ref.trace_key, kind="stable")
+        order_b = np.argsort(got.trace_key, kind="stable")
+        np.testing.assert_array_equal(
+            ref.trace_key[order_a], got.trace_key[order_b]
+        )
+        np.testing.assert_array_equal(
+            ref.lat_us[order_a], got.lat_us[order_b]
+        )
+        np.testing.assert_array_equal(
+            ref.is_error[order_a], got.is_error[order_b]
+        )
+        np.testing.assert_array_equal(
+            ref.attr_crc[order_a], got.attr_crc[order_b]
+        )
+
+
+class TestVerdicts:
+    @needs_native
+    def test_malformed_payload_fails_only_its_ticket(self):
+        payloads = _payloads(n_requests=6)
+        bad = b"\x0a\xff"  # truncated length
+        tz = SpanTensorizer(num_services=32)
+        got: list[SpanColumns] = []
+        pool = IngestPool(got.append, tz, workers=1)
+        try:
+            tickets = [
+                pool.submit(p)
+                for p in payloads[:3] + [bad] + payloads[3:]
+            ]
+            for i, t in enumerate(tickets):
+                if i == 3:
+                    with pytest.raises(ValueError):
+                        t.result()
+                else:
+                    t.result()  # batchmates unaffected
+        finally:
+            pool.close()
+        total = sum(c.rows for c in got)
+        assert total == 6 * 32  # every good payload landed
+
+    def test_malformed_python_fallback_verdict(self, monkeypatch):
+        monkeypatch.setattr(ip_mod.native, "available", lambda: False)
+        tz = SpanTensorizer(num_services=32)
+        pool = IngestPool(lambda c: None, tz, workers=1)
+        try:
+            t_bad = pool.submit(b"\x0a\xff")
+            t_ok = pool.submit(_payloads(n_requests=1)[0])
+            with pytest.raises(wire.WireError):
+                t_bad.result()
+            t_ok.result()
+        finally:
+            pool.close()
+
+    def test_ticket_resolves_after_submit_columns(self):
+        # A 200 means "enqueued": the ticket must not resolve before
+        # the flush reached the pipeline sink.
+        flushed = threading.Event()
+        seen_before_resolve = []
+
+        def sink(cols):
+            time.sleep(0.05)
+            flushed.set()
+
+        tz = SpanTensorizer(num_services=32)
+        pool = IngestPool(sink, tz, workers=1)
+        try:
+            ticket = pool.submit(_payloads(n_requests=1)[0])
+            ticket.result()
+            seen_before_resolve.append(flushed.is_set())
+        finally:
+            pool.close()
+        assert seen_before_resolve == [True]
+
+    def test_saturation_raises_and_recovers(self):
+        # Workers blocked in the sink + a full bounded queue must
+        # surface IngestPoolSaturated (the receivers' 429), and the
+        # pool must serve normally once the jam clears.
+        gate = threading.Event()
+        tz = SpanTensorizer(num_services=32)
+        pool = IngestPool(
+            lambda c: gate.wait(10.0), tz, workers=1, coalesce_max=1,
+            max_pending=1,
+        )
+        pool.SUBMIT_TIMEOUT_S = 0.05
+        payload = _payloads(n_requests=1)[0]
+        try:
+            pool.submit(payload)  # worker picks this up, blocks in sink
+            time.sleep(0.1)
+            pool.submit(payload)  # fills the 1-slot queue
+            with pytest.raises(IngestPoolSaturated):
+                pool.submit(payload)
+            gate.set()
+            t = pool.submit(payload)
+            t.result()
+        finally:
+            gate.set()
+            pool.close()
+
+    def test_sink_failure_resolves_tickets(self):
+        # A raising pipeline sink must not hang receivers: the worker
+        # resolves every ticket with a SERVER-fault wrapper (so the
+        # receivers answer 5xx/INTERNAL, never 400) and keeps serving;
+        # the failure counts as a worker failure, NOT a decode error.
+        from opentelemetry_demo_tpu.runtime.ingest_pool import (
+            IngestWorkerError,
+        )
+
+        calls = []
+
+        def sink(cols):
+            calls.append(cols.rows)
+            if len(calls) == 1:
+                raise RuntimeError("pipeline exploded")
+
+        tz = SpanTensorizer(num_services=32)
+        pool = IngestPool(sink, tz, workers=1)
+        try:
+            t1 = pool.submit(_payloads(n_requests=1)[0])
+            with pytest.raises(IngestWorkerError):
+                t1.result()
+            t2 = pool.submit(_payloads(n_requests=1)[0])
+            t2.result()  # worker survived
+            st = pool.stats()
+            assert st["worker_failures"] == 1
+            assert st["decode_errors"] == 0  # not the client's fault
+        finally:
+            pool.close()
+        assert len(calls) == 2
+
+
+class TestScratchPool:
+    @needs_native
+    def test_scratch_reuse_no_aliasing(self):
+        # Two sequential flushes reuse the SAME pooled scratch; the
+        # first flush's columns must be untouched by the second decode
+        # — the copy-out contract of columns_from_columnar(copy=True).
+        tz = SpanTensorizer(num_services=32)
+        got: list[SpanColumns] = []
+        pool = IngestPool(got.append, tz, workers=1)
+        try:
+            a = _payloads(n_requests=4, seed=1)
+            b = _payloads(n_requests=4, seed=2)
+            for p in a:
+                pool.submit(p)
+            assert pool.drain()
+            snapshot = SpanColumns(*(x.copy() for x in got[0]))
+            for p in b:
+                pool.submit(p)
+            assert pool.drain()
+            assert pool._scratch.allocations <= 2  # reuse, not realloc
+            _assert_columns_equal(snapshot, got[0])
+        finally:
+            pool.close()
+
+    @needs_native
+    def test_freelist_high_watermark_growth(self):
+        sp = ip_mod.ScratchPool(keep=2)
+        s1 = sp.acquire(100, 1000, 10)
+        sp.release(s1)
+        s2 = sp.acquire(50, 500, 5)  # smaller ask: reuse s1
+        assert s2 is s1
+        sp.release(s2)
+        s3 = sp.acquire(200, 2000, 20)  # bigger ask: fresh, at new HW
+        assert s3.cap >= 200
+        sp.release(s3)
+        # After the growth, both retained sets satisfy the old ask.
+        s4 = sp.acquire(100, 1000, 10)
+        assert s4.cap >= 100
+
+
+class TestGilAndInterner:
+    @needs_native
+    def test_native_decode_releases_gil(self):
+        # The pool's scaling depends on ctypes.CDLL dropping the GIL
+        # during native calls: a pure-Python counter thread must make
+        # substantial progress WHILE one big decode call is in flight.
+        # One big request, built by repetition (decode cost is what
+        # matters, not span uniqueness): ~60k spans ≈ 10ms of native
+        # decode — a wide window for the counter to run in.
+        span = wire.encode_len(2, (
+            wire.encode_len(1, b"\x42" * 16)
+            + wire.encode_fixed64(7, 10**18)
+            + wire.encode_fixed64(8, 10**18 + 5 * 10**6)
+        ))
+        rs = wire.encode_len(1, wire.encode_len(2, span * 60_000))
+        payload = rs
+        counts = {"n": 0}
+        stop = threading.Event()
+
+        def count():
+            while not stop.is_set():
+                counts["n"] += 1
+
+        th = threading.Thread(target=count, daemon=True)
+        th.start()
+        time.sleep(0.01)  # let the counter reach steady state
+        before = counts["n"]
+        cols = native.decode_otlp(payload, MONITORED_ATTR_KEYS)
+        during = counts["n"] - before
+        stop.set()
+        th.join(timeout=2.0)
+        assert cols.duration_us.shape[0] == 60_000
+        # A held GIL would freeze the counter for the whole call
+        # (~10ms of decode): require real progress, far above the few
+        # iterations a context-switch boundary could leak.
+        assert during > 1_000, f"counter advanced only {during}x"
+
+    def test_interner_thread_stress(self):
+        # Many threads interning overlapping name sets concurrently:
+        # every name must map to exactly one stable id, ids must be
+        # dense first-appearance ranks, and the overflow bucket must
+        # catch the tail — no torn snapshot, no duplicate assignment.
+        tz = SpanTensorizer(num_services=16)
+        names = [f"svc-{i}" for i in range(40)]
+        results: list[dict] = []
+        barrier = threading.Barrier(8)
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            local = {}
+            barrier.wait()
+            for _ in range(2000):
+                name = names[int(rng.integers(0, len(names)))]
+                sid = tz.service_id(name)
+                prev = local.get(name)
+                assert prev is None or prev == sid  # stable per name
+                local[name] = sid
+            results.append(local)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(results) == 8
+        merged: dict = {}
+        for local in results:
+            for name, sid in local.items():
+                assert merged.setdefault(name, sid) == sid  # global agree
+        # Non-overflow ids are unique and dense in [0, 15).
+        non_overflow = sorted(
+            sid for sid in set(tz._svc_ids.values()) if sid != 15
+        )
+        assert non_overflow == list(range(len(non_overflow)))
+        # 40 names > 15 slots: the overflow bucket must be in use.
+        assert 15 in tz._svc_ids.values()
+        # Snapshot and table agree after the dust settles.
+        assert tz._svc_snapshot == tz._svc_ids
+
+
+class TestVectorizedRecordPath:
+    def _reference_loop(self, tz, records):
+        """The pre-vectorization per-row loop, kept as the oracle."""
+        from opentelemetry_demo_tpu.runtime.tensorize import (
+            has_exception_event,
+        )
+
+        n = len(records)
+        svc = np.zeros(n, np.int32)
+        lat = np.zeros(n, np.float32)
+        err = np.zeros(n, np.float32)
+        tid = np.zeros(n, np.uint64)
+        crc = np.zeros(n, np.uint64)
+        for i, r in enumerate(records):
+            svc[i] = tz.service_id(r.service)
+            lat[i] = r.duration_us
+            err[i] = 1.0 if (r.is_error or has_exception_event(r.events)) else 0.0
+            if isinstance(r.trace_id, (bytes, bytearray)):
+                raw = bytes(r.trace_id[:8]).ljust(8, b"\0")
+                tid[i] = np.frombuffer(raw, dtype=np.uint64)[0]
+            else:
+                tid[i] = np.uint64(r.trace_id & 0xFFFFFFFFFFFFFFFF)
+            attr = r.attr if r.attr is not None else ""
+            crc[i] = zlib.crc32(attr.encode())
+        return SpanColumns(svc, lat, err, tid, crc)
+
+    def test_matches_reference_loop(self):
+        rng = np.random.default_rng(5)
+        records = []
+        for i in range(300):
+            kind = i % 5
+            trace_id: bytes | int
+            if kind == 0:
+                trace_id = bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+            elif kind == 1:
+                trace_id = bytes(rng.integers(0, 256, 3, dtype=np.uint8))
+            elif kind == 2:
+                trace_id = b""
+            elif kind == 3:
+                trace_id = int(rng.integers(0, 2**63))
+            else:
+                trace_id = (1 << 64) + 12345  # masked down
+            records.append(
+                SpanRecord(
+                    service=f"svc-{i % 7}",
+                    duration_us=float(rng.gamma(4.0, 250.0)),
+                    trace_id=trace_id,
+                    is_error=bool(rng.random() < 0.2),
+                    attr=None if kind == 2 else f"P-{i % 11}",
+                    events=(
+                        (SpanEvent(name="exception"),) if kind == 3 else ()
+                    ),
+                )
+            )
+        tz_a = SpanTensorizer(num_services=8)
+        tz_b = SpanTensorizer(num_services=8)
+        ref = self._reference_loop(tz_a, records)
+        got = tz_b.columns_from_records(records)
+        assert tz_a.service_names == tz_b.service_names
+        _assert_columns_equal(ref, got)
+
+    def test_empty_records(self):
+        got = SpanTensorizer().columns_from_records([])
+        assert got.rows == 0
+
+
+class TestDecodeMany:
+    @needs_native
+    def test_copyless_and_copy_defaults(self):
+        payloads = _payloads(n_requests=3)
+        cols, rows = native.decode_otlp_many(payloads, MONITORED_ATTR_KEYS)
+        assert rows.tolist() == [32, 32, 32]
+        # Default (no scratch): arrays own their memory.
+        assert cols.duration_us.base is None or cols.duration_us.flags.owndata
+
+    @needs_native
+    def test_empty_batch(self):
+        cols, rows = native.decode_otlp_many([], MONITORED_ATTR_KEYS)
+        assert cols.duration_us.shape[0] == 0
+        assert rows.shape[0] == 0
+
+    @needs_native
+    def test_capacity_retry_tiny_spans(self):
+        # Pathologically tiny spans overflow the len/16 heuristic; the
+        # wrapper must retry at the hard ceiling, not fail.
+        span = wire.encode_len(2, b"")  # empty span submessage
+        many = b"".join([span] * 2000)
+        rs = wire.encode_len(1, wire.encode_len(2, many))
+        cols, rows = native.decode_otlp_many([rs], MONITORED_ATTR_KEYS)
+        assert rows.tolist() == [2000]
+        assert cols.duration_us.shape[0] == 2000
+
+
+class TestReceiverIntegration:
+    def test_http_verdicts_through_pool(self):
+        # The receiver's answer classes through the pooled path:
+        # 200 = decoded AND enqueued, 400 = the client's bytes,
+        # 500 = OUR flush failure (an exporter must not discard the
+        # batch as permanently-malformed when the pipeline hiccuped).
+        import urllib.error
+        import urllib.request
+
+        from opentelemetry_demo_tpu.runtime.otlp import OtlpHttpReceiver
+
+        fail = {"n": 0}
+        got: list[SpanColumns] = []
+
+        def sink(cols):
+            if fail["n"]:
+                fail["n"] -= 1
+                raise RuntimeError("pipeline exploded")
+            got.append(cols)
+
+        tz = SpanTensorizer(num_services=8)
+        pool = IngestPool(sink, tz, workers=1)
+        rx = OtlpHttpReceiver(
+            lambda r: None, host="127.0.0.1", port=0,
+            on_payload=pool.submit,
+        )
+        rx.start()
+        try:
+            url = f"http://127.0.0.1:{rx.port}/v1/traces"
+
+            def post(body):
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/x-protobuf"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            payload = _payloads(n_requests=1)[0]
+            assert post(payload) == 200
+            assert post(b"\x0a\xff") == 400
+            fail["n"] = 1
+            assert post(payload) == 500  # server fault, NOT "malformed"
+            assert post(payload) == 200  # pool recovered
+            assert rx.rejects.get("malformed", 0) == 1  # only the bad bytes
+        finally:
+            rx.stop()
+            pool.close()
+        assert sum(c.rows for c in got) == 2 * 32
+
+
+class TestRecordsLane:
+    def test_submit_records_coalesces_with_payloads(self):
+        # The Kafka pump's lane: already-decoded records fold into the
+        # same flushes; rows land exactly once.
+        tz = SpanTensorizer(num_services=8)
+        got: list[SpanColumns] = []
+        pool = IngestPool(got.append, tz, workers=1)
+        try:
+            records = [
+                SpanRecord("checkout-orders", 5.0, b"ord-%d" % i)
+                for i in range(17)
+            ]
+            pool.submit_records(records)
+            assert pool.drain()
+        finally:
+            pool.close()
+        assert sum(c.rows for c in got) == 17
+
+    def test_lazy_ticket_event(self):
+        # Resolve-before-wait never allocates an Event; wait-after-
+        # resolve returns immediately.
+        t = DecodeTicket()
+        t._resolve(None)
+        assert t._event is None
+        t.result(timeout=0.01)
+        t2 = DecodeTicket()
+        t2._resolve(ValueError("boom"))
+        with pytest.raises(ValueError):
+            t2.result(timeout=0.01)
