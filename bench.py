@@ -236,6 +236,23 @@ def main():
             ingest_serial = ingest_rate = None
             ingest_scaling = {}
 
+    # ---- hot-standby failover (the replication tentpole) -------------
+    # Real replication link, real kill: failover_ttd_s is the blind
+    # window a primary host loss costs (watchdog fire → promoted), and
+    # replication_lag_p99_ms bounds how stale the standby's mirror can
+    # be. CPU-friendly small geometry — the protocol, not the kernels,
+    # is under test. None on failure (additive artifact fields).
+    repl = {}
+    if os.environ.get("BENCH_REPL", "1") != "0":
+        from opentelemetry_demo_tpu.runtime.replbench import (
+            measure_failover,
+        )
+
+        try:
+            repl = measure_failover()
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            repl = {}
+
     # ---- north star #2: detection lag through the real pipeline ------
     fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
@@ -346,6 +363,11 @@ def main():
                     round(ingest_rate / R5_HOST_INGEST_SPANS_PER_SEC, 3)
                     if ingest_rate else None
                 ),
+                "failover_ttd_s": repl.get("failover_ttd_s"),
+                "replication_lag_p99_ms": repl.get(
+                    "replication_lag_p99_ms"
+                ),
+                "failover_converged_exact": repl.get("converged_exact"),
                 "sketch_impl_matrix": matrix,
                 "lag_note": (
                     "gross p99 is submit-to-harvest through the real "

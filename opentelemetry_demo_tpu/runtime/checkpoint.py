@@ -48,6 +48,19 @@ class CheckpointCorrupt(Exception):
     through (cold start) instead of crashing on."""
 
 
+class StaleEpochError(RuntimeError):
+    """A write carrying an old fencing epoch was rejected.
+
+    The storage half of split-brain prevention (runtime.replication):
+    every snapshot is stamped with the writer's epoch, and
+    :func:`save_state` refuses to replace a snapshot whose on-disk
+    epoch is NEWER than the writer's — a resurrected stale primary
+    sharing the checkpoint volume with its promoted successor must not
+    clobber the successor's state. The same error fences Kafka offset
+    commits (kafka_orders.OrdersSource.commit) and replication frames
+    (replication.EpochFence)."""
+
+
 def _content_digest(state_np: dict, meta_json: str) -> str:
     """sha256 over the meta JSON + every array's bytes (name-sorted).
 
@@ -71,11 +84,13 @@ def save(
     offsets: dict[str, Any] | None = None,
     service_names: list[str] | None = None,
     metrics_feed=None,
+    epoch: int = 0,
 ) -> None:
     save_state(
         path, detector.state, detector.config,
         offsets=offsets, service_names=service_names,
         clock_t_prev=detector.clock._t_prev, metrics_feed=metrics_feed,
+        epoch=epoch,
     )
 
 
@@ -87,6 +102,7 @@ def save_state(
     service_names: list[str] | None = None,
     clock_t_prev: float | None = None,
     metrics_feed=None,
+    epoch: int = 0,
 ) -> None:
     """Snapshot any DetectorState — single-chip or MESH-SHARDED.
 
@@ -99,6 +115,20 @@ def save_state(
     CMS counters and EWMA heads mean the same thing wherever the
     service/depth axes land.
     """
+    # Save-time fencing (runtime.replication): a snapshot written at a
+    # NEWER epoch than ours means another process promoted past us —
+    # replacing it would be the stale half of a split brain overwriting
+    # the live half's durable state. Checked before any serialization
+    # work, and again implicitly by the atomic os.replace below (the
+    # window between peek and replace is accepted: both writers sharing
+    # a volume also share the replication fence, which learns epochs
+    # faster than the checkpoint cadence).
+    existing_epoch = peek_epoch(path)
+    if existing_epoch is not None and existing_epoch > epoch:
+        raise StaleEpochError(
+            f"{path}.npz carries epoch {existing_epoch} > writer epoch "
+            f"{epoch}: refusing a stale-primary checkpoint save"
+        )
     state_np = {k: np.asarray(v) for k, v in state._asdict().items()}
     # sketch_impl is an execution-backend knob, not state: a snapshot
     # written on TPU (pallas) must restore on a CPU box (xla) and vice
@@ -108,6 +138,7 @@ def save_state(
         "service_names": service_names or [],
         "config": list(config._replace(sketch_impl=None)),
         "clock_t_prev": clock_t_prev,
+        "epoch": int(epoch),
     }
     if metrics_feed is not None:
         # The metrics-leg head warms in minutes, but a restart must not
@@ -305,15 +336,50 @@ def exists(path: str) -> bool:
     return os.path.exists(path + ".npz")
 
 
+def peek_epoch(path: str) -> int | None:
+    """Fencing epoch of the snapshot at ``path``, or None.
+
+    None means "no fencing evidence": missing file, unreadable file, or
+    a pre-epoch snapshot (treated as epoch 0 by ``meta.get``). Reads
+    only the ``__meta__`` entry — npz loads entries lazily, so this is
+    a central-directory walk plus one small decompress, cheap enough
+    for the save path to call every time."""
+    if not exists(path):
+        return None
+    try:
+        with np.load(path + ".npz") as data:
+            if "__meta__" not in data.files:
+                return None
+            meta = json.loads(str(data["__meta__"][()]))
+    except Exception:  # noqa: BLE001 — corruption is load_resilient's
+        # problem; fencing only needs readable evidence of a newer epoch
+        return None
+    return int(meta.get("epoch", 0))
+
+
 def restore_metrics_feed(meta: dict, feed) -> bool:
     """Hydrate a MetricsFeed from checkpoint meta (load() output).
 
     Returns False (feed untouched) when the snapshot has no metrics leg
     or its geometry doesn't match the feed's — a geometry change means
     the cells don't line up and warm state would be attributed to the
-    wrong (service, metric)."""
+    wrong (service, metric). A mismatch is LOGGED with the offending
+    key (a silent partial restore looks exactly like a warm one until
+    the metrics head mis-flags), and the daemon exports each False
+    return on a snapshot that HAD a metrics leg as
+    ``anomaly_restore_partial_total``."""
     arrays = meta.get("_metrics_arrays") or {}
     if not arrays or meta.get("metrics_config") is None:
+        if arrays or meta.get("metrics_config") is not None:
+            # Half a metrics leg (arrays without config or vice versa)
+            # is a torn snapshot shape worth naming; a snapshot with
+            # neither is simply pre-metrics — silent.
+            log.warning(
+                "metrics-feed restore skipped: snapshot carries %s but "
+                "not %s — metrics head cold-starts",
+                "arrays" if arrays else "metrics_config",
+                "metrics_config" if arrays else "arrays",
+            )
         return False
     from ..models.metrics_head import MetricsHeadConfig, MetricsHeadState
 
@@ -322,6 +388,21 @@ def restore_metrics_feed(meta: dict, feed) -> bool:
           for v in meta["metrics_config"]]
     )
     if list(saved_cfg) != list(feed.config):
+        mismatched = [
+            name
+            for name, saved, cur in zip(
+                MetricsHeadConfig._fields, saved_cfg, feed.config
+            )
+            if (tuple(saved) if isinstance(saved, (list, tuple)) else saved)
+            != (tuple(cur) if isinstance(cur, (list, tuple)) else cur)
+        ]
+        log.warning(
+            "metrics-feed restore skipped: config mismatch on %s "
+            "(snapshot %s vs running %s) — metrics head cold-starts, "
+            "span-leg state restored normally",
+            ", ".join(mismatched) or "<unknown field>",
+            saved_cfg, feed.config,
+        )
         return False
     feed.head.state = MetricsHeadState(
         **{k: jax.device_put(v) for k, v in arrays.items()}

@@ -55,6 +55,32 @@ Env contract (all optional, sensible defaults):
   ``ANOMALY_INGEST_COALESCE`` (max requests per batched decode+flush,
   default 64), ``ANOMALY_INGEST_MAX_PENDING`` (bounded request queue
   ahead of the pool, default 512; full = retryable 429)
+- Hot-standby replication knobs (one registry:
+  ``utils.config.REPLICATION_KNOBS``; engine: ``runtime.replication``):
+  ``ANOMALY_ROLE`` (``primary`` serves + ships state deltas,
+  ``standby`` applies them and promotes itself on primary silence),
+  ``ANOMALY_REPLICATION_PORT`` (primary-side listener, -1 off),
+  ``ANOMALY_REPLICATION_TARGET`` (standby-side primary host:port),
+  ``ANOMALY_REPLICATION_INTERVAL_S`` (delta cadence, default 1.0),
+  ``ANOMALY_FAILOVER_TIMEOUT_S`` (standby watchdog before promotion,
+  default 5.0), ``ANOMALY_PRIMARY_HEALTH_ADDR`` (optional grpc-health
+  double-check before promoting), ``ANOMALY_OFFSET_DEFER_MAX`` (cap on
+  the deferred-confirmation offset list, default 64)
+
+Replication / failover (runtime.replication; tests/test_replication.py):
+the daemon runs a role state machine — PRIMARY / STANDBY / PROMOTING
+(plus FENCED, the visible end state of a stale resurrected primary).
+A primary ships epoch-stamped state deltas to attached standbys;
+offsets ship only after flush confirmation (the PR-3 deferred-
+confirmation rule), so a promoted standby resumes the ``orders`` pump
+at-least-once from its replicated offset map. A standby that stops
+hearing frames for ``ANOMALY_FAILOVER_TIMEOUT_S`` (optionally
+double-checking the primary's gRPC health first) promotes: epoch bump,
+Kafka seek to the replicated offsets, OTLP receivers up, immediate
+epoch-stamped checkpoint, and its own replication listener for the
+next standby. A stale primary's writes are fenced on all three paths
+(checkpoint save, epoch-tagged Kafka offset commit, replication
+frames); it parks in role=fenced instead of split-braining.
 
 Overload protection (tests/test_overload.py): above the high watermark
 the pending queue sheds oldest OK-lane rows (never error-lane), trace
@@ -83,12 +109,24 @@ import time
 
 from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
-from ..utils.config import ConfigError, ingest_config, overload_config
+from ..utils.config import (
+    ConfigError,
+    ingest_config,
+    overload_config,
+    replication_config,
+)
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint
+from . import checkpoint, replication
 from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
+from .replication import (
+    ROLE_FENCED,
+    ROLE_PRIMARY,
+    ROLE_PROMOTING,
+    ROLE_STANDBY,
+    EpochFence,
+)
 from .supervision import Supervisor
 
 
@@ -122,6 +160,29 @@ class DetectorDaemon:
         self.pump_interval_s = _env_float("ANOMALY_PUMP_INTERVAL_S", 0.05)
         self.ckpt_path = os.environ.get("ANOMALY_CHECKPOINT") or None
         self.ckpt_interval_s = _env_float("ANOMALY_CHECKPOINT_INTERVAL_S", 30.0)
+
+        # Replication role state machine (knob registry:
+        # utils.config.REPLICATION_KNOBS; engine: runtime.replication).
+        try:
+            rp = replication_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.role = (
+            ROLE_STANDBY if rp["ANOMALY_ROLE"] == "standby" else ROLE_PRIMARY
+        )
+        self._repl_port = int(rp["ANOMALY_REPLICATION_PORT"])
+        self._repl_target = str(rp["ANOMALY_REPLICATION_TARGET"])
+        self._repl_interval_s = float(rp["ANOMALY_REPLICATION_INTERVAL_S"])
+        self._failover_timeout_s = float(rp["ANOMALY_FAILOVER_TIMEOUT_S"])
+        self._primary_health_addr = str(rp["ANOMALY_PRIMARY_HEALTH_ADDR"])
+        self._offset_defer_max = int(rp["ANOMALY_OFFSET_DEFER_MAX"])
+        if self.role == ROLE_STANDBY and not self._repl_target:
+            raise SystemExit(
+                "ANOMALY_ROLE=standby requires ANOMALY_REPLICATION_TARGET "
+                "(the primary's replication listener host:port)"
+            )
+        self.repl_primary: replication.ReplicationPrimary | None = None
+        self.repl_standby: replication.ReplicationStandby | None = None
 
         flagd_file = os.environ.get("FLAGD_FILE")
         ofrep = os.environ.get("OFREP_URL")
@@ -166,6 +227,14 @@ class DetectorDaemon:
         else:
             self.detector = AnomalyDetector(config)
             restored_names = []
+        # The fencing epoch resumes from the snapshot (a promoted
+        # standby's checkpoint carries its bumped epoch, so ITS restart
+        # keeps outranking the old primary); further fencing evidence
+        # arrives from the broker's commit tags below and from
+        # replication frames at runtime.
+        self._fence = EpochFence(
+            int(meta.get("epoch", 0)) if meta is not None else 0
+        )
 
         self.registry = tele_metrics.MetricRegistry()
         self.registry.describe(
@@ -246,6 +315,48 @@ class DetectorDaemon:
             "Decode-worker busy fraction over the last scrape window "
             "(1.0 = the pool itself is the bottleneck: add workers)",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_ROLE,
+            "1 on the series matching this process's replication role",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_EPOCH,
+            "Current fencing epoch (bumped by every promotion)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_REPLICATION_DELTAS,
+            "Replication deltas, by direction (shipped on the primary, "
+            "applied on the standby)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_REPLICATION_SNAPSHOTS,
+            "Full-state replication snapshots, by direction",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_REPLICATION_LAG,
+            "Primary: seconds since the last acked delta; standby: "
+            "seconds since the last frame (the watchdog's clock)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_REPLICATION_FENCED,
+            "Stale-epoch writes rejected, by path "
+            "(checkpoint/offsets/frame)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FAILOVERS,
+            "Standby promotions performed by this process",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_OFFSET_DEFER_DROPPED,
+            "Deferred-confirmation offset entries shed at the cap "
+            "(each = a bounded replay on restart, never silent loss)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_RESTORE_PARTIAL,
+            "Boots whose snapshot had a metrics leg that could not be "
+            "hydrated (geometry change): span leg restored, metrics "
+            "head cold-started",
+        )
         if ckpt_corrupt:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_CHECKPOINT_CORRUPT, 1.0
@@ -312,6 +423,12 @@ class DetectorDaemon:
         # supervisor reports it on overall_state()/anomaly_saturated,
         # /healthz (below) serves it to probes.
         self._supervisor.set_saturation_probe(lambda: self.pipeline.saturated)
+        # Role/epoch surface beside saturation: anomaly_role/anomaly_epoch
+        # from the supervisor's tick, role+epoch on /healthz below —
+        # how a probe tells a healthy standby from a degraded primary.
+        self._supervisor.set_role_probe(
+            lambda: (self.role, self._fence.epoch)
+        )
         if self.pipeline.adaptive_batching:
             threading.Thread(
                 target=self._warm_widths_quietly,
@@ -355,8 +472,14 @@ class DetectorDaemon:
         # pump's wait: offsets are withheld until the flush confirms,
         # so a checkpoint can never persist offsets for records that
         # never reached the pipeline (at-least-once: a crash before
-        # confirmation replays them from the broker on resume).
-        self._pending_order_flushes: list = []
+        # confirmation replays them from the broker on resume). BOUNDED
+        # (ANOMALY_OFFSET_DEFER_MAX): a permanently-failing flush path
+        # sheds the oldest entry (counted; its records replay on
+        # restart) and forces a checkpoint barrier.
+        from .kafka_orders import DeferredOffsets
+
+        self._deferred_offsets = DeferredOffsets(cap=self._offset_defer_max)
+        self._defer_dropped_seen = 0
 
         # The OTLP metrics leg: /v1/metrics → feed → metrics head. The
         # feed keeps its OWN service table: results join on service NAME
@@ -370,7 +493,20 @@ class DetectorDaemon:
             on_report=self._on_metrics_report,
         )
         if meta is not None:
-            checkpoint.restore_metrics_feed(meta, self.metrics_feed)
+            restored_feed = checkpoint.restore_metrics_feed(
+                meta, self.metrics_feed
+            )
+            if not restored_feed and (
+                meta.get("_metrics_arrays")
+                or meta.get("metrics_config") is not None
+            ):
+                # The snapshot HAD a metrics leg we could not hydrate
+                # (geometry change — restore_metrics_feed logged the
+                # mismatching key): a partial restore an operator must
+                # be able to see, not infer from a cold metrics head.
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_RESTORE_PARTIAL, 1.0
+                )
         self._metric_series_seen: set[tuple[str, str]] = set()
         # Logs leg (the collector's third signal,
         # otelcol-config.yml:128-131): /v1/logs → bounded store (the
@@ -381,16 +517,15 @@ class DetectorDaemon:
 
         self.log_store = LogStore()
         self.max_body_bytes = _env_int("ANOMALY_OTLP_MAX_BODY", 16 << 20)
-        self.receiver = self._make_http_receiver(self.otlp_port)
-        # OTLP/gRPC :4317 — the reference collector's primary ingress
-        # (otelcol-config.yml:5-8); every SDK defaults to gRPC export.
+        self._grpc_port_req = _env_int("ANOMALY_OTLP_GRPC_PORT", 4317)
+        # A standby answers no ingest until promotion, and a
+        # boot-fenced stale primary answers none EVER (a fenced process
+        # that kept serving would hold the orchestrator's readiness and
+        # the collector's traffic on a replica whose writes are all
+        # rejected): receivers are constructed below only once the
+        # fence evidence is in, and at promote time for standbys.
+        self.receiver = None
         self.grpc_receiver = None
-        grpc_port = _env_int("ANOMALY_OTLP_GRPC_PORT", 4317)
-        if grpc_port >= 0:
-            try:
-                self.grpc_receiver = self._make_grpc_receiver(grpc_port)
-            except ImportError:  # grpcio absent: HTTP leg still serves
-                self.grpc_receiver = None
         self.exporter = tele_metrics.PrometheusExporter(
             self.registry, port=self.metrics_port, health=self._healthz
         )
@@ -401,6 +536,12 @@ class DetectorDaemon:
             from .kafka_orders import OrdersSource  # gated import
 
             self._orders = OrdersSource(kafka_addr)
+            # Fencing: commits are epoch-tagged + fence-guarded, and a
+            # resurrected primary reads the tag its successor left on
+            # the group's committed offsets BEFORE its first write —
+            # the broker doubles as a fencing witness.
+            self._orders.fence = self._fence
+            self._fence.observe(self._orders.last_committed_epoch())
             if restored_offsets:
                 # The snapshot's offsets win over broker-committed ones:
                 # sketch state corresponds to THEM (checkpoint.py module
@@ -409,11 +550,35 @@ class DetectorDaemon:
             self._supervisor.register(
                 "kafka-orders", base_backoff_s=0.5, max_backoff_s=15.0,
             )
+        if self.role == ROLE_PRIMARY and self._fence.stale():
+            # Booted into a world that promoted past us (newer epoch on
+            # the broker's commit tags or our own snapshot volume):
+            # park FENCED instead of split-braining. Visible on
+            # anomaly_role and /healthz; an operator redeploys us as a
+            # standby (or retires us).
+            self._become_fenced(at_boot=True)
+        if self.role == ROLE_PRIMARY:
+            self.receiver = self._make_http_receiver(self.otlp_port)
+            # OTLP/gRPC :4317 — the reference collector's primary
+            # ingress (otelcol-config.yml:5-8); every SDK defaults to
+            # gRPC export.
+            if self._grpc_port_req >= 0:
+                try:
+                    self.grpc_receiver = self._make_grpc_receiver(
+                        self._grpc_port_req
+                    )
+                except ImportError:  # grpcio absent: HTTP leg serves
+                    self.grpc_receiver = None
         if self.ckpt_path:
             self._supervisor.register(
                 "checkpoint", base_backoff_s=1.0, max_backoff_s=60.0,
             )
         self._offsets: dict = dict(restored_offsets)
+        # Guards _offsets against the replication session thread's
+        # snapshot read: the pump thread mutates the map per poll,
+        # and an unguarded concurrent iteration can raise
+        # "dictionary changed size during iteration".
+        self._offsets_lock = threading.Lock()
         self._stop = threading.Event()
         self._last_ckpt = time.monotonic()
 
@@ -468,6 +633,8 @@ class DetectorDaemon:
         )
 
     def _restart_http_receiver(self) -> None:
+        if self.role == ROLE_FENCED or self.receiver is None:
+            return  # fenced: the stop was deliberate, stay down
         # Rebind on the RESOLVED port: env may have requested :0, and
         # the collector's exporter keeps pointing at the first bind.
         port = self.receiver.port
@@ -479,7 +646,7 @@ class DetectorDaemon:
         self.receiver.start()
 
     def _restart_grpc_receiver(self) -> None:
-        if self.grpc_receiver is None:
+        if self.role == ROLE_FENCED or self.grpc_receiver is None:
             return
         port = self.grpc_receiver.port
         try:
@@ -490,6 +657,8 @@ class DetectorDaemon:
         self.grpc_receiver.start()
 
     def _probe_grpc(self) -> bool:
+        if self.role == ROLE_FENCED or self.grpc_receiver is None:
+            return True  # deliberately down, nothing to restart
         from .health_probe import probe
 
         return probe(f"127.0.0.1:{self.grpc_receiver.port}", timeout_s=2.0)
@@ -544,6 +713,11 @@ class DetectorDaemon:
             "queue_max_rows": self.pipeline.queue_max_rows,
             "brownout_level": self.pipeline.brownout_level,
             "shed_rows": dict(self.pipeline.stats.shed_rows),
+            # Replication surface: how Grafana/k8s tell a healthy
+            # standby (role=standby, status ok) from a degraded primary
+            # — and what health_probe --role prints.
+            "role": self.role,
+            "epoch": self._fence.epoch,
         }
         return ("ok" if state == UP else state), detail
 
@@ -589,10 +763,28 @@ class DetectorDaemon:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
+        if self.role == ROLE_STANDBY:
+            # A standby serves only its metrics/health surface and the
+            # replication client; ingest legs come up at promotion.
+            self.exporter.start()
+            self._start_replication_standby()
+            return
+        if self.role == ROLE_FENCED:
+            # Boot-fenced: health/metrics stay observable (that is how
+            # the operator finds us), but no ingest, no replication —
+            # readiness probes against the (absent) ingest ports fail
+            # and the orchestrator keeps traffic on the live primary.
+            self.exporter.start()
+            return
         self.receiver.start()
         if self.grpc_receiver is not None:
             self.grpc_receiver.start()
         self.exporter.start()
+        self._register_serving_components()
+        if self._repl_port >= 0:
+            self._start_replication_primary()
+
+    def _register_serving_components(self) -> None:
         # Thread/server-backed components join the supervision tree
         # once they are actually up (registering before start() would
         # probe a receiver that hasn't bound yet).
@@ -600,8 +792,12 @@ class DetectorDaemon:
             "otlp-http",
             restart=self._restart_http_receiver,
             # Late-bound: a restart swaps self.receiver for a new
-            # object, and the probe must follow it.
-            probe=lambda: self.receiver.alive(),
+            # object, and the probe must follow it. Fenced = the
+            # receiver was stopped ON PURPOSE — not a crash to undo.
+            probe=lambda: (
+                self.role == ROLE_FENCED
+                or (self.receiver is not None and self.receiver.alive())
+            ),
         )
         if self.grpc_receiver is not None:
             self._supervisor.register(
@@ -620,8 +816,124 @@ class DetectorDaemon:
                 probe=self.pipeline.harvester_alive,
             )
 
+    # -- replication wiring --------------------------------------------
+
+    def _replication_snapshot(self) -> tuple[dict, dict]:
+        """(arrays, meta) of the CURRENT state for the replication
+        layer. Snapshotted under the pipeline's dispatch lock — live
+        dispatch DONATES the state buffers, so an unlocked read could
+        touch a just-deleted array (same rule as warm_widths)."""
+        import numpy as np
+
+        with self.pipeline._dispatch_lock:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in self.detector.state._asdict().items()
+            }
+            clock_t_prev = self.detector.clock._t_prev
+        meta = {
+            # Confirmed offsets ONLY (self._offsets merges after flush
+            # confirmation — the PR-3 rule): a standby promoted from
+            # this map replays any unconfirmed tail, never skips it.
+            "offsets": self._offsets_snapshot(),
+            "service_names": self.pipeline.tensorizer.service_names,
+            "clock_t_prev": clock_t_prev,
+            "config": list(
+                self.detector.config._replace(sketch_impl=None)
+            ),
+        }
+        return arrays, meta
+
+    def _register_replication_component(self) -> None:
+        """One supervised 'replication' component for either role: the
+        standby watchdog thread and the primary listener both restart
+        under the same backoff/budget discipline as every ingest leg.
+        Registered once — a supervised restart must not reset its own
+        crash-budget accounting."""
+        if "replication" in self._supervisor._components:
+            return
+        self._supervisor.register(
+            "replication", base_backoff_s=0.5, max_backoff_s=15.0,
+            probe=self._replication_alive,
+            restart=self._restart_replication,
+        )
+
+    def _replication_alive(self) -> bool:
+        if self.role == ROLE_STANDBY and self.repl_standby is not None:
+            return self.repl_standby.alive()
+        if self.role == ROLE_PRIMARY and self.repl_primary is not None:
+            return self.repl_primary.alive()
+        return True  # promoting/fenced: nothing to probe
+
+    def _restart_replication(self) -> None:
+        # The replacement object counts from zero: reset the delta
+        # baselines so its first exports aren't swallowed by the old
+        # object's high-water marks (see _export_counter_delta).
+        self._repl_counters().clear()
+        if self.role == ROLE_STANDBY and self.repl_standby is not None:
+            try:
+                self.repl_standby.stop()
+            except Exception:  # noqa: BLE001 — may be half-dead already
+                pass
+            self._start_replication_standby()
+        elif self.role == ROLE_PRIMARY and self.repl_primary is not None:
+            port = self.repl_primary.port
+            try:
+                self.repl_primary.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._start_replication_primary(port=port)
+
+    def _offsets_snapshot(self) -> dict[int, int]:
+        with self._offsets_lock:
+            return {int(p): int(o) for p, o in self._offsets.items()}
+
+    def _start_replication_primary(self, port: int | None = None) -> None:
+        self.repl_primary = replication.ReplicationPrimary(
+            snapshot_fn=self._replication_snapshot,
+            fence=self._fence,
+            port=self._repl_port if port is None else port,
+            interval_s=self._repl_interval_s,
+        )
+        self.repl_primary.start()
+        self._register_replication_component()
+
+    def _start_replication_standby(self) -> None:
+        self.repl_standby = replication.ReplicationStandby(
+            target=self._repl_target,
+            fence=self._fence,
+            config_fingerprint=list(
+                self.detector.config._replace(sketch_impl=None)
+            ),
+            # Abandon a half-open session well before the promotion
+            # watchdog would fire on the same silence.
+            silence_reconnect_s=max(3 * self._repl_interval_s, 2.0),
+        )
+        self.repl_standby.start()
+        self._register_replication_component()
+
     def step(self, t_now: float | None = None) -> None:
         """One pump + housekeeping tick (public for tests/sims)."""
+        if self.role in (ROLE_STANDBY, ROLE_PROMOTING):
+            self._standby_step()
+            return
+        if self.role == ROLE_PRIMARY and self._fence.stale():
+            # Someone promoted past us (learned via a replication
+            # frame, the broker's commit tags, or the checkpoint
+            # volume): stop writing IMMEDIATELY and visibly.
+            self._become_fenced()
+        if self.role == ROLE_FENCED:
+            # A fenced ex-primary keeps draining what it already
+            # admitted (and keeps its health/metrics surface honest)
+            # but performs no durable writes: no orders pump, no offset
+            # commits, no checkpoints.
+            self.pipeline.pump(t_now)
+            self.metrics_feed.pump(
+                time.monotonic() if t_now is None else t_now
+            )
+            self._export_fence_stats()
+            self._supervisor.tick()
+            return
         # Self-telemetry on a 1 s cadence (the collector's own otelcol_*
         # habit): ingest/batch/backlog visibility even before the first
         # detector report, and the first handle on a wedged pipeline.
@@ -679,6 +991,9 @@ class DetectorDaemon:
             self._brownout_seen = brownout
         if self.ingest_pool is not None:
             self._export_pool_stats()
+        self._export_fence_stats()
+        if self.repl_primary is not None:
+            self._export_replication_stats()
         if self._orders is not None:
             # Guarded: an exception escaping the poll/submit loop (a
             # transport state no one anticipated) backs the pump off
@@ -725,6 +1040,234 @@ class DetectorDaemon:
         seen["busy_s"] = st["busy_s"]
         seen["wall_t"] = now
 
+    # -- replication: standby step / promotion / fencing ----------------
+
+    def _repl_counters(self) -> dict:
+        if not hasattr(self, "_repl_seen"):
+            self._repl_seen = {}
+        return self._repl_seen
+
+    def _export_counter_delta(self, metric: str, key: str, value: int, **labels):
+        seen = self._repl_counters()
+        delta = value - seen.get(key, 0)
+        # delta > 0 only: a supervised replication restart swaps in a
+        # fresh stats object (counts restart at 0), and a negative add
+        # would make the Prometheus counter decrease — rate() would
+        # read it as a bogus reset spike. _restart_replication also
+        # clears the seen map so post-restart counts aren't swallowed.
+        if delta > 0:
+            self.registry.counter_add(metric, float(delta), **labels)
+        seen[key] = value
+
+    def _export_fence_stats(self) -> None:
+        """Fence-rejected writes by path — the split-brain audit trail
+        (anomaly_replication_fenced_total{path=checkpoint|offsets|…});
+        frame-path rejections are exported from the replication stats,
+        these are the checkpoint/commit halves."""
+        for path, count in list(self._fence.fenced_by_path.items()):
+            label = "offsets" if "offset" in path else path
+            self._export_counter_delta(
+                tele_metrics.ANOMALY_REPLICATION_FENCED,
+                f"fence_{path}", count, path=label,
+            )
+
+    def _export_replication_stats(self) -> None:
+        p = self.repl_primary
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_REPLICATION_LAG, p.lag_seconds()
+        )
+        self._export_counter_delta(
+            tele_metrics.ANOMALY_REPLICATION_DELTAS, "shipped",
+            p.deltas_shipped, direction="shipped",
+        )
+        self._export_counter_delta(
+            tele_metrics.ANOMALY_REPLICATION_SNAPSHOTS, "snap_shipped",
+            p.snapshots_shipped, direction="shipped",
+        )
+        self._export_counter_delta(
+            tele_metrics.ANOMALY_REPLICATION_FENCED, "frame_fenced",
+            p.fenced_events, path="frame",
+        )
+
+    def _standby_step(self) -> None:
+        """One standby housekeeping tick: watchdog + metrics. No
+        ingest, no Kafka, no checkpoints — the standby's only job is
+        staying current and noticing the primary die."""
+        self._export_fence_stats()
+        st = self.repl_standby
+        if st is not None:
+            quiet_s = st.seconds_since_frame()
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_REPLICATION_LAG, quiet_s
+            )
+            self._export_counter_delta(
+                tele_metrics.ANOMALY_REPLICATION_DELTAS, "applied",
+                st.deltas_applied, direction="applied",
+            )
+            self._export_counter_delta(
+                tele_metrics.ANOMALY_REPLICATION_SNAPSHOTS, "snap_applied",
+                st.snapshots_applied, direction="applied",
+            )
+            self._export_counter_delta(
+                tele_metrics.ANOMALY_REPLICATION_FENCED, "fenced_sent",
+                st.fenced_sent, path="frame",
+            )
+            if (
+                self.role == ROLE_STANDBY
+                and quiet_s > self._failover_timeout_s
+                and st.applied_seq >= 0  # never promote off nothing
+            ):
+                if self._primary_confirmed_alive():
+                    # Link fault, not primary death: promoting now would
+                    # split-brain against a serving primary. Reset the
+                    # watchdog and keep reconnecting.
+                    st.last_frame_t = time.monotonic()
+                else:
+                    self.promote()
+        self._supervisor.tick()
+
+    def _primary_confirmed_alive(self) -> bool:
+        """grpc.health double-check before promotion (only when
+        ANOMALY_PRIMARY_HEALTH_ADDR is configured): True means the
+        primary still answers SERVING and the silence is the LINK's
+        fault."""
+        if not self._primary_health_addr:
+            return False
+        try:
+            from .health_probe import probe
+
+            return probe(self._primary_health_addr, timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — no grpcio / unreachable:
+            return False  # treat as dead, promotion proceeds
+
+    def promote(self) -> None:
+        """STANDBY → PROMOTING → PRIMARY: the failover path.
+
+        Order matters: the epoch bump comes FIRST (every later write is
+        stamped with it), then state hydration from the replicated
+        mirror, then the Kafka seek to the replicated offset map
+        (at-least-once: offsets only ever replicated after flush
+        confirmation), then ingest comes up, then an immediate
+        epoch-stamped checkpoint makes the promotion durable — a
+        promoted standby that crashes and restarts keeps outranking
+        the old primary."""
+        import logging
+
+        self.role = ROLE_PROMOTING
+        epoch = self._fence.bump()
+        try:
+            # Everything fallible happens BEFORE the standby client is
+            # stopped: if any step raises (wrong-shaped replicated
+            # arrays, a broker fault in seek, a receiver bind failure),
+            # we return to STANDBY with the mirror intact and the
+            # watchdog re-fires after another failover timeout — a
+            # failed promotion must be a retry, never a process parked
+            # in PROMOTING with no ingest and no way forward.
+            arrays, meta = {}, {}
+            if self.repl_standby is not None:
+                arrays, meta = self.repl_standby.snapshot()
+            if arrays:
+                import jax
+
+                from ..models.detector import DetectorState
+
+                self.detector.state = DetectorState(
+                    **{k: jax.device_put(v) for k, v in arrays.items()}
+                )
+                self.detector.clock._t_prev = meta.get("clock_t_prev")
+                for name in meta.get("service_names", []):
+                    self.pipeline.tensorizer.service_id(name)
+                self._offsets = {
+                    int(p): int(o)
+                    for p, o in (meta.get("offsets") or {}).items()
+                }
+            if self._orders is not None and self._offsets:
+                # Replicated offsets win over broker-committed ones for
+                # the same reason checkpoint offsets do: the sketch
+                # state we just hydrated corresponds to THEM.
+                self._orders.seek(self._offsets)
+            # Ingest up: construct + start the receivers the standby
+            # never built, join them to the supervision tree.
+            if self.receiver is None:
+                self.receiver = self._make_http_receiver(self.otlp_port)
+                self.receiver.start()
+            if self.grpc_receiver is None and self._grpc_port_req >= 0:
+                try:
+                    self.grpc_receiver = self._make_grpc_receiver(
+                        self._grpc_port_req
+                    )
+                    self.grpc_receiver.start()
+                except ImportError:
+                    self.grpc_receiver = None
+            self._register_serving_components()
+        except Exception:  # noqa: BLE001 — promotion retries, never parks
+            logging.getLogger(__name__).exception(
+                "promotion failed; returning to standby for retry"
+            )
+            self.role = ROLE_STANDBY
+            return
+        if self.repl_standby is not None:
+            try:
+                self.repl_standby.stop()
+            except Exception:  # noqa: BLE001 — a half-dead client must
+                pass  # not block the failover
+        self.role = ROLE_PRIMARY
+        self.registry.counter_add(tele_metrics.ANOMALY_FAILOVERS, 1.0)
+        if self.ckpt_path:
+            # Durable promotion (and the first fencing artifact the old
+            # primary can trip over on a shared volume).
+            self._supervisor.run_step("checkpoint", self._checkpoint)
+        if self._repl_port >= 0:
+            # Serve the NEXT standby (failure here is the supervised
+            # replication component's to retry, not the promotion's).
+            try:
+                self._start_replication_primary()
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "promoted, but the replication listener failed to "
+                    "start — running unreplicated until it recovers"
+                )
+        logging.getLogger(__name__).warning(
+            "promoted to primary at epoch %d (offsets %s)",
+            epoch, dict(self._offsets),
+        )
+
+    def _become_fenced(self, at_boot: bool = False) -> None:
+        self.role = ROLE_FENCED
+        self.registry.counter_add(
+            tele_metrics.ANOMALY_REPLICATION_FENCED, 1.0, path="role",
+        )
+        if self.repl_primary is not None:
+            try:
+                self.repl_primary.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        # Stop SERVING too: a fenced replica that kept answering OTLP
+        # would hold the orchestrator's readiness probes (the k8s
+        # bundle probes grpc.health on :4317) and the collector's
+        # traffic on a process whose durable writes are all rejected —
+        # the failover would never actually move ingest. The supervised
+        # receiver components are role-gated (below), so this is a
+        # deliberate stop, not a crash they would undo.
+        for recv in (self.receiver, self.grpc_receiver):
+            if recv is None:
+                continue
+            try:
+                recv.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self.receiver = None
+        self.grpc_receiver = None
+        import logging
+
+        logging.getLogger(__name__).error(
+            "fenced%s: epoch %d superseded by %d — durable writes "
+            "stopped (checkpoint/offset-commit/replication); redeploy "
+            "this process as a standby or retire it",
+            " at boot" if at_boot else "",
+            self._fence.epoch, self._fence.observed,
+        )
+
     def _pump_orders(self) -> None:
         # Saturation pause: Kafka is the one ingest leg with a durable
         # upstream buffer, so backpressure here is simply NOT polling —
@@ -743,14 +1286,20 @@ class DetectorDaemon:
         # earlier pool flush that has since resolved CLEANLY (a failed
         # flush keeps its offsets out of the checkpoint, so a restart
         # replays those records — at-least-once, never silent loss).
-        if self._pending_order_flushes:
-            unresolved = []
-            for ticket, offs in self._pending_order_flushes:
-                if not ticket._done:
-                    unresolved.append((ticket, offs))
-                elif ticket._error is None:
-                    self._offsets.update(offs)
-            self._pending_order_flushes = unresolved
+        # The list is BOUNDED: sheds are counted and force a checkpoint
+        # barrier (persist what IS confirmed, bound the replay window).
+        if len(self._deferred_offsets):
+            with self._offsets_lock:
+                self._offsets.update(self._deferred_offsets.resolve())
+        dropped = self._deferred_offsets.dropped_total
+        if dropped != self._defer_dropped_seen:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_OFFSET_DEFER_DROPPED,
+                float(dropped - self._defer_dropped_seen),
+            )
+            self._defer_dropped_seen = dropped
+        if self._deferred_offsets.take_barrier() and self.ckpt_path:
+            self._supervisor.run_step("checkpoint", self._checkpoint)
         # One poll = one batch: records coalesce into a single
         # tensorize pass (through the ingest pool when enabled, so the
         # Kafka leg shares the pool's flush amortization) instead of a
@@ -763,7 +1312,8 @@ class DetectorDaemon:
             # Tombstones / quarantined poison pills: their offsets
             # still advance, or a pill at the partition tail replays
             # (and re-logs) on every restart.
-            self._offsets.update(offsets)
+            with self._offsets_lock:
+                    self._offsets.update(offsets)
             return
         if self.ingest_pool is not None:
             from .ingest_pool import IngestPoolSaturated
@@ -774,23 +1324,26 @@ class DetectorDaemon:
                 # trip per record); on timeout the confirmation — and
                 # the offset merge — is deferred to a later pump.
                 ticket.result(timeout=10.0)
-                self._offsets.update(offsets)
+                with self._offsets_lock:
+                    self._offsets.update(offsets)
             except IngestPoolSaturated:
                 # The pool queue is full: fall back to the direct path
                 # rather than dropping.
                 self.pipeline.submit(batch)
-                self._offsets.update(offsets)
+                with self._offsets_lock:
+                    self._offsets.update(offsets)
             except TimeoutError:
                 # Flush still pending (wedged worker — the
                 # supervisor's probe/restart handles it); records sit
                 # in the pool queue, offsets withheld until confirmed.
-                self._pending_order_flushes.append((ticket, offsets))
+                self._deferred_offsets.add(ticket, offsets)
             # An IngestWorkerError resolution means the flush died
             # server-side: offsets are NOT merged (the records never
             # reached the pipeline), so a restart replays them.
         else:
             self.pipeline.submit(batch)
-            self._offsets.update(offsets)
+            with self._offsets_lock:
+                    self._offsets.update(offsets)
         quarantined = self._orders.decode_failures
         if quarantined != self._quarantine_seen:
             self.registry.counter_add(
@@ -806,14 +1359,33 @@ class DetectorDaemon:
             self._quarantine_seen = quarantined
 
     def _checkpoint(self) -> None:
+        # Fence first (a process that has OBSERVED a newer epoch must
+        # not write even to an empty path), then the epoch-stamped save
+        # (which additionally refuses to replace a newer-epoch snapshot
+        # on a shared volume — checkpoint.StaleEpochError either way).
+        self._fence.check(path="checkpoint")
         checkpoint.save(
             self.ckpt_path,
             self.detector,
             offsets=dict(self._offsets),
             service_names=self.pipeline.tensorizer.service_names,
             metrics_feed=self.metrics_feed,
+            epoch=self._fence.epoch,
         )
         self._last_ckpt = time.monotonic()
+        if self._orders is not None and self._offsets:
+            # Epoch-tagged broker commit beside the snapshot: the
+            # broker becomes a fencing witness any resurrected writer
+            # consults at boot. Broker-down is NOT a checkpoint failure
+            # (the snapshot, the real durability, already landed) — but
+            # a StaleEpochError propagates: it means fence state
+            # changed mid-step and the caller must see it.
+            try:
+                self._orders.commit(self._offsets, epoch=self._fence.epoch)
+            except checkpoint.StaleEpochError:
+                raise
+            except Exception:  # noqa: BLE001 — transport-only failure
+                pass
 
     def run(self, on_ready=None) -> None:
         """Blocking serve loop; returns after :meth:`stop`.
@@ -844,7 +1416,12 @@ class DetectorDaemon:
         self._stop.set()
 
     def shutdown(self) -> None:
-        self.receiver.stop()
+        if self.repl_standby is not None:
+            self.repl_standby.stop()
+        if self.repl_primary is not None:
+            self.repl_primary.stop()
+        if self.receiver is not None:
+            self.receiver.stop()
         if self.grpc_receiver is not None:
             self.grpc_receiver.stop()
         if self._orders is not None:
@@ -855,7 +1432,10 @@ class DetectorDaemon:
             # the pipeline drains, so nothing in flight is lost.
             self.ingest_pool.close()
         self.pipeline.close()  # drain + stop the harvester thread if any
-        if self.ckpt_path:
+        if self.ckpt_path and self.role == ROLE_PRIMARY:
+            # A standby's state is the primary's to persist; a fenced
+            # ex-primary's save would (correctly) raise — neither
+            # writes a shutdown snapshot.
             self._checkpoint()
         self.exporter.stop()
 
@@ -875,9 +1455,12 @@ def main() -> None:
         # Announce resolved ports (env may request ephemeral :0) so
         # operators and cross-process harnesses can discover them.
         grpc_port = d.grpc_receiver.port if d.grpc_receiver else -1
+        http_port = d.receiver.port if d.receiver else -1
+        repl_port = d.repl_primary.port if d.repl_primary else -1
         print(
-            f"anomaly-detector: otlp-http :{d.receiver.port} "
-            f"otlp-grpc :{grpc_port} metrics :{d.exporter.port}",
+            f"anomaly-detector: otlp-http :{http_port} "
+            f"otlp-grpc :{grpc_port} metrics :{d.exporter.port} "
+            f"repl :{repl_port} role {d.role}",
             flush=True,
         )
 

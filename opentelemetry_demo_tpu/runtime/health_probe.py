@@ -17,6 +17,20 @@ Per-component probing (the supervised runtime, runtime.supervision):
 ``--service anomaly.component.kafka-orders`` — exit 0 only while that
 supervised component is UP (not in backoff or crash-looping), the
 k8s-liveness handle on a single degraded ingest leg.
+
+Role probing (hot-standby replication, runtime.replication):
+``--role`` queries the daemon's ``/healthz`` JSON on the METRICS port
+(``--addr host:9464`` — a standby serves no gRPC ingress, so the role
+surface lives beside Prometheus) and prints ``PRIMARY``/``STANDBY``/
+``PROMOTING``/``FENCED`` plus the current fencing epoch::
+
+    python -m opentelemetry_demo_tpu.runtime.health_probe \
+        --role --addr 127.0.0.1:9464
+    PRIMARY epoch=3
+
+Exit 0 whenever the role was readable — a healthy standby IS healthy;
+gate k8s readiness on the printed role, not the exit code, when only
+the primary should receive traffic.
 """
 
 from __future__ import annotations
@@ -26,6 +40,32 @@ import sys
 
 from . import wire
 from .grpc_health import SERVING
+
+
+def probe_role(addr: str, timeout_s: float = 3.0) -> tuple[str, int] | None:
+    """(role, epoch) from the daemon's /healthz, or None when
+    unreachable/old (a pre-replication daemon omits the fields —
+    reported as primary at epoch 0, which is exactly what it is)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://{addr}/healthz", timeout=timeout_s
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # 503 = degraded, which still carries the JSON body — a
+        # degraded primary's role must stay readable (that IS the
+        # triage question).
+        try:
+            doc = json.loads(e.read().decode())
+        except Exception:  # noqa: BLE001
+            return None
+    except Exception:  # noqa: BLE001 — any transport/parse failure is
+        return None  # "role unreadable" to the caller
+    return str(doc.get("role", "primary")), int(doc.get("epoch", 0))
 
 
 def probe(addr: str, service: str = "", timeout_s: float = 3.0) -> bool:
@@ -56,8 +96,21 @@ def main() -> None:
         help="supervised component name (shorthand for "
         "--service anomaly.component.<name>)",
     )
+    parser.add_argument(
+        "--role", action="store_true",
+        help="print the replication role + epoch from /healthz on the "
+        "metrics port (point --addr at host:9464, not the gRPC ingress)",
+    )
     parser.add_argument("--timeout", type=float, default=3.0)
     args = parser.parse_args()
+    if args.role:
+        role_epoch = probe_role(args.addr, args.timeout)
+        if role_epoch is None:
+            print("role unreadable", file=sys.stderr)
+            sys.exit(1)
+        role, epoch = role_epoch
+        print(f"{role.upper()} epoch={epoch}")
+        sys.exit(0)
     service = args.service
     if args.component:
         from .supervision import HEALTH_PREFIX

@@ -49,6 +49,9 @@ class KafkaBroker:
         self._lock = threading.Lock()
         self._topics: dict[str, list[_PartitionLog]] = {}
         self._group_offsets: dict[tuple[str, str, int], int] = {}
+        # Commit metadata strings beside the offsets (real Kafka stores
+        # them together): the epoch-tag channel for fenced commits.
+        self._group_meta: dict[tuple[str, str, int], str] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -481,8 +484,8 @@ class KafkaBroker:
         def read_partition():
             partition = r.int32()
             offset = r.int64()
-            r.string()  # metadata
-            return partition, offset
+            metadata = r.string()  # stored + served back (epoch tags)
+            return partition, offset, metadata
 
         def read_topic():
             return r.string(), r.array(read_partition)
@@ -492,8 +495,9 @@ class KafkaBroker:
         with self._lock:
             for name, parts in topics:
                 resp_parts = []
-                for partition, offset in parts:
+                for partition, offset, metadata in parts:
                     self._group_offsets[(group, name, partition)] = offset
+                    self._group_meta[(group, name, partition)] = metadata or ""
                     resp_parts.append((partition, kw.NO_ERROR))
                 resp_topics.append((name, resp_parts))
         return kw.enc_array(
@@ -517,7 +521,8 @@ class KafkaBroker:
                 resp_parts = []
                 for partition in parts:
                     offset = self._group_offsets.get((group, name, partition), -1)
-                    resp_parts.append((partition, offset))
+                    meta = self._group_meta.get((group, name, partition), "")
+                    resp_parts.append((partition, offset, meta))
                 resp_topics.append((name, resp_parts))
         return kw.enc_array(
             resp_topics,
@@ -526,7 +531,7 @@ class KafkaBroker:
                 t[1],
                 lambda p: kw.enc_int32(p[0])
                 + kw.enc_int64(p[1])
-                + kw.enc_string("")
+                + kw.enc_string(p[2])
                 + kw.enc_int16(kw.NO_ERROR),
             ),
         )

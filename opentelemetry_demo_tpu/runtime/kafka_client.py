@@ -167,6 +167,15 @@ class KafkaConsumer:
 
     def committed(self) -> dict[int, int]:
         """Consumer-group committed offsets (next-to-read), -1 = none."""
+        return {p: off for p, (off, _meta) in self.committed_meta().items()}
+
+    def committed_meta(self) -> dict[int, tuple[int, str]]:
+        """Committed offsets WITH their metadata strings.
+
+        The metadata slot is where epoch-tagged commits
+        (``kafka_orders.OrdersSource.commit``) park the writer's
+        fencing epoch — a resurrected stale primary reads it at boot
+        and learns it has been promoted past before its first write."""
         body = kw.enc_string(self.group_id) + kw.enc_array(
             [(self.topic, self._partitions if hasattr(self, "_partitions") else [0])],
             lambda t: kw.enc_string(t[0]) + kw.enc_array(t[1], kw.enc_int32),
@@ -176,18 +185,26 @@ class KafkaConsumer:
         def read_partition():
             partition = r.int32()
             offset = r.int64()
-            r.string()  # metadata
+            metadata = r.string()
             r.int16()  # error
-            return partition, offset
+            return partition, (offset, metadata or "")
 
         topics = r.array(lambda: (r.string(), r.array(read_partition)))
-        out: dict[int, int] = {}
+        out: dict[int, tuple[int, str]] = {}
         for _name, parts in topics:
             out.update(dict(parts))
         return out
 
-    def commit(self, offsets: dict[int, int] | None = None) -> None:
-        """Commit next-to-read offsets (defaults to current positions)."""
+    def commit(
+        self,
+        offsets: dict[int, int] | None = None,
+        metadata: str = "",
+    ) -> None:
+        """Commit next-to-read offsets (defaults to current positions).
+
+        ``metadata`` rides in the protocol's per-partition metadata
+        string (stored by the broker, returned by OFFSET_FETCH) — the
+        epoch-tag channel for fenced commits."""
         offsets = offsets if offsets is not None else dict(self._positions)
         body = (
             kw.enc_string(self.group_id)
@@ -201,7 +218,7 @@ class KafkaConsumer:
                     t[1],
                     lambda p: kw.enc_int32(p[0])
                     + kw.enc_int64(p[1])
-                    + kw.enc_string(""),
+                    + kw.enc_string(metadata),
                 ),
             )
         )

@@ -131,6 +131,60 @@ def decode_orders_columnar(
     return tensorizer.columns_from_records(records)
 
 
+class DeferredOffsets:
+    """Bounded deferred-confirmation offset list (the daemon's orders
+    pump): flushes whose pool ticket hasn't resolved park here until
+    the flush confirms cleanly, and only THEN do their offsets join the
+    checkpointable map (the PR-3 at-least-once rule).
+
+    Unbounded, a permanently-failing flush path would grow this list
+    forever (one entry per pump). Bounded: over ``cap`` entries the
+    OLDEST is shed — its records simply replay from the broker on
+    restart (at-least-once preserved, never silent loss), the shed is
+    counted (``anomaly_offset_defer_dropped_total``) and
+    ``barrier_needed`` flips so the daemon forces an immediate
+    checkpoint, persisting what IS confirmed and bounding the replay
+    window the sheds opened.
+    """
+
+    def __init__(self, cap: int = 64):
+        self.cap = max(int(cap), 1)
+        self._items: deque = deque()
+        self.dropped_total = 0
+        self.barrier_needed = False
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, ticket, offsets: dict) -> None:
+        self._items.append((ticket, offsets))
+        while len(self._items) > self.cap:
+            self._items.popleft()
+            self.dropped_total += 1
+            self.barrier_needed = True
+
+    def resolve(self) -> dict:
+        """Merged offsets of every flush that has since confirmed
+        CLEANLY; failed/unresolved flushes stay out (failed ones are
+        dropped — their records replay on restart)."""
+        merged: dict = {}
+        unresolved: deque = deque()
+        for ticket, offsets in self._items:
+            if not ticket._done:
+                unresolved.append((ticket, offsets))
+            elif ticket._error is None:
+                merged.update(offsets)
+        self._items = unresolved
+        return merged
+
+    def take_barrier(self) -> bool:
+        """True once per cap-hit episode: the caller owes a checkpoint."""
+        if self.barrier_needed:
+            self.barrier_needed = False
+            return True
+        return False
+
+
 MoneyTuple = tuple  # (currency: str, units: int, nanos: int)
 
 
@@ -232,6 +286,13 @@ class OrdersSource:
         self._bootstrap = bootstrap
         self._group_id = group_id
         self._pending_seek: dict[int, int] = {}
+        # Epoch fencing (runtime.replication.EpochFence, set by the
+        # daemon): every explicit commit is fence-checked and
+        # epoch-tagged in the commit metadata string, so a resurrected
+        # stale primary can neither commit past its successor nor boot
+        # without discovering the successor's epoch
+        # (:meth:`last_committed_epoch`).
+        self.fence = None
         self.decode_failures = 0  # poison pills skipped (not crashed on)
         # Consumer-side quarantine, mirroring the producer-side
         # dead-letter discipline in services.kafka_bus: the poison
@@ -427,6 +488,88 @@ class OrdersSource:
                 self.decode_failures,
             )
             return None
+
+    def commit(self, offsets: dict[int, int], epoch: int = 0) -> None:
+        """Epoch-tagged offset commit (fence-guarded).
+
+        The commit metadata string carries ``{"epoch": N}`` — durable
+        fencing evidence beside the offsets themselves, readable by any
+        later consumer via OFFSET_FETCH. The fence check runs FIRST: a
+        process that has observed a newer epoch must not write, however
+        briefly (``checkpoint.StaleEpochError``). Raises on transport
+        failure too — the caller (a supervised step) owns the retry.
+        """
+        if self.fence is not None:
+            self.fence.check(path="kafka-offset-commit")
+        offsets = {int(p): int(o) for p, o in offsets.items()}
+        if not offsets:
+            return
+        import json as _json
+
+        tag = _json.dumps({"epoch": int(epoch)})
+        if self._consumer is not None:  # pragma: no cover - confluent
+            from confluent_kafka import TopicPartition  # type: ignore
+
+            try:
+                # metadata kwarg exists on confluent-kafka >= 1.9 —
+                # the epoch tag must ride on REAL Kafka too, or the
+                # broker-witness fencing leg only exists against the
+                # in-repo broker.
+                tps = [
+                    TopicPartition(self.TOPIC, p, o, metadata=tag)
+                    for p, o in offsets.items()
+                ]
+            except TypeError:  # ancient client: commit untagged
+                tps = [
+                    TopicPartition(self.TOPIC, p, o)
+                    for p, o in offsets.items()
+                ]
+            self._consumer.commit(offsets=tps, asynchronous=False)
+            return
+        wire_c = self._ensure_wire(raise_on_fail=True)
+        if wire_c is None:
+            raise ConnectionError("Kafka broker unreachable for commit")
+        wire_c.commit(offsets, metadata=tag)
+
+    def last_committed_epoch(self) -> int:
+        """Largest epoch tag on the group's committed offsets (0 when
+        untagged/unreachable): the boot-time fencing probe a
+        resurrected primary runs before its first write."""
+        import json as _json
+
+        def parse(meta: str | None) -> int:
+            if not meta:
+                return 0
+            try:
+                return int(_json.loads(meta).get("epoch", 0))
+            except (ValueError, TypeError):
+                return 0
+
+        try:
+            if self._consumer is not None:  # pragma: no cover - confluent
+                from confluent_kafka import TopicPartition  # type: ignore
+
+                tps = self._consumer.committed(
+                    [TopicPartition(self.TOPIC, p) for p in range(8)],
+                    timeout=5.0,
+                )
+                return max(
+                    (parse(getattr(tp, "metadata", None)) for tp in tps),
+                    default=0,
+                )
+            wire_c = self._ensure_wire(raise_on_fail=False)
+            if wire_c is None:
+                return 0
+            return max(
+                (
+                    parse(meta)
+                    for _p, (_off, meta) in wire_c.committed_meta().items()
+                ),
+                default=0,
+            )
+        except Exception:  # noqa: BLE001 — fencing evidence is
+            # best-effort here; the checkpoint + frame paths still fence
+            return 0
 
     def close(self) -> None:
         if self._wire is not None:

@@ -1,0 +1,163 @@
+"""Failover drill driver: one methodology, bench + tests.
+
+The overloadbench/lagbench sibling for the replication fault class:
+run a REAL detector as primary with a live replication link, a standby
+applying deltas, then kill the primary abruptly (RST, the SIGKILL
+shape from the standby's point of view) and measure the hot-standby
+contract end to end:
+
+- ``replication_lag_p99_ms`` — p99 of ship→ack round trips while the
+  link is healthy (how stale the standby's mirror can be);
+- ``failover_ttd_s`` — wall time from primary death to the standby
+  PROMOTED (watchdog fire + epoch bump + state hydration), the blind
+  window a host loss actually costs;
+- convergence — the promoted state's HLL/CMS equal the primary's last
+  acked state exactly (merge semantics, not replay).
+
+``tests/test_replication.py`` asserts on this dict (the acceptance
+bar); ``make replbench`` prints it as ONE json line, the bench.py
+habit. ``bench.py`` lifts ``failover_ttd_s`` / ``replication_lag_p99_ms``
+into the flagship artifact.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..models import AnomalyDetector, DetectorConfig
+from .lagbench import make_columns
+from .pipeline import DetectorPipeline
+from .replication import EpochFence, ReplicationPrimary, ReplicationStandby
+
+
+def measure_failover(
+    seconds: float = 2.0,
+    batch: int = 256,
+    interval_s: float = 0.05,
+    failover_timeout_s: float = 0.5,
+    pump_interval_s: float = 0.01,
+    seed: int = 0,
+    config: DetectorConfig | None = None,
+) -> dict:
+    """Drive a primary pipeline under load with a live standby, kill
+    the primary, and time the standby's promotion decision + hydration.
+
+    The watchdog here is the same rule the daemon's standby step runs
+    (silence > ``failover_timeout_s`` after a completed bootstrap), so
+    the number is the deployment's TTD floor, not a toy's.
+    """
+    config = config or DetectorConfig(
+        num_services=8, hll_p=8, cms_width=512
+    )
+    detector = AnomalyDetector(config)
+    pipe = DetectorPipeline(detector, batch_size=batch)
+    offsets = {0: 0}
+
+    def snapshot():
+        with pipe._dispatch_lock:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in detector.state._asdict().items()
+            }
+            clock_t_prev = detector.clock._t_prev
+        return arrays, {
+            "offsets": dict(offsets),
+            "service_names": pipe.tensorizer.service_names,
+            "clock_t_prev": clock_t_prev,
+            "config": list(config._replace(sketch_impl=None)),
+        }
+
+    fence_p = EpochFence(0)
+    primary = ReplicationPrimary(
+        snapshot, fence_p, interval_s=interval_s
+    )
+    primary.start()
+    fence_s = EpochFence(0)
+    standby = ReplicationStandby(
+        f"127.0.0.1:{primary.port}", fence_s,
+        config_fingerprint=list(config._replace(sketch_impl=None)),
+    )
+    standby.start()
+    if not standby.wait_for_state(10.0):
+        raise RuntimeError("standby never bootstrapped")
+
+    # Load: realistic columns at a steady cadence, offsets advancing
+    # the way confirmed Kafka offsets would. One warmup dispatch first
+    # so the jit compile doesn't eat the timed load window.
+    rng = np.random.default_rng(seed)
+    pipe.submit_columns(make_columns(rng, batch))
+    pipe.pump(0.0)
+    pipe.drain()
+    offsets[0] += batch
+    t_end = time.monotonic() + seconds
+    t = pump_interval_s  # virtual clock continues past the warmup pump
+    while time.monotonic() < t_end:
+        pipe.submit_columns(make_columns(rng, batch))
+        pipe.pump(t)
+        offsets[0] += batch
+        t += pump_interval_s
+        time.sleep(pump_interval_s)
+    pipe.drain()
+
+    # Let the link quiesce so the standby's mirror reaches the final
+    # state (one last delta + ack), then record the healthy-link lag.
+    deadline = time.monotonic() + max(10 * interval_s, 2.0)
+    final = snapshot()[0]
+    while time.monotonic() < deadline:
+        arrs, _meta = standby.snapshot()
+        if arrs and (arrs["cms_bank"] == final["cms_bank"]).all():
+            break
+        time.sleep(interval_s / 2)
+    stats = primary.stats()
+    lag_p99_ms = stats["ack_lag_p99_ms"]
+
+    # Death: RST every session — what a SIGKILLed host looks like.
+    t_kill = time.monotonic()
+    primary.kill()
+    # The standby-side watchdog loop (the daemon's _standby_step rule).
+    promoted_at = None
+    give_up = t_kill + failover_timeout_s * 20 + 10.0
+    while time.monotonic() < give_up:
+        if (
+            standby.seconds_since_frame() > failover_timeout_s
+            and standby.applied_seq >= 0
+        ):
+            fence_s.bump()  # the promotion's first act
+            promoted_at = time.monotonic()
+            break
+        time.sleep(0.005)
+    if promoted_at is None:
+        raise RuntimeError("standby never promoted")
+    arrays, meta = standby.snapshot()
+    standby.stop()
+    converged = bool(
+        arrays
+        and (arrays["cms_bank"] == final["cms_bank"]).all()
+        and (arrays["hll_bank"] == final["hll_bank"]).all()
+    )
+    return {
+        "failover_ttd_s": round(promoted_at - t_kill, 4),
+        "replication_lag_p99_ms": (
+            round(lag_p99_ms, 3) if lag_p99_ms is not None else None
+        ),
+        "failover_timeout_s": failover_timeout_s,
+        "replication_interval_s": interval_s,
+        "deltas_shipped": stats["deltas_shipped"],
+        "snapshots_shipped": stats["snapshots_shipped"],
+        "converged_exact": converged,
+        "promoted_epoch": fence_s.epoch,
+        "replicated_offsets": meta.get("offsets"),
+        "spans_fed": int(pipe.stats.spans),
+    }
+
+
+def main() -> None:
+    import json
+
+    print(json.dumps(measure_failover()))
+
+
+if __name__ == "__main__":
+    main()

@@ -101,6 +101,9 @@ class Supervisor:
         self._lock = threading.RLock()
         self._saturation_probe: Callable[[], bool] | None = None
         self._last_saturated: bool | None = None
+        self._role_probe: Callable[[], tuple[str, int]] | None = None
+        self._last_role: tuple[str, int] | None = None
+        self._roles_seen: set[str] = set()
 
     # -- registration ---------------------------------------------------
 
@@ -244,6 +247,22 @@ class Supervisor:
                 self._registry.gauge_set(
                     tm.ANOMALY_SATURATED, 1.0 if sat else 0.0
                 )
+        if self._registry is not None and self._role_probe is not None:
+            role_epoch = self.role()
+            if role_epoch is not None and role_epoch != self._last_role:
+                # Edge-triggered like saturation: role flips are rare
+                # (failover), scrapes are not.
+                self._last_role = role_epoch
+                role, epoch = role_epoch
+                self._roles_seen.add(role)
+                from ..telemetry import metrics as tm
+
+                for seen in self._roles_seen:
+                    self._registry.gauge_set(
+                        tm.ANOMALY_ROLE, 1.0 if seen == role else 0.0,
+                        role=seen,
+                    )
+                self._registry.gauge_set(tm.ANOMALY_EPOCH, float(epoch))
         with self._lock:
             comps = list(self._components.values())
         for c in comps:
@@ -292,6 +311,30 @@ class Supervisor:
             return bool(self._saturation_probe())
         except Exception:  # noqa: BLE001 — a broken probe must not kill tick
             return False
+
+    # -- replication role (failover, not crashes) -----------------------
+
+    def set_role_probe(self, probe: Callable[[], tuple[str, int]]) -> None:
+        """Register the replication-role signal (``(role, epoch)`` from
+        the daemon's state machine — runtime.replication role
+        constants). Like saturation, the supervisor doesn't own
+        failover, it REPORTS it: ``anomaly_role{role=...}`` /
+        ``anomaly_epoch`` from :meth:`tick`, and ``role()`` for the
+        /healthz surface. A promotion (the standby watchdog firing) is
+        driven by the daemon's supervised pump step, so the promotion
+        path inherits the same crash quarantine every component gets."""
+        self._role_probe = probe
+
+    def role(self) -> tuple[str, int] | None:
+        """Current ``(role, epoch)``, or None when replication is off
+        (single-process deployments never see the role family)."""
+        if self._role_probe is None:
+            return None
+        try:
+            role, epoch = self._role_probe()
+            return str(role), int(epoch)
+        except Exception:  # noqa: BLE001 — a broken probe must not kill tick
+            return None
 
     def overall_state(self) -> str:
         """One word for the whole daemon: DEGRADED beats SATURATED

@@ -166,6 +166,23 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Ingest-pool decoded spans",
                       Query("rate", "anomaly_ingest_pool_spans_total"),
                       "spans/s"),
+                # Hot-standby replication: who is serving (role series
+                # are 0/1 per process), at what epoch (a step up = a
+                # failover happened), how far behind the standby is,
+                # and every fenced write a stale primary attempted.
+                Panel("Replication role",
+                      Query("instant", "anomaly_role", by=("role",))),
+                Panel("Fencing epoch",
+                      Query("instant", "anomaly_epoch"), "epoch"),
+                Panel("Replication lag",
+                      Query("instant", "anomaly_replication_lag_seconds"),
+                      "s"),
+                Panel("Replication deltas",
+                      Query("rate", "anomaly_replication_deltas_total",
+                            by=("direction",)), "deltas/s"),
+                Panel("Fenced writes (stale primary)",
+                      Query("rate", "anomaly_replication_fenced_total",
+                            by=("path",)), "writes/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

@@ -227,6 +227,25 @@ ANOMALY_INGEST_POOL_UTILIZATION = "anomaly_ingest_pool_worker_utilization"
 # the drop-oldest path and its backlog, per signal.
 ANOMALY_EXPORT_DROPPED = "anomaly_export_dropped_total"  # {signal=}
 ANOMALY_EXPORT_QUEUE_DEPTH = "anomaly_export_queue_depth"  # {signal=}
+# Hot-standby replication family (runtime.replication + the daemon's
+# role state machine): who is serving, at what epoch, how far behind
+# the standby is, and every fenced write a resurrected stale primary
+# attempted — the split-brain audit trail.
+ANOMALY_ROLE = "anomaly_role"  # {role=primary|standby|promoting|fenced}
+ANOMALY_EPOCH = "anomaly_epoch"
+ANOMALY_REPLICATION_DELTAS = "anomaly_replication_deltas_total"  # {direction=}
+ANOMALY_REPLICATION_SNAPSHOTS = "anomaly_replication_snapshots_total"  # {direction=}
+ANOMALY_REPLICATION_LAG = "anomaly_replication_lag_seconds"
+ANOMALY_REPLICATION_FENCED = "anomaly_replication_fenced_total"  # {path=}
+ANOMALY_FAILOVERS = "anomaly_failovers_total"
+# Deferred-confirmation offset list (daemon orders pump): entries shed
+# when the bounded list overflows — each one is a bounded replay on
+# restart, never silent loss.
+ANOMALY_OFFSET_DEFER_DROPPED = "anomaly_offset_defer_dropped_total"
+# Partial restores (checkpoint.restore_metrics_feed): a snapshot whose
+# metrics leg could not be hydrated (geometry change) — the span leg
+# restored, the metrics head cold-started.
+ANOMALY_RESTORE_PARTIAL = "anomaly_restore_partial_total"
 
 
 def export_metrics_report(

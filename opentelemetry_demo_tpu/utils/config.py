@@ -131,12 +131,66 @@ INGEST_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
-def _resolve(registry: dict) -> dict[str, int | float]:
-    out: dict[str, int | float] = {}
+# Hot-standby replication knobs (runtime.replication: epoch-fenced
+# primary→standby state streaming; runtime/daemon.py role state
+# machine). Same ONE-registry discipline as OVERLOAD_KNOBS/INGEST_KNOBS
+# — daemon, compose overlay, k8s generator and sanitycheck.py all
+# consume this dict. Values must stay literals (sanitycheck reads via
+# ast.literal_eval, without importing jax).
+REPLICATION_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_ROLE": (
+        "str", "primary",
+        "boot role: 'primary' serves + ships deltas, 'standby' applies "
+        "them and promotes itself when the primary goes quiet",
+    ),
+    "ANOMALY_REPLICATION_PORT": (
+        "int", -1,
+        "primary-side replication listener port (-1 disables "
+        "replication; a promoted standby opens the same listener so "
+        "the NEXT standby can attach)",
+    ),
+    "ANOMALY_REPLICATION_TARGET": (
+        "str", "",
+        "standby-side primary address host:port (the primary's "
+        "ANOMALY_REPLICATION_PORT listener); empty = no replication",
+    ),
+    "ANOMALY_REPLICATION_INTERVAL_S": (
+        "float", 1.0,
+        "delta ship cadence seconds — replicated state lags the "
+        "primary by at most this much (the failover data-loss bound "
+        "for the replace-latest EWMA block; HLL/CMS converge exactly "
+        "by merge regardless)",
+    ),
+    "ANOMALY_FAILOVER_TIMEOUT_S": (
+        "float", 5.0,
+        "standby watchdog: seconds without a replication frame before "
+        "the standby promotes itself (epoch bump + Kafka resume from "
+        "the replicated offset map + OTLP ingest up)",
+    ),
+    "ANOMALY_PRIMARY_HEALTH_ADDR": (
+        "str", "",
+        "optional grpc.health.v1 address of the primary; when set, the "
+        "standby double-checks it before promoting (a SERVING primary "
+        "behind a broken replication link must not cause split-brain)",
+    ),
+    "ANOMALY_OFFSET_DEFER_MAX": (
+        "int", 64,
+        "cap on the deferred-confirmation offset list (orders flushes "
+        "whose pool ticket hasn't resolved); over it the oldest entry "
+        "is shed (anomaly_offset_defer_dropped_total — its records "
+        "replay on restart, at-least-once preserved) and a checkpoint "
+        "barrier is forced",
+    ),
+}
+
+
+def _resolve(registry: dict) -> dict[str, int | float | str]:
+    out: dict[str, int | float | str] = {}
     for env_name, (kind, default, _help) in registry.items():
         out[env_name] = (
             env_int(env_name, default) if kind == "int"
-            else env_float(env_name, default)
+            else env_float(env_name, default) if kind == "float"
+            else env_str(env_name, default)
         )
     return out
 
@@ -151,3 +205,16 @@ def ingest_config() -> dict[str, int | float]:
     """Resolve every INGEST_KNOBS entry from the environment (same
     contract as :func:`overload_config`)."""
     return _resolve(INGEST_KNOBS)
+
+
+def replication_config() -> dict[str, int | float | str]:
+    """Resolve every REPLICATION_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the role name —
+    a typo'd role must refuse to boot, not silently run as primary."""
+    out = _resolve(REPLICATION_KNOBS)
+    if out["ANOMALY_ROLE"] not in ("primary", "standby"):
+        raise ConfigError(
+            f"ANOMALY_ROLE={out['ANOMALY_ROLE']!r} is not a role "
+            "(expected 'primary' or 'standby')"
+        )
+    return out

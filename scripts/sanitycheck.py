@@ -163,11 +163,14 @@ def main() -> int:
             for t in targets:
                 if (
                     isinstance(t, ast.Name)
-                    and t.id in ("OVERLOAD_KNOBS", "INGEST_KNOBS")
+                    and t.id in (
+                        "OVERLOAD_KNOBS", "INGEST_KNOBS",
+                        "REPLICATION_KNOBS",
+                    )
                     and node.value is not None
                 ):
                     registries[t.id] = ast.literal_eval(node.value)
-    for reg_name in ("OVERLOAD_KNOBS", "INGEST_KNOBS"):
+    for reg_name in ("OVERLOAD_KNOBS", "INGEST_KNOBS", "REPLICATION_KNOBS"):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
         for consumer in (
@@ -218,6 +221,48 @@ def main() -> int:
             "test_native_decode_releases_gil",
         ):
             check(marker in ttext, f"ingest-pool suite pins {marker}")
+
+    # 5) hot-standby replication invariants: both deploy surfaces
+    #    define the standby service (a replication layer nobody can
+    #    deploy is dead code), and the suite pins the fencing +
+    #    anti-entropy proofs.
+    compose_text = open(
+        os.path.join(ROOT, "deploy", "docker-compose.anomaly.yml")
+    ).read()
+    check(
+        "anomaly-detector-standby:" in compose_text,
+        "compose overlay defines the anomaly-detector-standby service",
+    )
+    check(
+        "ANOMALY_ROLE=standby" in compose_text,
+        "compose standby service runs ANOMALY_ROLE=standby",
+    )
+    k8s_text = open(
+        os.path.join(ROOT, "opentelemetry_demo_tpu", "utils", "k8s.py")
+    ).read()
+    check(
+        "anomaly-detector-standby" in k8s_text,
+        "k8s generator emits the anomaly-detector-standby deployment",
+    )
+    if os.path.exists(sidecar):
+        check(
+            "anomaly-detector-standby" in open(sidecar).read(),
+            "deploy/k8s sidecar bundle carries the standby deployment",
+        )
+    repl_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "replication.py"
+    )
+    check(os.path.exists(repl_py), "runtime/replication.py exists")
+    repl_tests = os.path.join(ROOT, "tests", "test_replication.py")
+    check(os.path.exists(repl_tests), "tests/test_replication.py exists")
+    if os.path.exists(repl_tests):
+        rtext = open(repl_tests).read()
+        for marker in (
+            "test_stale_primary_fenced_on_all_three_paths",
+            "test_blackholed_standby_converges_by_merge",
+            "test_failover_drill_sigkill_primary",
+        ):
+            check(marker in rtext, f"replication suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -238,6 +238,84 @@ class TestCorruptCheckpoint:
         finally:
             d3.shutdown()
 
+    def test_array_blob_corrupt_midstream_meta_intact(
+        self, monkeypatch, tmp_path
+    ):
+        """The partial-write gap: ``__meta__`` reads fine but an ARRAY
+        entry dies mid-stream (its deflate data corrupted in place —
+        the shape a torn flush leaves inside a still-valid container).
+        load_resilient must cold-start, move the file aside, and the
+        boot must count anomaly_checkpoint_corrupt_total."""
+        import zipfile
+
+        config = DetectorConfig(**SMALL)
+        _daemon_env(monkeypatch, tmp_path)
+        d1 = DetectorDaemon(config)
+        try:
+            d1.pipeline.tensorizer.service_id("payment")
+        finally:
+            d1.shutdown()  # writes the snapshot
+        ckpt = tmp_path / "ckpt.npz"
+        blob = bytearray(ckpt.read_bytes())
+        # Locate a real array entry's data region via the zip central
+        # directory and zero its payload: the container stays valid,
+        # __meta__ stays readable, but reading THAT entry raises
+        # mid-stream (zlib/EOF) — exactly a blob truncated in flight.
+        with zipfile.ZipFile(str(ckpt)) as zf:
+            names = [
+                n for n in zf.namelist()
+                if n not in ("__meta__.npy", "__digest__.npy")
+            ]
+            info = zf.getinfo(names[-1])
+            data_start = info.header_offset + 30 + len(info.filename)
+        for i in range(data_start + 16, data_start + info.compress_size):
+            blob[i] = 0
+        ckpt.write_bytes(bytes(blob))
+        # Meta is still readable — the corruption is strictly inside an
+        # array entry, the case whole-file truncation tests can't see.
+        import numpy as np_
+        with np_.load(str(ckpt)) as data:
+            assert "__meta__" in data.files
+            assert str(data["__meta__"][()])  # decodes fine
+        det, meta, corrupt = checkpoint.load_resilient(
+            str(tmp_path / "ckpt"), config
+        )
+        assert det is None and meta is None and corrupt is True
+        assert (tmp_path / "ckpt.npz.corrupt").exists()
+        # And the daemon boot path surfaces it as a counter (the file
+        # was already moved aside, so re-create the corruption).
+        (tmp_path / "ckpt.npz.corrupt").rename(ckpt)
+        d2 = DetectorDaemon(config)  # must NOT raise
+        try:
+            assert d2.pipeline.tensorizer.service_names == []
+            d2.start()
+            assert "anomaly_checkpoint_corrupt_total 1.0" in _scrape(d2)
+        finally:
+            d2.shutdown()
+
+    def test_restore_metrics_feed_logs_mismatching_key(self, caplog):
+        """Satellite: a metrics-leg geometry mismatch is a LOGGED
+        partial restore naming the offending config field, not a
+        silent False."""
+        import logging as _logging
+
+        from opentelemetry_demo_tpu.models.metrics_head import (
+            MetricsHeadConfig,
+        )
+        from opentelemetry_demo_tpu.runtime.metrics_feed import MetricsFeed
+
+        feed = MetricsFeed(MetricsHeadConfig(num_services=8))
+        saved_cfg = MetricsHeadConfig(num_services=16)
+        meta = {
+            "_metrics_arrays": {"dummy": np.zeros(1)},
+            "metrics_config": list(saved_cfg),
+        }
+        with caplog.at_level(_logging.WARNING):
+            assert checkpoint.restore_metrics_feed(meta, feed) is False
+        assert any(
+            "num_services" in rec.message for rec in caplog.records
+        ), caplog.records
+
     def test_digest_catches_silent_bit_rot(self, tmp_path):
         from opentelemetry_demo_tpu.models import AnomalyDetector
 

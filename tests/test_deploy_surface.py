@@ -75,7 +75,9 @@ class TestManifests:
         assert "--kafka" in shop["command"]
         assert shop["command"][shop["command"].index("--kafka") + 1] == "kafka:9092"
         assert "--otlp-endpoint" in shop["command"]
-        assert "anomaly-detector:4318" in shop["command"][
+        # The FAILOVER Service: traffic follows readiness to whichever
+        # detector role is serving (primary, or a promoted standby).
+        assert "anomaly-detector-ha:4318" in shop["command"][
             shop["command"].index("--otlp-endpoint") + 1
         ]
         env = {e["name"]: e["value"] for e in shop["env"]}
@@ -94,13 +96,47 @@ class TestManifests:
         assert env["ANOMALY_OTLP_PORT"] == "4318"
         assert env["FLAGD_FILE"] == "/app/flagd/demo.flagd.json"
         ports = {p["containerPort"] for p in container["ports"]}
-        assert ports == {4317, 4318, 9464}
+        # 4319 = the hot-standby replication listener (runtime.replication).
+        assert ports == {4317, 4318, 4319, 9464}
         mounts = {m["mountPath"] for m in container["volumeMounts"]}
         assert "/var/lib/anomaly" in mounts and "/app/flagd" in mounts
-        # Health-gated like every reference service (main.go:223-224):
-        # kubelet-native gRPC probes against grpc.health.v1 on :4317.
-        assert container["readinessProbe"]["grpc"]["port"] == 4317
-        assert container["livenessProbe"]["grpc"]["port"] == 4317
+        # HA probe split: alive on /healthz (a fenced ex-primary is
+        # ALIVE — restarting it re-fences, not recovers), READY only
+        # while the ingest port is bound — readiness moves the
+        # anomaly-detector-ha Service endpoints at failover.
+        assert container["readinessProbe"]["tcpSocket"]["port"] == 4318
+        assert container["livenessProbe"]["httpGet"]["port"] == 9464
+        # The hot standby rides in the same bundle: standby role env,
+        # its OWN checkpoint PVC, and HTTP health on the metrics port
+        # (no gRPC ingress exists before promotion).
+        sb = idx[("Deployment", "anomaly-detector-standby")]
+        sb_container = sb["spec"]["template"]["spec"]["containers"][0]
+        sb_env = {e["name"]: e["value"] for e in sb_container["env"]}
+        assert sb_env["ANOMALY_ROLE"] == "standby"
+        assert sb_env["ANOMALY_REPLICATION_TARGET"] == "anomaly-detector:4319"
+        assert sb_env["ANOMALY_PRIMARY_HEALTH_ADDR"] == "anomaly-detector:4317"
+        assert env.get("ANOMALY_ROLE") == "primary"
+        assert env["ANOMALY_REPLICATION_PORT"] == "4319"
+        sb_claims = {
+            v["persistentVolumeClaim"]["claimName"]
+            for v in sb["spec"]["template"]["spec"]["volumes"]
+            if "persistentVolumeClaim" in v
+        }
+        assert sb_claims == {"anomaly-state-standby"}
+        assert ("PersistentVolumeClaim", "anomaly-state-standby") in idx
+        assert sb_container["readinessProbe"]["tcpSocket"]["port"] == 4318
+        assert sb_container["livenessProbe"]["httpGet"]["port"] == 9464
+        # Both roles carry the shared HA component label, and the
+        # failover Service selects on it (readiness decides which pod
+        # actually holds the endpoints).
+        ha_svc = idx[("Service", "anomaly-detector-ha")]
+        sel = set(ha_svc["spec"]["selector"].items())
+        for d in (dep, sb):
+            pod_labels = set(
+                d["spec"]["template"]["metadata"]["labels"].items()
+            )
+            assert sel <= pod_labels
+        assert {p["port"] for p in ha_svc["spec"]["ports"]} == {4317, 4318}
 
     def test_selectors_match_pod_labels(self):
         for docs in (k8s.standalone_stack(), k8s.sidecar_overlay()):
@@ -276,6 +312,13 @@ class TestGeneratorGuards:
         with pytest.raises(ValueError, match="probe kinds"):
             k8s.deployment("x", "img", readiness_http=("/h", 1),
                            grpc_health_port=2)
+        # The one sanctioned mix: readiness_tcp_port + liveness_http;
+        # any other companion for readiness_tcp_port still refuses.
+        k8s.deployment("x", "img", liveness_http=("/h", 1),
+                       readiness_tcp_port=2)
+        with pytest.raises(ValueError, match="readiness_tcp_port"):
+            k8s.deployment("x", "img", grpc_health_port=1,
+                           readiness_tcp_port=2)
 
     def test_stale_component_files_pruned(self, tmp_path):
         stale = tmp_path / "components" / "removed-tier.yaml"
