@@ -67,6 +67,45 @@ uint32_t crc32(const uint8_t* p, size_t n) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// --------------------------------------------------------------- crc32c
+// CRC-32C (Castagnoli polynomial 0x82F63B78, reflected) — the frame
+// checksum (runtime/frame.py); slicing-by-8 so verify runs at memory
+// bandwidth rather than per-byte table speed. Must match frame.py's
+// portable _py_crc32c bit-for-bit (pinned by tests/test_frame.py).
+struct Crc32cTable {
+  uint32_t t[8][256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = t[0][t[s - 1][i] & 0xFF] ^ (t[s - 1][i] >> 8);
+  }
+};
+const Crc32cTable kCrc32c;
+
+uint32_t crc32c_update(uint32_t seed, const uint8_t* p, size_t n) {
+  uint32_t c = ~seed;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    c ^= lo;
+    c = kCrc32c.t[7][c & 0xFF] ^ kCrc32c.t[6][(c >> 8) & 0xFF] ^
+        kCrc32c.t[5][(c >> 16) & 0xFF] ^ kCrc32c.t[4][c >> 24] ^
+        kCrc32c.t[3][hi & 0xFF] ^ kCrc32c.t[2][(hi >> 8) & 0xFF] ^
+        kCrc32c.t[1][(hi >> 16) & 0xFF] ^ kCrc32c.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = kCrc32c.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
 // ------------------------------------------------------------ wire scan
 constexpr int kVarint = 0;
 constexpr int kFixed64 = 1;
@@ -734,5 +773,12 @@ int otd_decode_orders(const uint8_t* const* bufs, const size_t* lens,
 // CRC32 of one buffer — exposed so Python-side fallbacks/tests can
 // assert the hash contract without zlib.
 uint32_t otd_crc32(const uint8_t* p, size_t n) { return crc32(p, n); }
+
+// CRC-32C with a running seed (0 to start): the frame checksum
+// (runtime/frame.py). Called with the GIL released like every foreign
+// call here — column verify overlaps other workers' Python.
+uint32_t otd_crc32c(const uint8_t* p, size_t n, uint32_t seed) {
+  return crc32c_update(seed, p, n);
+}
 
 }  // extern "C"

@@ -4,20 +4,34 @@ The reference system's only durability is Kafka consumer offsets
 (auto-commit, /root/reference/src/accounting/Consumer.cs:79-80) — state
 lost on restart is re-derived by replaying the topic. Sketch state makes
 that cheap to improve on: the whole detector is a few MB of mergeable
-integers/floats, so an atomic ``.npz`` snapshot stamped with the Kafka
+integers/floats, so an atomic one-file snapshot stamped with the Kafka
 offsets (and the tensorizer's intern table) gives exactly-once-ish
 resume: restore the snapshot, seek the consumer to the stored offsets,
 and the sketches continue as if never interrupted. Anything replayed
 twice would double-count in CMS — seeking to the recorded offset is what
 prevents that; HLL/EWMA are idempotent/robust to small overlaps anyway.
 
-Format: one ``<path>.npz`` holding the state arrays plus the metadata
-(offsets, intern table, config fingerprint) as an embedded JSON entry —
-a single file so that state and offsets can never be torn apart by a
-crash between two writes. The write goes through a temp file +
+Format: one ``<path>.ckpt`` file that IS a verified columnar frame
+(``runtime.frame``: magic, format version, schema hash, per-column
+CRC32C checksums, trailer checksum) — the SAME byte layout replication
+ships over TCP and the ingest pool moves from decode scratch, so disk,
+link and device feed all carry one format with zero re-encode. The
+metadata (offsets, intern table, config fingerprint, fencing epoch)
+rides in the frame's meta block beside the state columns — a single
+file so that state and offsets can never be torn apart by a crash
+between two writes. The write goes through a temp file + ``fsync`` +
 ``os.replace`` so a crash mid-write leaves the previous snapshot intact
 — the same torn-write discipline flagd-ui needs for its JSON file
-(SURVEY.md §2.2).
+(SURVEY.md §2.2). The frame checksums replace the old sha256 sidecar
+digest: truncation fails the trailer, bit rot fails a column CRC, and
+either way :func:`load_resilient` quarantines the file and cold-starts.
+
+Version skew: snapshots written by the pre-frame layout (an npz with an
+embedded ``__meta__`` entry — "v0", at ``<path>.npz``) still restore
+through the explicit migration shim in :func:`_load_arrays`; the next
+save writes the current frame format and retires the legacy file, so a
+rolling upgrade (or a rollback within the frame-version window via
+``ANOMALY_FRAME_WRITE_VERSION``) never strands durable state.
 """
 
 from __future__ import annotations
@@ -26,9 +40,6 @@ import hashlib
 import json
 import logging
 import os
-import struct
-import zipfile
-import zlib
 from typing import Any
 
 import numpy as np
@@ -36,8 +47,14 @@ import numpy as np
 import jax
 
 from ..models.detector import AnomalyDetector, DetectorConfig, DetectorState
+from . import frame
 
 log = logging.getLogger(__name__)
+
+# Current snapshot files are frames; ``.npz`` is the pre-frame ("v0")
+# layout the loader still migrates from.
+SUFFIX = ".ckpt"
+LEGACY_SUFFIX = ".npz"
 
 
 class CheckpointCorrupt(Exception):
@@ -126,7 +143,7 @@ def save_state(
     existing_epoch = peek_epoch(path)
     if existing_epoch is not None and existing_epoch > epoch:
         raise StaleEpochError(
-            f"{path}.npz carries epoch {existing_epoch} > writer epoch "
+            f"snapshot at {path} carries epoch {existing_epoch} > writer epoch "
             f"{epoch}: refusing a stale-primary checkpoint save"
         )
     state_np = {k: np.asarray(v) for k, v in state._asdict().items()}
@@ -150,96 +167,60 @@ def save_state(
         meta["metrics_config"] = list(head.config)
         meta["metrics_service_names"] = metrics_feed.service_names
         meta["metrics_metric_names"] = metrics_feed.metric_names
-    # Metadata rides inside the npz (as a unicode scalar) so snapshot
-    # and offsets commit in ONE os.replace — a crash can only ever leave
+    # Metadata rides inside the frame's meta block so snapshot and
+    # offsets commit in ONE os.replace — a crash can only ever leave
     # the previous complete (state, offsets) pair, never a mixed one.
-    # The digest rides beside it so a boot can verify content, and
-    # fsync-before-rename makes the replace itself crash-safe: without
-    # it a power cut can leave the *renamed* file with zero-filled
-    # pages on journaled filesystems.
-    meta_json = json.dumps(meta)
-    digest = _content_digest(state_np, meta_json)
-    tmp = path + ".tmp.npz"
+    # The frame's per-column CRCs + trailer are the content integrity
+    # (the old sha256 sidecar digest retired), and fsync-before-rename
+    # makes the replace itself crash-safe: without it a power cut can
+    # leave the *renamed* file with zero-filled pages on journaled
+    # filesystems.
+    blob = frame.encode(state_np, meta=meta)
+    tmp = path + ".tmp" + SUFFIX
     with open(tmp, "wb") as f:
-        np.savez_compressed(
-            f,
-            __meta__=np.asarray(meta_json),
-            __digest__=np.asarray(digest),
-            **state_np,
-        )
+        f.write(blob)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, path + ".npz")
-    # Clean up a sidecar left by the old two-file format so it can't
-    # shadow or confuse a later inspection of the snapshot directory.
-    try:
-        os.remove(path + ".json")
-    except OSError:
-        pass
+    os.replace(tmp, path + SUFFIX)
+    # Retire artifacts of older layouts AFTER the new snapshot landed
+    # (the crash window always leaves at least one complete snapshot):
+    # the pre-frame npz ("v0" — just migrated from) and the ancient
+    # two-file JSON sidecar, either of which could otherwise shadow or
+    # confuse a later inspection of the snapshot directory.
+    for stale in (path + LEGACY_SUFFIX, path + ".json"):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+
+
+def _snapshot_file(path: str) -> str | None:
+    """The snapshot file for ``path``: the current frame layout wins;
+    a legacy npz ("v0") is the migration source. None = cold."""
+    for suffix in (SUFFIX, LEGACY_SUFFIX):
+        if os.path.exists(path + suffix):
+            return path + suffix
+    return None
 
 
 def _load_arrays(
     path: str, config: DetectorConfig | None
 ) -> tuple[dict, dict, DetectorConfig]:
-    """Shared npz read + config validation → (arrays, meta, saved_cfg).
+    """Shared snapshot read + config validation → (arrays, meta, cfg).
 
-    Anything the *file* can do wrong — truncation, a torn zip, an
-    unreadable entry, digest mismatch — raises
-    :class:`CheckpointCorrupt`; only the post-read *semantic* checks
-    (incompatible version, config mismatch) raise ``ValueError``.
+    Reads the current frame layout, or migrates a pre-frame npz
+    ("v0") through the explicit shim below. Anything the *file* can do
+    wrong — truncation, a failed trailer/column checksum, a torn zip —
+    raises :class:`CheckpointCorrupt`; only the post-read *semantic*
+    checks (incompatible version, config mismatch) raise ``ValueError``.
     """
-    class _IncompatibleVersion(Exception):
-        pass
-
-    try:
-        with np.load(path + ".npz") as data:
-            if "__meta__" not in data.files:
-                raise _IncompatibleVersion
-            meta_json = str(data["__meta__"][()])
-            meta = json.loads(meta_json)
-            stored_digest = (
-                str(data["__digest__"][()])
-                if "__digest__" in data.files else None
-            )
-            arrays = {
-                k: data[k]
-                for k in data.files
-                if k not in ("__meta__", "__digest__")
-                and not k.startswith("metrics_")
-            }
-            metrics_arrays = {
-                k[len("metrics_"):]: data[k]
-                for k in data.files
-                if k.startswith("metrics_")
-            }
-    except _IncompatibleVersion:
-        raise ValueError(
-            f"{path}.npz is not a self-contained checkpoint (missing "
-            "__meta__); it was written by an incompatible version"
-        ) from None
-    except (
-        zipfile.BadZipFile,  # truncated/garbage container
-        zlib.error,          # corrupt deflate stream inside an entry
-        EOFError,            # entry shorter than its header claims
-        struct.error,        # torn zip/npy structural fields
-        ValueError,          # bad npy magic/header, bad meta JSON
-        KeyError,            # central directory references a lost entry
-        IndexError,
-    ) as e:
-        # File-content faults only: transient ENVIRONMENT errors
-        # (PermissionError, EIO, MemoryError) propagate — a retry could
-        # succeed, and mislabeling them corrupt would make
-        # load_resilient move a perfectly good snapshot aside.
-        raise CheckpointCorrupt(f"{path}.npz unreadable: {e}") from e
-    if stored_digest is not None:
-        all_arrays = dict(arrays)
-        all_arrays.update({f"metrics_{k}": v for k, v in metrics_arrays.items()})
-        actual = _content_digest(all_arrays, meta_json)
-        if actual != stored_digest:
-            raise CheckpointCorrupt(
-                f"{path}.npz content digest mismatch "
-                f"(stored {stored_digest[:12]}…, computed {actual[:12]}…)"
-            )
+    file = _snapshot_file(path)
+    if file is None:
+        raise FileNotFoundError(f"no snapshot at {path}")
+    if file.endswith(SUFFIX):
+        arrays, metrics_arrays, meta = _read_frame_snapshot(file)
+    else:
+        arrays, metrics_arrays, meta = _read_legacy_snapshot(file)
     meta["_metrics_arrays"] = metrics_arrays
     saved_cfg = DetectorConfig(
         *[tuple(v) if isinstance(v, list) else v for v in meta["config"]]
@@ -254,6 +235,83 @@ def _load_arrays(
                 f"requested {config}"
             )
     return arrays, meta, saved_cfg
+
+
+def _split_metric_arrays(all_arrays: dict) -> tuple[dict, dict]:
+    arrays = {
+        k: v for k, v in all_arrays.items()
+        if not k.startswith("metrics_")
+        and k not in ("__meta__", "__digest__")
+    }
+    metrics_arrays = {
+        k[len("metrics_"):]: v
+        for k, v in all_arrays.items()
+        if k.startswith("metrics_")
+    }
+    return arrays, metrics_arrays
+
+
+def _read_frame_snapshot(file: str) -> tuple[dict, dict, dict]:
+    """Current layout: the file IS one verified columnar frame."""
+    try:
+        with open(file, "rb") as fh:
+            blob = fh.read()
+        fr = frame.decode(blob)
+    except frame.FrameVersionError as e:
+        # An upgrade-order problem (a frame version outside this
+        # reader's window), not corruption: refuse loudly rather than
+        # quarantining a perfectly intact newer snapshot.
+        raise ValueError(f"{file}: {e}") from e
+    except frame.FrameError as e:
+        # File-content faults only: transient ENVIRONMENT errors
+        # (PermissionError, EIO, MemoryError) propagate — a retry could
+        # succeed, and mislabeling them corrupt would make
+        # load_resilient move a perfectly good snapshot aside.
+        raise CheckpointCorrupt(f"{file} unreadable: {e}") from e
+    arrays, metrics_arrays = _split_metric_arrays(fr.arrays)
+    if "config" not in fr.meta:
+        raise ValueError(
+            f"{file} carries no config fingerprint; it was written by "
+            "an incompatible version"
+        )
+    return arrays, metrics_arrays, dict(fr.meta)
+
+
+def _read_legacy_snapshot(file: str) -> tuple[dict, dict, dict]:
+    """The pre-frame npz layout ("v0") — the migration shim. Verified
+    by its embedded sha256 digest when present (older-still snapshots
+    verify by the zip container alone); the next save rewrites the
+    state as a frame and retires this file."""
+    try:
+        raw = frame.read_npz(file)
+    except frame.FrameCorrupt as e:  # container faults (torn zip, …)
+        raise CheckpointCorrupt(f"{file} unreadable: {e}") from e
+    if "__meta__" not in raw:
+        raise ValueError(
+            f"{file} is not a self-contained checkpoint (missing "
+            "__meta__); it was written by an incompatible version"
+        )
+    try:
+        meta_json = str(raw["__meta__"][()])
+        meta = json.loads(meta_json)
+    except ValueError as e:
+        raise CheckpointCorrupt(f"{file} meta unreadable: {e}") from e
+    stored_digest = (
+        str(raw["__digest__"][()]) if "__digest__" in raw else None
+    )
+    arrays, metrics_arrays = _split_metric_arrays(raw)
+    if stored_digest is not None:
+        all_arrays = dict(arrays)
+        all_arrays.update(
+            {f"metrics_{k}": v for k, v in metrics_arrays.items()}
+        )
+        actual = _content_digest(all_arrays, meta_json)
+        if actual != stored_digest:
+            raise CheckpointCorrupt(
+                f"{file} content digest mismatch "
+                f"(stored {stored_digest[:12]}…, computed {actual[:12]}…)"
+            )
+    return arrays, metrics_arrays, meta
 
 
 def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetector, dict]:
@@ -277,16 +335,18 @@ def load_resilient(
 ) -> tuple[AnomalyDetector | None, dict | None, bool]:
     """Boot-path load: ``(detector, meta, corrupt)``.
 
-    A truncated or bit-rotted snapshot degrades to a cold start
-    (``(None, None, True)``) instead of crashing the daemon at boot —
+    A truncated or bit-rotted snapshot — a failed frame trailer or
+    column CRC, a torn legacy zip — degrades to a cold start
+    (``(None, None, True)``) instead of crashing the daemon at boot:
     the snapshot is an *optimization* (skip topic replay / re-warmup),
-    never a boot dependency. The bad file is moved aside to
-    ``<path>.npz.corrupt`` so the evidence survives for inspection AND
+    never a boot dependency. The bad file is QUARANTINED — moved aside
+    to ``<file>.corrupt`` — so the evidence survives for inspection AND
     the next restart doesn't trip on it again. Config mismatch still
     raises (operator error, mustMapEnv discipline); a missing file is
     ``(None, None, False)`` — a plain cold start.
     """
-    if not exists(path):
+    file = _snapshot_file(path)
+    if file is None:
         return None, None, False
     try:
         detector, meta = load(path, config)
@@ -294,7 +354,7 @@ def load_resilient(
     except CheckpointCorrupt as e:
         log.error("checkpoint corrupt, falling back to cold start: %s", e)
         try:
-            os.replace(path + ".npz", path + ".npz.corrupt")
+            os.replace(file, file + ".corrupt")
         except OSError:
             pass
         return None, None, True
@@ -333,28 +393,40 @@ def load_onto_mesh(
 
 
 def exists(path: str) -> bool:
-    return os.path.exists(path + ".npz")
+    return _snapshot_file(path) is not None
 
 
 def peek_epoch(path: str) -> int | None:
     """Fencing epoch of the snapshot at ``path``, or None.
 
     None means "no fencing evidence": missing file, unreadable file, or
-    a pre-epoch snapshot (treated as epoch 0 by ``meta.get``). Reads
-    only the ``__meta__`` entry — npz loads entries lazily, so this is
-    a central-directory walk plus one small decompress, cheap enough
-    for the save path to call every time."""
-    if not exists(path):
-        return None
-    try:
-        with np.load(path + ".npz") as data:
-            if "__meta__" not in data.files:
-                return None
-            meta = json.loads(str(data["__meta__"][()]))
-    except Exception:  # noqa: BLE001 — corruption is load_resilient's
-        # problem; fencing only needs readable evidence of a newer epoch
-        return None
-    return int(meta.get("epoch", 0))
+    a pre-epoch snapshot (treated as epoch 0 by ``meta.get``). Frame
+    snapshots answer from a header-only read (fixed header + meta JSON,
+    never the state payload — cheap enough for the save path to call
+    every time); a legacy npz pays one full container read, once, on
+    the save that retires it. When BOTH layouts are present (a crash
+    between the frame replace and the legacy unlink), the LARGEST
+    epoch wins — fencing must see the strongest evidence."""
+    best: int | None = None
+    for suffix in (SUFFIX, LEGACY_SUFFIX):
+        file = path + suffix
+        if not os.path.exists(file):
+            continue
+        try:
+            if suffix == SUFFIX:
+                _version, meta = frame.peek_file_meta(file)
+            else:
+                raw = frame.read_npz(file)
+                if "__meta__" not in raw:
+                    continue
+                meta = json.loads(str(raw["__meta__"][()]))
+        except Exception:  # noqa: BLE001 — corruption is
+            # load_resilient's problem; fencing only needs readable
+            # evidence of a newer epoch
+            continue
+        epoch = int(meta.get("epoch", 0))
+        best = epoch if best is None else max(best, epoch)
+    return best
 
 
 def restore_metrics_feed(meta: dict, feed) -> bool:

@@ -66,6 +66,14 @@ Env contract (all optional, sensible defaults):
   default 5.0), ``ANOMALY_PRIMARY_HEALTH_ADDR`` (optional grpc-health
   double-check before promoting), ``ANOMALY_OFFSET_DEFER_MAX`` (cap on
   the deferred-confirmation offset list, default 64)
+- Verified-frame knobs (one registry: ``utils.config.FRAME_KNOBS``;
+  engine: ``runtime.frame`` — the ONE checksummed columnar format that
+  ingest scratch→pipeline, replication payloads and checkpoint files
+  all move): ``ANOMALY_FRAME_VERIFY`` (checksum verification at every
+  hop, default 1), ``ANOMALY_FRAME_WRITE_VERSION`` (format version
+  written, default 2; readers accept 1..2 — pin to 1 mid-rolling-
+  upgrade), ``ANOMALY_FRAME_QUARANTINE_DIR`` (where corrupt frames are
+  written aside for forensics; empty = count + drop)
 
 Replication / failover (runtime.replication; tests/test_replication.py):
 the daemon runs a role state machine — PRIMARY / STANDBY / PROMOTING
@@ -111,12 +119,14 @@ from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
 from ..utils.config import (
     ConfigError,
+    frame_config,
     ingest_config,
     overload_config,
     replication_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
 from . import checkpoint, replication
+from . import frame as frame_fmt
 from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
@@ -160,6 +170,21 @@ class DetectorDaemon:
         self.pump_interval_s = _env_float("ANOMALY_PUMP_INTERVAL_S", 0.05)
         self.ckpt_path = os.environ.get("ANOMALY_CHECKPOINT") or None
         self.ckpt_interval_s = _env_float("ANOMALY_CHECKPOINT_INTERVAL_S", 30.0)
+
+        # Verified-frame policy FIRST (knob registry:
+        # utils.config.FRAME_KNOBS; engine: runtime.frame): the
+        # checkpoint load below and every hop after it read/write the
+        # one columnar format, so the write-version/verify/quarantine
+        # knobs must be installed before any byte moves.
+        try:
+            fk = frame_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        frame_fmt.configure(
+            write_version=int(fk["ANOMALY_FRAME_WRITE_VERSION"]),
+            verify=bool(int(fk["ANOMALY_FRAME_VERIFY"])),
+            quarantine_dir=str(fk["ANOMALY_FRAME_QUARANTINE_DIR"]),
+        )
 
         # Replication role state machine (knob registry:
         # utils.config.REPLICATION_KNOBS; engine: runtime.replication).
@@ -357,9 +382,33 @@ class DetectorDaemon:
             "hydrated (geometry change): span leg restored, metrics "
             "head cold-started",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FRAME_CORRUPT,
+            "Frames that failed checksum verification, by hop — each "
+            "one is corruption caught at a boundary and quarantined, "
+            "never merged into sketch state",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FRAME_VERSION,
+            "Columnar frame format version this process writes "
+            "(mixed values across a fleet = rolling upgrade in flight)",
+        )
+        # Mint the per-hop corrupt series at zero (like the shed-lane
+        # counters): "this number never moved" must be a visible 0.
+        for hop in ("ingest", "replication", "checkpoint"):
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FRAME_CORRUPT, 0.0, hop=hop
+            )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_FRAME_VERSION,
+            float(frame_fmt.write_version()),
+        )
         if ckpt_corrupt:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_CHECKPOINT_CORRUPT, 1.0
+            )
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FRAME_CORRUPT, 1.0, hop="checkpoint"
             )
         # The supervision tree: restart hooks + probes are registered
         # for each ingest leg; passive (run_step-guarded) components
@@ -466,7 +515,7 @@ class DetectorDaemon:
             )
         self._pool_seen = {
             "flushes": 0, "flushed_spans": 0, "coalesced_requests": 0,
-            "busy_s": 0.0, "wall_t": time.monotonic(),
+            "frames_corrupt": 0, "busy_s": 0.0, "wall_t": time.monotonic(),
         }
         # Orders flushes whose pool ticket hadn't resolved within the
         # pump's wait: offsets are withheld until the flush confirms,
@@ -1027,6 +1076,13 @@ class DetectorDaemon:
             if delta:
                 self.registry.counter_add(metric, float(delta))
                 seen[key] = st[key]
+        delta = st["frames_corrupt"] - seen["frames_corrupt"]
+        if delta:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FRAME_CORRUPT, float(delta),
+                hop="ingest",
+            )
+            seen["frames_corrupt"] = st["frames_corrupt"]
         # Windowed utilization: busy-seconds delta over wall delta,
         # normalized by worker count — the "is the pool the
         # bottleneck" gauge.
@@ -1088,6 +1144,10 @@ class DetectorDaemon:
             tele_metrics.ANOMALY_REPLICATION_FENCED, "frame_fenced",
             p.fenced_events, path="frame",
         )
+        self._export_counter_delta(
+            tele_metrics.ANOMALY_FRAME_CORRUPT, "frames_corrupt_primary",
+            p.frames_corrupt, hop="replication",
+        )
 
     def _standby_step(self) -> None:
         """One standby housekeeping tick: watchdog + metrics. No
@@ -1111,6 +1171,10 @@ class DetectorDaemon:
             self._export_counter_delta(
                 tele_metrics.ANOMALY_REPLICATION_FENCED, "fenced_sent",
                 st.fenced_sent, path="frame",
+            )
+            self._export_counter_delta(
+                tele_metrics.ANOMALY_FRAME_CORRUPT, "frames_corrupt",
+                st.frames_corrupt, hop="replication",
             )
             if (
                 self.role == ROLE_STANDBY

@@ -1,0 +1,622 @@
+"""The ONE verified columnar wire format: checksummed, versioned frames.
+
+Before this module, the stack moved state bytes in three ad-hoc
+layouts: the ingest pool copied decode-scratch columns into the
+pipeline with bare ``ndarray.copy`` semantics, ``replication.py``
+shipped SNAPSHOT/DELTA payloads as ad-hoc npz blobs, and
+``checkpoint.py`` persisted npz archives with a sha256 sidecar digest.
+Three encoders meant three corruption surfaces — and two of them
+(replication deltas, recycled scratch buffers) had NO detection at
+all: a flipped bit merged straight into live sketch state. PR 4 proved
+bit-identical monoid convergence only when the bytes arrive intact;
+this module makes "intact" enforced rather than hoped, and makes the
+three hops ONE layout so Kafka→device, primary→standby and disk are
+all verify + memcpy + monoid merge with zero re-encode.
+
+Layout (all integers little-endian)::
+
+    offset  size  field
+    0       4     magic            b"OTDF"
+    4       2     format version   (FRAME_VERSION; readers accept
+                                    MIN_READ_VERSION..FRAME_VERSION)
+    6       2     flags            (reserved, 0)
+    8       8     schema hash      (u64 over the column name/dtype/rank
+                                    table; 0 in v1 frames)
+    16      4     header length    (u32, JSON bytes incl. alignment pad)
+    20      ...   header JSON      {"cols": [{"n", "t", "s"[, "c"]}...],
+                                    "meta": {...}} — "t" is the numpy
+                                    dtype.str, "s" the shape, "c" the
+                                    per-column CRC32C (v2+)
+    ...     ...   column payloads  contiguous C-order bytes, each
+                                    column start padded to 8-byte
+                                    alignment (zero-copy views decode
+                                    aligned)
+    end-4   4     trailer          CRC32C over bytes[0 : end-4]
+
+Verification discipline — why BOTH a trailer and per-column CRCs:
+
+- The **trailer** catches transport/storage corruption: any flipped
+  bit anywhere in the frame (header included) fails the single
+  whole-frame check. ``tests/test_frame.py`` proves it exhaustively —
+  every single-bit flip of a small frame is caught.
+- The **per-column CRCs** are computed from the SOURCE memory before
+  the bytes are copied into the frame, and re-checked against the
+  copy at decode time. A reusable decode-scratch buffer recycled while
+  its rows were still being encoded (the ingest pool's aliasing
+  hazard) produces a copy that diverges from its source CRC — a race
+  the self-consistent trailer can never see.
+
+Version skew: a v(N) reader accepts v(N−1) frames through the explicit
+shim in :func:`decode` (v1 frames carry no per-column CRCs and a zero
+schema hash — the trailer still verifies), and :func:`decode_arrays`
+additionally accepts the pre-frame npz blob layout ("v0") by sniffing,
+so a rolling primary/standby upgrade never bricks replication
+mid-failover. ``ANOMALY_FRAME_WRITE_VERSION`` (utils.config
+FRAME_KNOBS) lets a half-upgraded fleet keep WRITING v1 until every
+reader is current.
+
+CRC32C (Castagnoli) is the checksum: hardware-friendly, and the
+polynomial with the best burst-detection record for storage framing
+(the same choice as Kafka record batches, ext4 metadata and iSCSI).
+The native kernel (``native/ingest.cc otd_crc32c``, slicing-by-8,
+GIL-released like every other native call) computes it at memory
+bandwidth; environments without a compiler fall back to the table
+implementation below — same bits, less throughput.
+
+Corruption handling contract for every consumer: verify BEFORE
+merging; a failed check **quarantines** the frame (``quarantine()``
+writes the evidence aside when ``ANOMALY_FRAME_QUARANTINE_DIR`` is
+set), increments ``anomaly_frame_corrupt_total{hop}``, and the live
+sketch state is never touched. ``scripts/sanitycheck.py`` pins this
+module as the single source of truth: npz/frombuffer byte layouts
+anywhere else in the package fail ``make check``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import struct
+import threading
+from typing import NamedTuple
+
+import numpy as np
+
+FRAME_MAGIC = b"OTDF"
+FRAME_VERSION = 2
+# Oldest frame version this reader still decodes (the rolling-upgrade
+# window). The pre-frame npz layout ("v0") is additionally accepted by
+# decode_arrays/read_npz — a sniffed shim, not a frame version.
+MIN_READ_VERSION = 1
+
+_FIXED = struct.Struct("<4sHHQI")  # magic, version, flags, schema, hlen
+_TRAILER = struct.Struct("<I")
+_ALIGN = 8
+
+
+class FrameError(ValueError):
+    """Malformed frame (structure, schema, or checksum)."""
+
+
+class FrameCorrupt(FrameError):
+    """A frame whose bytes cannot be trusted: truncated, checksum
+    mismatch, or an unparseable header. Consumers quarantine instead of
+    merging (the counter/quarantine contract in the module doc)."""
+
+
+class FrameVersionError(FrameError):
+    """A frame whose format version is outside this reader's window —
+    an upgrade-order problem (operator), not corruption (environment);
+    consumers must NOT quarantine these as bad bytes."""
+
+
+class Frame(NamedTuple):
+    """A decoded frame: ``arrays`` are zero-copy views into the frame
+    buffer (the buffer stays alive through the views' ``.base``)."""
+
+    version: int
+    arrays: dict[str, np.ndarray]
+    meta: dict
+    schema: int
+
+
+# -- CRC32C ------------------------------------------------------------
+
+_CRC32C_POLY = 0x82F63B78  # reflected Castagnoli
+_py_table: list[int] | None = None
+_crc_native: bool | None = None  # resolved on first call
+
+
+def _py_crc32c_table() -> list[int]:
+    global _py_table
+    if _py_table is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+            table.append(c)
+        _py_table = table
+    return _py_table
+
+
+def _py_crc32c(data, crc: int = 0) -> int:
+    """Portable table-driven CRC32C — the no-compiler fallback (same
+    bits as the native slicing-by-8 kernel, ~100× slower)."""
+    table = _py_crc32c_table()
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    c = ~crc & 0xFFFFFFFF
+    for b in bytes(data):
+        c = table[(c ^ b) & 0xFF] ^ (c >> 8)
+    return ~c & 0xFFFFFFFF
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C over ``data`` (bytes/bytearray/contiguous ndarray).
+
+    For an ndarray the SOURCE memory is checksummed directly (no
+    tobytes copy) — that is what lets encode() certify scratch views
+    before the copy-out, the race the per-column CRCs exist to catch.
+    """
+    global _crc_native
+    if _crc_native is None:
+        try:
+            from . import native
+
+            _crc_native = native.available()
+        except Exception:  # noqa: BLE001 — any binding/build fault
+            _crc_native = False  # means the portable path owns it
+    if _crc_native:
+        from . import native
+
+        return native.crc32c(data, crc)
+    return _py_crc32c(data, crc)
+
+
+# -- schema hash -------------------------------------------------------
+
+
+def _crc_range(buf, start: int, end: int) -> int:
+    """CRC32C over ``buf[start:end]`` without slicing (a slice of a
+    multi-MB frame is a full memcpy; a frombuffer view is free)."""
+    return crc32c(np.frombuffer(buf, np.uint8, count=end - start, offset=start))
+
+
+def schema_hash(cols: list[tuple[str, str, int]]) -> int:
+    """u64 over the (name, dtype.str, rank) table — the frame's
+    self-description fingerprint. Shapes are excluded on purpose: row
+    counts vary per frame, the LAYOUT contract does not."""
+    blob = ";".join(f"{n}:{t}:{r}" for n, t, r in cols).encode()
+    return int.from_bytes(hashlib.sha256(blob).digest()[:8], "little")
+
+
+# -- module write/verify configuration ---------------------------------
+
+# Process-wide knobs (daemon boot threads utils.config.FRAME_KNOBS in
+# here via configure(); bare-component tests override per call). One
+# module global per knob keeps the single-source-of-truth property the
+# registry promises — every hop in the process writes/verifies alike.
+_write_version = FRAME_VERSION
+_verify_default = True
+_quarantine_dir: str | None = None
+_quarantine_seq = itertools.count()
+_quarantine_lock = threading.Lock()
+
+
+def configure(
+    write_version: int | None = None,
+    verify: bool | None = None,
+    quarantine_dir: str | None = None,
+) -> None:
+    """Set the process-wide frame policy (daemon boot)."""
+    global _write_version, _verify_default, _quarantine_dir
+    if write_version is not None:
+        if not MIN_READ_VERSION <= int(write_version) <= FRAME_VERSION:
+            raise ValueError(
+                f"frame write version {write_version} outside "
+                f"{MIN_READ_VERSION}..{FRAME_VERSION}"
+            )
+        _write_version = int(write_version)
+    if verify is not None:
+        _verify_default = bool(verify)
+    if quarantine_dir is not None:
+        _quarantine_dir = quarantine_dir or None
+
+
+def write_version() -> int:
+    return _write_version
+
+
+def verify_enabled() -> bool:
+    return _verify_default
+
+
+def quarantine(buf: bytes, hop: str, directory: str | None = None) -> str | None:
+    """Move a corrupt frame's bytes aside for inspection.
+
+    Returns the evidence path, or None when no quarantine directory is
+    configured (in-memory hops then drop the bytes after counting — the
+    counter is the contract, the file is the forensics bonus)."""
+    directory = directory or _quarantine_dir
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with _quarantine_lock:
+            seq = next(_quarantine_seq)
+        path = os.path.join(
+            directory, f"{hop}-{os.getpid()}-{seq}.frame.corrupt"
+        )
+        with open(path, "wb") as f:
+            f.write(buf)
+        return path
+    except OSError:
+        return None  # forensics must never compound the fault
+
+
+# -- encode ------------------------------------------------------------
+
+
+def _pad_to(n: int, align: int = _ALIGN) -> int:
+    return (-n) % align
+
+
+def encode(
+    arrays: dict[str, np.ndarray],
+    meta: dict | None = None,
+    version: int | None = None,
+) -> bytes:
+    """Arrays + meta → one self-describing frame (bytes).
+
+    Column order is dict order. Per-column CRCs (v2+) are computed from
+    the SOURCE arrays before their bytes are copied into the frame —
+    see the module doc's scratch-recycling rationale. ``meta`` must be
+    JSON-serializable.
+    """
+    if version is None:
+        version = _write_version
+    if not MIN_READ_VERSION <= version <= FRAME_VERSION:
+        raise ValueError(f"cannot write frame version {version}")
+    cols = []
+    blobs: list[bytes] = []
+    schema_rows: list[tuple[str, str, int]] = []
+    for name, arr in arrays.items():
+        # NOT ascontiguousarray: that call promotes 0-d arrays to 1-d
+        # and would silently rewrite scalar state (step_idx) shapes.
+        a = np.asarray(arr)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        entry: dict = {"n": name, "t": a.dtype.str, "s": list(a.shape)}
+        if version >= 2:
+            # CRC the source memory FIRST, copy second: a source that
+            # mutates between the two (scratch recycled under us)
+            # yields a copy that fails this CRC at decode.
+            entry["c"] = crc32c(a)
+        cols.append(entry)
+        schema_rows.append((name, a.dtype.str, a.ndim))
+        blobs.append(a.tobytes())
+    schema = schema_hash(schema_rows) if version >= 2 else 0
+    header = json.dumps(
+        {"cols": cols, "meta": meta or {}}, separators=(",", ":")
+    ).encode()
+    # Pad the header with spaces (JSON-transparent) so the payload
+    # region starts 8-byte aligned — decode's zero-copy views then
+    # never touch unaligned memory.
+    header += b" " * _pad_to(_FIXED.size + len(header))
+    out = bytearray()
+    out += _FIXED.pack(FRAME_MAGIC, version, 0, schema, len(header))
+    out += header
+    for blob in blobs:
+        out += b"\0" * _pad_to(len(out))
+        out += blob
+    out += _TRAILER.pack(crc32c(out))  # bytearray: checksummed in place
+    return bytes(out)
+
+
+# -- decode ------------------------------------------------------------
+
+
+def _parse_header(buf: bytes) -> tuple[int, int, int, dict, int]:
+    """(version, schema, header_len, header_doc, payload_start) —
+    structure only, no checksum verification."""
+    if len(buf) < _FIXED.size + _TRAILER.size:
+        raise FrameCorrupt(f"frame truncated at {len(buf)} bytes")
+    magic, version, _flags, schema, hlen = _FIXED.unpack_from(buf, 0)
+    if magic != FRAME_MAGIC:
+        raise FrameCorrupt(f"bad frame magic {magic!r}")
+    if version > FRAME_VERSION or version < MIN_READ_VERSION:
+        # Disambiguate a REAL version-window miss from a bit flip in
+        # the version field itself: the trailer (last 4 bytes, a
+        # format invariant across versions) decides. A failing trailer
+        # means corruption — and it must be reported as such, or a
+        # single flipped version bit in a checkpoint would crash the
+        # boot path (FrameVersionError → ValueError) instead of
+        # quarantining + cold-starting. (Header-only peeks pass a
+        # fabricated trailer and so report corrupt here — peek callers
+        # treat any failure as "no evidence", which is right.)
+        stored = _TRAILER.unpack_from(buf, len(buf) - _TRAILER.size)[0]
+        if _crc_range(buf, 0, len(buf) - _TRAILER.size) != stored:
+            raise FrameCorrupt(
+                f"frame version field reads {version} and the trailer "
+                "CRC fails: corrupt header, not version skew"
+            )
+        raise FrameVersionError(
+            f"frame version {version} outside this reader's window "
+            f"{MIN_READ_VERSION}..{FRAME_VERSION}"
+        )
+    start = _FIXED.size + hlen
+    if start + _TRAILER.size > len(buf):
+        raise FrameCorrupt("frame header overruns the buffer")
+
+    def _require(ok: bool, why: str) -> None:
+        # Explicit raises, not asserts: the negative-dimension guard
+        # below stops np.frombuffer's count=-1 read-to-end semantics
+        # and must survive python -O.
+        if not ok:
+            raise FrameCorrupt(f"frame header unparseable: {why}")
+
+    try:
+        doc = json.loads(buf[_FIXED.size : start].decode())
+        cols = doc["cols"]
+    except Exception as e:  # noqa: BLE001 — any header shape fault is
+        # corruption by definition (the writer only emits valid JSON)
+        raise FrameCorrupt(f"frame header unparseable: {e}") from e
+    _require(isinstance(cols, list), "cols is not a list")
+    for c in cols:
+        _require(
+            isinstance(c, dict) and isinstance(c.get("n"), str),
+            "column name missing",
+        )
+        try:
+            np.dtype(c.get("t"))
+        except Exception as e:  # noqa: BLE001 — unknown dtype string
+            raise FrameCorrupt(f"frame header unparseable: {e}") from e
+        shape = c.get("s")
+        _require(
+            isinstance(shape, list)
+            and all(isinstance(d, int) and d >= 0 for d in shape),
+            f"column {c.get('n')!r} has a non-natural shape",
+        )
+    return version, schema, hlen, doc, start
+
+
+def decode(
+    buf: bytes,
+    verify: bool | None = None,
+    expect_schema: int | None = None,
+) -> Frame:
+    """One frame → :class:`Frame` (zero-copy array views).
+
+    With ``verify`` (default: the module policy, normally True) the
+    trailer CRC is checked first, then every per-column CRC (v2+) and
+    the schema hash. Raises :class:`FrameCorrupt` on any mismatch or
+    truncation, :class:`FrameVersionError` outside the version window.
+    ``expect_schema`` additionally pins the frame to a known profile
+    (e.g. the ingest span columns) — a hash mismatch there is a
+    protocol error, not corruption, and raises :class:`FrameError`.
+    """
+    if verify is None:
+        verify = _verify_default
+    version, schema, _hlen, doc, start = _parse_header(buf)
+    cols = doc["cols"]
+    if verify:
+        stored = _TRAILER.unpack_from(buf, len(buf) - _TRAILER.size)[0]
+        actual = _crc_range(buf, 0, len(buf) - _TRAILER.size)
+        if actual != stored:
+            # Name the damaged column when the per-column CRCs can —
+            # better forensics than "trailer mismatch" alone.
+            bad = _bad_columns(buf, cols, start) if version >= 2 else []
+            raise FrameCorrupt(
+                f"frame trailer CRC mismatch (stored {stored:#010x}, "
+                f"computed {actual:#010x})"
+                + (f"; corrupt column(s): {', '.join(bad)}" if bad else "")
+            )
+    arrays: dict[str, np.ndarray] = {}
+    pos = start
+    schema_rows: list[tuple[str, str, int]] = []
+    for c in cols:
+        dtype = np.dtype(c["t"])
+        shape = tuple(c["s"])
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        pos += _pad_to(pos)
+        if pos + nbytes + _TRAILER.size > len(buf):
+            raise FrameCorrupt(
+                f"column {c['n']!r} overruns the frame "
+                f"({pos + nbytes} past {len(buf) - _TRAILER.size})"
+            )
+        count = nbytes // dtype.itemsize if dtype.itemsize else 0
+        try:
+            view = np.frombuffer(buf, dtype=dtype, count=count, offset=pos)
+            arrays[c["n"]] = view.reshape(shape)
+        except (ValueError, TypeError) as e:
+            # Unreachable when the trailer verified (a lying column
+            # table fails the CRC first) — but with verification
+            # disabled a malformed header must still surface as
+            # corruption, not a bare numpy error.
+            raise FrameCorrupt(
+                f"column {c['n']!r} unmappable ({dtype}, {shape}): {e}"
+            ) from e
+        schema_rows.append((c["n"], dtype.str, len(shape)))
+        if verify and version >= 2:
+            actual = _crc_range(buf, pos, pos + nbytes)
+            if actual != int(c["c"]):
+                raise FrameCorrupt(
+                    f"column {c['n']!r} CRC mismatch (stored "
+                    f"{int(c['c']):#010x}, computed {actual:#010x}) — "
+                    "source mutated during encode, or storage rot"
+                )
+        pos += nbytes
+    if version >= 2:
+        computed_schema = schema_hash(schema_rows)
+        if verify and computed_schema != schema:
+            raise FrameCorrupt(
+                "frame schema hash does not match its column table"
+            )
+        schema = computed_schema
+    if expect_schema is not None and version >= 2 and schema != expect_schema:
+        raise FrameError(
+            f"frame schema {schema:#018x} is not the expected profile "
+            f"{expect_schema:#018x}"
+        )
+    return Frame(version, arrays, doc.get("meta", {}), schema)
+
+
+def _bad_columns(buf: bytes, cols: list, start: int) -> list[str]:
+    """Best-effort list of columns whose stored CRC mismatches."""
+    bad = []
+    pos = start
+    try:
+        for c in cols:
+            dtype = np.dtype(c["t"])
+            nbytes = int(
+                dtype.itemsize * int(np.prod(tuple(c["s"]), dtype=np.int64))
+            )
+            pos += _pad_to(pos)
+            if pos + nbytes + _TRAILER.size > len(buf):
+                bad.append(c["n"])
+                break
+            if _crc_range(buf, pos, pos + nbytes) != int(c.get("c", -1)):
+                bad.append(c["n"])
+            pos += nbytes
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
+    return bad
+
+
+def peek_meta(buf: bytes) -> tuple[int, dict]:
+    """(version, meta) from the header ONLY — no payload verification.
+
+    For fencing-style peeks (checkpoint epoch on a shared volume) that
+    need evidence cheaply and treat unreadable as absent."""
+    version, _schema, _hlen, doc, _start = _parse_header(buf)
+    return version, doc.get("meta", {})
+
+
+def peek_file_meta(path: str) -> tuple[int, dict]:
+    """Header-only read of a frame FILE: fixed header + JSON, never the
+    payload — cheap enough for every save-time fencing peek."""
+    with open(path, "rb") as f:
+        fixed = f.read(_FIXED.size)
+        if len(fixed) < _FIXED.size:
+            raise FrameCorrupt("frame file shorter than its fixed header")
+        _magic, _version, _flags, _schema, hlen = _FIXED.unpack(fixed)
+        header = f.read(hlen)
+    return peek_meta(fixed + header + b"\0" * _TRAILER.size)
+
+
+# -- migration shims ---------------------------------------------------
+
+
+def sniff(buf: bytes) -> str:
+    """'frame' | 'npz' (the pre-frame v0 zip layout) | 'unknown'."""
+    if buf[:4] == FRAME_MAGIC:
+        return "frame"
+    if buf[:2] == b"PK":
+        return "npz"
+    return "unknown"
+
+
+def read_npz(source) -> dict[str, np.ndarray]:
+    """Legacy ("v0") npz decode — the ONLY np.load in the package.
+
+    ``source`` is a path or bytes. Every way the CONTAINER can lie —
+    truncation, a torn zip, a corrupt deflate stream, a bad npy header
+    — raises :class:`FrameCorrupt`; environment faults (permissions,
+    EIO, memory) propagate untouched so callers can retry them.
+    """
+    import io
+    import zipfile
+    import zlib
+
+    f = io.BytesIO(source) if isinstance(source, (bytes, bytearray)) else source
+    try:
+        with np.load(f) as data:
+            return {k: data[k] for k in data.files}
+    except (
+        zipfile.BadZipFile,  # truncated/garbage container
+        zlib.error,          # corrupt deflate stream inside an entry
+        EOFError,            # entry shorter than its header claims
+        struct.error,        # torn zip/npy structural fields
+        ValueError,          # bad npy magic/header
+        KeyError,            # central directory references a lost entry
+        IndexError,
+    ) as e:
+        raise FrameCorrupt(f"legacy npz unreadable: {e}") from e
+
+
+def write_npz(arrays: dict[str, np.ndarray], compressed: bool = True) -> bytes:
+    """Legacy ("v0") npz encode — test fixtures and the version-skew
+    suites build old-layout blobs through here so the writer stays in
+    the one module sanitycheck pins."""
+    import io
+
+    buf = io.BytesIO()
+    (np.savez_compressed if compressed else np.savez)(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_arrays(
+    blob: bytes, verify: bool | None = None
+) -> dict[str, np.ndarray]:
+    """Arrays from a frame OR a legacy npz blob (sniffed) — the shim
+    replication uses so an un-upgraded primary's npz payloads still
+    hydrate an upgraded standby mid-rolling-upgrade."""
+    kind = sniff(blob)
+    if kind == "frame":
+        return decode(blob, verify=verify).arrays
+    if kind == "npz":
+        return read_npz(blob)
+    raise FrameCorrupt(f"payload is neither frame nor npz ({blob[:4]!r})")
+
+
+# -- the ingest span profile -------------------------------------------
+
+# The decode-scratch column set (native.ColumnarSpans minus the
+# services list, which rides in meta): the ONE layout the ingest pool
+# moves from scratch to pipeline. Declared here so the schema hash is
+# a compile-time constant both ends pin.
+SPAN_COLUMNS: tuple[tuple[str, str], ...] = (
+    ("duration_us", "<f4"),
+    ("trace_key", "<u8"),
+    ("is_error", "|u1"),
+    ("attr_crc", "<u4"),
+    ("attr_present", "|u1"),
+    ("svc_idx", "<i4"),
+    ("event_count", "<i4"),
+    ("has_exception", "|u1"),
+)
+SPAN_SCHEMA = schema_hash(
+    [(n, np.dtype(t).str, 1) for n, t in SPAN_COLUMNS]
+)
+
+
+def encode_spans(cols, version: int | None = None) -> bytes:
+    """native.ColumnarSpans → one frame; the encode IS the copy-out of
+    the pooled decode scratch (CRC source views, then memcpy)."""
+    arrays = {
+        name: np.asarray(getattr(cols, name)).astype(
+            np.dtype(t), copy=False
+        )
+        for name, t in SPAN_COLUMNS
+    }
+    return encode(arrays, meta={"services": list(cols.services)}, version=version)
+
+
+def decode_spans(buf: bytes, verify: bool | None = None):
+    """Frame → native.ColumnarSpans (verified, zero-copy views)."""
+    from .native import ColumnarSpans
+
+    f = decode(buf, verify=verify, expect_schema=SPAN_SCHEMA)
+    missing = [n for n, _t in SPAN_COLUMNS if n not in f.arrays]
+    if missing:
+        raise FrameError(f"span frame missing columns {missing}")
+    return ColumnarSpans(
+        *(f.arrays[n] for n, _t in SPAN_COLUMNS),
+        services=[
+            s if s is None else str(s)
+            for s in f.meta.get("services", [])
+        ],
+    )

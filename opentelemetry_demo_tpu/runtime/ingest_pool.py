@@ -23,10 +23,14 @@ each of those per-REQUEST costs by making them per-FLUSH:
 - **Pooled zero-copy output buffers** — decode writes into a
   :class:`ScratchPool` freelist of column arrays sized by
   high-watermark: steady-state decode performs zero numpy allocations.
-  The coalesce step copies rows out (``columns_from_columnar(...,
-  copy=True)``) before the scratch is released, so a recycled buffer
-  can never alias rows still queued in the pipeline
-  (tests/test_ingest_pool.py pins this).
+  The coalesce step moves rows out of the scratch as ONE verified
+  columnar frame (``runtime.frame``): encoding CRCs the scratch views
+  and copies the bytes into a self-owned buffer before the scratch is
+  released, and the flush verifies the frame before the pipeline sees
+  it — a recycled buffer that scribbled over in-flight rows now fails
+  a column CRC (counted + quarantined, flush dies server-side) instead
+  of aliasing rows still queued in the pipeline
+  (tests/test_ingest_pool.py + tests/test_frame.py pin this).
 - **One tensorize + one merge per flush** — a single intern pass over
   the batch-wide service list and a single
   ``SpanColumns``/``submit_columns`` call per flush, so the pipeline
@@ -63,7 +67,7 @@ import time
 from collections import deque
 from typing import Callable, Sequence
 
-from . import native
+from . import frame, native
 from .otlp import MONITORED_ATTR_KEYS, decode_export_request
 from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
@@ -250,6 +254,11 @@ class IngestPool:
         self.coalesced_requests = 0
         self.decode_errors = 0
         self.worker_failures = 0  # server-side flush failures (per flush)
+        # Scratch→pipeline frames that failed verification (recycled-
+        # buffer races, memory corruption): quarantined, flush dies as
+        # a server fault, sketches untouched. Exported as
+        # anomaly_frame_corrupt_total{hop="ingest"}.
+        self.frames_corrupt = 0
         self.busy_s = 0.0  # summed across workers
         self._started = time.monotonic()
         # Drain accounting: jobs submitted but not yet fully processed.
@@ -405,12 +414,35 @@ class IngestPool:
                     errors[i] = ValueError("malformed OTLP payload")
             if not cols.duration_us.shape[0]:
                 return []
-            # copy=True: the outputs are views into the pooled scratch,
-            # which the NEXT flush will overwrite — rows handed to the
-            # pipeline must own their memory.
-            return [self.tensorizer.columns_from_columnar(cols, copy=True)]
+            # The frame IS the copy-out of the pooled scratch (the ONE
+            # columnar format, runtime.frame): per-column CRC32Cs are
+            # computed from the scratch VIEWS, then the bytes are
+            # copied into a self-owned buffer — so a scratch recycled
+            # while rows were still in flight (the aliasing hazard the
+            # old copy=True guarded by convention) now FAILS the column
+            # CRC at the verify below instead of silently feeding the
+            # pipeline another request's rows.
+            buf = frame.encode_spans(cols)
         finally:
             self._scratch.release(scratch)
+        try:
+            verified = frame.decode_spans(buf)
+        except frame.FrameError as e:
+            with self._stats_lock:
+                self.frames_corrupt += 1
+            evidence = frame.quarantine(buf, "ingest")
+            # A server-side fault by definition (the client's bytes
+            # decoded fine; OUR copy-out diverged): the flush dies as
+            # an IngestWorkerError → 5xx/INTERNAL, never a 400, and
+            # nothing reaches the sketches.
+            raise IngestWorkerError(
+                "ingest frame failed verification"
+                + (f" (evidence at {evidence})" if evidence else "")
+                + f": {e}"
+            ) from e
+        # Zero-copy hand-off: the views own the frame buffer (their
+        # .base), so no further copy is needed before the pipeline.
+        return [self.tensorizer.columns_from_columnar(verified, copy=False)]
 
     def _decode_python(self, payload_jobs, errors) -> list[SpanColumns]:
         """No-compiler fallback: per-request wire decode, still ONE
@@ -473,6 +505,7 @@ class IngestPool:
                 "coalesced_requests": self.coalesced_requests,
                 "decode_errors": self.decode_errors,
                 "worker_failures": self.worker_failures,
+                "frames_corrupt": self.frames_corrupt,
                 "busy_s": self.busy_s,
                 "workers": self.workers,
                 # Lifetime busy fraction; the daemon exports a windowed

@@ -166,6 +166,13 @@ def _configure_ingest(lib: ctypes.CDLL) -> None:
     ]
     lib.otd_crc32.restype = ctypes.c_uint32
     lib.otd_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    # CRC-32C (frame checksum): void* so ndarray memory passes by
+    # address without a tobytes copy — checksumming the SOURCE view is
+    # what makes frame.encode's scratch-race detection work.
+    lib.otd_crc32c.restype = ctypes.c_uint32
+    lib.otd_crc32c.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32
+    ]
     # Install the USD-normalization table for the order value lane once
     # per load — the same factors kafka_orders.order_to_record applies
     # on the Python path (currency_data is a leaf module; no cycle).
@@ -324,6 +331,25 @@ def crc32(data: bytes) -> int:
     lib = _load()
     assert lib is not None
     return int(lib.otd_crc32(data, len(data)))
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC-32C (Castagnoli) — the frame checksum (runtime/frame.py).
+
+    Accepts bytes, bytearray, or a C-contiguous ndarray; array memory
+    is checksummed in place (no copy). ``crc`` seeds a running
+    checksum (0 to start). Slicing-by-8 in C, GIL released.
+    """
+    lib = _load()
+    assert lib is not None
+    if isinstance(data, np.ndarray):
+        a = data if data.flags.c_contiguous else np.ascontiguousarray(data)
+        return int(lib.otd_crc32c(a.ctypes.data, a.nbytes, crc))
+    if isinstance(data, bytearray):
+        n = len(data)
+        buf = (ctypes.c_char * n).from_buffer(data) if n else b""
+        return int(lib.otd_crc32c(buf, n, crc))
+    return int(lib.otd_crc32c(bytes(data), len(data), crc))
 
 
 def decode_otlp(

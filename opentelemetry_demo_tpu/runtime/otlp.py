@@ -41,6 +41,19 @@ MONITORED_ATTR_KEYS = (
 )
 
 
+def _as_msg(val) -> bytes:
+    """An embedded-message field must arrive length-delimited: a
+    corrupted tag can flip its wire type so the scanner hands back an
+    int where sub-scan expects bytes. That is malformed wire data — a
+    clean 400 verdict (WireError is a ValueError) — not a TypeError
+    crash in the receiver; the fuzz suite pins this."""
+    if not isinstance(val, bytes):
+        raise wire.WireError(
+            f"embedded message field carries wire type of {type(val).__name__}"
+        )
+    return val
+
+
 def _anyvalue_str(buf: bytes) -> str | None:
     f = wire.scan_fields(buf)
     sv = wire.first(f, 1)
@@ -52,10 +65,10 @@ def _anyvalue_str(buf: bytes) -> str | None:
 def _attrs_to_dict(attr_bufs: list[bytes]) -> dict[str, str]:
     out: dict[str, str] = {}
     for kv_buf in attr_bufs:
-        kv = wire.scan_fields(kv_buf)
+        kv = wire.scan_fields(_as_msg(kv_buf))
         key = wire.first(kv, 1, b"")
         val_buf = wire.first(kv, 2)
-        if key and isinstance(val_buf, bytes):
+        if key and isinstance(key, bytes) and isinstance(val_buf, bytes):
             sval = _anyvalue_str(val_buf)
             if sval is not None:
                 out[key.decode("utf-8", "replace")] = sval
@@ -74,22 +87,22 @@ def decode_export_request(payload: bytes) -> list[SpanRecord]:
     records: list[SpanRecord] = []
     req = wire.scan_fields(payload)
     for rs_buf in req.get(1, []):
-        rs = wire.scan_fields(rs_buf)
+        rs = wire.scan_fields(_as_msg(rs_buf))
         service = "unknown"
         res_buf = wire.first(rs, 1)
         if res_buf:
-            res = wire.scan_fields(res_buf)
+            res = wire.scan_fields(_as_msg(res_buf))
             res_attrs = _attrs_to_dict(res.get(1, []))
             service = res_attrs.get("service.name", service)
         for ss_buf in rs.get(2, []):
-            ss = wire.scan_fields(ss_buf)
+            ss = wire.scan_fields(_as_msg(ss_buf))
             for span_buf in ss.get(2, []):
-                records.append(_decode_span(span_buf, service))
+                records.append(_decode_span(_as_msg(span_buf), service))
     return records
 
 
 def _decode_event(ev_buf: bytes, span_start_ns: int) -> SpanEvent:
-    ev = wire.scan_fields(ev_buf)
+    ev = wire.scan_fields(_as_msg(ev_buf))
     t_ns = int(wire.first(ev, 1, 0) or 0)
     name_raw = wire.first(ev, 2)
     name = (
@@ -114,7 +127,7 @@ def _decode_span(span_buf: bytes, service: str) -> SpanRecord:
     is_error = False
     status_buf = wire.first(sp, 15)
     if status_buf:
-        st = wire.scan_fields(status_buf)
+        st = wire.scan_fields(_as_msg(status_buf))
         is_error = int(wire.first(st, 3, 0) or 0) == _STATUS_ERROR
     name_raw = wire.first(sp, 5)
     return SpanRecord(

@@ -14,10 +14,24 @@ across *processes*.
 
 Topology: the PRIMARY listens (``ANOMALY_REPLICATION_PORT``); each
 STANDBY dials it (``ANOMALY_REPLICATION_TARGET``) and receives a
-full-snapshot bootstrap followed by periodic deltas. Frames are
-length-prefixed (4-byte big-endian) protobuf-style messages built from
+full-snapshot bootstrap followed by periodic deltas. Messages are
+length-prefixed (4-byte big-endian) protobuf-style envelopes built from
 ``runtime.wire``'s encoding helpers — the same wire discipline as the
-Kafka and OTLP seams.
+Kafka and OTLP seams — and every SNAPSHOT/DELTA payload is ONE verified
+columnar frame (``runtime.frame``: magic, version, schema hash,
+per-column CRC32C, trailer checksum — the same bytes checkpoints write
+to disk and the ingest pool moves to the device feed). The standby
+VERIFIES before it merges: a payload failing its checksums is counted
+(``anomaly_frame_corrupt_total{hop="replication"}``), quarantined, and
+never applied — the ACK of the unchanged ``applied_seq`` doubles as the
+NACK that makes the primary reship against the retained base, so a
+flipped bit on the link costs one retransmit instead of silently
+poisoning sketch state. A corrupt frame still counts as LIVENESS
+(``last_frame_t``), so a lossy-but-alive link never starves the
+promotion watchdog into split-brain. Un-upgraded peers that still ship
+the pre-frame npz payload ("v0") are accepted through
+``frame.decode_arrays``'s sniffing shim — a rolling upgrade never
+bricks replication mid-failover.
 
 Delta algebra — why a lossy link still converges bit-exactly:
 
@@ -82,7 +96,6 @@ rejected on all three write paths.
 
 from __future__ import annotations
 
-import io
 import json
 import logging
 import socket
@@ -96,6 +109,7 @@ from typing import Callable
 import numpy as np
 
 from . import wire
+from . import frame as frame_fmt
 from .checkpoint import StaleEpochError
 
 log = logging.getLogger(__name__)
@@ -120,8 +134,21 @@ _F_TYPE = 1
 _F_EPOCH = 2
 _F_SEQ = 3
 _F_BASE_SEQ = 4
-_F_ARRAYS = 5  # npz bytes
+_F_ARRAYS = 5  # ONE columnar frame (runtime.frame); legacy peers: npz
 _F_META = 6  # JSON bytes
+# Trailing envelope checksum: CRC32C over every preceding body byte,
+# appended as a fixed64 (low 32 bits used). The columnar payload
+# already self-verifies, but the ENVELOPE — type, epoch, seq, meta —
+# did not, and a flipped bit in an ACK's epoch varint could fence a
+# healthy primary (the one corruption that causes a ROLE regression
+# rather than a bad merge). Presence is sniffed positionally (the
+# field is always last), so envelopes from un-upgraded peers that
+# never append it still decode — and a frame whose envelope CRC fails
+# is SKIPPED (counted, liveness still credited), not a session kill:
+# the length-prefixed stream is still aligned, only this frame lied.
+_F_BODY_CRC = 7
+_CRC_TAG_BYTE = wire.encode_tag(_F_BODY_CRC, 1)[0]  # fixed64 tag
+_CRC_FIELD_LEN = 9  # 1 tag byte + 8 value bytes
 
 # State-key merge classes (DetectorState fields). HLL merges by max
 # (idempotent), CMS by add (aggregate delta vs acked base); the rest is
@@ -134,6 +161,14 @@ _MAX_FRAME_BYTES = 256 << 20  # corrupt length prefix guard
 
 class ReplicationError(RuntimeError):
     """Transport/protocol failure on the replication link."""
+
+
+class EnvelopeCorrupt(Exception):
+    """A received envelope failed its trailing CRC: the frame is a lie
+    but the length-prefixed stream is still aligned — receivers SKIP
+    the frame (count + keep the session) instead of reconnecting.
+    Deliberately neither a ReplicationError nor a ValueError so the
+    session-fatal catch paths never swallow it."""
 
 
 class EpochFence:
@@ -206,35 +241,89 @@ def encode_frame(
     if base_seq:
         body += wire.encode_int(_F_BASE_SEQ, base_seq)
     if arrays:
-        buf = io.BytesIO()
-        # npz (the checkpoint module's container) so array dtypes/shapes
-        # self-describe; uncompressed — deltas are mostly small ints and
-        # the TCP link is local/rack-scale, CPU beats wire here.
-        np.savez(buf, **arrays)
-        body += wire.encode_len(_F_ARRAYS, buf.getvalue())
+        # The ONE columnar frame format (runtime.frame): self-describing
+        # dtypes/shapes, per-column CRC32C + trailer checksum — the
+        # standby VERIFIES before it merges (a flipped bit on this link
+        # used to merge straight into sketch state). Uncompressed:
+        # deltas are mostly small ints and the TCP link is local/rack-
+        # scale, CPU beats wire here.
+        body += wire.encode_len(_F_ARRAYS, frame_fmt.encode(arrays))
     if meta is not None:
         body += wire.encode_len(_F_META, json.dumps(meta).encode())
+    body += wire.encode_fixed64(_F_BODY_CRC, frame_fmt.crc32c(body))
     return struct.pack(">I", len(body)) + body
 
 
 def decode_frame(body: bytes) -> dict:
+    """Protocol fields only — the ARRAYS payload stays RAW bytes.
+
+    Deferring the columnar decode to the apply step is deliberate: a
+    frame that ARRIVES but fails verification must still count as
+    liveness (the primary is alive, the bytes are bad), so the receive
+    loop touches the payload only after stamping ``last_frame_t`` —
+    otherwise a corrupting link would starve the promotion watchdog
+    into a split-brain promotion against a live primary."""
+    # Positional probe for the trailing CRC field: the tag byte at -9
+    # AND four zero bytes at the tail (the fixed64's unused high half,
+    # always zeroed by the writer). The zero-tail requirement is what
+    # tells a REAL CRC field from a legacy peer's coincidence — a
+    # pre-CRC envelope ends in JSON text or varint bytes, neither of
+    # which produces four NULs, so a legacy HELLO whose meta JSON
+    # happens to put a '9' (0x39, the tag byte) 9 bytes from the end
+    # is not misread as a failing CRC and dropped forever.
+    probed = (
+        len(body) >= _CRC_FIELD_LEN
+        and body[-_CRC_FIELD_LEN] == _CRC_TAG_BYTE
+        and body[-4:] == b"\0\0\0\0"
+    )
+    if probed:
+        # Full 64-bit compare (the high half must BE zero — masking it
+        # would leave those four bytes writable by line noise), and a
+        # mismatch is corrupt with NO further sniffing: deciding by
+        # what the scanner sees instead would let a single flip that
+        # makes a length field absorb the CRC field downgrade the
+        # envelope to "legacy, unverified".
+        stored = int.from_bytes(body[-8:], "little")
+        if frame_fmt.crc32c(body[: -_CRC_FIELD_LEN]) != stored:
+            raise EnvelopeCorrupt("replication envelope CRC mismatch")
     f = wire.scan_fields(body)
-    out = {
-        "type": wire.first(f, _F_TYPE, 0),
-        "epoch": wire.first(f, _F_EPOCH, 0),
-        "seq": wire.first(f, _F_SEQ, 0),
-        "base_seq": wire.first(f, _F_BASE_SEQ, 0),
-        "arrays": {},
-        "meta": {},
-    }
-    blob = wire.first(f, _F_ARRAYS)
-    if blob:
-        with np.load(io.BytesIO(blob)) as data:
-            out["arrays"] = {k: data[k] for k in data.files}
+    if not probed and _F_BODY_CRC in f:
+        # The scanner sees a CRC field the positional probe didn't
+        # (displaced, or its zero tail was overwritten): the envelope
+        # claims a checksum it cannot cash.
+        raise EnvelopeCorrupt("envelope CRC field displaced")
+
+    def _int(no: int) -> int:
+        v = wire.first(f, no, 0)
+        if not isinstance(v, int):
+            # A rewritten tag flipped the field's wire type: acting on
+            # it (an epoch compared, a seq acked) would be acting on
+            # line noise.
+            raise EnvelopeCorrupt(f"envelope field {no} wrong type")
+        return v
+
     meta = wire.first(f, _F_META)
-    if meta:
-        out["meta"] = json.loads(meta.decode())
-    return out
+    arrays = wire.first(f, _F_ARRAYS, b"")
+    if meta is not None and not isinstance(meta, bytes):
+        raise EnvelopeCorrupt("envelope meta field wrong type")
+    if not isinstance(arrays, bytes):
+        raise EnvelopeCorrupt("envelope arrays field wrong type")
+    return {
+        "type": _int(_F_TYPE),
+        "epoch": _int(_F_EPOCH),
+        "seq": _int(_F_SEQ),
+        "base_seq": _int(_F_BASE_SEQ),
+        "arrays": arrays,
+        "meta": json.loads(meta.decode()) if meta else {},
+    }
+
+
+def decode_arrays(blob: bytes) -> dict[str, np.ndarray]:
+    """Verify + decode an ARRAYS payload: a current frame, or — the
+    rolling-upgrade shim — a pre-frame npz blob from an un-upgraded
+    peer ("v0"). Raises :class:`frame.FrameError` when the bytes fail
+    verification; callers quarantine instead of merging."""
+    return frame_fmt.decode_arrays(blob)
 
 
 def _recv_frame(sock: socket.socket) -> dict | None:
@@ -255,7 +344,15 @@ def _recv_frame(sock: socket.socket) -> dict | None:
     body = _recv_exact(sock, length, mid_frame=True)
     if body is None:
         raise ReplicationError("connection died mid-frame")
-    return decode_frame(body)
+    try:
+        return decode_frame(body)
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        # Corrupted PROTOCOL fields (a bit flip in the tag/meta region
+        # rather than the checksummed columnar payload): the stream can
+        # no longer be trusted frame-aligned — end the session cleanly
+        # and let the reconnect path resume, instead of letting a
+        # WireError kill the thread.
+        raise ReplicationError(f"undecodable frame: {e}") from e
 
 
 def _recv_exact(
@@ -359,6 +456,7 @@ class ReplicationPrimary:
         self.deltas_shipped = 0
         self.snapshots_shipped = 0
         self.acks_received = 0
+        self.frames_corrupt = 0  # corrupt HELLO/ACK envelopes skipped
         self.fenced_events = 0
         self.last_ack_t: float = 0.0
         self.ack_lag_s: deque = deque(maxlen=1024)  # ship→ack round trips
@@ -458,6 +556,11 @@ class ReplicationPrimary:
             hello = None
             try:
                 hello = _recv_frame(conn)
+            except EnvelopeCorrupt:
+                # A corrupt HELLO: drop the session; the standby's
+                # reconnect sends a fresh (hopefully clean) one.
+                self.frames_corrupt += 1
+                return
             except (socket.timeout, OSError, ReplicationError):
                 return
             if hello is None or hello["type"] != HELLO:
@@ -504,6 +607,13 @@ class ReplicationPrimary:
                         frame = _recv_frame(conn)
                     except socket.timeout:
                         break
+                    except EnvelopeCorrupt:
+                        # A corrupt ACK must neither kill the session
+                        # nor — the real hazard — have its (possibly
+                        # rewritten) epoch observed: skip exactly one
+                        # frame, keep draining.
+                        self.frames_corrupt += 1
+                        continue
                     except (OSError, ReplicationError):
                         return
                     if frame is None:
@@ -666,6 +776,7 @@ class ReplicationPrimary:
             "deltas_shipped": self.deltas_shipped,
             "snapshots_shipped": self.snapshots_shipped,
             "acks_received": self.acks_received,
+            "frames_corrupt": self.frames_corrupt,
             "fenced_events": self.fenced_events,
             "lag_s": self.lag_seconds(),
             "ack_lag_p99_ms": (
@@ -716,6 +827,15 @@ class ReplicationStandby:
         self.deltas_applied = 0
         self.snapshots_applied = 0
         self.frames_rejected = 0  # base mismatch (would double-count)
+        # Frames whose columnar payload failed verification (corrupt
+        # link / bit rot): quarantined — never merged — and the ACK
+        # re-asserts our last GOOD position, so the primary reships
+        # against the retained base. The daemon exports this as
+        # anomaly_frame_corrupt_total{hop="replication"}.
+        self.frames_corrupt = 0
+        # Intact frames from a NEWER format version (upgrade-order
+        # problem, not corruption — never quarantined).
+        self.frames_version_skew = 0
         self.fenced_sent = 0
         self.last_frame_t: float = time.monotonic()
         self._have_state = threading.Event()
@@ -787,6 +907,16 @@ class ReplicationStandby:
             while not self._stop:
                 try:
                     frame = _recv_frame(sock)
+                except EnvelopeCorrupt:
+                    # A corrupt envelope still PROVES the primary is
+                    # alive and framing correctly — credit liveness
+                    # (else a lossy-but-alive link starves the
+                    # promotion watchdog into split-brain), count, and
+                    # skip exactly this frame. None of its fields —
+                    # epoch included — may be acted on.
+                    self.frames_corrupt += 1
+                    self.last_frame_t = time.monotonic()
+                    continue
                 except socket.timeout:
                     quiet_since = max(self.last_frame_t, session_started)
                     if (
@@ -823,15 +953,59 @@ class ReplicationStandby:
             except OSError:
                 pass
 
+    def _verified_arrays(self, frame: dict) -> dict[str, np.ndarray] | None:
+        """Verify+decode a frame's columnar payload; None = quarantined.
+
+        The corruption boundary: a payload that fails its checksums is
+        counted, written aside (when ANOMALY_FRAME_QUARANTINE_DIR is
+        set) and NEVER merged — the subsequent ACK of our unchanged
+        ``applied_seq`` is the NACK that makes the primary reship
+        against the retained base, so a clean retransmit converges
+        without any extra protocol."""
+        blob = frame["arrays"]
+        try:
+            return decode_arrays(blob)
+        except frame_fmt.FrameVersionError as e:
+            # An upgrade-order problem, NOT bad bytes (the frame is
+            # intact — its version is simply outside our window):
+            # never quarantined or counted as corruption. Not applied
+            # either — the stale ACK tells the primary we are behind,
+            # and the operator signal is this log + the skew counter,
+            # not a "bad hardware" panel.
+            self.frames_version_skew += 1
+            log.error(
+                "replication frame seq %d from a NEWER format (%s) — "
+                "upgrade this standby; not applied",
+                frame["seq"], e,
+            )
+            return None
+        except frame_fmt.FrameError as e:
+            self.frames_corrupt += 1
+            path = frame_fmt.quarantine(blob, "replication")
+            log.error(
+                "replication frame seq %d failed verification (%s)%s — "
+                "quarantined, not applied; acking last good seq %d",
+                frame["seq"], e,
+                f"; evidence at {path}" if path else "",
+                self.applied_seq,
+            )
+            return None
+
     def _apply_snapshot(self, frame: dict) -> None:
+        arrays = self._verified_arrays(frame)
+        if arrays is None:
+            return
         with self._lock:
-            self.arrays = frame["arrays"]
+            self.arrays = arrays
             self.meta = frame["meta"]
             self.applied_seq = frame["seq"]
         self.snapshots_applied += 1
         self._have_state.set()
 
     def _apply_delta(self, frame: dict) -> None:
+        arrays = self._verified_arrays(frame)
+        if arrays is None:
+            return
         with self._lock:
             if frame["base_seq"] != self.applied_seq or not self.arrays:
                 # Applying an add-delta against the wrong base would
@@ -840,7 +1014,7 @@ class ReplicationStandby:
                 self.frames_rejected += 1
                 return
             hll_monotone = frame["meta"].get("hll_monotone", True)
-            for key, inc in frame["arrays"].items():
+            for key, inc in arrays.items():
                 if key in MAX_KEYS and hll_monotone:
                     # hll_merge: elementwise max (ops/hll.py:94) — the
                     # commutative-idempotent half of the monoid pair.
@@ -863,6 +1037,8 @@ class ReplicationStandby:
             "deltas_applied": self.deltas_applied,
             "snapshots_applied": self.snapshots_applied,
             "frames_rejected": self.frames_rejected,
+            "frames_corrupt": self.frames_corrupt,
+            "frames_version_skew": self.frames_version_skew,
             "fenced_sent": self.fenced_sent,
             "applied_seq": self.applied_seq,
             "seconds_since_frame": self.seconds_since_frame(),

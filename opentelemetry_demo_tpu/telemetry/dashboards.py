@@ -183,6 +183,17 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Fenced writes (stale primary)",
                       Query("rate", "anomaly_replication_fenced_total",
                             by=("path",)), "writes/s"),
+                # Verified wire format: every corrupt frame CAUGHT at a
+                # hop boundary (quarantined, never merged) — a nonzero
+                # rate here is bad hardware/link, not bad sketches —
+                # and the frame version each process writes (mixed
+                # values = a rolling upgrade in flight).
+                Panel("Corrupt frames quarantined",
+                      Query("rate", "anomaly_frame_corrupt_total",
+                            by=("hop",)), "frames/s"),
+                Panel("Frame format version",
+                      Query("instant", "anomaly_frame_version"),
+                      "version"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

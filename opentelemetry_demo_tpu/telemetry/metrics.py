@@ -246,6 +246,14 @@ ANOMALY_OFFSET_DEFER_DROPPED = "anomaly_offset_defer_dropped_total"
 # metrics leg could not be hydrated (geometry change) — the span leg
 # restored, the metrics head cold-started.
 ANOMALY_RESTORE_PARTIAL = "anomaly_restore_partial_total"
+# Verified-frame family (runtime.frame — the ONE columnar wire format
+# every state byte moves in): frames that failed verification at each
+# hop (ingest scratch→pipeline, replication link, checkpoint file) —
+# each one is corruption CAUGHT at a boundary and quarantined instead
+# of merged into live sketches — plus the format version this process
+# writes (a fleet mid-rolling-upgrade shows a mixed gauge).
+ANOMALY_FRAME_CORRUPT = "anomaly_frame_corrupt_total"  # {hop=}
+ANOMALY_FRAME_VERSION = "anomaly_frame_version"
 
 
 def export_metrics_report(

@@ -184,6 +184,38 @@ REPLICATION_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Verified-frame knobs (runtime.frame: the ONE columnar wire format —
+# checksummed, versioned — that ingest scratch→pipeline, replication
+# payloads and checkpoint files all move; runtime/daemon.py threads
+# these into frame.configure() at boot). Same ONE-registry discipline
+# as the other knob families — daemon, compose overlay, k8s generator
+# and sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+FRAME_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_FRAME_VERIFY": (
+        "int", 1,
+        "verify frame checksums (per-column CRC32C + trailer) at every "
+        "hop before state merges (0 = trust the bytes — benchmarking "
+        "only; corruption then merges undetected, the pre-frame "
+        "behavior)",
+    ),
+    "ANOMALY_FRAME_WRITE_VERSION": (
+        "int", 2,
+        "frame format version this process WRITES (readers always "
+        "accept the full window, currently 1..2): pin to the old "
+        "version while a rolling primary/standby upgrade is in flight "
+        "so the not-yet-upgraded side keeps reading every payload",
+    ),
+    "ANOMALY_FRAME_QUARANTINE_DIR": (
+        "str", "",
+        "directory where frames that fail verification are written "
+        "aside for forensics (empty = count + drop for in-memory hops; "
+        "corrupt checkpoint FILES always move aside to <file>.corrupt "
+        "regardless)",
+    ),
+}
+
+
 def _resolve(registry: dict) -> dict[str, int | float | str]:
     out: dict[str, int | float | str] = {}
     for env_name, (kind, default, _help) in registry.items():
@@ -205,6 +237,25 @@ def ingest_config() -> dict[str, int | float]:
     """Resolve every INGEST_KNOBS entry from the environment (same
     contract as :func:`overload_config`)."""
     return _resolve(INGEST_KNOBS)
+
+
+def frame_config() -> dict[str, int | float | str]:
+    """Resolve every FRAME_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the write version
+    against the reader window — a version nobody could read back must
+    refuse to boot, not corrupt-by-construction."""
+    out = _resolve(FRAME_KNOBS)
+    # Literal window bounds (not an import of runtime.frame: this
+    # module stays jax/numpy-free for sanitycheck's AST read); the
+    # correspondence with frame.MIN_READ_VERSION..FRAME_VERSION is
+    # asserted by tests/test_frame.py.
+    if not 1 <= int(out["ANOMALY_FRAME_WRITE_VERSION"]) <= 2:
+        raise ConfigError(
+            f"ANOMALY_FRAME_WRITE_VERSION="
+            f"{out['ANOMALY_FRAME_WRITE_VERSION']} outside the readable "
+            "window 1..2"
+        )
+    return out
 
 
 def replication_config() -> dict[str, int | float | str]:

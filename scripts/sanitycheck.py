@@ -165,12 +165,15 @@ def main() -> int:
                     isinstance(t, ast.Name)
                     and t.id in (
                         "OVERLOAD_KNOBS", "INGEST_KNOBS",
-                        "REPLICATION_KNOBS",
+                        "REPLICATION_KNOBS", "FRAME_KNOBS",
                     )
                     and node.value is not None
                 ):
                     registries[t.id] = ast.literal_eval(node.value)
-    for reg_name in ("OVERLOAD_KNOBS", "INGEST_KNOBS", "REPLICATION_KNOBS"):
+    for reg_name in (
+        "OVERLOAD_KNOBS", "INGEST_KNOBS", "REPLICATION_KNOBS",
+        "FRAME_KNOBS",
+    ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
         for consumer in (
@@ -263,6 +266,69 @@ def main() -> int:
             "test_failover_drill_sigkill_primary",
         ):
             check(marker in rtext, f"replication suite pins {marker}")
+
+    # 6) ONE verified wire format (runtime/frame.py): the checksummed
+    #    columnar frame is the single source of truth for every state
+    #    byte layout — ingest scratch→pipeline, replication payloads,
+    #    checkpoint files. Statically pinned two ways so a future PR
+    #    cannot silently fork the format:
+    #    a) npz containers (np.savez/np.load — the pre-frame layouts)
+    #       appear ONLY in frame.py (which owns the legacy "v0"
+    #       migration shim);
+    #    b) raw byte-reinterpretation of state (np.frombuffer) inside
+    #       runtime/ appears only in frame.py and tensorize.py (the
+    #       documented record-join, a hash input, not a wire layout).
+    frame_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "frame.py"
+    )
+    check(os.path.exists(frame_py), "runtime/frame.py exists")
+    if os.path.exists(frame_py):
+        ftext = open(frame_py).read()
+        for marker in ("FRAME_MAGIC", "FRAME_VERSION", "def encode",
+                       "def decode", "crc32c"):
+            check(marker in ftext, f"runtime/frame.py declares {marker}")
+    pkg_root = os.path.join(ROOT, "opentelemetry_demo_tpu")
+    npz_offenders, frombuffer_offenders = [], []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, ROOT)
+            text = open(path).read()
+            if fname != "frame.py" and (
+                "np.savez" in text or "np.load(" in text
+            ):
+                npz_offenders.append(rel)
+            in_runtime = os.path.basename(dirpath) == "runtime"
+            if (
+                in_runtime
+                and fname not in ("frame.py", "tensorize.py")
+                and "np.frombuffer(" in text
+            ):
+                frombuffer_offenders.append(rel)
+    check(
+        not npz_offenders,
+        "np.savez/np.load only in runtime/frame.py (one wire format) "
+        f"{npz_offenders or ''}",
+    )
+    check(
+        not frombuffer_offenders,
+        "np.frombuffer in runtime/ only in frame.py/tensorize.py "
+        f"{frombuffer_offenders or ''}",
+    )
+    frame_tests = os.path.join(ROOT, "tests", "test_frame.py")
+    check(os.path.exists(frame_tests), "tests/test_frame.py exists")
+    if os.path.exists(frame_tests):
+        fttext = open(frame_tests).read()
+        for marker in (
+            "test_every_single_bit_flip_is_caught",
+            "test_corrupt_link_quarantines_and_converges",
+            "test_checkpoint_v0_npz_migrates",
+            "test_truncated_trailer_quarantined",
+        ):
+            check(marker in fttext, f"frame suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -214,7 +214,7 @@ class TestCorruptCheckpoint:
             d1.pipeline.tensorizer.service_id("payment")
         finally:
             d1.shutdown()  # writes the snapshot
-        ckpt = tmp_path / "ckpt.npz"
+        ckpt = tmp_path / "ckpt.ckpt"
         blob = ckpt.read_bytes()
         assert len(blob) > 64
         ckpt.write_bytes(blob[: len(blob) // 3])  # torn write / truncation
@@ -227,11 +227,13 @@ class TestCorruptCheckpoint:
             d2.start()
             text = _scrape(d2)
             assert "anomaly_checkpoint_corrupt_total 1.0" in text
+            # The frame family counts the same event by hop.
+            assert 'anomaly_frame_corrupt_total{hop="checkpoint"} 1.0' in text
         finally:
             d2.shutdown()
         # Evidence moved aside; the daemon's own shutdown snapshot owns
         # the canonical path again (next boot restores normally).
-        assert (tmp_path / "ckpt.npz.corrupt").exists()
+        assert (tmp_path / "ckpt.ckpt.corrupt").exists()
         d3 = DetectorDaemon(config)
         try:
             assert checkpoint.exists(str(tmp_path / "ckpt"))
@@ -241,12 +243,14 @@ class TestCorruptCheckpoint:
     def test_array_blob_corrupt_midstream_meta_intact(
         self, monkeypatch, tmp_path
     ):
-        """The partial-write gap: ``__meta__`` reads fine but an ARRAY
-        entry dies mid-stream (its deflate data corrupted in place —
-        the shape a torn flush leaves inside a still-valid container).
+        """The partial-write gap: the frame header (and the meta block
+        inside it — offsets, epoch, config) reads FINE but a state
+        column's payload bytes were scribbled in place — the shape a
+        torn flush leaves inside a structurally-valid file, and exactly
+        what the per-column CRC32C + trailer exist to catch.
         load_resilient must cold-start, move the file aside, and the
         boot must count anomaly_checkpoint_corrupt_total."""
-        import zipfile
+        from opentelemetry_demo_tpu.runtime import frame
 
         config = DetectorConfig(**SMALL)
         _daemon_env(monkeypatch, tmp_path)
@@ -255,36 +259,35 @@ class TestCorruptCheckpoint:
             d1.pipeline.tensorizer.service_id("payment")
         finally:
             d1.shutdown()  # writes the snapshot
-        ckpt = tmp_path / "ckpt.npz"
+        ckpt = tmp_path / "ckpt.ckpt"
         blob = bytearray(ckpt.read_bytes())
-        # Locate a real array entry's data region via the zip central
-        # directory and zero its payload: the container stays valid,
-        # __meta__ stays readable, but reading THAT entry raises
-        # mid-stream (zlib/EOF) — exactly a blob truncated in flight.
-        with zipfile.ZipFile(str(ckpt)) as zf:
-            names = [
-                n for n in zf.namelist()
-                if n not in ("__meta__.npy", "__digest__.npy")
-            ]
-            info = zf.getinfo(names[-1])
-            data_start = info.header_offset + 30 + len(info.filename)
-        for i in range(data_start + 16, data_start + info.compress_size):
-            blob[i] = 0
+        # Zero a stretch strictly INSIDE the column payload region
+        # (past the header, short of the trailer): the header — and the
+        # meta it carries — stays byte-for-byte intact.
+        _version, _flags, hlen = (
+            int.from_bytes(blob[4:6], "little"),
+            int.from_bytes(blob[6:8], "little"),
+            int.from_bytes(blob[16:20], "little"),
+        )
+        payload_start = 20 + hlen
+        assert payload_start + 64 < len(blob) - 4
+        for i in range(payload_start + 16, payload_start + 48):
+            blob[i] ^= 0xFF
         ckpt.write_bytes(bytes(blob))
-        # Meta is still readable — the corruption is strictly inside an
-        # array entry, the case whole-file truncation tests can't see.
-        import numpy as np_
-        with np_.load(str(ckpt)) as data:
-            assert "__meta__" in data.files
-            assert str(data["__meta__"][()])  # decodes fine
+        # The header is still readable — the corruption is strictly
+        # inside a column payload, the case whole-file truncation
+        # tests can't see (peek_file_meta is the header-only read the
+        # fencing path uses).
+        _v, meta_peek = frame.peek_file_meta(str(ckpt))
+        assert meta_peek["config"]  # meta decodes fine
         det, meta, corrupt = checkpoint.load_resilient(
             str(tmp_path / "ckpt"), config
         )
         assert det is None and meta is None and corrupt is True
-        assert (tmp_path / "ckpt.npz.corrupt").exists()
+        assert (tmp_path / "ckpt.ckpt.corrupt").exists()
         # And the daemon boot path surfaces it as a counter (the file
         # was already moved aside, so re-create the corruption).
-        (tmp_path / "ckpt.npz.corrupt").rename(ckpt)
+        (tmp_path / "ckpt.ckpt.corrupt").rename(ckpt)
         d2 = DetectorDaemon(config)  # must NOT raise
         try:
             assert d2.pipeline.tensorizer.service_names == []
@@ -322,9 +325,11 @@ class TestCorruptCheckpoint:
         det = AnomalyDetector(DetectorConfig(**SMALL))
         path = str(tmp_path / "snap")
         checkpoint.save(path, det, offsets={0: 5})
-        # Flip bytes INSIDE the zip payload without breaking the
-        # container (the corruption a torn-write check can't see).
-        f = tmp_path / "snap.npz"
+        # Flip bytes mid-file without breaking the structure (the
+        # corruption a torn-write check can't see): the frame's
+        # per-column CRC32C / trailer is what catches it — the role
+        # the retired sha256 sidecar digest used to play.
+        f = tmp_path / ("snap" + checkpoint.SUFFIX)
         blob = bytearray(f.read_bytes())
         mid = len(blob) // 2
         for i in range(mid, mid + 8):
@@ -334,7 +339,7 @@ class TestCorruptCheckpoint:
             path, DetectorConfig(**SMALL)
         )
         assert det2 is None and meta2 is None and corrupt is True
-        assert (tmp_path / "snap.npz.corrupt").exists()
+        assert (tmp_path / ("snap" + checkpoint.SUFFIX + ".corrupt")).exists()
 
     def test_config_mismatch_still_refuses(self, tmp_path):
         from opentelemetry_demo_tpu.models import AnomalyDetector

@@ -1,0 +1,774 @@
+"""The ONE verified columnar frame (runtime.frame) — format proofs and
+the corruption chaos drills.
+
+The acceptance bars this suite proves (ISSUE 6):
+
+- **Exhaustive detection** (``test_every_single_bit_flip_is_caught``):
+  EVERY single-bit flip of a frame — header, payload, trailer, v1 or
+  v2 — fails verification. Not sampled: all of them.
+- **Replication chaos**
+  (``test_corrupt_link_quarantines_and_converges``): a faultwire
+  ``corrupt``-mode link between primary and standby flips bits at a
+  seeded rate; every bad frame is counted + quarantined (never
+  merged), the session survives, and once the link heals the deprived
+  standby converges BIT-EXACT to an uncorrupted witness replica.
+- **Role stability** (``test_daemon_roles_stable_under_corrupt_link``):
+  corrupt frames still feed the standby's liveness watchdog and a
+  corrupt ACK can never fence the primary (the envelope CRC) — no
+  FENCED/role regression while the link is lying.
+- **Checkpoint version skew + quarantine**
+  (``test_checkpoint_v0_npz_migrates``,
+  ``test_truncated_trailer_quarantined``): the pre-frame npz layout
+  restores through the migration shim; a truncated or bit-flipped
+  frame file cold-starts with the file moved aside.
+
+scripts/sanitycheck.py pins the named tests above so the proofs can't
+silently disappear.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import AnomalyDetector
+from opentelemetry_demo_tpu.models.detector import DetectorConfig
+from opentelemetry_demo_tpu.runtime import checkpoint, frame, native, wire
+from opentelemetry_demo_tpu.runtime.faultwire import FaultWire, corrupt_bytes
+from opentelemetry_demo_tpu.runtime.replication import (
+    DELTA,
+    SNAPSHOT,
+    EnvelopeCorrupt,
+    EpochFence,
+    ReplicationPrimary,
+    ReplicationStandby,
+    decode_frame,
+    encode_frame,
+)
+
+SMALL = dict(num_services=8, hll_p=8, cms_width=512)
+
+needs_native = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native ingest unavailable: {native.load_error()}",
+)
+
+
+def _sample_arrays() -> dict[str, np.ndarray]:
+    return {
+        "hll_bank": np.arange(48, dtype=np.uint8).reshape(2, 24),
+        "cms_bank": (np.arange(16, dtype=np.int64) * 7).reshape(4, 4),
+        "lat_mean": np.linspace(-1, 1, 6).astype(np.float32),
+        "trace_keys": np.arange(5, dtype=np.uint64) << np.uint64(40),
+        "step_idx": np.asarray(9, dtype=np.int32),
+        "empty": np.zeros((0, 3), np.float32),
+    }
+
+
+# --- format units -----------------------------------------------------
+
+
+class TestFrameFormat:
+    def test_round_trip_preserves_dtype_shape_meta(self):
+        arrays = _sample_arrays()
+        meta = {"offsets": {"0": 7}, "epoch": 3, "services": ["a", None]}
+        buf = frame.encode(arrays, meta=meta)
+        assert buf[:4] == frame.FRAME_MAGIC
+        f = frame.decode(buf)
+        assert f.version == frame.FRAME_VERSION
+        assert f.meta == meta
+        for k, v in arrays.items():
+            assert f.arrays[k].dtype == v.dtype, k
+            assert f.arrays[k].shape == v.shape, k
+            np.testing.assert_array_equal(f.arrays[k], v)
+            # Zero-copy: every non-empty column is a view into the
+            # frame buffer, not a fresh allocation.
+            if v.size:
+                assert f.arrays[k].base is not None, k
+
+    def test_every_single_bit_flip_is_caught(self):
+        """The exhaustive corruption proof, both format versions: no
+        single-bit flip anywhere in a frame survives verification."""
+        for version in (1, 2):
+            buf = frame.encode(
+                {"a": np.arange(6, dtype=np.uint16),
+                 "b": np.asarray([1.5], np.float32)},
+                meta={"m": 1}, version=version,
+            )
+            for i in range(len(buf)):
+                for bit in range(8):
+                    bad = bytearray(buf)
+                    bad[i] ^= 1 << bit
+                    with pytest.raises(frame.FrameError):
+                        frame.decode(bytes(bad))
+
+    def test_truncation_at_every_length_is_caught(self):
+        buf = frame.encode({"a": np.arange(32, dtype=np.uint32)})
+        for n in range(len(buf)):
+            with pytest.raises(frame.FrameError):
+                frame.decode(buf[:n])
+
+    def test_v1_shim_and_future_version_refused(self):
+        arrays = _sample_arrays()
+        v1 = frame.encode(arrays, meta={"epoch": 2}, version=1)
+        f = frame.decode(v1)  # the v(N) reader accepts v(N-1)
+        assert f.version == 1 and f.meta["epoch"] == 2
+        np.testing.assert_array_equal(f.arrays["cms_bank"], arrays["cms_bank"])
+        # A future version with an INTACT trailer is a version error
+        # (upgrade order); the trailer must be recomputed because a
+        # version field that disagrees with the trailer is corruption
+        # (the bit-flip disambiguation), not skew.
+        import struct as _struct
+
+        future = bytearray(frame.encode(arrays))
+        future[4:6] = int(frame.FRAME_VERSION + 1).to_bytes(2, "little")
+        with pytest.raises(frame.FrameCorrupt):
+            frame.decode(bytes(future))  # trailer says: flipped bits
+        future[-4:] = _struct.pack("<I", frame.crc32c(bytes(future[:-4])))
+        with pytest.raises(frame.FrameVersionError):
+            frame.decode(bytes(future))
+        # And the writer refuses to emit outside the window at all.
+        with pytest.raises(ValueError):
+            frame.encode(arrays, version=frame.FRAME_VERSION + 1)
+        with pytest.raises(ValueError):
+            frame.configure(write_version=frame.FRAME_VERSION + 1)
+
+    def test_knob_window_matches_module_constants(self, monkeypatch):
+        """utils.config.FRAME_KNOBS validates the write version with a
+        LITERAL window (sanitycheck reads it via AST) — this pins the
+        literals to the module constants so they can't drift."""
+        from opentelemetry_demo_tpu.utils.config import (
+            ConfigError,
+            frame_config,
+        )
+
+        for good in (frame.MIN_READ_VERSION, frame.FRAME_VERSION):
+            monkeypatch.setenv("ANOMALY_FRAME_WRITE_VERSION", str(good))
+            assert frame_config()["ANOMALY_FRAME_WRITE_VERSION"] == good
+        for bad in (frame.MIN_READ_VERSION - 1, frame.FRAME_VERSION + 1):
+            monkeypatch.setenv("ANOMALY_FRAME_WRITE_VERSION", str(bad))
+            with pytest.raises(ConfigError):
+                frame_config()
+
+    def test_schema_profile_pinned(self):
+        """decode_spans refuses a frame whose column table is not the
+        ingest span profile — a wrong-profile frame is a protocol bug,
+        caught before any rows reach the tensorizer."""
+        wrong = frame.encode({"duration_us": np.zeros(3, np.float32)})
+        with pytest.raises(frame.FrameError):
+            frame.decode_spans(wrong)
+
+    def test_peek_file_meta_reads_header_only(self, tmp_path):
+        arrays = _sample_arrays()
+        p = tmp_path / "x.ckpt"
+        p.write_bytes(frame.encode(arrays, meta={"epoch": 5}))
+        version, meta = frame.peek_file_meta(str(p))
+        assert version == frame.FRAME_VERSION and meta["epoch"] == 5
+        # Peek succeeds even when the PAYLOAD is corrupt (fencing wants
+        # cheap evidence; full verification is the loader's job)…
+        blob = bytearray(p.read_bytes())
+        blob[-12] ^= 0xFF
+        p.write_bytes(bytes(blob))
+        assert frame.peek_file_meta(str(p))[1]["epoch"] == 5
+        # …but a truncated header is an error, not a guess.
+        p.write_bytes(blob[:10])
+        with pytest.raises(frame.FrameError):
+            frame.peek_file_meta(str(p))
+
+    def test_npz_v0_shim_sniffed(self):
+        arrays = {"cms_bank": np.arange(12, dtype=np.int32)}
+        blob = frame.write_npz(arrays)
+        assert frame.sniff(blob) == "npz"
+        out = frame.decode_arrays(blob)
+        np.testing.assert_array_equal(out["cms_bank"], arrays["cms_bank"])
+        with pytest.raises(frame.FrameCorrupt):
+            frame.decode_arrays(b"\x00garbage")
+
+    def test_quarantine_writes_evidence(self, tmp_path):
+        buf = frame.encode({"a": np.zeros(4, np.uint8)})
+        path = frame.quarantine(buf, "testhop", directory=str(tmp_path))
+        assert path is not None and os.path.exists(path)
+        assert open(path, "rb").read() == buf
+        assert "testhop" in os.path.basename(path)
+        # No directory configured → count-and-drop (None), not a crash.
+        assert frame.quarantine(buf, "testhop", directory=None) is None
+
+
+# --- deterministic bit-flip injector ----------------------------------
+
+
+class TestCorruptBytes:
+    def test_deterministic_and_offset_respected(self):
+        data = bytes(range(256)) * 8
+        a, na = corrupt_bytes(data, seed=3, rate=0.05)
+        b, nb = corrupt_bytes(data, seed=3, rate=0.05)
+        assert a == b and na == nb > 0  # same seed → same plan
+        c, _ = corrupt_bytes(data, seed=4, rate=0.05)
+        assert c != a  # different seed → different plan
+        # Chunking does not change the plan: positions are absolute.
+        half = len(data) // 2
+        d1, _ = corrupt_bytes(data[:half], seed=3, rate=0.05, start=0)
+        d2, _ = corrupt_bytes(data[half:], seed=3, rate=0.05, start=half)
+        assert d1 + d2 == a
+        # offset spares the prefix.
+        e, _ = corrupt_bytes(data, seed=3, rate=1.0, offset=100)
+        assert e[:100] == data[:100] and e[100:] != data[100:]
+        assert corrupt_bytes(data, seed=3, rate=0.0)[0] == data
+
+
+# --- the ingest hop ---------------------------------------------------
+
+
+@needs_native
+class TestIngestHopCorruption:
+    def _payload(self):
+        span = (
+            wire.encode_len(1, b"\x11" * 16)
+            + wire.encode_len(5, b"op")
+            + wire.encode_fixed64(7, 1_000)
+            + wire.encode_fixed64(8, 5_000)
+        )
+        kv = wire.encode_len(1, b"service.name") + wire.encode_len(
+            2, wire.encode_len(1, b"checkout")
+        )
+        rs = (
+            wire.encode_len(1, wire.encode_len(1, kv))  # resource
+            + wire.encode_len(2, wire.encode_len(2, span))  # scope spans
+        )
+        return wire.encode_len(1, rs)
+
+    def test_scratch_frame_corruption_quarantined_pool_survives(
+        self, tmp_path
+    ):
+        """A frame that fails verification between scratch and pipeline
+        (the recycled-buffer race shape, injected by corrupting the
+        encoder's output) is counted + quarantined, the flush dies as a
+        SERVER fault, nothing reaches the pipeline, and the next flush
+        proceeds normally."""
+        from opentelemetry_demo_tpu.runtime import ingest_pool as ip_mod
+        from opentelemetry_demo_tpu.runtime.ingest_pool import (
+            IngestPool,
+            IngestWorkerError,
+        )
+        from opentelemetry_demo_tpu.runtime.tensorize import SpanTensorizer
+
+        payload = self._payload()
+        got = []
+        pool = IngestPool(
+            got.append, SpanTensorizer(num_services=8), workers=1
+        )
+        orig = frame.encode_spans
+
+        def corrupting(cols, version=None):
+            out = bytearray(orig(cols, version))
+            out[-8] ^= 0x20  # flip one payload bit; trailer now lies
+            return bytes(out)
+
+        frame.configure(quarantine_dir=str(tmp_path))
+        ip_mod.frame.encode_spans = corrupting
+        try:
+            ticket = pool.submit(payload)
+            with pytest.raises(IngestWorkerError) as exc:
+                ticket.result()
+            assert "frame" in str(exc.value).lower()
+            assert pool.stats()["frames_corrupt"] == 1
+            assert got == []  # the sketches never saw the bad rows
+            evidence = [
+                f for f in os.listdir(tmp_path) if f.startswith("ingest-")
+            ]
+            assert evidence, "corrupt frame not quarantined to disk"
+        finally:
+            ip_mod.frame.encode_spans = orig
+            frame.configure(quarantine_dir="")  # "" → back to None
+        # Clean flush afterwards: the worker survived the bad frame.
+        pool.submit(payload).result()
+        assert pool.drain() and len(got) == 1 and got[0].rows == 1
+        pool.close()
+
+
+# --- the replication hop ----------------------------------------------
+
+
+def _repl_state() -> dict[str, np.ndarray]:
+    return {
+        "hll_bank": np.zeros((8, 256), np.uint8),
+        "cms_bank": np.zeros((4, 256), np.int64),
+        "lat_mean": np.zeros(8, np.float32),
+    }
+
+
+def _mutate(state: dict, rng: np.random.Generator) -> None:
+    """Monoid-lawful evolution: HLL registers only ever rise (max),
+    CMS only ever accumulates (add), the latest block free-changes."""
+    hll = state["hll_bank"]
+    idx = rng.integers(0, hll.size, 32)
+    flat = hll.reshape(-1)
+    flat[idx] = np.maximum(flat[idx], rng.integers(1, 32, 32))
+    state["cms_bank"] += rng.integers(0, 3, state["cms_bank"].shape)
+    state["lat_mean"] = rng.normal(0, 1, 8).astype(np.float32)
+
+
+@pytest.mark.chaos
+class TestReplicationCorruption:
+    def test_envelope_crc_skips_frame_without_killing_session(self):
+        body = encode_frame(SNAPSHOT, epoch=4, seq=9)[4:]
+        ok = decode_frame(body)
+        assert (ok["type"], ok["epoch"], ok["seq"]) == (SNAPSHOT, 4, 9)
+        crc_field = 9  # 1 tag byte + 8 value bytes, always trailing
+        for i in range(len(body)):
+            for bit in range(8):
+                bad = bytearray(body)
+                bad[i] ^= 1 << bit
+                # Any flip in the PROTECTED region (every byte before
+                # the CRC field) must surface as EnvelopeCorrupt — the
+                # skip-one-frame semantics. A flip inside the CRC
+                # field itself either raises too, or — when only the
+                # CRC's own tag byte was damaged — decodes to EXACTLY
+                # the original fields: either way a lying field is
+                # never acted on.
+                if i < len(body) - crc_field:
+                    with pytest.raises(EnvelopeCorrupt):
+                        decode_frame(bytes(bad))
+                else:
+                    try:
+                        out = decode_frame(bytes(bad))
+                    except (EnvelopeCorrupt, ValueError):
+                        continue
+                    assert out == ok, (i, bit, out)
+
+    def test_legacy_envelope_with_coincidental_crc_tag_byte_accepted(self):
+        """Rolling-upgrade shim: a pre-CRC peer's envelope whose
+        9th-from-last byte happens to equal the CRC field's tag (an
+        ASCII '9' in its meta JSON here) must NOT be dropped as
+        corrupt — positional sniffing alone would refuse the same
+        legacy HELLO on every reconnect, forever."""
+        body = (
+            wire.encode_int(1, SNAPSHOT) + wire.encode_int(2, 4)
+            + wire.encode_int(3, 7)
+            # JSON tail '9999999"}' puts 0x39 exactly 9 bytes from
+            # the end — the false-positive shape.
+            + wire.encode_len(6, json.dumps({"s": "9999999"}).encode())
+        )
+        assert body[-9] == 0x39 and wire.encode_tag(7, 1)[0] == 0x39
+        out = decode_frame(body)
+        assert (out["type"], out["epoch"], out["seq"]) == (SNAPSHOT, 4, 7)
+        assert out["meta"] == {"s": "9999999"}
+
+    def test_corrupt_payload_with_valid_envelope_not_merged(self):
+        """Defense in depth: even a body whose ENVELOPE checks out but
+        whose columnar payload is corrupt (hop-internal rot) is caught
+        by the frame's own checksums at apply time — counted, state
+        untouched, applied_seq unchanged (the ACK-as-NACK)."""
+        st = ReplicationStandby("127.0.0.1:1", EpochFence())
+        snap = decode_frame(
+            encode_frame(SNAPSHOT, 0, seq=1, arrays=_repl_state())[4:]
+        )
+        st._apply_snapshot(snap)
+        assert st.applied_seq == 1 and st.snapshots_applied == 1
+        # Hand-assemble a DELTA whose envelope CRC is VALID over a
+        # corrupted inner frame.
+        inner = bytearray(frame.encode({"cms_bank": np.ones((4, 256), np.int64)}))
+        inner[len(inner) // 2] ^= 0x40
+        body = (
+            wire.encode_int(1, DELTA) + wire.encode_int(2, 0)
+            + wire.encode_int(3, 2) + wire.encode_int(4, 1)
+            + wire.encode_len(5, bytes(inner))
+            + wire.encode_len(6, json.dumps({}).encode())
+        )
+        body += wire.encode_fixed64(7, frame.crc32c(body))
+        fr = decode_frame(body)
+        st._apply_delta(fr)
+        assert st.frames_corrupt == 1
+        assert st.applied_seq == 1  # NACK by unchanged position
+        assert (st.arrays["cms_bank"] == 0).all()  # never merged
+        # The legacy npz payload ("v0") still applies — rolling-upgrade
+        # shim: an un-upgraded primary's deltas are not refused.
+        legacy_body = (
+            wire.encode_int(1, DELTA) + wire.encode_int(2, 0)
+            + wire.encode_int(3, 2) + wire.encode_int(4, 1)
+            + wire.encode_len(5, frame.write_npz(
+                {"cms_bank": np.ones((4, 256), np.int64),
+                 "hll_bank": np.zeros((8, 256), np.uint8),
+                 "lat_mean": np.zeros(8, np.float32)}, compressed=False,
+            ))
+            + wire.encode_len(6, json.dumps({}).encode())
+        )
+        legacy_body += wire.encode_fixed64(7, frame.crc32c(legacy_body))
+        st._apply_delta(decode_frame(legacy_body))
+        assert st.applied_seq == 2
+        assert (st.arrays["cms_bank"] == 1).all()
+
+    def test_corrupt_link_quarantines_and_converges(self, tmp_path):
+        """THE replication chaos drill: a corrupt-mode faultwire link
+        flips bits while the primary's state evolves. Corrupt frames
+        are counted + quarantined (never merged) and the session
+        survives them; after the link heals, the victim standby is
+        BIT-EXACT against both the primary and an uncorrupted witness
+        replica — corruption cost retransmits, never correctness."""
+        state = _repl_state()
+        rng = np.random.default_rng(11)
+        lock = threading.Lock()
+
+        def snapshot_fn():
+            with lock:
+                return (
+                    {k: v.copy() for k, v in state.items()},
+                    {"offsets": {"0": 0}, "config": None},
+                )
+
+        primary = ReplicationPrimary(
+            snapshot_fn, EpochFence(), interval_s=0.05
+        )
+        primary.start()
+        proxy = FaultWire("127.0.0.1", primary.port)
+        proxy.corrupt_seed = 1234
+        proxy.corrupt_rate = 3e-5
+        proxy.start()
+        victim = ReplicationStandby(
+            f"127.0.0.1:{proxy.port}", EpochFence(),
+            silence_reconnect_s=1.0,
+        )
+        victim.RECONNECT_BACKOFF_S = 0.1
+        witness = ReplicationStandby(
+            f"127.0.0.1:{primary.port}", EpochFence()
+        )
+        frame.configure(quarantine_dir=str(tmp_path))
+        try:
+            victim.start()
+            witness.start()
+            assert witness.wait_for_state(10.0)
+            # Evolve the state through the lying link until corruption
+            # has provably been caught at least a few times.
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with lock:
+                    _mutate(state, rng)
+                if (
+                    victim.frames_corrupt >= 3
+                    and proxy.bytes_corrupted >= 3
+                ):
+                    break
+                time.sleep(0.05)
+            assert victim.frames_corrupt >= 3, (
+                victim.frames_corrupt, proxy.bytes_corrupted,
+            )
+            # No fencing side effects from garbage: the victim never
+            # learned a bogus epoch (envelope CRC) and never merged a
+            # bad frame (frame checksums).
+            assert victim.fence.epoch == 0
+            assert victim.fenced_sent == 0
+            # Heal; freeze the state; everyone must converge exactly.
+            proxy.clear()
+            with lock:
+                final = {k: v.copy() for k, v in state.items()}
+
+            def converged(st):
+                arrs, _ = st.snapshot()
+                return arrs and all(
+                    np.array_equal(arrs[k], final[k]) for k in final
+                )
+
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if converged(victim) and converged(witness):
+                    break
+                time.sleep(0.05)
+            assert converged(witness), "witness failed to converge"
+            assert converged(victim), (
+                "victim not bit-exact after heal: corruption leaked"
+            )
+            varr, _ = victim.snapshot()
+            warr, _ = witness.snapshot()
+            for key in final:
+                np.testing.assert_array_equal(varr[key], warr[key])
+        finally:
+            frame.configure(quarantine_dir="")  # "" → back to None
+            victim.stop()
+            witness.stop()
+            proxy.stop()
+            primary.stop()
+
+
+# --- daemon-level role stability --------------------------------------
+
+
+def _daemon_env(monkeypatch, tmp_path, name, **extra):
+    monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+    monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "-1")
+    monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+    monkeypatch.setenv("ANOMALY_BATCH", "256")
+    # No width-ladder warmup: its background compile threads outlive
+    # the in-proc daemons and would CPU-starve whichever timing-
+    # sensitive suite runs next (adaptive batching is irrelevant to
+    # the corruption properties this class proves).
+    monkeypatch.setenv("ANOMALY_ADAPTIVE_BATCH", "0")
+    monkeypatch.setenv("ANOMALY_CHECKPOINT", str(tmp_path / name))
+    monkeypatch.delenv("KAFKA_ADDR", raising=False)
+    for knob in (
+        "ANOMALY_ROLE", "ANOMALY_REPLICATION_PORT",
+        "ANOMALY_REPLICATION_TARGET", "ANOMALY_REPLICATION_INTERVAL_S",
+        "ANOMALY_FAILOVER_TIMEOUT_S", "ANOMALY_PRIMARY_HEALTH_ADDR",
+        "ANOMALY_FRAME_VERIFY", "ANOMALY_FRAME_WRITE_VERSION",
+        "ANOMALY_FRAME_QUARANTINE_DIR",
+    ):
+        monkeypatch.delenv(knob, raising=False)
+    for k, v in extra.items():
+        monkeypatch.setenv(k, v)
+
+
+def _scrape(daemon) -> str:
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", daemon.exporter.port, timeout=5.0
+    )
+    conn.request("GET", "/metrics")
+    return conn.getresponse().read().decode()
+
+
+@pytest.mark.chaos
+class TestDaemonRolesUnderCorruption:
+    def test_daemon_roles_stable_under_corrupt_link(
+        self, monkeypatch, tmp_path
+    ):
+        """No FENCED/role regression while the replication link lies:
+        the standby keeps role=standby past several failover timeouts
+        (corrupt frames feed its liveness watchdog), the primary stays
+        primary (a corrupt ACK cannot teach it a bogus epoch), the
+        corrupt counter moves on /metrics — and after the link heals
+        the standby's mirror converges to the primary's state."""
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+        from opentelemetry_demo_tpu.runtime.replication import (
+            ROLE_PRIMARY,
+            ROLE_STANDBY,
+        )
+
+        _daemon_env(
+            monkeypatch, tmp_path, "prim",
+            ANOMALY_ROLE="primary",
+            ANOMALY_REPLICATION_PORT="0",
+            ANOMALY_REPLICATION_INTERVAL_S="0.1",
+        )
+        primary = DetectorDaemon(DetectorConfig(**SMALL))
+        primary.start()
+        proxy = None
+        standby = None
+        try:
+            proxy = FaultWire("127.0.0.1", primary.repl_primary.port)
+            proxy.corrupt_seed = 99
+            proxy.corrupt_rate = 2e-5
+            proxy.start()
+            _daemon_env(
+                monkeypatch, tmp_path, "sb",
+                ANOMALY_ROLE="standby",
+                ANOMALY_REPLICATION_TARGET=f"127.0.0.1:{proxy.port}",
+                ANOMALY_REPLICATION_INTERVAL_S="0.1",
+                # Generous vs. the reconnect backoff (a flip that hits
+                # the length prefix kills the session for ~0.5 s) but
+                # the 12 s run still spans FOUR timeouts — a watchdog
+                # starved by corrupt-but-arriving frames would fire.
+                ANOMALY_FAILOVER_TIMEOUT_S="3.0",
+            )
+            standby = DetectorDaemon(DetectorConfig(**SMALL))
+            standby.start()
+            # Run well past several failover timeouts with the link
+            # lying the whole time; both daemons must hold their roles.
+            deadline = time.monotonic() + 12.0
+            corrupt_seen = 0
+            while time.monotonic() < deadline:
+                primary.step(0.0)
+                standby.step(0.0)
+                assert standby.role == ROLE_STANDBY, "standby promoted!"
+                assert primary.role == ROLE_PRIMARY, "primary fenced!"
+                corrupt_seen = standby.repl_standby.frames_corrupt
+                if corrupt_seen >= 2 and standby.repl_standby.applied_seq >= 0:
+                    break
+                time.sleep(0.05)
+            assert corrupt_seen >= 2, (
+                corrupt_seen, proxy.bytes_corrupted,
+            )
+            standby.step(0.0)
+            text = _scrape(standby)
+            assert 'anomaly_frame_corrupt_total{hop="replication"}' in text
+            line = [
+                ln for ln in text.splitlines()
+                if ln.startswith(
+                    'anomaly_frame_corrupt_total{hop="replication"}'
+                )
+            ][0]
+            assert float(line.rsplit(" ", 1)[1]) >= 2.0
+            assert 'anomaly_frame_version 2.0' in text
+            # Heal → the standby mirror converges to the primary state.
+            proxy.clear()
+            want = {
+                k: np.asarray(v)
+                for k, v in primary.detector.state._asdict().items()
+            }
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                standby.step(0.0)
+                arrs, _ = standby.repl_standby.snapshot()
+                if arrs and all(
+                    np.array_equal(arrs[k], want[k]) for k in want
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("standby mirror never converged")
+            assert standby.role == ROLE_STANDBY
+            assert primary.role == ROLE_PRIMARY
+        finally:
+            if standby is not None:
+                standby.shutdown()
+            if proxy is not None:
+                proxy.stop()
+            primary.shutdown()
+
+
+# --- the checkpoint hop -----------------------------------------------
+
+
+class TestCheckpointSkew:
+    def _detector(self):
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        return det
+
+    def test_checkpoint_v0_npz_migrates(self, tmp_path):
+        """A snapshot written by the pre-frame layout (npz + __meta__ +
+        sha256 digest — byte-faithful to the old writer) restores
+        through the migration shim, and the NEXT save rewrites it as a
+        frame and retires the legacy file."""
+        det = self._detector()
+        path = str(tmp_path / "v0")
+        arrays = {
+            k: np.asarray(v) for k, v in det.state._asdict().items()
+        }
+        meta = {
+            "offsets": {"0": 44},
+            "service_names": ["cart"],
+            "config": list(det.config._replace(sketch_impl=None)),
+            "clock_t_prev": 123.0,
+            "epoch": 2,
+        }
+        meta_json = json.dumps(meta)
+        digest = checkpoint._content_digest(arrays, meta_json)
+        with open(path + ".npz", "wb") as f:
+            f.write(frame.write_npz({
+                "__meta__": np.asarray(meta_json),
+                "__digest__": np.asarray(digest),
+                **arrays,
+            }))
+        assert checkpoint.exists(path)
+        assert checkpoint.peek_epoch(path) == 2
+        det2, meta2, corrupt = checkpoint.load_resilient(
+            path, DetectorConfig(**SMALL)
+        )
+        assert not corrupt and det2 is not None
+        assert meta2["offsets"] == {"0": 44}
+        np.testing.assert_array_equal(
+            np.asarray(det2.state.hll_bank), arrays["hll_bank"]
+        )
+        # Roll forward: the next save writes the frame layout and
+        # retires the npz (one snapshot, one format, going forward).
+        checkpoint.save(path, det2, offsets={0: 45}, epoch=2)
+        assert os.path.exists(path + checkpoint.SUFFIX)
+        assert not os.path.exists(path + ".npz")
+        assert checkpoint.peek_epoch(path) == 2
+        _det3, meta3 = checkpoint.load(path, DetectorConfig(**SMALL))
+        assert meta3["offsets"] == {"0": 45}
+
+    def test_truncated_trailer_quarantined(self, tmp_path):
+        """A frame file missing its tail (torn write) cold-starts with
+        the evidence moved aside — never a boot crash, never a partial
+        restore."""
+        det = self._detector()
+        path = str(tmp_path / "t")
+        checkpoint.save(path, det, offsets={0: 3})
+        file = path + checkpoint.SUFFIX
+        blob = open(file, "rb").read()
+        open(file, "wb").write(blob[:-3])  # lose part of the trailer
+        det2, meta2, corrupt = checkpoint.load_resilient(
+            path, DetectorConfig(**SMALL)
+        )
+        assert det2 is None and meta2 is None and corrupt is True
+        assert os.path.exists(file + ".corrupt")
+        assert not checkpoint.exists(path)
+
+    def test_faultwire_corrupt_mode_on_checkpoint_file(self, tmp_path):
+        """The at-rest half of the chaos bar: the SAME seeded bit-flip
+        plan the proxy uses, applied to a checkpoint file, is caught by
+        the frame checksums and quarantined — cold start, file aside,
+        no crash, nothing restored from lying bytes."""
+        det = self._detector()
+        path = str(tmp_path / "rot")
+        checkpoint.save(path, det, offsets={0: 8})
+        file = path + checkpoint.SUFFIX
+        blob = open(file, "rb").read()
+        flipped, n = corrupt_bytes(blob, seed=7, rate=1e-4)
+        assert n > 0  # the plan actually flipped something
+        open(file, "wb").write(flipped)
+        det2, meta2, corrupt = checkpoint.load_resilient(
+            path, DetectorConfig(**SMALL)
+        )
+        assert det2 is None and corrupt is True
+        assert os.path.exists(file + ".corrupt")
+
+    def test_version_field_bit_flip_quarantined_not_boot_crash(
+        self, tmp_path
+    ):
+        """A bit flip in the VERSION field must read as corruption
+        (trailer CRC disambiguates), not as a version-window miss —
+        a version error maps to ValueError, which would crash-loop the
+        boot path instead of quarantining + cold-starting."""
+        det = self._detector()
+        path = str(tmp_path / "vflip")
+        checkpoint.save(path, det)
+        file = path + checkpoint.SUFFIX
+        blob = bytearray(open(file, "rb").read())
+        blob[4] ^= 0x04  # version 2 -> 6: outside the window
+        open(file, "wb").write(bytes(blob))
+        with pytest.raises(frame.FrameCorrupt):
+            frame.decode(bytes(blob))
+        det2, meta2, corrupt = checkpoint.load_resilient(
+            path, DetectorConfig(**SMALL)
+        )
+        assert det2 is None and corrupt is True
+        assert os.path.exists(file + ".corrupt")
+        # A GENUINE future version (intact trailer) is the ValueError.
+        good = bytearray(frame.encode({"a": np.zeros(2, np.uint8)}))
+        good[4:6] = int(frame.FRAME_VERSION + 1).to_bytes(2, "little")
+        import struct as _struct
+
+        good[-4:] = _struct.pack("<I", frame.crc32c(bytes(good[:-4])))
+        with pytest.raises(frame.FrameVersionError):
+            frame.decode(bytes(good))
+
+    def test_v0_corruption_still_quarantined(self, tmp_path):
+        """The legacy shim keeps the legacy protections: a corrupt v0
+        container cold-starts + quarantines, same as a corrupt frame."""
+        path = str(tmp_path / "v0rot")
+        open(path + ".npz", "wb").write(b"PK\x03\x04 torn beyond repair")
+        det2, meta2, corrupt = checkpoint.load_resilient(
+            path, DetectorConfig(**SMALL)
+        )
+        assert det2 is None and corrupt is True
+        assert os.path.exists(path + ".npz.corrupt")
+
+    def test_rollback_window_write_version_one(self, tmp_path):
+        """ANOMALY_FRAME_WRITE_VERSION=1: the process writes v1 frames
+        (the rolling-upgrade escape hatch) and reads them back fine."""
+        det = self._detector()
+        path = str(tmp_path / "v1")
+        frame.configure(write_version=1)
+        try:
+            checkpoint.save(path, det, offsets={0: 1})
+        finally:
+            frame.configure(write_version=frame.FRAME_VERSION)
+        blob = open(path + checkpoint.SUFFIX, "rb").read()
+        assert frame.decode(blob).version == 1
+        _det2, meta2 = checkpoint.load(path, DetectorConfig(**SMALL))
+        assert meta2["offsets"] == {"0": 1}

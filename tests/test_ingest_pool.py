@@ -271,7 +271,9 @@ class TestScratchPool:
     def test_scratch_reuse_no_aliasing(self):
         # Two sequential flushes reuse the SAME pooled scratch; the
         # first flush's columns must be untouched by the second decode
-        # — the copy-out contract of columns_from_columnar(copy=True).
+        # — the copy-out contract, now enforced by the frame boundary
+        # (encode_spans CRCs the scratch views and copies the bytes
+        # into a self-owned buffer before the scratch is released).
         tz = SpanTensorizer(num_services=32)
         got: list[SpanColumns] = []
         pool = IngestPool(got.append, tz, workers=1)

@@ -399,3 +399,90 @@ class TestCrc32:
     def test_matches_zlib(self):
         for s in (b"", b"P-7", b"abcdefgh" * 100, bytes(range(256))):
             assert native.crc32(s) == zlib.crc32(s)
+
+
+class TestCrc32c:
+    def test_known_answer_and_python_parity(self):
+        """CRC-32C (the frame checksum): the RFC 3720 check value, and
+        bit-parity between the native slicing-by-8 kernel and frame.py's
+        portable fallback — a primary with a compiler and a standby
+        without one MUST agree on every checksum."""
+        from opentelemetry_demo_tpu.runtime import frame
+
+        assert native.crc32c(b"123456789") == 0xE3069283
+        assert frame._py_crc32c(b"123456789") == 0xE3069283
+        rng = np.random.default_rng(7)
+        for n in (0, 1, 7, 8, 9, 63, 64, 1000):
+            data = rng.integers(0, 256, n, dtype=np.uint8)
+            assert native.crc32c(data) == frame._py_crc32c(data.tobytes())
+        # Running-seed composition (how the trailer could be streamed).
+        a, b = b"abcdefgh", b"ijklm"
+        assert native.crc32c(b, native.crc32c(a)) == native.crc32c(a + b)
+
+
+@pytest.mark.fuzz
+class TestDecodeFuzz:
+    """Satellite: deterministic seeded byte-mutation fuzz. A mutated
+    OTLP payload through the batched native decoder must NEVER crash
+    the worker — every payload gets either a clean per-payload -1
+    verdict (the receivers' 400) or a successful parse, and a valid
+    batchmate always survives. Seeds are fixed: any failure reproduces
+    byte-for-byte."""
+
+    SEEDS = range(40)
+
+    def _base_payloads(self):
+        spans = [
+            _span(bytes([i + 1]) * 16, 1_000, 5_000 + i * 997,
+                  attrs=[("app.product.id", f"P{i}")], err=bool(i % 2))
+            for i in range(6)
+        ]
+        return [
+            _rs("checkout", spans),
+            _rs("cart", spans[:2]) + _rs("frontend", spans[2:4]),
+            _rs("", spans[:1], with_resource=False),
+        ]
+
+    def test_seeded_mutations_clean_verdict_or_parse(self):
+        from opentelemetry_demo_tpu.runtime.faultwire import corrupt_bytes
+
+        bases = self._base_payloads()
+        witness = bases[0]  # rides UNMUTATED in every batch
+        for seed in self.SEEDS:
+            rate = 0.002 + (seed % 8) * 0.01  # light nicks → heavy damage
+            batch = [
+                corrupt_bytes(p, seed=seed, rate=rate)[0] for p in bases
+            ]
+            cols, rows = native.decode_otlp_many(
+                batch + [witness], MONITORED_ATTR_KEYS
+            )
+            assert rows.shape[0] == len(batch) + 1
+            # Every verdict is clean: parsed (>=0) or rejected (-1);
+            # the decoder never wrote more rows than it reported.
+            assert all(int(r) >= -1 for r in rows), (seed, rows)
+            assert cols.duration_us.shape[0] == sum(
+                int(r) for r in rows if r > 0
+            )
+            # The valid batchmate is never poisoned by its neighbors.
+            assert int(rows[-1]) == 6, (seed, rows)
+            # And whatever parsed feeds the tensorizer without fault.
+            tz = SpanTensorizer(num_services=16)
+            out = tz.columns_from_columnar(cols, copy=True)
+            assert out.rows == cols.duration_us.shape[0]
+
+    def test_python_decoder_same_contract(self):
+        """The no-compiler fallback path (otlp.decode_export_request)
+        under the same corpus: parse or ValueError, never a crash —
+        the serial receivers' 400 contract."""
+        from opentelemetry_demo_tpu.runtime.faultwire import corrupt_bytes
+
+        for seed in self.SEEDS:
+            for p in self._base_payloads():
+                mutated = corrupt_bytes(p, seed=seed, rate=0.01)[0]
+                try:
+                    records = decode_export_request(mutated)
+                except ValueError:
+                    continue  # the clean 400 verdict
+                for r in records:
+                    assert isinstance(r.service, str)
+                    float(r.duration_us)

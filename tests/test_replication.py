@@ -60,6 +60,7 @@ from opentelemetry_demo_tpu.runtime.replication import (
     EpochFence,
     ReplicationPrimary,
     ReplicationStandby,
+    decode_arrays,
     decode_frame,
     encode_frame,
 )
@@ -102,12 +103,16 @@ class TestEpochFence:
         assert frame["type"] == DELTA
         assert frame["epoch"] == 7
         assert (frame["seq"], frame["base_seq"]) == (42, 41)
-        assert (frame["arrays"]["cms_bank"] == arrays["cms_bank"]).all()
-        assert frame["arrays"]["lat_mean"].dtype == np.float32
+        # The ARRAYS payload rides as ONE verified columnar frame
+        # (runtime.frame) and stays raw until the apply step verifies
+        # it — decode_arrays is that verify+decode.
+        payload = decode_arrays(frame["arrays"])
+        assert (payload["cms_bank"] == arrays["cms_bank"]).all()
+        assert payload["lat_mean"].dtype == np.float32
         assert frame["meta"] == {"offsets": {"0": 9}, "hll_monotone": False}
         # ACK carries no payload.
         ack = decode_frame(encode_frame(ACK, 7, seq=42)[4:])
-        assert ack["type"] == ACK and ack["arrays"] == {}
+        assert ack["type"] == ACK and ack["arrays"] == b""
 
 
 # --- checkpoint epoch fencing -----------------------------------------

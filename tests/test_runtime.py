@@ -551,8 +551,13 @@ class TestCheckpoint:
         """Config fields appended at the tuple end (the required growth
         direction — DetectorConfig's NOTE) restore from OLDER snapshots
         with their defaults; a mid-tuple insertion would instead shift
-        every later field silently."""
+        every later field silently. The "older snapshot" here is the
+        real deal: the pre-frame npz layout ("v0"), truncated config,
+        no __digest__ entry — so this also exercises the legacy
+        migration shim end to end."""
         import json
+
+        from opentelemetry_demo_tpu.runtime import frame
 
         det = AnomalyDetector(DetectorConfig(num_services=8))
         tz = SpanTensorizer(num_services=8, batch_size=128)
@@ -563,24 +568,23 @@ class TestCheckpoint:
         for b in tz.tensorize(recs):
             det.observe(b, 1000.0)
         path = str(tmp_path / "old")
-        checkpoint.save(path, det)
-        # Rewrite the snapshot as an older version would have written
-        # it: config list truncated before the newest trailing field,
-        # and no __digest__ entry (pre-digest formats verify by the zip
-        # container alone — the loader must accept their absence).
-        with np.load(path + ".npz") as data:
-            arrays = {
-                k: data[k]
-                for k in data.files
-                if k not in ("__meta__", "__digest__")
-            }
-            meta = json.loads(str(data["__meta__"][()]))
-        assert meta["config"][-1] == DetectorConfig().cusum_h_rate
-        meta["config"] = meta["config"][:-1]
+        # Write the snapshot as an older version would have: the v0
+        # npz container, config list truncated before the newest
+        # trailing field, and no __digest__ entry (pre-digest formats
+        # verify by the zip container alone — the loader must accept
+        # their absence).
+        arrays = {k: np.asarray(v) for k, v in det.state._asdict().items()}
+        meta = {
+            "offsets": {},
+            "service_names": ["a"],
+            "config": list(det.config._replace(sketch_impl=None))[:-1],
+            "clock_t_prev": det.clock._t_prev,
+        }
+        assert list(det.config)[-1] == DetectorConfig().cusum_h_rate
         with open(path + ".npz", "wb") as f:
-            np.savez_compressed(
-                f, __meta__=np.asarray(json.dumps(meta)), **arrays
-            )
+            f.write(frame.write_npz(
+                {"__meta__": np.asarray(json.dumps(meta)), **arrays}
+            ))
 
         det2, _ = checkpoint.load(path)
         assert det2.config.cusum_h_rate == DetectorConfig().cusum_h_rate
@@ -590,13 +594,15 @@ class TestCheckpoint:
         assert det3.config.cusum_h_rate == DetectorConfig().cusum_h_rate
 
     def test_snapshot_is_one_file(self, tmp_path):
-        # State and offsets must commit atomically: a single npz, no
-        # sidecar that a crash could leave out of step with the arrays.
+        # State and offsets must commit atomically: a single frame
+        # file, no sidecar that a crash could leave out of step with
+        # the arrays.
         det = AnomalyDetector(DetectorConfig(num_services=8))
         path = str(tmp_path / "ckpt")
         checkpoint.save(path, det, offsets={"0": 7})
-        assert os.path.exists(path + ".npz")
+        assert os.path.exists(path + checkpoint.SUFFIX)
         assert not os.path.exists(path + ".json")
+        assert not os.path.exists(path + checkpoint.LEGACY_SUFFIX)
         _, meta = checkpoint.load(path)
         assert meta["offsets"] == {"0": 7}
 
