@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench replbench gen-k8s gen-proto gen-dashboards build-native check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench replbench querybench gen-k8s gen-proto gen-dashboards build-native check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -40,6 +40,9 @@ ingestbench:    ## host-ingest engines + decode-pool worker sweep (same methodol
 
 replbench:      ## hot-standby failover drill (ONE json line: replication lag p99, failover TTD, exact convergence)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replbench
+
+querybench:     ## live query plane under concurrent ingest (ONE json line: query p99/qps, ingest interference ratio)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.querybench
 
 gen-k8s:        ## regenerate deploy/k8s manifests
 	$(PY) -m opentelemetry_demo_tpu.utils.k8s --out deploy/k8s

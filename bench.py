@@ -253,6 +253,23 @@ def main():
         except Exception:  # noqa: BLE001 — artifact field is optional
             repl = {}
 
+    # ---- live query plane (the read-path tentpole) -------------------
+    # Real HTTP query service hammered beside live ingest in one
+    # process: query_p99_ms is the dashboard-refresh cost over live
+    # sketches, query_qps the sustained read rate, ingest_ratio the
+    # "reads don't degrade the write path" guard (the ingest/lag SLOs
+    # above stay gated independently). {} on failure — additive fields.
+    queryq = {}
+    if os.environ.get("BENCH_QUERY", "1") != "0":
+        from opentelemetry_demo_tpu.runtime.querybench import (
+            measure_query,
+        )
+
+        try:
+            queryq = measure_query()
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            queryq = {}
+
     # ---- north star #2: detection lag through the real pipeline ------
     fetch_rtt_ms = measure_fetch_rtt()
     lag = measure_lag(rng)
@@ -363,6 +380,10 @@ def main():
                     round(ingest_rate / R5_HOST_INGEST_SPANS_PER_SEC, 3)
                     if ingest_rate else None
                 ),
+                "query_p99_ms": queryq.get("query_p99_ms"),
+                "query_p50_ms": queryq.get("query_p50_ms"),
+                "query_qps": queryq.get("query_qps"),
+                "query_ingest_ratio": queryq.get("ingest_ratio"),
                 "failover_ttd_s": repl.get("failover_ttd_s"),
                 "replication_lag_p99_ms": repl.get(
                     "replication_lag_p99_ms"

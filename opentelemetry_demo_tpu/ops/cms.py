@@ -301,3 +301,48 @@ def cms_query(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 def cms_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """CMS union: tables merge by elementwise addition (exact)."""
     return a + b
+
+
+# -- host-side read helpers (the query plane) --------------------------
+#
+# The live query service (runtime.query) answers point queries from a
+# SNAPSHOT of sketch state — host numpy arrays taken under the
+# pipeline's dispatch lock on the primary, or the replication mirror on
+# a read replica (which has no device at all). These numpy twins of
+# cms_indices/cms_query exist so both roles run the IDENTICAL read
+# path: same dtypes, same wrapping arithmetic, bit-identical answers
+# from bit-identical state (the read-replica consistency bar
+# tests/test_query.py asserts).
+
+
+def cms_indices_np(
+    hash_hi: "np.ndarray",
+    hash_lo: "np.ndarray",
+    depth: int = CMS_DEPTH,
+    width: int = CMS_WIDTH,
+) -> "np.ndarray":
+    """Host twin of :func:`cms_indices`: ``int32[depth, B]`` rows via
+    the same Kirsch–Mitzenmacher construction in wrapping uint32."""
+    import numpy as np
+
+    assert width & (width - 1) == 0, "CMS width must be a power of two"
+    hi = hash_hi.astype(np.uint32)
+    lo = hash_lo.astype(np.uint32)
+    rows = []
+    with np.errstate(over="ignore"):
+        for i in range(depth):
+            g = lo + np.uint32(i) * hi
+            rows.append((g & np.uint32(width - 1)).astype(np.int32))
+    return np.stack(rows, axis=0)
+
+
+def cms_query_np(table: "np.ndarray", idx: "np.ndarray") -> "np.ndarray":
+    """Host twin of :func:`cms_query`: min over the D rows of a host
+    table snapshot. ``table[..., D, W]``, ``idx[D, B]`` →
+    ``int32[..., B]``."""
+    import numpy as np
+
+    gathered = np.take_along_axis(
+        table, np.broadcast_to(idx, (*table.shape[:-2], *idx.shape)), axis=-1
+    )
+    return np.min(gathered, axis=-2)

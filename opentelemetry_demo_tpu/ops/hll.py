@@ -118,3 +118,26 @@ def hll_estimate(regs: jnp.ndarray) -> jnp.ndarray:
     lc = m * jnp.log(m / jnp.maximum(zeros, 1.0))
     use_lc = (raw <= 2.5 * m) & (zeros > 0)
     return jnp.where(use_lc, lc, raw)
+
+
+def hll_estimate_np(regs: "object"):
+    """Host twin of :func:`hll_estimate` for the query plane.
+
+    Runs the same estimator (same float32 arithmetic, same
+    linear-counting switch) over a host numpy register snapshot — the
+    pipeline's dispatch-lock snapshot on a primary, the replication
+    mirror on a read replica. Both roles answering a cardinality query
+    through THIS function is what makes their answers bit-identical at
+    the same replicated state (runtime.query's consistency contract)."""
+    import numpy as np
+
+    regs = np.asarray(regs)
+    m = np.float32(regs.shape[-1])
+    regs_f = regs.astype(np.float32)
+    alpha = np.float32(0.7213) / (np.float32(1.0) + np.float32(1.079) / m)
+    inv_sum = np.sum(np.exp2(-regs_f, dtype=np.float32), axis=-1, dtype=np.float32)
+    raw = alpha * m * m / inv_sum
+    zeros = np.sum((regs == 0), axis=-1).astype(np.float32)
+    lc = m * np.log(m / np.maximum(zeros, np.float32(1.0)), dtype=np.float32)
+    use_lc = (raw <= np.float32(2.5) * m) & (zeros > 0)
+    return np.where(use_lc, lc, raw)

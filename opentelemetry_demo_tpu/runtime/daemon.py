@@ -66,6 +66,18 @@ Env contract (all optional, sensible defaults):
   default 5.0), ``ANOMALY_PRIMARY_HEALTH_ADDR`` (optional grpc-health
   double-check before promoting), ``ANOMALY_OFFSET_DEFER_MAX`` (cap on
   the deferred-confirmation offset list, default 64)
+- Live-query-plane knobs (one registry: ``utils.config.QUERY_KNOBS``;
+  engine: ``runtime.query`` — the HTTP/gRPC read API over live sketch
+  state + the Grafana simple-JSON datasource):
+  ``ANOMALY_QUERY_PORT`` (HTTP/JSON + Grafana surface, 0 = ephemeral,
+  -1 disables), ``ANOMALY_QUERY_GRPC_PORT`` (same documents over
+  gRPC, default -1), ``ANOMALY_QUERY_TOPK`` (default k for top-k
+  answers), ``ANOMALY_QUERY_EXEMPLARS`` (per-service exemplar-ring
+  size — trace ids captured at flag time), ``ANOMALY_QUERY_TIMELINE``
+  (snapshot-timeline ring depth), ``ANOMALY_QUERY_READ_REPLICA``
+  (1 = a standby serves queries from its replicated mirror while
+  remaining promotable), ``ANOMALY_QUERY_MAX_STALENESS_S`` (snapshot
+  cache budget; every answer reports its staleness)
 - Verified-frame knobs (one registry: ``utils.config.FRAME_KNOBS``;
   engine: ``runtime.frame`` — the ONE checksummed columnar format that
   ingest scratch→pipeline, replication payloads and checkpoint files
@@ -122,6 +134,7 @@ from ..utils.config import (
     frame_config,
     ingest_config,
     overload_config,
+    query_config,
     replication_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
@@ -208,6 +221,26 @@ class DetectorDaemon:
             )
         self.repl_primary: replication.ReplicationPrimary | None = None
         self.repl_standby: replication.ReplicationStandby | None = None
+
+        # Live query plane (knob registry: utils.config.QUERY_KNOBS;
+        # engine: runtime.query). Parsed before the pipeline below —
+        # the exemplar-ring size is a pipeline constructor knob.
+        try:
+            qk = query_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self._query_port_req = int(qk["ANOMALY_QUERY_PORT"])
+        self._query_grpc_port_req = int(qk["ANOMALY_QUERY_GRPC_PORT"])
+        self._query_topk = int(qk["ANOMALY_QUERY_TOPK"])
+        self._query_exemplars = int(qk["ANOMALY_QUERY_EXEMPLARS"])
+        self._query_candidates = int(qk["ANOMALY_QUERY_CANDIDATES"])
+        self._query_timeline = int(qk["ANOMALY_QUERY_TIMELINE"])
+        self._query_read_replica = bool(
+            int(qk["ANOMALY_QUERY_READ_REPLICA"])
+        )
+        self._query_max_staleness_s = float(
+            qk["ANOMALY_QUERY_MAX_STALENESS_S"]
+        )
 
         flagd_file = os.environ.get("FLAGD_FILE")
         ofrep = os.environ.get("OFREP_URL")
@@ -393,6 +426,29 @@ class DetectorDaemon:
             "Columnar frame format version this process writes "
             "(mixed values across a fleet = rolling upgrade in flight)",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUERY_REQUESTS,
+            "Query-plane requests, by endpoint and HTTP status code",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUERY_LATENCY,
+            "Query-plane request latency (host-side numpy over the "
+            "cached state snapshot)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUERY_STALENESS,
+            "Bound on how old query answers are: snapshot age plus "
+            "replication lag on a read replica",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_EXEMPLARS_CAPTURED,
+            "Exemplar trace ids captured at anomaly-flag time (each "
+            "links a flag to a concrete Jaeger trace)",
+        )
+        self.registry.counter_add(
+            tele_metrics.ANOMALY_EXEMPLARS_CAPTURED, 0.0
+        )
+        self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
         for hop in ("ingest", "replication", "checkpoint"):
@@ -445,6 +501,11 @@ class DetectorDaemon:
             brownout_hold_s=ov["ANOMALY_BROWNOUT_HOLD_S"],
             brownout_max_level=ov["ANOMALY_BROWNOUT_MAX_LEVEL"],
             retry_after_s=ov["ANOMALY_RETRY_AFTER_S"],
+            # Query plane: exemplar trace ids captured at flag time —
+            # every anomaly answer links to a concrete Jaeger trace —
+            # and the recently-seen candidate keys top-k scores.
+            exemplar_ring=self._query_exemplars,
+            hh_candidates=self._query_candidates,
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -628,6 +689,42 @@ class DetectorDaemon:
         # and an unguarded concurrent iteration can raise
         # "dictionary changed size during iteration".
         self._offsets_lock = threading.Lock()
+        # Live query plane (runtime.query): the engine consumes ONLY
+        # the role-dispatched snapshot helper below — live state under
+        # the dispatch lock on a primary, the replication mirror on a
+        # standby — so queries fail over with the role and never race
+        # donated device buffers. Constructed for every role; a plain
+        # standby (read-replica off) starts it only at promotion.
+        self.query_engine = None
+        self.query_service = None
+        self.query_grpc = None
+        self._query_started = False
+        if self._query_port_req >= 0:
+            from .query import QueryEngine, QueryService
+
+            self.query_engine = QueryEngine(
+                snapshot_fn=self._query_snapshot,
+                role_fn=lambda: self.role,
+                epoch_fn=lambda: self._fence.epoch,
+                lag_fn=self._query_lag,
+                max_staleness_s=self._query_max_staleness_s,
+                timeline_depth=self._query_timeline,
+                topk_default=self._query_topk,
+            )
+            self.query_service = QueryService(
+                self.query_engine, registry=self.registry,
+                port=self._query_port_req,
+            )
+            if self._query_grpc_port_req >= 0:
+                try:
+                    from .query import QueryGrpcService
+
+                    self.query_grpc = QueryGrpcService(
+                        self.query_engine, registry=self.registry,
+                        port=self._query_grpc_port_req,
+                    )
+                except ImportError:  # grpcio absent: HTTP leg serves
+                    self.query_grpc = None
         self._stop = threading.Event()
         self._last_ckpt = time.monotonic()
 
@@ -815,20 +912,29 @@ class DetectorDaemon:
         if self.role == ROLE_STANDBY:
             # A standby serves only its metrics/health surface and the
             # replication client; ingest legs come up at promotion.
+            # In read-replica mode it ALSO serves the query API from
+            # the replicated mirror — the standby stops idling and
+            # becomes the read path, while remaining promotable.
             self.exporter.start()
             self._start_replication_standby()
+            if self._query_read_replica:
+                self._start_query_plane()
             return
         if self.role == ROLE_FENCED:
             # Boot-fenced: health/metrics stay observable (that is how
             # the operator finds us), but no ingest, no replication —
             # readiness probes against the (absent) ingest ports fail
             # and the orchestrator keeps traffic on the live primary.
+            # The query plane stays up: reads mutate nothing, and every
+            # answer is labeled role=fenced for the operator to judge.
             self.exporter.start()
+            self._start_query_plane()
             return
         self.receiver.start()
         if self.grpc_receiver is not None:
             self.grpc_receiver.start()
         self.exporter.start()
+        self._start_query_plane()
         self._register_serving_components()
         if self._repl_port >= 0:
             self._start_replication_primary()
@@ -890,8 +996,106 @@ class DetectorDaemon:
             "config": list(
                 self.detector.config._replace(sketch_impl=None)
             ),
+            # Query-plane block (exemplar rings, anomaly events, top-k
+            # candidates — all JSON-able): riding the replication meta
+            # is what lets a read replica answer exemplar/anomaly/top-k
+            # queries bit-identically to the primary.
+            "query": self.pipeline.query_meta(),
         }
         return arrays, meta
+
+    # -- query plane ---------------------------------------------------
+
+    def _query_snapshot(self) -> tuple[dict, dict]:
+        """THE query plane's single state access, role-dispatched:
+        a standby answers from its replicated mirror (so queries work
+        before promotion and fail over WITH the role), everything else
+        from the replication snapshot helper — which copies live state
+        under the pipeline's dispatch lock, the same discipline that
+        keeps replication from racing donated device buffers.
+        runtime/query.py itself never touches detector state
+        (scripts/sanitycheck.py pins that statically)."""
+        if (
+            self.role in (ROLE_STANDBY, ROLE_PROMOTING)
+            and self.repl_standby is not None
+        ):
+            return self.repl_standby.snapshot()
+        return self._replication_snapshot()
+
+    def _query_lag(self) -> float:
+        """The replica half of reported staleness: seconds since the
+        last replication frame on a standby, 0 on a serving role (its
+        snapshot IS the live state at refresh time)."""
+        if (
+            self.role in (ROLE_STANDBY, ROLE_PROMOTING)
+            and self.repl_standby is not None
+        ):
+            return max(self.repl_standby.seconds_since_frame(), 0.0)
+        return 0.0
+
+    def _start_query_plane(self) -> None:
+        """Start + supervise the query listeners (idempotent): called
+        at boot for serving roles and read-replica standbys, and at
+        promotion for a standby that booted with read-replica off."""
+        if self.query_service is None or self._query_started:
+            return
+        self.query_service.start()
+        if self.query_grpc is not None:
+            # The gRPC twin is optional: losing it must not take the
+            # HTTP leg (already bound) down with it, and leaving
+            # _query_started unset here would double-start HTTP on
+            # the next call.
+            try:
+                self.query_grpc.start()
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "query gRPC twin failed to start; HTTP-only"
+                )
+                self.query_grpc = None
+        self._query_started = True
+        if not self._supervisor.registered("query"):
+            self._supervisor.register(
+                "query", base_backoff_s=0.5, max_backoff_s=15.0,
+                probe=lambda: (
+                    self.query_service is None
+                    or self.query_service.alive()
+                ),
+                restart=self._restart_query_service,
+            )
+
+    def _restart_query_service(self) -> None:
+        if self.query_service is None:
+            return
+        from .query import QueryService
+
+        port = self.query_service.port
+        try:
+            self.query_service.stop()
+        except Exception:  # noqa: BLE001 — a dead server may half-stop
+            pass
+        self.query_service = QueryService(
+            self.query_engine, registry=self.registry, port=port
+        )
+        self.query_service.start()
+
+    def _export_query_stats(self) -> None:
+        """Per-step query-plane housekeeping: keep the snapshot cache
+        within its staleness budget even with no queries arriving (the
+        timeline ring accretes from these refreshes), export the
+        staleness gauge and the exemplar-capture counter delta."""
+        self.query_engine.maybe_refresh()
+        staleness = self.query_engine.staleness_s()
+        if staleness != float("inf"):
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_QUERY_STALENESS, staleness
+            )
+        captured = self.pipeline.exemplars_captured
+        delta = captured - self._exemplars_seen
+        if delta > 0:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_EXEMPLARS_CAPTURED, float(delta)
+            )
+            self._exemplars_seen = captured
 
     def _register_replication_component(self) -> None:
         """One supervised 'replication' component for either role: the
@@ -981,6 +1185,8 @@ class DetectorDaemon:
                 time.monotonic() if t_now is None else t_now
             )
             self._export_fence_stats()
+            if self.query_engine is not None and self._query_started:
+                self._export_query_stats()
             self._supervisor.tick()
             return
         # Self-telemetry on a 1 s cadence (the collector's own otelcol_*
@@ -1041,6 +1247,8 @@ class DetectorDaemon:
         if self.ingest_pool is not None:
             self._export_pool_stats()
         self._export_fence_stats()
+        if self.query_engine is not None and self._query_started:
+            self._export_query_stats()
         if self.repl_primary is not None:
             self._export_replication_stats()
         if self._orders is not None:
@@ -1150,10 +1358,13 @@ class DetectorDaemon:
         )
 
     def _standby_step(self) -> None:
-        """One standby housekeeping tick: watchdog + metrics. No
-        ingest, no Kafka, no checkpoints — the standby's only job is
-        staying current and noticing the primary die."""
+        """One standby housekeeping tick: watchdog + metrics (and, in
+        read-replica mode, the query snapshot cache). No ingest, no
+        Kafka, no checkpoints — beyond serving reads, the standby's
+        job is staying current and noticing the primary die."""
         self._export_fence_stats()
+        if self.query_engine is not None and self._query_started:
+            self._export_query_stats()
         st = self.repl_standby
         if st is not None:
             quiet_s = st.seconds_since_frame()
@@ -1245,6 +1456,15 @@ class DetectorDaemon:
                     int(p): int(o)
                     for p, o in (meta.get("offsets") or {}).items()
                 }
+                # Query-plane continuity: once role==PRIMARY the
+                # engine reads the LIVE pipeline, whose exemplar/
+                # anomaly/candidate rings are empty on a fresh
+                # standby — refill them from the mirror or the
+                # replicated history vanishes as soon as the snapshot
+                # cache expires.
+                self.pipeline.restore_query_meta(
+                    meta.get("query") or {}
+                )
             if self._orders is not None and self._offsets:
                 # Replicated offsets win over broker-committed ones for
                 # the same reason checkpoint offsets do: the sketch
@@ -1277,6 +1497,19 @@ class DetectorDaemon:
                 pass  # not block the failover
         self.role = ROLE_PRIMARY
         self.registry.counter_add(tele_metrics.ANOMALY_FAILOVERS, 1.0)
+        # Queries fail over WITH the role: the engine's role-dispatched
+        # snapshot now reads live state (an already-serving read
+        # replica needs no rewiring); a standby that booted with
+        # read-replica off starts its listeners here. A bind failure
+        # (port clash on a shared host) must not kill a daemon that
+        # just took over ingest — promote without the read path.
+        try:
+            self._start_query_plane()
+        except Exception:  # noqa: BLE001
+            logging.getLogger(__name__).exception(
+                "promoted, but the query listener failed to start — "
+                "serving ingest without the read path"
+            )
         if self.ckpt_path:
             # Durable promotion (and the first fencing artifact the old
             # primary can trip over on a shared volume).
@@ -1484,6 +1717,10 @@ class DetectorDaemon:
             self.repl_standby.stop()
         if self.repl_primary is not None:
             self.repl_primary.stop()
+        if self.query_service is not None:
+            self.query_service.stop()
+        if self.query_grpc is not None:
+            self.query_grpc.stop()
         if self.receiver is not None:
             self.receiver.stop()
         if self.grpc_receiver is not None:
@@ -1521,10 +1758,18 @@ def main() -> None:
         grpc_port = d.grpc_receiver.port if d.grpc_receiver else -1
         http_port = d.receiver.port if d.receiver else -1
         repl_port = d.repl_primary.port if d.repl_primary else -1
+        # A constructed-but-unstarted QueryService (standby with
+        # read-replica off) would report its *requested* port; gate on
+        # _query_started so -1 means "nothing listening", like repl.
+        query_port = (
+            d.query_service.port
+            if d.query_service is not None and d._query_started
+            else -1
+        )
         print(
             f"anomaly-detector: otlp-http :{http_port} "
             f"otlp-grpc :{grpc_port} metrics :{d.exporter.port} "
-            f"repl :{repl_port} role {d.role}",
+            f"repl :{repl_port} query :{query_port} role {d.role}",
             flush=True,
         )
 

@@ -144,6 +144,8 @@ class DetectorPipeline:
         brownout_hold_s: float = 2.0,
         brownout_max_level: int = 4,
         retry_after_s: float = 1.0,
+        exemplar_ring: int = 8,
+        hh_candidates: int = 64,
     ):
         self.detector = detector
         self.flags = flags or FlagEvaluator()
@@ -263,13 +265,34 @@ class DetectorPipeline:
         # from every receiver thread AND the pump; an unguarded race
         # could double-step the ladder inside one hold window.
         self._admission_lock = threading.Lock()
-        self._inflight: deque = deque()  # (t_batch, dispatch_clock, report)
+        # (t_batch, dispatch_clock, report, cols) — cols is the host
+        # SpanColumns of the dispatched batch, kept for flag-time
+        # exemplar capture (bounded: the deque holds ≤3 entries).
+        self._inflight: deque = deque()
         self._inflight_lock = threading.Lock()
         # Serializes detector-state advancement: observe_packed is a
         # read-modify-write on detector.state, and warm_widths() may run
         # on a background thread beside the pump thread.
         self._dispatch_lock = threading.Lock()
         self._last_t: float | None = None
+        # Query-plane capture (runtime.query): bounded per-service rings
+        # of (a) exemplar trace ids taken AT FLAG TIME from the flagged
+        # batch's trace-id column — every anomaly links to a concrete
+        # Jaeger trace — and (b) recent attribute-CRC candidates, the
+        # host-side candidate set a CMS top-k query needs (a CMS can
+        # answer "how often?" but never enumerate its keys). Everything
+        # here is JSON-able (query_meta) so it rides the replication
+        # meta block and a read replica answers the same queries from
+        # the same data. Guarded by its own lock: writers are the pump
+        # thread (candidates) and the harvester (exemplars), readers
+        # the replication/query snapshot threads.
+        self._exemplar_ring = int(exemplar_ring)
+        self._hh_cand_max = int(hh_candidates)
+        self._query_lock = threading.Lock()
+        self._exemplars: dict[int, deque] = {}
+        self._hh_cands: dict[int, deque] = {}
+        self._anomaly_ring: deque = deque(maxlen=64)
+        self.exemplars_captured = 0
 
     # -- ingestion -----------------------------------------------------
 
@@ -504,6 +527,7 @@ class DetectorPipeline:
             self._maybe_sync_harvest(keep=0)
             return
         cols = SpanColumns.concat(parts)
+        self._capture_candidates(cols)
         batch = self.tensorizer.pack_columns(cols, width=width)
         self._last_dispatch = time.monotonic()
         # Packed dispatch: the report comes back as ONE device vector so
@@ -522,7 +546,10 @@ class DetectorPipeline:
             # Lag clock = the oldest row's enqueue time, not dispatch
             # time: under the adaptive accumulate-hold rows can wait up
             # to hold_s before dispatch, and that wait IS detection lag.
-            self._inflight.append((t_now, t_oldest, report))
+            # The host-side columns ride along so the harvester can
+            # capture exemplar trace ids AT FLAG TIME from the exact
+            # batch that flagged (bounded: ≤3 batches in flight).
+            self._inflight.append((t_now, t_oldest, report, cols))
             # Bound the in-flight window: stale reports are dropped
             # unfetched (their batches already updated device state) so
             # readback RTT never throttles dispatch.
@@ -857,8 +884,179 @@ class DetectorPipeline:
         self._process_report(item)
         return True
 
+    # -- query-plane capture ------------------------------------------
+
+    def _capture_candidates(self, cols: SpanColumns) -> None:
+        """Remember recent per-service attribute keys (pump thread).
+
+        The CMS absorbs every span but can never list its keys; a
+        top-k query therefore needs candidates. Heavy hitters are, by
+        definition, frequent — any attr with real share appears in the
+        recent stream, so a bounded ring of recently-seen distinct
+        CRCs per service IS the candidate set (counts stay exact: they
+        come from the full table at query time)."""
+        if not self._hh_cand_max:
+            return
+        svcs = np.unique(cols.svc)
+        # The O(services × rows) mask/unique pass runs lock-free:
+        # query_meta() and exemplar capture contend on _query_lock
+        # every refresh/snapshot, so only the ring mutation may hold
+        # it — not per-batch numpy work.
+        tails = []
+        for s in svcs:
+            vals = cols.attr_crc[cols.svc == s]
+            # Distinct values in ARRIVAL order (np.unique sorts by
+            # value — slicing that would keep the numerically
+            # largest CRCs forever, not the recent ones): sort the
+            # first-appearance indices back into stream order,
+            # then keep the tail.
+            _u, first = np.unique(vals, return_index=True)
+            ordered = vals[np.sort(first)]
+            tails.append(
+                (int(s), [int(v) for v in ordered[-self._hh_cand_max:]])
+            )
+        with self._query_lock:
+            for s, tail in tails:
+                ring = self._hh_cands.get(s)
+                if ring is None:
+                    ring = self._hh_cands[s] = deque(
+                        maxlen=self._hh_cand_max
+                    )
+                ring.extend(tail)
+
+    def _capture_exemplars(
+        self, t_batch, cols, report, flags_np, threshold
+    ) -> None:
+        """At flag time: link each flagged service to concrete trace
+        ids from the batch that flagged it (harvester thread).
+
+        The exemplar is the first 8 bytes of the OTLP trace id (the
+        tensorizer's ``trace_key``, little-endian) rendered as hex —
+        exactly the prefix a Jaeger UI search matches on. A flag whose
+        evidence is windowed (CUSUM/cardinality, no row of the service
+        in THIS batch) still records the anomaly event; the ring keeps
+        the service's most recent exemplars from earlier batches.
+
+        ``exemplar_ring=0`` disables only the trace-id capture (the
+        privacy knob) — anomaly EVENTS still land in the ring, or
+        /query/anomalies and the Grafana annotations would go dark."""
+        if not flags_np.any():
+            return
+        cusum_thr = np.asarray(
+            self.detector.config.cusum_thresholds, np.float32
+        )
+        now = time.time()
+        with self._query_lock:
+            for i in np.nonzero(flags_np)[0]:
+                i = int(i)
+                signals = [
+                    name
+                    for name, z in (
+                        ("latency", report.lat_z[i]),
+                        ("error_rate", report.err_z[i]),
+                        ("throughput", report.rate_z[i]),
+                        ("cardinality", report.card_z[i]),
+                    )
+                    if np.abs(z).max() > threshold
+                ] + (
+                    ["cusum"]
+                    if (report.cusum[i] > cusum_thr).any()
+                    else []
+                )
+                traces: list[str] = []
+                if self._exemplar_ring and cols is not None:
+                    keys = cols.trace_key[cols.svc == i]
+                    for v in keys[-self._exemplar_ring:]:
+                        traces.append(int(v).to_bytes(8, "little").hex())
+                if self._exemplar_ring:
+                    ring = self._exemplars.get(i)
+                    if ring is None:
+                        ring = self._exemplars[i] = deque(
+                            maxlen=self._exemplar_ring
+                        )
+                    sig = signals[0] if signals else "flag"
+                    for tid in traces:
+                        ring.append(
+                            {"trace_id": tid, "t": now, "signal": sig}
+                        )
+                self.exemplars_captured += len(traces)
+                self._anomaly_ring.append({
+                    "t": now,
+                    "t_batch": float(t_batch),
+                    "service": i,
+                    "signals": signals,
+                    "exemplars": traces,
+                })
+
+    def query_meta(self) -> dict:
+        """JSON-able query-plane block: exemplar rings, recent anomaly
+        events, and top-k candidate keys. Shipped inside the
+        replication meta so a read replica answers exemplar/anomaly/
+        top-k queries from the same data the primary would — the
+        bit-consistency contract runtime.query is built on."""
+        with self._query_lock:
+            return {
+                "exemplars": {
+                    str(svc): [dict(e) for e in ring]
+                    for svc, ring in self._exemplars.items()
+                },
+                "anomalies": [dict(ev) for ev in self._anomaly_ring],
+                "hh_candidates": {
+                    # Most-recent-first distinct CRCs (the ring keeps
+                    # arrival order; dict.fromkeys dedups stably).
+                    str(svc): list(
+                        dict.fromkeys(reversed(ring))
+                    )[: self._hh_cand_max]
+                    for svc, ring in self._hh_cands.items()
+                },
+                "exemplars_captured": self.exemplars_captured,
+            }
+
+    def restore_query_meta(self, block: dict) -> None:
+        """Promotion hydration: refill the query-plane rings from a
+        replicated :meth:`query_meta` block, so exemplar/anomaly/top-k
+        answers survive the role flip — the mirror is the ONLY copy a
+        promoting standby has, and without this the history would
+        vanish the moment its snapshot cache expires post-promotion.
+
+        ``exemplars_captured`` is deliberately NOT restored: it backs
+        this process's Prometheus counter delta, and importing the dead
+        primary's lifetime total would spike the promoted daemon's
+        ``anomaly_exemplars_captured_total`` by traffic it never saw."""
+        if not block:
+            return
+        with self._query_lock:
+            if self._exemplar_ring:
+                for svc, events in (block.get("exemplars") or {}).items():
+                    ring = self._exemplars.get(int(svc))
+                    if ring is None:
+                        ring = self._exemplars[int(svc)] = deque(
+                            maxlen=self._exemplar_ring
+                        )
+                    ring.extend(
+                        dict(e) for e in events[-self._exemplar_ring:]
+                    )
+            for ev in (block.get("anomalies") or [])[
+                -self._anomaly_ring.maxlen:
+            ]:
+                self._anomaly_ring.append(dict(ev))
+            if self._hh_cand_max:
+                for svc, crcs in (
+                    block.get("hh_candidates") or {}
+                ).items():
+                    ring = self._hh_cands.get(int(svc))
+                    if ring is None:
+                        ring = self._hh_cands[int(svc)] = deque(
+                            maxlen=self._hh_cand_max
+                        )
+                    # query_meta lists most-recent-FIRST; the rings
+                    # keep arrival order (most recent at the right).
+                    ring.extend(int(c) for c in reversed(crcs))
+
+    # -- report processing --------------------------------------------
+
     def _process_report(self, item) -> None:
-        t_batch, t_dispatch, dev_report = item
+        t_batch, t_dispatch, dev_report, cols = item
         self._note_outcome(skipped=False)
         probe = self._start_rtt_probe() if self.rtt_probe else None
         # Single-array fetch + host-side unpack (see pump()).
@@ -897,6 +1095,9 @@ class DetectorPipeline:
                 names[i] if i < len(names) else f"svc-{i}"
                 for i in np.nonzero(flags_np)[0]
             ]
+            self._capture_exemplars(
+                t_batch, cols, report, flags_np, threshold
+            )
         else:
             flagged = []
         if self.on_report is not None:

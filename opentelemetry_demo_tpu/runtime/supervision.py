@@ -133,6 +133,15 @@ class Supervisor:
                 name, restart, probe, probe_interval_s, base_backoff_s,
                 max_backoff_s, restart_budget, budget_window_s, self._time(),
             )
+
+    def registered(self, name: str) -> bool:
+        """True if ``name`` is already registered.
+
+        Re-registering would mint a fresh crash/backoff budget, so
+        callers whose start path can run more than once (promotion,
+        restart-after-failure) gate on this to stay idempotent."""
+        with self._lock:
+            return name in self._components
         self._export(self._components[name])
 
     # -- crash accounting ----------------------------------------------

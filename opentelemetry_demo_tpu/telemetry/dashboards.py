@@ -194,8 +194,48 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Frame format version",
                       Query("instant", "anomaly_frame_version"),
                       "version"),
+                # Live query plane (runtime.query): the read path's own
+                # health — request rate per endpoint/status, latency,
+                # the staleness bound every answer carries, and the
+                # exemplar trace ids captured at flag time.
+                Panel("Query request rate",
+                      Query("rate", "anomaly_query_requests_total",
+                            by=("endpoint", "code")), "req/s"),
+                Panel("Query latency p99",
+                      Query("quantile",
+                            "anomaly_query_latency_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Query answer staleness",
+                      Query("instant", "anomaly_query_staleness_seconds"),
+                      "s"),
+                Panel("Anomaly exemplars captured",
+                      Query("rate", "anomaly_exemplars_captured_total"),
+                      "traces/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
+            ],
+        ),
+        # Panels backed by the query plane ITSELF (the Grafana
+        # simple-JSON datasource runtime.query serves): dashboards
+        # query live sketches directly — estimates, accumulators and
+        # anomaly+exemplar tables — instead of only scraping gauges.
+        # The "sketch" query kind renders as a simple-JSON datasource
+        # target (uid "anomaly-query"; point it at the detector's
+        # ANOMALY_QUERY_PORT).
+        Dashboard(
+            uid="sketch-live",
+            title="Live Sketch Queries (TPU detector read plane)",
+            panels=[
+                Panel("Distinct traces — frontend (live HLL)",
+                      Query("sketch", "cardinality:frontend"), "traces"),
+                Panel("CUSUM max — frontend (live accumulator)",
+                      Query("sketch", "cusum:frontend"), "score"),
+                Panel("Distinct traces — checkout (live HLL)",
+                      Query("sketch", "cardinality:checkout"), "traces"),
+                Panel("Top-k heavy hitters — frontend (live CMS)",
+                      Query("sketch", "topk:frontend"), "count"),
+                Panel("Recent anomalies with exemplar traces",
+                      Query("sketch", "anomalies"), "events"),
             ],
         ),
     ]
@@ -236,6 +276,10 @@ def evaluate_panel(panel: Panel, collector: Collector, at: float):
             service=q.service, severity=q.severity, limit=20
         )
         return [((d.service, d.severity), d.body) for d in docs]
+    if q.kind == "sketch":
+        # Backed by the live query plane (runtime.query's simple-JSON
+        # datasource), not the in-proc TSDB — nothing to evaluate here.
+        return []
     raise ValueError(f"unknown query kind {q.kind!r}")
 
 
@@ -257,6 +301,7 @@ def to_grafana_json(dashboard: Dashboard) -> dict:
     for i, panel in enumerate(dashboard.panels):
         q = panel.query
         w = int(q.window_s)
+        sketch_target = None
         if q.kind == "rate":
             by = f" by ({', '.join(q.by)})" if q.by else ""
             sel = _promql_selector(q.metric, q.matchers)
@@ -270,19 +315,48 @@ def to_grafana_json(dashboard: Dashboard) -> dict:
             )
         elif q.kind == "instant":
             expr = _promql_selector(q.metric, q.matchers)
+        elif q.kind == "sketch":
+            # A live-sketch panel: the target goes to the simple-JSON
+            # datasource runtime.query serves (uid "anomaly-query"),
+            # not to Prometheus — dashboards read the sketches
+            # themselves. q.metric carries the datasource target
+            # ("cardinality:<svc>" | "cusum:<svc>" | "topk:<svc>" |
+            # "anomalies" — the /search vocabulary).
+            expr = ""
+            sketch_target = q.metric
         else:  # traces/logs/exemplars panels target other datasources
             expr = ""
-        panels.append({
+        kind_prefix = (sketch_target or "").partition(":")[0]
+        panel_doc = {
             "id": i + 1,
             "title": panel.title,
-            "type": "timeseries" if expr else "table",
+            "type": (
+                "timeseries" if expr or kind_prefix in (
+                    "cardinality", "cusum",
+                ) else "table"
+            ),
             "gridPos": {"h": 8, "w": 12, "x": 12 * (i % 2), "y": 8 * (i // 2)},
             "fieldConfig": {"defaults": {"unit": panel.unit or "none"}},
             "targets": (
                 [{"expr": expr, "refId": "A", "exemplar": q.kind == "quantile"}]
                 if expr else []
             ),
-        })
+        }
+        if sketch_target is not None:
+            panel_doc["datasource"] = {
+                "type": "grafana-simple-json-datasource",
+                "uid": "anomaly-query",
+            }
+            panel_doc["targets"] = [{
+                "target": sketch_target,
+                "refId": "A",
+                "type": (
+                    "timeseries"
+                    if kind_prefix in ("cardinality", "cusum")
+                    else "table"
+                ),
+            }]
+        panels.append(panel_doc)
     return {
         "uid": dashboard.uid,
         "title": dashboard.title,

@@ -254,6 +254,14 @@ ANOMALY_RESTORE_PARTIAL = "anomaly_restore_partial_total"
 # writes (a fleet mid-rolling-upgrade shows a mixed gauge).
 ANOMALY_FRAME_CORRUPT = "anomaly_frame_corrupt_total"  # {hop=}
 ANOMALY_FRAME_VERSION = "anomaly_frame_version"
+# Live query plane (runtime.query: HTTP/gRPC reads over live sketch
+# state, the Grafana JSON datasource, read-replica serving): request
+# rate/latency per endpoint, the staleness bound every answer carries,
+# and the exemplar trace ids captured at flag time.
+ANOMALY_QUERY_REQUESTS = "anomaly_query_requests_total"  # {endpoint=, code=}
+ANOMALY_QUERY_LATENCY = "anomaly_query_latency_seconds"  # histogram
+ANOMALY_QUERY_STALENESS = "anomaly_query_staleness_seconds"
+ANOMALY_EXEMPLARS_CAPTURED = "anomaly_exemplars_captured_total"
 
 
 def export_metrics_report(

@@ -216,6 +216,66 @@ FRAME_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Live-query-plane knobs (runtime.query: the HTTP/gRPC read API over
+# live sketch state, the Grafana JSON datasource, and read-replica
+# serving on a standby; runtime/daemon.py wires them). Same ONE-
+# registry discipline as the other knob families — daemon, compose
+# overlay, k8s generator and sanitycheck.py all consume this dict.
+# Values must stay literals (sanitycheck reads via ast.literal_eval,
+# without importing jax).
+QUERY_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_QUERY_PORT": (
+        "int", 0,
+        "HTTP/JSON query port (also the Grafana simple-JSON datasource "
+        "surface); 0 binds an ephemeral port (announced at boot), -1 "
+        "disables the query plane entirely",
+    ),
+    "ANOMALY_QUERY_GRPC_PORT": (
+        "int", -1,
+        "gRPC query port (same documents over "
+        "/otdtpu.query.v1.QueryService/Query); -1 disables, 0 binds an "
+        "ephemeral port; silently skipped when grpcio is absent",
+    ),
+    "ANOMALY_QUERY_TOPK": (
+        "int", 10,
+        "default k for /query/topk heavy-hitter answers (per-request "
+        "?k= overrides)",
+    ),
+    "ANOMALY_QUERY_EXEMPLARS": (
+        "int", 8,
+        "per-service exemplar-ring size: trace ids captured at flag "
+        "time from the flagged batch, linking every anomaly to a "
+        "concrete Jaeger trace (0 disables capture)",
+    ),
+    "ANOMALY_QUERY_CANDIDATES": (
+        "int", 64,
+        "per-service ring of recently-seen attribute keys — the "
+        "candidate set /query/topk scores against the live CMS (a CMS "
+        "cannot enumerate its keys); bounds how many distinct keys a "
+        "top-k answer can rank, so keep it >= the largest k queried",
+    ),
+    "ANOMALY_QUERY_TIMELINE": (
+        "int", 120,
+        "snapshot-timeline ring depth: per-interval cardinality/CUSUM "
+        "samples backing Grafana timeseries targets and "
+        "/query/cardinality timelines",
+    ),
+    "ANOMALY_QUERY_READ_REPLICA": (
+        "int", 1,
+        "1 = a STANDBY serves the query API from its replicated mirror "
+        "(staleness-bounded by replication lag, reported per response) "
+        "while remaining promotable; 0 = standby serves no queries "
+        "until promotion",
+    ),
+    "ANOMALY_QUERY_MAX_STALENESS_S": (
+        "float", 2.0,
+        "snapshot cache budget: a query re-snapshots state when the "
+        "cached copy is older than this, so every answer is at most "
+        "this stale (plus replication lag on a read replica)",
+    ),
+}
+
+
 def _resolve(registry: dict) -> dict[str, int | float | str]:
     out: dict[str, int | float | str] = {}
     for env_name, (kind, default, _help) in registry.items():
@@ -254,6 +314,35 @@ def frame_config() -> dict[str, int | float | str]:
             f"ANOMALY_FRAME_WRITE_VERSION="
             f"{out['ANOMALY_FRAME_WRITE_VERSION']} outside the readable "
             "window 1..2"
+        )
+    return out
+
+
+def query_config() -> dict[str, int | float]:
+    """Resolve every QUERY_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the shape knobs —
+    a query plane with a zero-deep timeline or a negative staleness
+    budget must refuse to boot, not serve nonsense."""
+    out = _resolve(QUERY_KNOBS)
+    if int(out["ANOMALY_QUERY_TOPK"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_QUERY_TOPK={out['ANOMALY_QUERY_TOPK']} must be >= 1"
+        )
+    if int(out["ANOMALY_QUERY_TIMELINE"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_QUERY_TIMELINE={out['ANOMALY_QUERY_TIMELINE']} "
+            "must be >= 1"
+        )
+    if int(out["ANOMALY_QUERY_CANDIDATES"]) < int(out["ANOMALY_QUERY_TOPK"]):
+        raise ConfigError(
+            f"ANOMALY_QUERY_CANDIDATES={out['ANOMALY_QUERY_CANDIDATES']} "
+            f"below ANOMALY_QUERY_TOPK={out['ANOMALY_QUERY_TOPK']}: "
+            "top-k could never rank k candidates"
+        )
+    if float(out["ANOMALY_QUERY_MAX_STALENESS_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_QUERY_MAX_STALENESS_S="
+            f"{out['ANOMALY_QUERY_MAX_STALENESS_S']} must be > 0"
         )
     return out
 

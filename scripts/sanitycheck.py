@@ -166,13 +166,14 @@ def main() -> int:
                     and t.id in (
                         "OVERLOAD_KNOBS", "INGEST_KNOBS",
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
+                        "QUERY_KNOBS",
                     )
                     and node.value is not None
                 ):
                     registries[t.id] = ast.literal_eval(node.value)
     for reg_name in (
         "OVERLOAD_KNOBS", "INGEST_KNOBS", "REPLICATION_KNOBS",
-        "FRAME_KNOBS",
+        "FRAME_KNOBS", "QUERY_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -329,6 +330,55 @@ def main() -> int:
             "test_truncated_trailer_quarantined",
         ):
             check(marker in fttext, f"frame suite pins {marker}")
+
+    # 7) live query plane (runtime/query.py): reads over live sketch
+    #    state happen ONLY through the role-dispatched snapshot helper
+    #    (live dispatch DONATES the detector's device buffers — a
+    #    direct read races "Array has been deleted", and a forked read
+    #    path would break the primary/replica bit-consistency
+    #    contract). Pinned grep-level, same style as the frame.py
+    #    np.frombuffer pin:
+    #    a) query.py consumes a snapshot_fn and NEVER names the
+    #       detector state or the dispatch lock;
+    #    b) the daemon wires the engine to its snapshot helper;
+    #    c) the suite pins the failover/consistency/exemplar proofs.
+    query_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "query.py"
+    )
+    check(os.path.exists(query_py), "runtime/query.py exists")
+    if os.path.exists(query_py):
+        qtext = open(query_py).read()
+        for marker in (
+            "class QueryEngine", "class QueryService", "snapshot_fn",
+            "def dispatch", "/search", "/annotations",
+        ):
+            check(marker in qtext, f"runtime/query.py declares {marker!r}")
+        check(
+            "detector.state" not in qtext and "_dispatch_lock" not in qtext,
+            "query.py reads state only via the snapshot helper "
+            "(no detector.state / _dispatch_lock reference)",
+        )
+    daemon_text = open(os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "daemon.py"
+    )).read()
+    check(
+        "def _query_snapshot" in daemon_text
+        and "snapshot_fn=self._query_snapshot" in daemon_text,
+        "daemon wires the query engine to the role-dispatched "
+        "snapshot helper",
+    )
+    query_tests = os.path.join(ROOT, "tests", "test_query.py")
+    check(os.path.exists(query_tests), "tests/test_query.py exists")
+    if os.path.exists(query_tests):
+        qttext = open(query_tests).read()
+        for marker in (
+            "test_read_replica_survives_primary_sigkill",
+            "test_replica_answers_bit_identical_at_same_seq",
+            "test_exemplars_round_trip_to_ingested_traces",
+            "test_queries_never_race_dispatch_donation",
+            "test_grafana_datasource_contract",
+        ):
+            check(marker in qttext, f"query suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -96,8 +96,11 @@ class TestManifests:
         assert env["ANOMALY_OTLP_PORT"] == "4318"
         assert env["FLAGD_FILE"] == "/app/flagd/demo.flagd.json"
         ports = {p["containerPort"] for p in container["ports"]}
-        # 4319 = the hot-standby replication listener (runtime.replication).
-        assert ports == {4317, 4318, 4319, 9464}
+        # 4319 = the hot-standby replication listener
+        # (runtime.replication); 9465 = the live query plane
+        # (runtime.query: read API + Grafana JSON datasource).
+        assert ports == {4317, 4318, 4319, 9464, 9465}
+        assert env["ANOMALY_QUERY_PORT"] == "9465"
         mounts = {m["mountPath"] for m in container["volumeMounts"]}
         assert "/var/lib/anomaly" in mounts and "/app/flagd" in mounts
         # HA probe split: alive on /healthz (a fenced ex-primary is

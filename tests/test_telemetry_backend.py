@@ -380,12 +380,21 @@ def test_grafana_json_export(tmp_path):
     import json
 
     paths = dashboards.write_grafana_dashboards(str(tmp_path))
-    assert len(paths) == 5
+    assert len(paths) == 6
     by_uid = {}
     for p in paths:
         doc = json.load(open(p))
         by_uid[doc["uid"]] = doc
         assert doc["panels"], p
+    # The sketch-live board targets the query plane's simple-JSON
+    # datasource (uid anomaly-query), not Prometheus.
+    live = by_uid["sketch-live"]
+    for panel in live["panels"]:
+        assert panel["datasource"]["uid"] == "anomaly-query"
+        assert panel["targets"][0]["target"]
+    assert any(
+        panel["type"] == "timeseries" for panel in live["panels"]
+    ) and any(panel["type"] == "table" for panel in live["panels"])
     # spanmetrics p95 panel renders the reference's query shape.
     span = by_uid["spanmetrics"]
     exprs = [t["expr"] for panel in span["panels"] for t in panel["targets"]]
