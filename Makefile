@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench replbench querybench gen-k8s gen-proto gen-dashboards build-native check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench replbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -50,9 +50,13 @@ gen-k8s:        ## regenerate deploy/k8s manifests
 build-native:   ## C++ ingest + currency kernels
 	$(MAKE) -C opentelemetry_demo_tpu/native
 
+staticcheck:    ## AST invariant analysis (scripts/staticcheck; no jax, <10s)
+	$(PY) -m scripts.staticcheck
+
 check:          ## fast static sanity (no network, no device)
 	$(PY) -m compileall -q opentelemetry_demo_tpu tests scripts bench.py __graft_entry__.py
-	$(PY) scripts/sanitycheck.py
+	$(PY) -m scripts.staticcheck
+	SANITYCHECK_SKIP_STATICCHECK=1 $(PY) scripts/sanitycheck.py
 
 gen-proto:      ## regenerate protobuf stubs (build artifact)
 	bash scripts/gen_proto.sh

@@ -36,6 +36,7 @@ rolling upgrade (or a rollback within the frame-version window via
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import logging
@@ -55,6 +56,10 @@ log = logging.getLogger(__name__)
 # layout the loader still migrates from.
 SUFFIX = ".ckpt"
 LEGACY_SUFFIX = ".npz"
+
+# Stand-in context for lock-free callers of :func:`save` (tests and
+# single-threaded harnesses with no dispatcher running).
+_NULL_LOCK = contextlib.nullcontext()
 
 
 class CheckpointCorrupt(Exception):
@@ -102,11 +107,32 @@ def save(
     service_names: list[str] | None = None,
     metrics_feed=None,
     epoch: int = 0,
+    *,
+    dispatch_lock,
 ) -> None:
+    """Snapshot a live detector to disk.
+
+    ``dispatch_lock`` is the owning pipeline's ``_dispatch_lock`` —
+    live dispatch DONATES the state buffers, so an unlocked read can
+    touch a just-deleted array. The argument is keyword-only and has
+    NO default: a caller with a quiesced detector (tests,
+    single-threaded harnesses) must write ``dispatch_lock=None``
+    deliberately, so the unsafe path is never reached by omission.
+    The lock is held ONLY for the host copy-out; the frame encode and
+    the fsync'd write below run outside it, so a slow disk never
+    stalls dispatch."""
+    with dispatch_lock if dispatch_lock is not None else _NULL_LOCK:
+        state_host = DetectorState(
+            **{
+                k: np.asarray(v)
+                for k, v in detector.state._asdict().items()
+            }
+        )
+        clock_t_prev = detector.clock._t_prev
     save_state(
-        path, detector.state, detector.config,
+        path, state_host, detector.config,
         offsets=offsets, service_names=service_names,
-        clock_t_prev=detector.clock._t_prev, metrics_feed=metrics_feed,
+        clock_t_prev=clock_t_prev, metrics_feed=metrics_feed,
         epoch=epoch,
     )
 
@@ -323,7 +349,7 @@ def load(path: str, config: DetectorConfig | None = None) -> tuple[AnomalyDetect
     """
     arrays, meta, saved_cfg = _load_arrays(path, config)
     detector = AnomalyDetector(saved_cfg)
-    detector.state = DetectorState(
+    detector.state = DetectorState(  # staticcheck: ok[donation-race] fresh detector constructed one line up — no pipeline, no dispatcher thread can hold it yet
         **{k: jax.device_put(v) for k, v in arrays.items()}
     )
     detector.clock._t_prev = meta.get("clock_t_prev")

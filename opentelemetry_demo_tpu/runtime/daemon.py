@@ -13,7 +13,11 @@ values — the reference's ``mustMapEnv`` discipline
 (/root/reference/src/checkout/main.go:230-236): a service that boots
 with half a config is worse than one that refuses to boot.
 
-Env contract (all optional, sensible defaults):
+Env contract (all optional, sensible defaults). Daemon-core knobs are
+ONE registry — ``utils.config.DAEMON_KNOBS`` — consumed here, by the
+compose overlay, the k8s generator and the checkers, so the set can
+never drift between surfaces (scripts/staticcheck knob-discipline
+pass):
 
 - ``ANOMALY_OTLP_PORT``      OTLP/HTTP listen port (default 4318)
 - ``ANOMALY_NUM_SERVICES`` / ``ANOMALY_CMS_WIDTH`` / ``ANOMALY_HLL_P`` /
@@ -123,7 +127,6 @@ boot degrades to a cold start. Component state is visible as
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
@@ -131,6 +134,7 @@ from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
 from ..utils.config import (
     ConfigError,
+    daemon_config,
     frame_config,
     ingest_config,
     overload_config,
@@ -153,36 +157,25 @@ from .replication import (
 from .supervision import Supervisor
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return int(raw)
-    except ValueError as e:
-        raise SystemExit(f"bad {name}={raw!r}: {e}") from e
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError as e:
-        raise SystemExit(f"bad {name}={raw!r}: {e}") from e
-
-
 class DetectorDaemon:
     """Wires receiver → pipeline → detector → metrics; owns the loop."""
 
     def __init__(self, config: DetectorConfig | None = None):
-        self.otlp_port = _env_int("ANOMALY_OTLP_PORT", 4318)
-        self.metrics_port = _env_int("ANOMALY_METRICS_PORT", 9464)
-        self.batch_size = _env_int("ANOMALY_BATCH", 2048)
-        self.pump_interval_s = _env_float("ANOMALY_PUMP_INTERVAL_S", 0.05)
-        self.ckpt_path = os.environ.get("ANOMALY_CHECKPOINT") or None
-        self.ckpt_interval_s = _env_float("ANOMALY_CHECKPOINT_INTERVAL_S", 30.0)
+        # Daemon-core knobs (ONE registry: utils.config.DAEMON_KNOBS —
+        # the same literal dict the compose overlay, the k8s generator
+        # and the checkers consume; the env reads this replaces were
+        # the stray-knob violations the staticcheck knob-discipline
+        # pass exists to catch).
+        try:
+            dk = daemon_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.otlp_port = int(dk["ANOMALY_OTLP_PORT"])
+        self.metrics_port = int(dk["ANOMALY_METRICS_PORT"])
+        self.batch_size = int(dk["ANOMALY_BATCH"])
+        self.pump_interval_s = float(dk["ANOMALY_PUMP_INTERVAL_S"])
+        self.ckpt_path = str(dk["ANOMALY_CHECKPOINT"]) or None
+        self.ckpt_interval_s = float(dk["ANOMALY_CHECKPOINT_INTERVAL_S"])
 
         # Verified-frame policy FIRST (knob registry:
         # utils.config.FRAME_KNOBS; engine: runtime.frame): the
@@ -242,8 +235,8 @@ class DetectorDaemon:
             qk["ANOMALY_QUERY_MAX_STALENESS_S"]
         )
 
-        flagd_file = os.environ.get("FLAGD_FILE")
-        ofrep = os.environ.get("OFREP_URL")
+        flagd_file = str(dk["FLAGD_FILE"]) or None
+        ofrep = str(dk["OFREP_URL"]) or None
         if flagd_file:
             flags = FlagFileStore(flagd_file)
         elif ofrep:
@@ -252,16 +245,27 @@ class DetectorDaemon:
             flags = FlagEvaluator()
 
         if config is None:
+            # Geometry knobs use -1 as "keep the model's default" (the
+            # registry must stay literal/jax-free, so it cannot name
+            # DetectorConfig's values).
             base = DetectorConfig()
+
+            def _geom(knob: str, current, cast):
+                value = dk[knob]
+                return current if float(value) < 0 else cast(value)
+
             config = base._replace(
-                num_services=_env_int("ANOMALY_NUM_SERVICES", base.num_services),
-                cms_width=_env_int("ANOMALY_CMS_WIDTH", base.cms_width),
-                hll_p=_env_int("ANOMALY_HLL_P", base.hll_p),
-                warmup_batches=_env_float(
-                    "ANOMALY_WARMUP_BATCHES", base.warmup_batches
+                num_services=_geom(
+                    "ANOMALY_NUM_SERVICES", base.num_services, int
                 ),
-                z_warmup_batches=_env_float(
-                    "ANOMALY_Z_WARMUP_BATCHES", base.z_warmup_batches
+                cms_width=_geom("ANOMALY_CMS_WIDTH", base.cms_width, int),
+                hll_p=_geom("ANOMALY_HLL_P", base.hll_p, int),
+                warmup_batches=_geom(
+                    "ANOMALY_WARMUP_BATCHES", base.warmup_batches, float
+                ),
+                z_warmup_batches=_geom(
+                    "ANOMALY_Z_WARMUP_BATCHES", base.z_warmup_batches,
+                    float,
                 ),
             )
         restored_offsets: dict = {}
@@ -485,14 +489,14 @@ class DetectorDaemon:
             batch_size=self.batch_size,
             # Remote/tunneled devices: readback RTT dominates — set an
             # interval (and/or async) so dispatch never waits on fetch.
-            harvest_interval_s=float(os.environ.get("ANOMALY_HARVEST_INTERVAL", "0")),
-            harvest_async=os.environ.get("ANOMALY_HARVEST_ASYNC", "") == "1",
+            harvest_interval_s=float(dk["ANOMALY_HARVEST_INTERVAL"]),
+            harvest_async=bool(int(dk["ANOMALY_HARVEST_ASYNC"])),
             # Adaptive width (on by default): bounds the report skip
             # rate when readback RTT outpaces the batch interval — the
             # 10× stress regime. The ladder precompiles in the
             # background below so an escalation never compiles
             # mid-incident.
-            adaptive_batching=os.environ.get("ANOMALY_ADAPTIVE_BATCH", "1") == "1",
+            adaptive_batching=bool(int(dk["ANOMALY_ADAPTIVE_BATCH"])),
             # Bounded admission + brownout (the overload half of the
             # fault matrix; knob registry: utils.config.OVERLOAD_KNOBS).
             queue_max_rows=ov["ANOMALY_QUEUE_MAX_ROWS"],
@@ -626,8 +630,8 @@ class DetectorDaemon:
         from ..telemetry.logstore import LogStore
 
         self.log_store = LogStore()
-        self.max_body_bytes = _env_int("ANOMALY_OTLP_MAX_BODY", 16 << 20)
-        self._grpc_port_req = _env_int("ANOMALY_OTLP_GRPC_PORT", 4317)
+        self.max_body_bytes = int(dk["ANOMALY_OTLP_MAX_BODY"])
+        self._grpc_port_req = int(dk["ANOMALY_OTLP_GRPC_PORT"])
         # A standby answers no ingest until promotion, and a
         # boot-fenced stale primary answers none EVER (a fenced process
         # that kept serving would hold the orchestrator's readiness and
@@ -641,7 +645,7 @@ class DetectorDaemon:
         )
         self._orders = None
         self._quarantine_seen = 0
-        kafka_addr = os.environ.get("KAFKA_ADDR")
+        kafka_addr = str(dk["KAFKA_ADDR"]) or None
         if kafka_addr:
             from .kafka_orders import OrdersSource  # gated import
 
@@ -797,7 +801,7 @@ class DetectorDaemon:
         port = self.grpc_receiver.port
         try:
             self.grpc_receiver.stop(grace=0.5)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — best-effort stop of the old receiver before rebind
             pass
         self.grpc_receiver = self._make_grpc_receiver(port)
         self.grpc_receiver.start()
@@ -1047,7 +1051,7 @@ class DetectorDaemon:
             # the next call.
             try:
                 self.query_grpc.start()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — optional twin; HTTP alone still serves every query
                 logging.getLogger(__name__).exception(
                     "query gRPC twin failed to start; HTTP-only"
                 )
@@ -1133,7 +1137,7 @@ class DetectorDaemon:
             port = self.repl_primary.port
             try:
                 self.repl_primary.stop()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — best-effort stop before relisten
                 pass
             self._start_replication_primary(port=port)
 
@@ -1205,16 +1209,20 @@ class DetectorDaemon:
                 )
             self._proc_stats.scrape()
             self.registry.gauge_set(
-                "app_anomaly_pending_rows", float(self.pipeline._pending_rows)
+                tele_metrics.ANOMALY_PENDING_ROWS,
+                float(self.pipeline._pending_rows),
             )
             self.registry.gauge_set(
-                "app_anomaly_batches_dispatched", float(self.pipeline.stats.batches)
+                tele_metrics.ANOMALY_BATCHES_DISPATCHED,
+                float(self.pipeline.stats.batches),
             )
             self.registry.gauge_set(
-                "app_anomaly_spans_ingested", float(self.pipeline.stats.spans)
+                tele_metrics.ANOMALY_SPANS_INGESTED,
+                float(self.pipeline.stats.spans),
             )
             self.registry.gauge_set(
-                "app_anomaly_log_docs_stored", float(self.log_store.count())
+                tele_metrics.ANOMALY_LOG_DOCS_STORED,
+                float(self.log_store.count()),
             )
         # Overload gauges/counters every step (not the 1 s cadence):
         # saturation flips sub-second and the chaos tests scrape between
@@ -1446,10 +1454,19 @@ class DetectorDaemon:
 
                 from ..models.detector import DetectorState
 
-                self.detector.state = DetectorState(
-                    **{k: jax.device_put(v) for k, v in arrays.items()}
-                )
-                self.detector.clock._t_prev = meta.get("clock_t_prev")
+                # Hydration swaps the live state object: under the
+                # dispatch lock, because the width-ladder warmup thread
+                # (spawned in __init__ for every role) snapshots state
+                # around its own dispatches — an unlocked swap here can
+                # be clobbered by a warmup copy-back mid-promotion.
+                with self.pipeline._dispatch_lock:
+                    self.detector.state = DetectorState(
+                        **{
+                            k: jax.device_put(v)
+                            for k, v in arrays.items()
+                        }
+                    )
+                    self.detector.clock._t_prev = meta.get("clock_t_prev")
                 for name in meta.get("service_names", []):
                     self.pipeline.tensorizer.service_id(name)
                 self._offsets = {
@@ -1505,7 +1522,7 @@ class DetectorDaemon:
         # just took over ingest — promote without the read path.
         try:
             self._start_query_plane()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — read path is optional after promotion; ingest must live
             logging.getLogger(__name__).exception(
                 "promoted, but the query listener failed to start — "
                 "serving ingest without the read path"
@@ -1519,7 +1536,7 @@ class DetectorDaemon:
             # replication component's to retry, not the promotion's).
             try:
                 self._start_replication_primary()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — the supervised replication component retries the listener
                 logging.getLogger(__name__).exception(
                     "promoted, but the replication listener failed to "
                     "start — running unreplicated until it recovers"
@@ -1537,7 +1554,7 @@ class DetectorDaemon:
         if self.repl_primary is not None:
             try:
                 self.repl_primary.stop()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — fenced teardown is best-effort; the daemon is exiting serving anyway
                 pass
         # Stop SERVING too: a fenced replica that kept answering OTLP
         # would hold the orchestrator's readiness probes (the k8s
@@ -1668,6 +1685,10 @@ class DetectorDaemon:
             service_names=self.pipeline.tensorizer.service_names,
             metrics_feed=self.metrics_feed,
             epoch=self._fence.epoch,
+            # The copy-out snapshots under the pipeline's dispatch
+            # lock: the width-ladder warmup (and any future background
+            # dispatcher) must never donate state mid-read.
+            dispatch_lock=self.pipeline._dispatch_lock,
         )
         self._last_ckpt = time.monotonic()
         if self._orders is not None and self._offsets:

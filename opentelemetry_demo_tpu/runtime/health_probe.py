@@ -61,7 +61,7 @@ def probe_role(addr: str, timeout_s: float = 3.0) -> tuple[str, int] | None:
         # triage question).
         try:
             doc = json.loads(e.read().decode())
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — unreadable body = role unknown
             return None
     except Exception:  # noqa: BLE001 — any transport/parse failure is
         return None  # "role unreadable" to the caller

@@ -344,7 +344,9 @@ class OrdersSource:
 
             self._wire = KafkaConsumer(self._bootstrap, self._group_id, self.TOPIC)
             self._last_connect_error = None
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — any connect/handshake
+            # fault (DNS, RST, wire-version mismatch) means "no broker
+            # yet": back off and retry on the next poll.
             if raise_on_fail:
                 raise
             # Log once per distinct failure — a silent forever-retry

@@ -196,7 +196,10 @@ class BackgroundPoster:
                         self._stop_event.wait(
                             self._retry_delay(e.retry_after_s)
                         )
-                except Exception:
+                except Exception:  # noqa: BLE001 — the sender loop is
+                    # the only drain of the queue: any transport fault
+                    # is counted and the next batch retried, never a
+                    # dead exporter thread.
                     self.errors += 1
 
     def flush(self, timeout_s: float = 5.0) -> bool:

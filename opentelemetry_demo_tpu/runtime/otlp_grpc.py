@@ -157,7 +157,9 @@ class OtlpGrpcReceiver:
                     columnar = otlp.decode_export_request_columnar(request)
                 if columnar is None:
                     records = otlp.decode_export_request(request)
-            except Exception:
+            except Exception:  # noqa: BLE001 — decoding the
+                # client's bytes: whatever malformed protobuf raises is
+                # the client's INVALID_ARGUMENT, never our crash.
                 _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
@@ -171,7 +173,9 @@ class OtlpGrpcReceiver:
         def export_metrics(request: bytes, context) -> bytes:
             try:
                 records = otlp_metrics.decode_metrics_request(request)
-            except Exception:
+            except Exception:  # noqa: BLE001 — decoding the
+                # client's bytes: whatever malformed protobuf raises is
+                # the client's INVALID_ARGUMENT, never our crash.
                 _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"
@@ -183,7 +187,9 @@ class OtlpGrpcReceiver:
         def export_logs(request: bytes, context) -> bytes:
             try:
                 docs = otlp.decode_logs_request(request)
-            except Exception:
+            except Exception:  # noqa: BLE001 — decoding the
+                # client's bytes: whatever malformed protobuf raises is
+                # the client's INVALID_ARGUMENT, never our crash.
                 _reject("malformed")
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "malformed OTLP payload"

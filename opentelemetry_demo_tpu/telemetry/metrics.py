@@ -194,6 +194,16 @@ ANOMALY_METRIC_Z = "app_anomaly_metric_z_score"
 ANOMALY_METRIC_FLAG_TOTAL = "app_anomaly_metric_flags_total"
 ANOMALY_METRIC_POINTS_TOTAL = "app_anomaly_metric_points_processed_total"
 ANOMALY_LOG_RECORDS_TOTAL = "app_anomaly_log_records_processed_total"
+# Self-telemetry gauges the daemon exports on a 1 s cadence (ingest/
+# batch/backlog visibility before the first detector report — the
+# otelcol_* habit). Declared here, not inline at the export site: the
+# staticcheck metric-surface pass fences anomaly-family names to this
+# table so a typo'd inline literal can never mint an undocumented
+# series.
+ANOMALY_PENDING_ROWS = "app_anomaly_pending_rows"
+ANOMALY_BATCHES_DISPATCHED = "app_anomaly_batches_dispatched"
+ANOMALY_SPANS_INGESTED = "app_anomaly_spans_ingested"
+ANOMALY_LOG_DOCS_STORED = "app_anomaly_log_docs_stored"
 # The fault-tolerant runtime's own health family (runtime.supervision):
 # the sidecar's job is to stay up while everything around it misbehaves,
 # so its component restarts/degradation are first-class metrics.

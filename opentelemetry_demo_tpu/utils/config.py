@@ -276,6 +276,194 @@ QUERY_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Daemon-core knobs (runtime/daemon.py boot contract): ports, batch
+# geometry, harvest/adaptive cadence, checkpoint path/cadence, body
+# cap, and the flag/Kafka wiring env. Historically these were ad-hoc
+# ``os.environ`` reads scattered through daemon.__init__ — outside any
+# registry, invisible to the deploy surfaces and the checkers. Same
+# ONE-registry discipline as every other family; scripts/staticcheck's
+# knob-discipline pass (and sanitycheck's literal pins) enforce the
+# correspondence. Values must stay literals (read via ast.literal_eval,
+# without importing jax). The -1 geometry defaults mean "use the
+# model's DetectorConfig default" (this module must stay jax-free, so
+# it cannot name those defaults directly).
+DAEMON_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_OTLP_PORT": (
+        "int", 4318,
+        "OTLP/HTTP listen port (the collector's otlphttp exporter "
+        "target); 0 binds an ephemeral port",
+    ),
+    "ANOMALY_OTLP_GRPC_PORT": (
+        "int", 4317,
+        "OTLP/gRPC listen port (the collector's primary ingress); "
+        "-1 disables the gRPC leg",
+    ),
+    "ANOMALY_METRICS_PORT": (
+        "int", 9464,
+        "Prometheus /metrics + /healthz listen port",
+    ),
+    "ANOMALY_BATCH": (
+        "int", 2048,
+        "device batch size (rows per dispatched step)",
+    ),
+    "ANOMALY_PUMP_INTERVAL_S": (
+        "float", 0.05,
+        "batch cadence seconds (the <100ms detection-lag budget "
+        "spends half on batching)",
+    ),
+    "ANOMALY_HARVEST_INTERVAL": (
+        "float", 0.0,
+        "report readback cadence seconds (0 = harvest every batch; "
+        "set on tunneled/remote devices where readback RTT dominates)",
+    ),
+    "ANOMALY_HARVEST_ASYNC": (
+        "int", 0,
+        "1 = fetch reports on a background harvester thread so "
+        "dispatch never waits on a device->host round trip",
+    ),
+    "ANOMALY_ADAPTIVE_BATCH": (
+        "int", 1,
+        "adaptive dispatch-width controller (1 = on): widens batches "
+        "in pow2 steps when readback can't keep pace; the width "
+        "ladder precompiles in the background at boot",
+    ),
+    "ANOMALY_NUM_SERVICES": (
+        "int", -1,
+        "detector service-axis size (-1 = DetectorConfig default); "
+        "smaller geometry shrinks compile time on small deployments",
+    ),
+    "ANOMALY_CMS_WIDTH": (
+        "int", -1,
+        "CMS sketch width (-1 = DetectorConfig default)",
+    ),
+    "ANOMALY_HLL_P": (
+        "int", -1,
+        "HLL precision p (-1 = DetectorConfig default)",
+    ),
+    "ANOMALY_WARMUP_BATCHES": (
+        "float", -1.0,
+        "EWMA warmup batches before z-scores count (-1 = "
+        "DetectorConfig default)",
+    ),
+    "ANOMALY_Z_WARMUP_BATCHES": (
+        "float", -1.0,
+        "z-score suppression window in batches (-1 = DetectorConfig "
+        "default)",
+    ),
+    "ANOMALY_CHECKPOINT": (
+        "str", "",
+        "snapshot path prefix (enables offset-keyed checkpoint/resume; "
+        "empty = stateless)",
+    ),
+    "ANOMALY_CHECKPOINT_INTERVAL_S": (
+        "float", 30.0,
+        "snapshot cadence seconds",
+    ),
+    "ANOMALY_OTLP_MAX_BODY": (
+        "int", 16777216,
+        "ingest body-size cap in bytes (oversized exports answer "
+        "413/RESOURCE_EXHAUSTED)",
+    ),
+    "FLAGD_FILE": (
+        "str", "",
+        "flagd-schema JSON path (hot-reloaded flag store; wins over "
+        "OFREP_URL)",
+    ),
+    "OFREP_URL": (
+        "str", "",
+        "OFREP flag endpoint (used when FLAGD_FILE is unset)",
+    ),
+    "KAFKA_ADDR": (
+        "str", "",
+        "Kafka bootstrap for the orders topic (empty = no Kafka leg)",
+    ),
+}
+
+
+# Registries whose knobs ride the DEPLOY surfaces: every knob in these
+# must be threaded through runtime/daemon.py, the compose overlay and
+# the k8s generator (scripts/staticcheck knob-discipline pass +
+# scripts/sanitycheck.py both assert the correspondence). The harness
+# registries below this tuple only legitimize env reads — a chaos
+# proxy or a bench driver has no business in the fleet compose file.
+DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
+    "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
+    "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
+)
+
+
+# Chaos-harness knobs (runtime/faultwire.py: the fault-injection TCP
+# proxy tests/test_chaos.py and test_frame.py drive). Registered so
+# the knob-discipline pass can resolve the proxy's env reads; NOT a
+# deployed registry — faults are injected by test harnesses, not by
+# the fleet config.
+FAULTWIRE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "FAULTWIRE_DELAY_MS": ("float", 0.0, "per-direction added latency"),
+    "FAULTWIRE_TRUNCATE_AFTER": (
+        "str", "", "close each connection after N relayed bytes",
+    ),
+    "FAULTWIRE_RST": ("int", 0, "1 = RST every connect immediately"),
+    "FAULTWIRE_BLACKHOLE": (
+        "int", 0, "1 = accept then drop all bytes (half-open link)",
+    ),
+    "FAULTWIRE_CORRUPT_RATE": (
+        "float", 0.0, "per-byte bit-flip probability (seeded)",
+    ),
+    "FAULTWIRE_CORRUPT_SEED": ("int", 0, "bit-flip plan seed"),
+    "FAULTWIRE_CORRUPT_OFFSET": (
+        "int", 0, "absolute stream offset where corruption starts",
+    ),
+}
+
+
+# Dev-harness knobs (scripts/serve_shop.py, scripts/serve_kafka.py and
+# the in-proc load generator): CLI-default conveniences for the local
+# shop stack. Registered, not deployed.
+SHOP_KNOBS: dict[str, tuple[str, object, str]] = {
+    "SHOP_PORT": ("int", 8080, "gateway listen port"),
+    "SHOP_USERS": ("int", 0, "simulated browsing users"),
+    "SHOP_MINIMAL": ("str", "", "non-empty = reduced profile"),
+    "SHOP_GRPC_PORT": ("int", -1, "gRPC edge port (-1 off)"),
+    "KAFKA_PORT": ("int", 9092, "in-repo broker listen port"),
+    "OTEL_EXPORTER_OTLP_ENDPOINT": (
+        "str", "", "where the shop exports OTLP (reference env name)",
+    ),
+    "LOCUST_BROWSER_TRAFFIC_ENABLED": (
+        "str", "",
+        "truthy = the load generator adds browser-shaped traffic "
+        "(reference locustfile env name)",
+    ),
+}
+
+
+# Benchmark scaffolding knobs (bench.py): section toggles and load
+# shapes for the flagship benchmark line. Registered, not deployed.
+BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
+    "BENCH_BATCH": ("int", 2097152, "device sketch benchmark batch"),
+    "BENCH_MATRIX": ("int", 1, "0 skips the sketch impl matrix"),
+    "BENCH_INGEST": ("int", 1, "0 skips host-ingest benches"),
+    "BENCH_REPL": ("int", 1, "0 skips the replication/failover drill"),
+    "BENCH_QUERY": ("int", 1, "0 skips the query-plane bench"),
+    "BENCH_QUALITY": ("int", 1, "0 skips detection-quality scenarios"),
+    "BENCH_LAG_STRESS": ("int", 1, "0 skips the lag stress leg"),
+    "BENCH_LAG_RATE": ("float", 2000.0, "lag bench offered spans/s"),
+    "BENCH_LAG_SECONDS": ("float", 12.0, "lag bench duration"),
+}
+
+
+# Native-build knobs (runtime/native.py's on-demand kernel compile)
+# and check-pipeline plumbing (scripts/sanitycheck.py).
+BUILD_KNOBS: dict[str, tuple[str, object, str]] = {
+    "CXX": ("str", "g++", "C++ compiler for the native kernels"),
+    "SANITYCHECK_SKIP_STATICCHECK": (
+        "int", 0,
+        "1 = the caller (make check) already ran the full staticcheck; "
+        "sanitycheck skips its delegated frame-monopoly re-run instead "
+        "of parsing the tree twice",
+    ),
+}
+
+
 def _resolve(registry: dict) -> dict[str, int | float | str]:
     out: dict[str, int | float | str] = {}
     for env_name, (kind, default, _help) in registry.items():
@@ -343,6 +531,34 @@ def query_config() -> dict[str, int | float]:
         raise ConfigError(
             "ANOMALY_QUERY_MAX_STALENESS_S="
             f"{out['ANOMALY_QUERY_MAX_STALENESS_S']} must be > 0"
+        )
+    return out
+
+
+def daemon_config() -> dict[str, int | float | str]:
+    """Resolve every DAEMON_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the shape knobs —
+    a daemon with a zero batch or a non-positive pump cadence must
+    refuse to boot, not spin."""
+    out = _resolve(DAEMON_KNOBS)
+    if int(out["ANOMALY_BATCH"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_BATCH={out['ANOMALY_BATCH']} must be >= 1"
+        )
+    if float(out["ANOMALY_PUMP_INTERVAL_S"]) <= 0:
+        raise ConfigError(
+            f"ANOMALY_PUMP_INTERVAL_S={out['ANOMALY_PUMP_INTERVAL_S']} "
+            "must be > 0"
+        )
+    if int(out["ANOMALY_OTLP_MAX_BODY"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_OTLP_MAX_BODY={out['ANOMALY_OTLP_MAX_BODY']} "
+            "must be >= 1"
+        )
+    if float(out["ANOMALY_CHECKPOINT_INTERVAL_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_CHECKPOINT_INTERVAL_S="
+            f"{out['ANOMALY_CHECKPOINT_INTERVAL_S']} must be > 0"
         )
     return out
 

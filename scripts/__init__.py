@@ -1,0 +1,2 @@
+# Makes scripts/ importable so `python -m scripts.staticcheck` (and the
+# sanitycheck delegation into its passes) work from the repo root.

@@ -1,0 +1,36 @@
+"""Repo-specific AST static analysis: the invariants checker.
+
+The stack carries five hard-won cross-cutting contracts — frame.py's
+byte-layout monopoly, the ``*_KNOBS`` registry threading, the
+dispatch-lock donation discipline, the error-lane shed exclusion and
+the ``anomaly_*`` metric/dashboard surface. ``scripts/sanitycheck.py``
+pins some of them with greps, but a grep is defeated by an aliased
+import, helper indirection or a renamed variable. This package checks
+them on the AST instead (import resolution, lexical lock context,
+literal tracing), so the contracts survive refactors — the way the
+reference demo's ``internal/tools`` lint pins gate its Makefile
+``check`` target.
+
+Run:
+
+    python -m scripts.staticcheck            # all passes, repo root
+    python -m scripts.staticcheck --list     # pass table
+    python -m scripts.staticcheck --select donation-race,frame-monopoly
+
+Every violation prints ``path:line: [pass-id] message``. A violation
+that is deliberate is suppressed IN PLACE with a pragma that must
+carry a reason::
+
+    detector.state = hydrate()  # staticcheck: ok[donation-race] boot-time, no dispatcher yet
+
+A pragma without a reason, with an unknown pass id, or suppressing
+nothing is itself an error — suppressions are documentation, not
+escape hatches. ``make staticcheck`` (folded into ``make check``) must
+run clean; tests/test_staticcheck.py proves each pass trips on a
+seeded-bad fixture and stays silent on its clean twin.
+
+No jax/numpy imports anywhere in this package: the whole run is pure
+``ast`` + file IO and completes in well under ten seconds.
+"""
+
+from .core import PASSES, Repo, Violation, run_repo  # noqa: F401

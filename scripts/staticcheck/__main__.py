@@ -1,0 +1,52 @@
+"""CLI: ``python -m scripts.staticcheck [--root R] [--select a,b] [--list]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from .core import PASSES, _load_passes, run_repo
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="staticcheck",
+        description="Repo-specific AST invariant analysis (see "
+                    "scripts/staticcheck/__init__.py for the contract).",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="repo root to analyze (default: cwd)",
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated pass ids (default: all)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list passes and exit",
+    )
+    args = parser.parse_args(argv)
+    _load_passes()
+    if args.list:
+        width = max(len(p) for p in PASSES)
+        for pass_id, (_fn, desc) in PASSES.items():
+            print(f"{pass_id:<{width}}  {desc}")
+        return 0
+    select = [p for p in args.select.split(",") if p] or None
+    t0 = time.monotonic()
+    violations, pragma_errors, suppressed = run_repo(args.root, select)
+    for v in violations + pragma_errors:
+        print(v.render())
+    n = len(violations) + len(pragma_errors)
+    took = time.monotonic() - t0
+    print(
+        f"staticcheck: {n} violation(s), {suppressed} suppressed "
+        f"(with reasons), {len(PASSES if select is None else select)} "
+        f"pass(es), {took:.2f}s"
+    )
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

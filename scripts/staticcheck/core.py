@@ -1,0 +1,378 @@
+"""Framework: source model, pragma handling, pass registry, runner.
+
+Passes are plain functions ``(repo) -> list[Violation]`` registered in
+:data:`PASSES`. The framework owns everything cross-cutting: file
+loading/caching, AST parse, parent links (for "am I inside a ``with``
+holding the dispatch lock" questions), the ``# staticcheck: ok[id]``
+suppression pragma (reason REQUIRED), and the unused/unknown-pragma
+errors. Keeping the framework dumb and the passes declarative is what
+lets tests run a single pass against a seeded-bad fixture tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+PRAGMA_RE = re.compile(
+    r"#\s*staticcheck:\s*ok\[([a-z0-9_-]+)\]\s*(.*?)\s*$"
+)
+
+# Directories never scanned (tests manipulate env/state deliberately;
+# caches and VCS metadata are noise; the checker itself necessarily
+# names the patterns it hunts — same self-exemption sanitycheck takes).
+SKIP_DIRS = {
+    "__pycache__", ".git", "build", "tests", "tracetesting",
+    "staticcheck",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    pass_id: str
+    path: str          # repo-relative, slash-separated
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+@dataclass
+class Pragma:
+    pass_id: str
+    reason: str
+    line: int
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST with parent links, pragmas."""
+
+    def __init__(self, root: str, relpath: str):
+        self.relpath = relpath
+        self.abspath = os.path.join(root, relpath)
+        with open(self.abspath, encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(self.text)
+        except SyntaxError as e:  # surfaced as a violation by run_repo
+            self.parse_error = f"syntax error: {e}"
+        self._parents: dict[ast.AST, ast.AST] = {}
+        if self.tree is not None:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        self._comments: dict[int, str] | None = None
+        self._pragmas: dict[int, Pragma] | None = None
+
+    @property
+    def pragmas(self) -> dict[int, Pragma]:
+        """line -> suppression pragma, harvested from REAL comments
+        (the tokenizer map) — a pragma-shaped string literal is data,
+        not a suppression, the same '#-inside-a-string' rule the
+        justification scan applies."""
+        if self._pragmas is None:
+            self._pragmas = {}
+            for ln, comment in sorted(self.comments.items()):
+                m = PRAGMA_RE.search(comment)
+                if m:
+                    self._pragmas[ln] = Pragma(m.group(1), m.group(2), ln)
+        return self._pragmas
+
+    @property
+    def comments(self) -> dict[int, str]:
+        """line -> comment text, from the tokenizer — unlike a ``'#' in
+        line`` scan this cannot be fooled by a ``#`` inside a string
+        literal. Empty on files the tokenizer rejects (those already
+        surface a parse-error violation)."""
+        if self._comments is None:
+            self._comments = {}
+            try:
+                readline = io.StringIO(self.text).readline
+                for tok in tokenize.generate_tokens(readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenizeError, SyntaxError,
+                    IndentationError, ValueError):
+                self._comments = {}
+        return self._comments
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def inside_with_matching(self, node: ast.AST, needle: str) -> bool:
+        """True when ``node`` sits inside a ``with`` statement whose
+        context expression source mentions ``needle`` (e.g. the
+        dispatch lock). Lexical, not dynamic — which is the point: the
+        contract is "the read is WRITTEN under the lock"."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    if needle in ast.unparse(item.context_expr):
+                        return True
+        return False
+
+    def segment(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.text, node) or ""
+        except Exception:  # pragma: no cover - malformed positions
+            return ""
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Alias → canonical dotted name, per module.
+
+    Resolves ``import numpy as np`` / ``from numpy import frombuffer
+    as fb`` so a pass can ask "does this call reach
+    ``numpy.frombuffer``?" regardless of spelling — the whole reason
+    these checks moved off grep."""
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def resolve_call(self, func: ast.AST) -> str | None:
+        """Canonical dotted target of a call's func expression."""
+        name = dotted(func)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        base = self.aliases.get(head)
+        if base is None:
+            return name
+        return f"{base}.{rest}" if rest else base
+
+
+class Repo:
+    """Scanned tree + cached parsed sources.
+
+    ``package`` is the main source package (detected: a top-level
+    directory with an ``__init__.py`` and a ``runtime/`` or ``utils/``
+    subdirectory), so fixtures in tests can use any package name and
+    the passes still find their anchor modules (``utils/config.py``,
+    ``telemetry/metrics.py``, ``runtime/frame.py``, …)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._cache: dict[str, SourceFile] = {}
+        self.package = self._detect_package()
+
+    def _detect_package(self) -> str | None:
+        candidates = []
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError:
+            return None
+        for name in entries:
+            path = os.path.join(self.root, name)
+            if name in SKIP_DIRS or not os.path.isdir(path):
+                continue
+            if not os.path.exists(os.path.join(path, "__init__.py")):
+                continue
+            if os.path.isdir(os.path.join(path, "runtime")) or os.path.isdir(
+                os.path.join(path, "utils")
+            ):
+                candidates.append(name)
+        return candidates[0] if candidates else None
+
+    # -- file iteration -------------------------------------------------
+
+    def iter_py(self, *subpaths: str) -> list[str]:
+        """Repo-relative paths of .py files under the given subpaths
+        (default: package + scripts + top-level .py files), skipping
+        tests/caches."""
+        roots = list(subpaths)
+        if not roots:
+            roots = [p for p in (self.package, "scripts") if p]
+            roots += [
+                f for f in ("bench.py", "__graft_entry__.py")
+                if os.path.exists(os.path.join(self.root, f))
+            ]
+        out: list[str] = []
+        for sub in roots:
+            absolute = os.path.join(self.root, sub)
+            if os.path.isfile(absolute) and sub.endswith(".py"):
+                out.append(sub.replace(os.sep, "/"))
+                continue
+            for dirpath, dirnames, filenames in os.walk(absolute):
+                dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fname), self.root
+                        )
+                        out.append(rel.replace(os.sep, "/"))
+        return sorted(set(out))
+
+    def source(self, relpath: str) -> SourceFile | None:
+        relpath = relpath.replace(os.sep, "/")
+        if relpath not in self._cache:
+            absolute = os.path.join(self.root, relpath)
+            if not os.path.exists(absolute):
+                return None
+            self._cache[relpath] = SourceFile(self.root, relpath)
+        return self._cache[relpath]
+
+    def pkg_path(self, *parts: str) -> str | None:
+        """Repo-relative path of a module inside the package."""
+        if self.package is None:
+            return None
+        return "/".join((self.package,) + parts)
+
+    def read_text(self, relpath: str) -> str | None:
+        absolute = os.path.join(self.root, relpath)
+        if not os.path.exists(absolute):
+            return None
+        with open(absolute, encoding="utf-8", errors="replace") as f:
+            return f.read()
+
+
+# -- pass registry -----------------------------------------------------
+
+# pass-id -> (callable, one-line description). Populated by
+# register_passes() below to keep import order simple.
+PASSES: dict = {}
+
+
+def _load_passes() -> None:
+    if PASSES:
+        return
+    from .passes import (
+        concurrency,
+        donation,
+        exception_status,
+        frame_monopoly,
+        knobs,
+        metric_surface,
+    )
+
+    for mod in (
+        donation, knobs, metric_surface,
+        frame_monopoly, concurrency, exception_status,
+    ):
+        PASSES[mod.PASS_ID] = (mod.run, mod.DESCRIPTION)
+
+
+def run_repo(
+    root: str,
+    select: list[str] | None = None,
+) -> tuple[list[Violation], list[Violation], int]:
+    """Run passes against a tree.
+
+    Returns ``(violations, pragma_errors, suppressed_count)``:
+    ``violations`` are unsuppressed findings; ``pragma_errors`` are
+    misused pragmas (missing reason / unknown id / suppressing
+    nothing) and are never themselves suppressible.
+    """
+    _load_passes()
+    repo = Repo(root)
+    chosen = select or list(PASSES)
+    unknown = [p for p in chosen if p not in PASSES]
+    if unknown:
+        raise SystemExit(
+            f"unknown pass id(s) {unknown}; known: {sorted(PASSES)}"
+        )
+    raw: list[Violation] = []
+    for pass_id in chosen:
+        fn, _desc = PASSES[pass_id]
+        raw.extend(fn(repo))
+    # Parse failures in scanned files surface once, unsuppressible.
+    pragma_errors: list[Violation] = []
+    for rel in repo.iter_py():
+        src = repo.source(rel)
+        if src is not None and src.parse_error:
+            pragma_errors.append(
+                Violation("framework", rel, 1, src.parse_error)
+            )
+
+    violations: list[Violation] = []
+    suppressed = 0
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.pass_id)):
+        src = repo.source(v.path)
+        pragma = src.pragmas.get(v.line) if src is not None else None
+        if pragma is not None and pragma.pass_id == v.pass_id:
+            pragma.used = True
+            if pragma.reason:
+                suppressed += 1
+                continue
+            # Reason missing: the violation stands AND the pragma is
+            # flagged — an unexplained suppression documents nothing.
+        violations.append(v)
+    # Pragma hygiene across every scanned file (selected passes only:
+    # a fixture run for one pass must not trip over pragmas aimed at
+    # another).
+    for rel in repo.iter_py():
+        src = repo.source(rel)
+        if src is None:
+            continue
+        for pragma in src.pragmas.values():
+            if pragma.pass_id not in PASSES:
+                pragma_errors.append(Violation(
+                    "pragma", rel, pragma.line,
+                    f"pragma names unknown pass id {pragma.pass_id!r}",
+                ))
+                continue
+            if pragma.pass_id not in chosen:
+                continue
+            if not pragma.reason:
+                pragma_errors.append(Violation(
+                    "pragma", rel, pragma.line,
+                    f"suppression ok[{pragma.pass_id}] carries no reason "
+                    "(a pragma must say WHY the finding is fine)",
+                ))
+            elif not pragma.used:
+                pragma_errors.append(Violation(
+                    "pragma", rel, pragma.line,
+                    f"suppression ok[{pragma.pass_id}] suppresses nothing "
+                    "(stale pragma — the code it excused is gone)",
+                ))
+    return violations, pragma_errors, suppressed

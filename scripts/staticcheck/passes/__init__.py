@@ -1,0 +1,1 @@
+# Pass modules. Each exposes PASS_ID, DESCRIPTION and run(repo).

@@ -1,0 +1,141 @@
+"""concurrency: thread lifecycle ownership + no blocking under the lock.
+
+Two rules, both born from real incidents in this repo's history (the
+ack-resync storm flake, the width-ladder warmup threads outliving a
+test daemon):
+
+1. **Every thread has an owner.** A ``threading.Thread`` must either
+   be daemonized (``daemon=True`` — the process's exit is its owner)
+   or be joined by the code that spawned it: a function-local thread
+   joins in its enclosing function; a thread stored on ``self`` joins
+   somewhere in its class (the stop/close/shutdown path). A
+   non-daemon, never-joined thread keeps a dead component's work alive
+   and starves whatever runs next.
+
+2. **No blocking call while holding the dispatch lock.** The dispatch
+   lock serializes detector-state advancement; every receiver thread
+   and the pump contend on it. A ``time.sleep``/socket op/``.join``/
+   ``.result``/``.wait`` inside ``with ..._dispatch_lock`` turns one
+   slow peer into a stalled ingest path. Snapshot under the lock,
+   block outside it — the discipline replication/checkpoint/warmup all
+   follow.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ImportMap, Repo, SourceFile, Violation, dotted
+
+PASS_ID = "concurrency"
+DESCRIPTION = (
+    "threads daemonized or joined by their owner; no blocking calls "
+    "inside `with ..._dispatch_lock`"
+)
+
+LOCK_NEEDLE = "_dispatch_lock"
+
+# Dotted-call prefixes considered blocking inside the dispatch lock.
+BLOCKING_PREFIXES = (
+    "time.sleep", "socket.", "subprocess.", "requests.",
+    "urllib.request.",
+)
+# Method names considered blocking when invoked on anything inside the
+# locked region (join/result/wait are the synchronization verbs; a
+# str.join would be `", ".join(...)` whose receiver is a Constant —
+# excluded below).
+BLOCKING_METHODS = {"join", "result", "wait", "acquire", "recv", "accept"}
+
+
+def _thread_spawn(node: ast.Call, imap: ImportMap) -> bool:
+    target = imap.resolve_call(node.func)
+    return target in ("threading.Thread", "threading.Timer")
+
+
+def _is_daemon(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and bool(kw.value.value)
+    return False
+
+
+def _has_thread_join(scope: ast.AST | None) -> bool:
+    """True when the scope contains a ``.join()`` call that could be a
+    thread join — i.e. NOT a string join (Constant receiver like
+    ``", ".join(...)``) and not ``os.path.join``. Without this
+    distinction one log-formatting str.join anywhere in a class would
+    vacuously satisfy the ownership rule for every thread in it."""
+    if scope is None:
+        return False
+    for n in ast.walk(scope):
+        if not (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "join"
+        ):
+            continue
+        recv = n.func.value
+        if isinstance(recv, ast.Constant):
+            continue  # ", ".join(...) — a string join
+        if dotted(recv) in ("os.path", "posixpath", "ntpath"):
+            continue
+        return True
+    return False
+
+
+def _joined_nearby(src: SourceFile, node: ast.Call) -> bool:
+    """Heuristic ownership check: a plausible thread `.join()` in the
+    enclosing function, or (for `self.x = Thread(...)`) anywhere in
+    the class — the stop/close path that owns the thread."""
+    return _has_thread_join(
+        src.enclosing_function(node)
+    ) or _has_thread_join(src.enclosing_class(node))
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    scan = repo.iter_py(repo.package) if repo.package else []
+    scan += repo.iter_py("scripts")
+    for rel in sorted(set(scan)):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        imap = ImportMap(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # Rule 1: thread ownership.
+            if _thread_spawn(node, imap):
+                if not _is_daemon(node) and not _joined_nearby(src, node):
+                    out.append(Violation(
+                        PASS_ID, rel, node.lineno,
+                        "non-daemon Thread with no join in its owner "
+                        "(enclosing function/class): daemonize it, or "
+                        "join it from the stop/close path that owns it",
+                    ))
+                continue
+            # Rule 2: blocking call under the dispatch lock.
+            if not src.inside_with_matching(node, LOCK_NEEDLE):
+                continue
+            target = imap.resolve_call(node.func) or ""
+            blocking = any(
+                target == p or target.startswith(p)
+                for p in BLOCKING_PREFIXES
+            )
+            if not blocking and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if (
+                    node.func.attr in BLOCKING_METHODS
+                    and not isinstance(recv, ast.Constant)
+                    and dotted(recv) != "os.path"
+                ):
+                    blocking = True
+            if blocking:
+                out.append(Violation(
+                    PASS_ID, rel, node.lineno,
+                    f"blocking call `{src.segment(node.func)}()` while "
+                    f"holding {LOCK_NEEDLE}: every receiver thread and "
+                    "the pump contend on this lock — copy under the "
+                    "lock, block outside it",
+                ))
+    return out

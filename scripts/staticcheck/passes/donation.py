@@ -1,0 +1,88 @@
+"""donation-race: detector state touched outside the dispatch lock.
+
+Live dispatch DONATES the detector's device buffers: the jitted step
+deletes its input arrays Python-side the moment it dispatches
+(``jax.jit(..., donate_argnums=...)``), so any other thread reading —
+or swapping — ``detector.state`` concurrently races "Array has been
+deleted". The repo's rule (previously a memory note, now enforced):
+every access to a ``detector.state`` chain outside the model package
+happens inside ``with <pipeline>._dispatch_lock``, the same lock
+``DetectorPipeline.pump`` holds for the dispatch itself. That covers
+reads (snapshot helpers: replication, checkpoint, benches) AND writes
+(promotion hydration) — an unlocked swap can be clobbered by a
+dispatcher mid-flight just as easily as an unlocked read can touch a
+deleted buffer.
+
+Accesses that are provably single-threaded (boot-time hydration before
+any dispatcher thread exists) carry the pragma with the proof as the
+reason.
+
+Scope: the package outside ``models/`` (the detector/head classes own
+their ``self.state``; the pipeline serializes them) plus ``scripts/``
+and ``bench.py``. The lock context is recognized lexically: any
+enclosing ``with`` whose context expression mentions ``dispatch_lock``
+(the pipeline attribute, or a ``dispatch_lock`` parameter a helper
+like ``checkpoint.save`` threads through).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Repo, Violation, dotted
+
+PASS_ID = "donation-race"
+DESCRIPTION = (
+    "detector.state read/written outside `with ..._dispatch_lock` "
+    "(donated device buffers: races 'Array has been deleted')"
+)
+
+LOCK_NEEDLE = "dispatch_lock"
+
+
+def _is_detector_state(node: ast.Attribute) -> bool:
+    """True for ``<...>.detector.state`` / ``detector.state`` chains
+    (and their ``._asdict()`` snapshot reads, which hang off the same
+    Attribute node)."""
+    if node.attr != "state":
+        return False
+    base = dotted(node.value)
+    return base is not None and (
+        base == "detector" or base.endswith(".detector")
+    )
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    scan: list[str] = []
+    if repo.package:
+        scan += [
+            p for p in repo.iter_py(repo.package)
+            if not p.startswith(f"{repo.package}/models/")
+        ]
+    scan += repo.iter_py("scripts")
+    for extra in ("bench.py",):
+        if repo.source(extra) is not None:
+            scan.append(extra)
+    for rel in sorted(set(scan)):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Attribute) and _is_detector_state(node)
+            ):
+                continue
+            if src.inside_with_matching(node, LOCK_NEEDLE):
+                continue
+            kind = (
+                "written" if isinstance(node.ctx, ast.Store) else "read"
+            )
+            out.append(Violation(
+                PASS_ID, rel, node.lineno,
+                f"`{src.segment(node) or 'detector.state'}` {kind} outside "
+                f"`with ...{LOCK_NEEDLE}`: live dispatch donates these "
+                "buffers — snapshot/swap under the pipeline's dispatch "
+                "lock (or prove single-threadedness in a pragma reason)",
+            ))
+    return out

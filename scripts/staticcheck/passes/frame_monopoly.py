@@ -1,0 +1,104 @@
+"""frame-monopoly: runtime/frame.py owns every state byte layout.
+
+PR 5's refactor put ALL state byte layouts — ingest scratch→pipeline,
+replication payloads, checkpoint files — into ONE checksummed,
+versioned columnar frame (``runtime/frame.py``). The contract is only
+worth anything if no later PR can quietly fork it, so raw
+byte-(de)serialization primitives are fenced to the layout owners:
+
+- ``numpy.savez``/``savez_compressed``/``load`` (the pre-frame npz
+  containers) and ``numpy.frombuffer``/``fromfile`` (raw
+  reinterpretation of state bytes) are allowed ONLY in ``frame.py``
+  (which owns the legacy-v0 migration shim) and ``tensorize.py``
+  (whose documented record-join frombuffer is a hash input, not a
+  wire layout).
+
+- ``struct.pack``/``unpack``/``Struct`` are additionally allowed in
+  the declared PROTOCOL CODECS — modules that implement byte layouts
+  owned by EXTERNAL protocols (Kafka wire format, protobuf varints,
+  HTTP/2 frames, the replication envelope header, faultwire's fault
+  plans). Those are not state layouts; forcing them through frame.py
+  would be category error. The list is closed: a new codec module is
+  a deliberate, reviewed addition here, not a drive-by.
+
+Detection is by import resolution, not text: ``from numpy import
+frombuffer as fb`` or ``import struct as s`` cannot dodge it — which
+is exactly why this pass replaces scripts/sanitycheck.py's old grep
+pins (sanitycheck now delegates to this pass, so the two can never
+disagree).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import ImportMap, Repo, Violation
+
+PASS_ID = "frame-monopoly"
+DESCRIPTION = (
+    "np.savez/np.load/np.frombuffer/struct.pack outside frame.py/"
+    "tensorize.py (+ declared protocol codecs for struct), resolved "
+    "through imports"
+)
+
+# Layout owners (repo-relative under the package).
+FRAME_OWNERS = ("runtime/frame.py", "runtime/tensorize.py")
+# External-protocol codecs: struct use here encodes SOMEONE ELSE'S
+# wire format, not detector state.
+PROTOCOL_CODECS = (
+    "runtime/wire.py",        # length-prefixed frame transport
+    "runtime/kafka_wire.py",  # Kafka protocol encoding
+    "runtime/structpb.py",    # protobuf wire primitives
+    "runtime/replication.py", # session envelope header (state INSIDE is frames)
+    "runtime/faultwire.py",   # chaos proxy fault plans
+    "runtime/otlp_metrics.py",# OTLP fixed64/double fields
+    "services/grpc_edge.py",  # HTTP/2 frame codec
+)
+
+NUMPY_FENCED = {
+    "numpy.savez", "numpy.savez_compressed", "numpy.load",
+    "numpy.frombuffer", "numpy.fromfile",
+}
+STRUCT_FENCED = {
+    "struct.pack", "struct.unpack", "struct.pack_into",
+    "struct.unpack_from", "struct.Struct", "struct.calcsize",
+}
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    if repo.package is None:
+        return out
+    owners = {f"{repo.package}/{p}" for p in FRAME_OWNERS}
+    codecs = {f"{repo.package}/{p}" for p in PROTOCOL_CODECS}
+    for rel in repo.iter_py(repo.package):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        imap = ImportMap(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = imap.resolve_call(node.func)
+            if target is None:
+                continue
+            if target in NUMPY_FENCED and rel not in owners:
+                out.append(Violation(
+                    PASS_ID, rel, node.lineno,
+                    f"{target}() outside runtime/frame.py|tensorize.py: "
+                    "state byte layouts have ONE owner — encode/decode "
+                    "through runtime.frame instead of minting a layout",
+                ))
+            elif (
+                target in STRUCT_FENCED
+                and rel not in owners
+                and rel not in codecs
+            ):
+                out.append(Violation(
+                    PASS_ID, rel, node.lineno,
+                    f"{target}() outside the layout owners and declared "
+                    "protocol codecs: a new byte layout goes through "
+                    "runtime.frame; a new external-protocol codec is a "
+                    "deliberate addition to PROTOCOL_CODECS in this pass",
+                ))
+    return out

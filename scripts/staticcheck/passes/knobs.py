@@ -1,0 +1,320 @@
+"""knob-discipline: every env knob lives in ONE ``*_KNOBS`` registry.
+
+The repo's config contract (``utils/config.py``): a knob family is ONE
+literal dict — env name → (type, default, meaning) — consumed by the
+daemon, the compose overlay, the k8s generator and the checkers, so
+the knob set can never drift between surfaces. This pass enforces it
+structurally:
+
+1. **No stray reads.** Every ``os.environ``/``os.getenv`` read outside
+   ``utils/config.py`` must name a registered knob (string literal
+   resolvable against the union of all ``*_KNOBS`` registries). Env
+   *writes* (``environ[k] = v`` / ``setdefault``) and whole-environment
+   passthrough (``dict(os.environ)`` / ``os.environ.copy()`` for
+   subprocess spawning) are fine — the contract is about configuration
+   reads. A read whose key is not a literal (helper indirection) is
+   checked at the helper's call sites instead: a function whose
+   parameter flows into an environ read is an *env accessor*, and each
+   of its call sites must pass a registered literal.
+
+2. **Deployed registries are threaded.** Registries named in
+   ``config.DEPLOYED_KNOB_REGISTRIES`` must have every knob present in
+   ``runtime/daemon.py`` (a string constant in its AST — the consuming
+   subscript), in ``deploy/docker-compose.anomaly.yml``, and the k8s
+   generator must reference the registry object itself (it consumes
+   the dict, so per-knob greps there would be redundant). Harness
+   registries (faultwire chaos knobs, bench/shop scaffolding) only
+   legitimize reads — a chaos proxy has no business in the fleet
+   compose file.
+
+3. **No dead knobs.** Every registered knob must be read somewhere
+   outside ``utils/config.py`` (as a string constant in a scanned
+   module) — a knob nobody consumes is documentation rot wearing a
+   registry entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import ImportMap, Repo, SourceFile, Violation, dotted
+
+PASS_ID = "knob-discipline"
+DESCRIPTION = (
+    "os.environ reads must resolve to a *_KNOBS registry entry; "
+    "deployed registries threaded through daemon/compose/k8s; "
+    "no dead knobs"
+)
+
+CONFIG_REL = ("utils", "config.py")
+DAEMON_REL = ("runtime", "daemon.py")
+K8S_REL = ("utils", "k8s.py")
+COMPOSE_REL = "deploy/docker-compose.anomaly.yml"
+
+
+def load_registries(src: SourceFile) -> tuple[dict[str, dict], tuple]:
+    """(registries, deployed_names) from utils/config.py's AST."""
+    registries: dict[str, dict] = {}
+    deployed: tuple = ()
+    if src.tree is None:
+        return registries, deployed
+    for node in src.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id.endswith("_KNOBS"):
+                try:
+                    registries[t.id] = ast.literal_eval(value)
+                except ValueError:
+                    continue  # non-literal registry: config's own tests
+            elif t.id == "DEPLOYED_KNOB_REGISTRIES":
+                try:
+                    deployed = tuple(ast.literal_eval(value))
+                except ValueError:
+                    pass
+    return registries, deployed
+
+
+def compose_defines(compose_text: str, knob: str) -> bool:
+    """True when the compose file DEFINES the knob: an env entry
+    ``- KNOB=...`` / ``KNOB: ...`` / bare ``- KNOB`` passthrough on a
+    non-comment line. A raw substring test would be fooled by prefix
+    knobs (``ANOMALY_CHECKPOINT`` matching inside
+    ``ANOMALY_CHECKPOINT_INTERVAL_S``) and by mentions in comments —
+    exactly the silent-drift this pass exists to prevent."""
+    pattern = re.compile(
+        rf"^\s*-?\s*[\"']?{re.escape(knob)}[\"']?\s*([=:]|$)"
+    )
+    for line in compose_text.splitlines():
+        code = line.split("#", 1)[0]
+        if pattern.match(code):
+            return True
+    return False
+
+
+def _env_read_key(node: ast.Call, imap: ImportMap) -> tuple[bool, ast.AST | None]:
+    """(is_env_read, key_node) for a call; key_node None = no args."""
+    target = imap.resolve_call(node.func)
+    if target in ("os.getenv", "os.environ.get"):
+        return True, (node.args[0] if node.args else None)
+    return False, None
+
+
+def _is_environ_expr(node: ast.AST, imap: ImportMap) -> bool:
+    name = dotted(node)
+    if name is None:
+        return False
+    head = name.split(".")[0]
+    resolved = imap.aliases.get(head, head)
+    full = ".".join([resolved] + name.split(".")[1:])
+    return full in ("os.environ", "environ")
+
+
+def _collect_accessors(src: SourceFile, imap: ImportMap) -> dict[str, int]:
+    """Function name → param index whose value flows into an environ
+    read key (the helper-indirection case: ``def env_int(name, ...):
+    ... os.environ.get(name)``)."""
+    accessors: dict[str, int] = {}
+    if src.tree is None:
+        return accessors
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in fn.args.args]
+        for node in ast.walk(fn):
+            key = None
+            if isinstance(node, ast.Call):
+                is_read, key = _env_read_key(node, imap)
+                if not is_read:
+                    continue
+            elif isinstance(node, ast.Subscript) and _is_environ_expr(
+                node.value, imap
+            ):
+                key = node.slice
+            else:
+                continue
+            if isinstance(key, ast.Name) and key.id in params:
+                accessors[fn.name] = params.index(key.id)
+    return accessors
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    config_rel = repo.pkg_path(*CONFIG_REL)
+    config_src = repo.source(config_rel) if config_rel else None
+    registries: dict[str, dict] = {}
+    deployed: tuple = ()
+    if config_src is not None:
+        registries, deployed = load_registries(config_src)
+    known = {k for reg in registries.values() for k in reg}
+
+    # Env accessors declared in config.py (env_str/env_int/...): their
+    # call sites elsewhere must pass registered literals.
+    accessor_params: dict[str, int] = {}
+    if config_src is not None and config_src.tree is not None:
+        accessor_params = _collect_accessors(
+            config_src, ImportMap(config_src.tree)
+        )
+
+    scanned: list[str] = []
+    for rel in repo.iter_py():
+        if config_rel is not None and rel == config_rel:
+            continue  # the registry module is the one legitimate home
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        scanned.append(rel)
+        imap = ImportMap(src.tree)
+        local_accessors = _collect_accessors(src, imap)
+
+        def check_key(key: ast.AST | None, line: int, how: str) -> None:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                if key.value not in known:
+                    out.append(Violation(
+                        PASS_ID, rel, line,
+                        f"{how} reads env {key.value!r} which is not in "
+                        "any utils/config.py *_KNOBS registry — register "
+                        "it (one literal dict per knob family) or read "
+                        "it through a registered family",
+                    ))
+            else:
+                out.append(Violation(
+                    PASS_ID, rel, line,
+                    f"{how} reads a non-literal env key — unresolvable "
+                    "against the knob registries; thread the literal "
+                    "name through, or use a config.py accessor",
+                ))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                is_read, key = _env_read_key(node, imap)
+                if is_read:
+                    fn = src.enclosing_function(node)
+                    if (
+                        fn is not None
+                        and isinstance(key, ast.Name)
+                        and fn.name in local_accessors
+                    ):
+                        continue  # the accessor body; call sites checked
+                    check_key(key, node.lineno, "call")
+                    continue
+                # Accessor call sites (config.env_int("NAME", ...) or a
+                # locally defined helper).
+                target = imap.resolve_call(node.func)
+                base = target.split(".")[-1] if target else None
+                idx = accessor_params.get(base) if base else None
+                if idx is None and base in local_accessors:
+                    idx = local_accessors[base]
+                if idx is not None and len(node.args) > idx:
+                    check_key(
+                        node.args[idx], node.lineno, f"{base}() call"
+                    )
+            elif isinstance(node, ast.Subscript) and _is_environ_expr(
+                node.value, imap
+            ):
+                if isinstance(node.ctx, ast.Load):
+                    fn = src.enclosing_function(node)
+                    if (
+                        fn is not None
+                        and isinstance(node.slice, ast.Name)
+                        and fn.name in local_accessors
+                    ):
+                        continue
+                    check_key(node.slice, node.lineno, "subscript")
+            elif isinstance(node, ast.Compare) and any(
+                _is_environ_expr(c, imap) for c in node.comparators
+            ):
+                left = node.left
+                check_key(left, node.lineno, "membership test")
+
+    # -- threading + dead-knob checks ---------------------------------
+    if config_src is None:
+        return out
+    daemon_rel = repo.pkg_path(*DAEMON_REL)
+    daemon_src = repo.source(daemon_rel) if daemon_rel else None
+    daemon_consts: set[str] = set()
+    if daemon_src is not None and daemon_src.tree is not None:
+        daemon_consts = {
+            n.value for n in ast.walk(daemon_src.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        }
+    compose_text = repo.read_text(COMPOSE_REL)
+    k8s_rel = repo.pkg_path(*K8S_REL)
+    k8s_src = repo.source(k8s_rel) if k8s_rel else None
+    k8s_names: set[str] = set()
+    if k8s_src is not None and k8s_src.tree is not None:
+        k8s_names = {
+            n.id for n in ast.walk(k8s_src.tree) if isinstance(n, ast.Name)
+        }
+        # An `from .config import X_KNOBS` counts too: the import IS
+        # the registry reference the check demands (vs copied strings).
+        k8s_names |= set(ImportMap(k8s_src.tree).aliases)
+
+    cfg_line = {  # registry name -> declaration line, for messages
+        t.id: node.lineno
+        for node in (config_src.tree.body if config_src.tree else [])
+        if isinstance(node, (ast.Assign, ast.AnnAssign))
+        for t in (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        if isinstance(t, ast.Name)
+    }
+    for reg_name in deployed:
+        reg = registries.get(reg_name)
+        line = cfg_line.get(reg_name, 1)
+        if reg is None:
+            out.append(Violation(
+                PASS_ID, config_rel, line,
+                f"DEPLOYED_KNOB_REGISTRIES names {reg_name} but no such "
+                "registry is declared",
+            ))
+            continue
+        if k8s_src is not None and reg_name not in k8s_names:
+            out.append(Violation(
+                PASS_ID, k8s_rel, 1,
+                f"k8s generator does not consume the {reg_name} registry "
+                "(it must import the dict, not copy its strings)",
+            ))
+        for knob in reg:
+            if daemon_src is not None and knob not in daemon_consts:
+                out.append(Violation(
+                    PASS_ID, config_rel, line,
+                    f"{reg_name}[{knob!r}] is not threaded through "
+                    "runtime/daemon.py (no consuming reference)",
+                ))
+            if compose_text is not None and not compose_defines(
+                compose_text, knob
+            ):
+                out.append(Violation(
+                    PASS_ID, config_rel, line,
+                    f"{reg_name}[{knob!r}] is not threaded through "
+                    f"{COMPOSE_REL}",
+                ))
+    # Dead knobs: registered but consumed nowhere.
+    consumed: set[str] = set()
+    for rel in scanned:
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        consumed |= {
+            n.value for n in ast.walk(src.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        }
+    for reg_name, reg in registries.items():
+        line = cfg_line.get(reg_name, 1)
+        for knob in reg:
+            if knob not in consumed:
+                out.append(Violation(
+                    PASS_ID, config_rel, line,
+                    f"{reg_name}[{knob!r}] is dead: no module outside "
+                    "utils/config.py ever names it",
+                ))
+    return out

@@ -1,0 +1,196 @@
+"""metric-surface: one metric name table, dashboards/docs in sync.
+
+The ``anomaly_*`` / ``app_anomaly_*`` Prometheus family is the
+operator surface: Grafana panels (``telemetry/dashboards.py``) and the
+ops docs (``deploy/README.md`` / ``README.md``) are written against
+the names ``telemetry/metrics.py`` declares. Three drift modes, each
+historically reachable by one careless edit:
+
+1. **Stray literal.** A metric constructed with an inline string
+   (``registry.gauge_set("app_anomaly_...", ...)``) bypasses the name
+   table — it can typo silently and no dashboard/doc check ever sees
+   it. Every anomaly-family construction site must reference a
+   ``metrics.py`` constant. (External vocabularies — ``container_*``,
+   ``otelcol_*``, spanmetrics — are other systems' names and exempt.)
+
+2. **Dangling panel.** A dashboard Query naming an anomaly-family
+   metric that no constant declares graphs nothing, forever
+   (histogram ``_bucket``/``_sum``/``_count`` suffixes are resolved to
+   their base constant first).
+
+3. **Orphan.** A constant no code ever constructs, or one missing
+   from the ops docs (``deploy/README.md`` or ``README.md``), is a
+   dead or invisible metric — either way the surface and its
+   documentation have forked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Repo, Violation, dotted
+
+PASS_ID = "metric-surface"
+DESCRIPTION = (
+    "anomaly metric names come from telemetry/metrics.py constants; "
+    "dashboards and deploy docs reference only declared names"
+)
+
+METRICS_REL = ("telemetry", "metrics.py")
+DASHBOARDS_REL = ("telemetry", "dashboards.py")
+FAMILY_PREFIXES = ("anomaly_", "app_anomaly_")
+CONSTRUCTORS = {
+    "counter_add", "gauge_set", "histogram_observe", "describe",
+}
+HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family(name: str) -> bool:
+    return name.startswith(FAMILY_PREFIXES)
+
+
+def _strip_histo(name: str) -> str:
+    for suf in HISTO_SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def load_constants(repo: Repo) -> dict[str, str]:
+    """UPPER_NAME -> metric string from telemetry/metrics.py."""
+    rel = repo.pkg_path(*METRICS_REL)
+    src = repo.source(rel) if rel else None
+    consts: dict[str, str] = {}
+    if src is None or src.tree is None:
+        return consts
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.isupper():
+                    consts[t.id] = node.value.value
+    return consts
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    if repo.package is None:
+        return out
+    consts = load_constants(repo)
+    values = set(consts.values())
+    metrics_rel = repo.pkg_path(*METRICS_REL)
+
+    # 1) construction sites across the package. A constant counts as
+    #    "constructed" when its value appears as a registry-call
+    #    literal OR its NAME is referenced anywhere outside metrics.py
+    #    (constants also flow through helpers like the daemon's
+    #    _export_counter_delta, where the call site isn't a registry
+    #    method).
+    used_values: set[str] = set()
+    referenced_names: set[str] = set()
+    for rel in repo.iter_py(repo.package):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        if rel != metrics_rel:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Attribute) and node.attr in consts:
+                    referenced_names.add(node.attr)
+                elif isinstance(node, ast.Name) and node.id in consts:
+                    referenced_names.add(node.id)
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in CONSTRUCTORS
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if _family(arg.value) and rel != metrics_rel:
+                    out.append(Violation(
+                        PASS_ID, rel, node.lineno,
+                        f"metric {arg.value!r} constructed from a string "
+                        "literal — anomaly-family names must come from "
+                        "the telemetry/metrics.py constant table (typos "
+                        "here are invisible to every other check)",
+                    ))
+                used_values.add(arg.value)
+            else:
+                name = dotted(arg)
+                if name is not None:
+                    const = consts.get(name.split(".")[-1])
+                    if const is not None:
+                        used_values.add(const)
+
+    # 2) dashboard references.
+    dash_rel = repo.pkg_path(*DASHBOARDS_REL)
+    dash_src = repo.source(dash_rel) if dash_rel else None
+    if dash_src is not None and dash_src.tree is not None:
+        for node in ast.walk(dash_src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Query"
+                and node.args
+            ):
+                continue
+            kind = (
+                node.args[0].value
+                if isinstance(node.args[0], ast.Constant) else None
+            )
+            if kind not in ("rate", "quantile", "instant"):
+                continue  # traces/logs/sketch target other datasources
+            metric = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                metric = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "metric" and isinstance(kw.value, ast.Constant):
+                    metric = kw.value.value
+            if not isinstance(metric, str) or not _family(metric):
+                continue
+            base = _strip_histo(metric)
+            if base not in values and metric not in values:
+                out.append(Violation(
+                    PASS_ID, dash_rel, node.lineno,
+                    f"dashboard panel queries {metric!r} but no "
+                    "telemetry/metrics.py constant declares it — the "
+                    "panel would graph nothing, forever",
+                ))
+            else:
+                used_values.add(base if base in values else metric)
+
+    # 3) orphans: every anomaly-family constant must be constructed
+    #    somewhere and documented in the ops docs.
+    docs = (
+        (repo.read_text("deploy/README.md") or "")
+        + (repo.read_text("README.md") or "")
+    )
+    metrics_src = repo.source(metrics_rel) if metrics_rel else None
+    const_line: dict[str, int] = {}
+    if metrics_src is not None and metrics_src.tree is not None:
+        for node in metrics_src.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        const_line[t.id] = node.lineno
+    for cname, value in consts.items():
+        if not _family(value):
+            continue
+        line = const_line.get(cname, 1)
+        if value not in used_values and cname not in referenced_names:
+            out.append(Violation(
+                PASS_ID, metrics_rel, line,
+                f"{cname} ({value!r}) is never constructed by any "
+                "registry call — a dead metric name",
+            ))
+        if docs and value not in docs:
+            out.append(Violation(
+                PASS_ID, metrics_rel, line,
+                f"{cname} ({value!r}) is not documented in "
+                "deploy/README.md or README.md — operators cannot "
+                "discover it",
+            ))
+    return out

@@ -324,7 +324,7 @@ class TestCorruptCheckpoint:
 
         det = AnomalyDetector(DetectorConfig(**SMALL))
         path = str(tmp_path / "snap")
-        checkpoint.save(path, det, offsets={0: 5})
+        checkpoint.save(path, det, offsets={0: 5}, dispatch_lock=None)
         # Flip bytes mid-file without breaking the structure (the
         # corruption a torn-write check can't see): the frame's
         # per-column CRC32C / trailer is what catches it — the role
@@ -346,7 +346,7 @@ class TestCorruptCheckpoint:
 
         det = AnomalyDetector(DetectorConfig(**SMALL))
         path = str(tmp_path / "snap")
-        checkpoint.save(path, det)
+        checkpoint.save(path, det, dispatch_lock=None)
         with pytest.raises(ValueError):
             checkpoint.load_resilient(path, DetectorConfig(num_services=16))
 
@@ -360,7 +360,7 @@ class TestCorruptCheckpoint:
         det = AnomalyDetector(DetectorConfig(**SMALL))
         det.clock._t_prev = 41.75
         path = str(tmp_path / "snap")
-        checkpoint.save(path, det)
+        checkpoint.save(path, det, dispatch_lock=None)
         det2, meta = checkpoint.load(path, DetectorConfig(**SMALL))
         assert meta["clock_t_prev"] == 41.75
         assert det2.clock._t_prev == 41.75
@@ -702,7 +702,7 @@ def test_ttd_unchanged_after_checkpoint_recovery(tmp_path):
             det.observe(qualbench._batch(rng, tz), step * qualbench.DT_S)
             if with_restart and step == RESTART_AT:
                 path = str(tmp_path / f"reco-{with_restart}")
-                checkpoint.save(path, det)
+                checkpoint.save(path, det, dispatch_lock=None)
                 det, _meta = checkpoint.load(path, config)
         for k in range(WINDOW):
             report = det.observe(

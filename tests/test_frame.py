@@ -674,7 +674,7 @@ class TestCheckpointSkew:
         )
         # Roll forward: the next save writes the frame layout and
         # retires the npz (one snapshot, one format, going forward).
-        checkpoint.save(path, det2, offsets={0: 45}, epoch=2)
+        checkpoint.save(path, det2, offsets={0: 45}, epoch=2, dispatch_lock=None)
         assert os.path.exists(path + checkpoint.SUFFIX)
         assert not os.path.exists(path + ".npz")
         assert checkpoint.peek_epoch(path) == 2
@@ -687,7 +687,7 @@ class TestCheckpointSkew:
         restore."""
         det = self._detector()
         path = str(tmp_path / "t")
-        checkpoint.save(path, det, offsets={0: 3})
+        checkpoint.save(path, det, offsets={0: 3}, dispatch_lock=None)
         file = path + checkpoint.SUFFIX
         blob = open(file, "rb").read()
         open(file, "wb").write(blob[:-3])  # lose part of the trailer
@@ -705,7 +705,7 @@ class TestCheckpointSkew:
         no crash, nothing restored from lying bytes."""
         det = self._detector()
         path = str(tmp_path / "rot")
-        checkpoint.save(path, det, offsets={0: 8})
+        checkpoint.save(path, det, offsets={0: 8}, dispatch_lock=None)
         file = path + checkpoint.SUFFIX
         blob = open(file, "rb").read()
         flipped, n = corrupt_bytes(blob, seed=7, rate=1e-4)
@@ -726,7 +726,7 @@ class TestCheckpointSkew:
         boot path instead of quarantining + cold-starting."""
         det = self._detector()
         path = str(tmp_path / "vflip")
-        checkpoint.save(path, det)
+        checkpoint.save(path, det, dispatch_lock=None)
         file = path + checkpoint.SUFFIX
         blob = bytearray(open(file, "rb").read())
         blob[4] ^= 0x04  # version 2 -> 6: outside the window
@@ -765,7 +765,7 @@ class TestCheckpointSkew:
         path = str(tmp_path / "v1")
         frame.configure(write_version=1)
         try:
-            checkpoint.save(path, det, offsets={0: 1})
+            checkpoint.save(path, det, offsets={0: 1}, dispatch_lock=None)
         finally:
             frame.configure(write_version=frame.FRAME_VERSION)
         blob = open(path + checkpoint.SUFFIX, "rb").read()

@@ -122,22 +122,22 @@ class TestCheckpointFencing:
     def test_save_refuses_older_epoch_on_shared_path(self, tmp_path):
         det = AnomalyDetector(DetectorConfig(**SMALL))
         path = str(tmp_path / "snap")
-        checkpoint.save(path, det, epoch=3)
+        checkpoint.save(path, det, epoch=3, dispatch_lock=None)
         assert checkpoint.peek_epoch(path) == 3
         with pytest.raises(StaleEpochError):
-            checkpoint.save(path, det, epoch=2)
+            checkpoint.save(path, det, epoch=2, dispatch_lock=None)
         # Equal or newer epochs replace normally.
-        checkpoint.save(path, det, epoch=3)
-        checkpoint.save(path, det, epoch=4)
+        checkpoint.save(path, det, epoch=3, dispatch_lock=None)
+        checkpoint.save(path, det, epoch=4, dispatch_lock=None)
         _det, meta = checkpoint.load(path, DetectorConfig(**SMALL))
         assert meta["epoch"] == 4
 
     def test_pre_epoch_snapshot_treated_as_epoch_zero(self, tmp_path):
         det = AnomalyDetector(DetectorConfig(**SMALL))
         path = str(tmp_path / "snap")
-        checkpoint.save(path, det)  # default epoch 0
+        checkpoint.save(path, det, dispatch_lock=None)  # default epoch 0
         assert checkpoint.peek_epoch(path) == 0
-        checkpoint.save(path, det, epoch=1)  # newer writer wins
+        checkpoint.save(path, det, epoch=1, dispatch_lock=None)  # newer writer wins
 
 
 # --- deferred-confirmation offset cap (satellite) ---------------------
@@ -355,11 +355,11 @@ class TestFencing:
             # process-local fence, and the on-disk epoch on a shared
             # volume even for a writer with no fence knowledge.
             path = str(tmp_path / "shared")
-            checkpoint.save(path, detector, epoch=new_epoch)
+            checkpoint.save(path, detector, epoch=new_epoch, dispatch_lock=None)
             with pytest.raises(StaleEpochError):
                 fence_old.check("checkpoint")
             with pytest.raises(StaleEpochError):
-                checkpoint.save(path, detector, epoch=fence_old.epoch)
+                checkpoint.save(path, detector, epoch=fence_old.epoch, dispatch_lock=None)
 
             # Path 2 (Kafka offset commit): the promoted side commits
             # with its epoch tag; the stale primary's commit is

@@ -532,7 +532,7 @@ class TestCheckpoint:
         for b in tz.tensorize(recs):
             det.observe(b, 1000.0)
         path = str(tmp_path / "ckpt")
-        checkpoint.save(path, det, offsets={"0": 1234}, service_names=tz.service_names)
+        checkpoint.save(path, det, offsets={"0": 1234}, service_names=tz.service_names, dispatch_lock=None)
         assert checkpoint.exists(path)
 
         det2, meta = checkpoint.load(path)
@@ -599,7 +599,7 @@ class TestCheckpoint:
         # the arrays.
         det = AnomalyDetector(DetectorConfig(num_services=8))
         path = str(tmp_path / "ckpt")
-        checkpoint.save(path, det, offsets={"0": 7})
+        checkpoint.save(path, det, offsets={"0": 7}, dispatch_lock=None)
         assert os.path.exists(path + checkpoint.SUFFIX)
         assert not os.path.exists(path + ".json")
         assert not os.path.exists(path + checkpoint.LEGACY_SUFFIX)
@@ -609,6 +609,6 @@ class TestCheckpoint:
     def test_config_mismatch_rejected(self, tmp_path):
         det = AnomalyDetector(DetectorConfig(num_services=8))
         path = str(tmp_path / "ckpt")
-        checkpoint.save(path, det)
+        checkpoint.save(path, det, dispatch_lock=None)
         with pytest.raises(ValueError):
             checkpoint.load(path, config=DetectorConfig(num_services=16))
