@@ -352,36 +352,64 @@ def detector_step(
         attr_hi, attr_lo, config.cms_depth, config.cms_width
     )
     cidx = jax.lax.dynamic_slice_in_dim(cidx_full, shard * d_local, d_local, 0)
-    delta = fused.sketch_batch_delta(
-        svc,
-        log_lat,
-        is_error,
-        trace_hi,
-        trace_lo,
-        cidx,
-        valid,
-        num_services=s_axis,
-        hll_p=config.hll_p,
-        cms_width=config.cms_width,
-        impl=fused.resolve_impl(
-            config.sketch_impl, batch=int(svc.shape[0]),
-            # Shard-LOCAL geometry: the kernel sweeps this shard's
-            # cells (s_axis services, d_local CMS rows), and the rate
-            # model must price what actually runs.
-            cms_depth=int(cidx.shape[0]), cms_width=config.cms_width,
-            num_services=s_axis, hll_p=config.hll_p,
-        ),
+    impl = fused.resolve_impl(
+        config.sketch_impl, batch=int(svc.shape[0]),
+        # Shard-LOCAL geometry: the kernel sweeps this shard's
+        # cells (s_axis services, d_local CMS rows), and the rate
+        # model must price what actually runs.
+        cms_depth=int(cidx.shape[0]), cms_width=config.cms_width,
+        num_services=s_axis, hll_p=config.hll_p,
     )
-    hll_delta = comm.pmax_batch(delta.hll)
-    cms_delta = comm.psum_batch(delta.cms)
-    # Float merge: always direct (order-stable f32) — see
-    # Comm.psum_batch_f32; only integer monoids ride the ring.
-    stats = comm.psum_batch_f32(delta.stats)
-    hll_bank = hll_bank.at[:, 0].set(
-        jnp.maximum(hll_bank[:, 0], hll_delta[None])
-    )
-    cms_bank = cms_bank.at[:, 0].set(cms_bank[:, 0] + cms_delta[None])
-    n_valid = comm.psum_batch_f32(jnp.sum(valid_f))
+    if comm is NO_COMM:
+        # Single chip: the one-pass spine update — the batch folds into
+        # EVERY current window bank inside one program instead of
+        # materializing a delta and broadcast-merging it as a second
+        # step (fused.sketch_batch_update; bit-identical by the integer
+        # monoids, pinned by tests/test_fused.py). The mesh path below
+        # cannot take this shortcut: per-shard deltas must cross the
+        # batch-axis collectives before any bank merge.
+        hll_new, cms_new, stats = fused.sketch_batch_update(
+            hll_bank[:, 0],
+            cms_bank[:, 0],
+            svc,
+            log_lat,
+            is_error,
+            trace_hi,
+            trace_lo,
+            cidx,
+            valid,
+            num_services=s_axis,
+            hll_p=config.hll_p,
+            cms_width=config.cms_width,
+            impl=impl,
+        )
+        hll_bank = hll_bank.at[:, 0].set(hll_new)
+        cms_bank = cms_bank.at[:, 0].set(cms_new)
+        n_valid = jnp.sum(valid_f)
+    else:
+        delta = fused.sketch_batch_delta(
+            svc,
+            log_lat,
+            is_error,
+            trace_hi,
+            trace_lo,
+            cidx,
+            valid,
+            num_services=s_axis,
+            hll_p=config.hll_p,
+            cms_width=config.cms_width,
+            impl=impl,
+        )
+        hll_delta = comm.pmax_batch(delta.hll)
+        cms_delta = comm.psum_batch(delta.cms)
+        # Float merge: always direct (order-stable f32) — see
+        # Comm.psum_batch_f32; only integer monoids ride the ring.
+        stats = comm.psum_batch_f32(delta.stats)
+        hll_bank = hll_bank.at[:, 0].set(
+            jnp.maximum(hll_bank[:, 0], hll_delta[None])
+        )
+        cms_bank = cms_bank.at[:, 0].set(cms_bank[:, 0] + cms_delta[None])
+        n_valid = comm.psum_batch_f32(jnp.sum(valid_f))
     span_total = span_total.at[:, 0].add(n_valid)
 
     # ---- 3b. count-aware detection heads -----------------------------

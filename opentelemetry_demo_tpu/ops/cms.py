@@ -259,7 +259,14 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
     sentinel_count = jnp.sum((flat >= n_bins).astype(jnp.int32))
     flat = jnp.minimum(flat, n_bins - 1)
     n_hi = n_bins // 256
-    vma = jax.typeof(flat).vma
+    try:
+        # Propagate the keys' varying-manual-axes under shard_map; on
+        # older jax (no typeof/vma) a plain struct is exactly right.
+        out_struct = jax.ShapeDtypeStruct(
+            (n_hi, 256), jnp.int32, vma=jax.typeof(flat).vma
+        )
+    except (AttributeError, TypeError):
+        out_struct = jax.ShapeDtypeStruct((n_hi, 256), jnp.int32)
 
     # Keys as ONE [1, n] row, blocked along the lane axis: the block's
     # leading dim (1) equals the array's, satisfying the Pallas TPU
@@ -271,7 +278,7 @@ def _hist_mxu(flat: jnp.ndarray, n_bins: int) -> jnp.ndarray:
         # int8 one-hots: [HI, TB]+[256, TB] ≈ 3 MiB at TB=8k —
         # comfortably inside the default scoped-VMEM budget (the r4
         # bf16 row-major tiles needed a 96 MiB override).
-        out_shape=jax.ShapeDtypeStruct((n_hi, 256), jnp.int32, vma=vma),
+        out_shape=out_struct,
         in_specs=[
             pl.BlockSpec(
                 (1, _HIST_TILE), lambda i: (0, i),

@@ -170,6 +170,153 @@ def _delta_kernel(
     stats_ref[:] = prev + tile_stats
 
 
+def _update_kernel(
+    flat_ref,  # int32[TB, 1] — svc*R + bucket (rank 0 ⇒ no-op)
+    rank_ref,  # int32[TB, 1] — HLL rank, 0 for masked lanes
+    cidx_ref,  # int32[TB, D] — CMS row indices
+    weight_ref,  # int32[TB, 1] — CMS increment (0 for masked lanes)
+    svc_ref,  # int32[TB, 1] — local service id, >=S for masked lanes
+    feats_ref,  # float32[4, TB] — premasked [1, loglat, loglat², err]
+    hll_in_ref,  # int32[W·SR/C, C] — current window banks, row-stacked
+    cms_in_ref,  # int32[W·D, Wc] — current window banks, row-stacked
+    hll_ref,  # out int32[W·SR/C, C] — merged banks
+    cms_ref,  # out int32[W·D, Wc] — merged banks
+    stats_ref,  # out float32[4, S]
+    *,
+    wide: bool,
+    n_windows: int,
+):
+    """One grid step absorbs one batch tile DIRECTLY into every window
+    bank — the single-pass form of :func:`_delta_kernel`.
+
+    The delta kernel materializes a [S,R]/[D,W] delta that the caller
+    then broadcast-merges into each of the W tumbling banks: one extra
+    HBM round trip for the delta plus a separate merge computation. Here
+    the accumulation is seeded from the INCOMING banks (first grid step)
+    instead of zero, and each cell tile's batch contribution — computed
+    once — is folded into all W banks while it is still VMEM-resident.
+    Integer max/add monoids make this bit-identical to delta-then-merge.
+    Only the single-chip path may use it: on a mesh the DELTA (not the
+    merged bank) must cross the batch-axis collectives.
+    """
+    b = flat_ref.shape[0]
+    rows_hll, c_hll = hll_ref.shape
+    n_hll = rows_hll // n_windows
+    rows_cms, w = cms_ref.shape
+    d = rows_cms // n_windows
+    s = stats_ref.shape[1]
+    first = pl.program_id(0) == 0
+    flat = flat_ref[:]  # [TB, 1]
+    rank = rank_ref[:]
+
+    # HLL: per cell tile, max rank over the batch — folded into every
+    # window's bank row (the windows are row-stacked, stride n_hll).
+    def hll_body(i, _):
+        cell = i * c_hll + jax.lax.broadcasted_iota(jnp.int32, (1, c_hll), 1)
+        contrib = jnp.where(flat == cell, rank, 0)  # [TB, C]
+        tile_max = jnp.max(contrib, axis=0, keepdims=True)
+        for wi in range(n_windows):
+            row = wi * n_hll + i
+            prev = jnp.where(
+                first,
+                hll_in_ref[pl.ds(row, 1), :],
+                hll_ref[pl.ds(row, 1), :],
+            )
+            hll_ref[pl.ds(row, 1), :] = jnp.maximum(prev, tile_max)
+        return 0
+
+    jax.lax.fori_loop(0, n_hll, hll_body, 0)
+
+    # CMS: per row and cell tile, sum weights over the batch — added
+    # into every window's matching bank row.
+    weight = weight_ref[:]  # [TB, 1] int32
+    c_cms = _cell_chunk(w, 2 * b, wide=wide)
+    for di in range(d):  # depth is small and static — unrolled
+        col = cidx_ref[:, pl.ds(di, 1)]  # [TB, 1]
+
+        def cms_body(i, _, col=col, di=di):
+            cell = i * c_cms + jax.lax.broadcasted_iota(
+                jnp.int32, (1, c_cms), 1
+            )
+            contrib = jnp.where(col == cell, weight, 0)  # [TB, C]
+            tile_sum = jnp.sum(contrib, axis=0, keepdims=True)
+            for wi in range(n_windows):
+                row = wi * d + di
+                prev = jnp.where(
+                    first,
+                    cms_in_ref[pl.ds(row, 1), pl.ds(i * c_cms, c_cms)],
+                    cms_ref[pl.ds(row, 1), pl.ds(i * c_cms, c_cms)],
+                )
+                cms_ref[pl.ds(row, 1), pl.ds(i * c_cms, c_cms)] = (
+                    prev + tile_sum
+                )
+            return 0
+
+        jax.lax.fori_loop(0, w // c_cms, cms_body, 0)
+
+    # Segment stats: one-hot matmul on the MXU (identical to the delta
+    # kernel — stats feed the EWMA fold, which is not window-banked).
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+    onehot = (cols == svc_ref[:]).astype(jnp.float32)  # [TB, S]
+    tile_stats = jnp.dot(
+        feats_ref[:], onehot, preferred_element_type=jnp.float32
+    )
+    prev = jnp.where(first, 0.0, stats_ref[:])
+    stats_ref[:] = prev + tile_stats
+
+
+def _out_structs(
+    shapes_dtypes: list[tuple[tuple[int, ...], jnp.dtype]],
+    inputs: tuple,
+) -> tuple:
+    """ShapeDtypeStructs carrying the inputs' vma union when this jax
+    can express it. Under shard_map the per-shard result varies across
+    every mesh axis any input varies across (batch-sharded lanes,
+    sketch-localised ids); pallas_call can't infer that, so propagate
+    the union. Older jax (no ``jax.typeof``/``vma``) tracks no varying
+    manual axes — plain structs are then exactly right, and gating here
+    keeps the kernels runnable (interpret mode included) across the
+    version window instead of failing on an AttributeError."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in inputs))
+        return tuple(
+            jax.ShapeDtypeStruct(s, d, vma=vma) for s, d in shapes_dtypes
+        )
+    except (AttributeError, TypeError):
+        return tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes)
+
+
+def _batch_tiling(b: int, batch_tile: int | None) -> tuple[int, int]:
+    """(grid steps, tile rows) for the batch axis.
+
+    Tile the batch axis so VMEM holds one tile, not the whole batch;
+    the grid accumulates tiles into one delta/bank (see the kernels).
+    4096 keeps the [TB, chunk] compare intermediates comfortably under
+    the 16M scoped-VMEM limit at any total B (8192 tiles sat at
+    16.04M — over by 40K — once the grid's double buffering counted).
+    Picks the LARGEST divisor tile ≤ target (fewest grid steps), not a
+    power-of-two shrink: every grid step re-sweeps all sketch cell
+    tiles, so a degenerate tile (e.g. 16 for b=6000) would be a
+    silent orders-of-magnitude cliff. Refuses instead of degrading.
+    """
+    target = min(b, batch_tile or 4096)
+    nb = -(-b // target)  # ceil
+    while nb <= b and b % nb:
+        nb += 1
+    tb = b // nb
+    if tb < min(target, 256):
+        hint = (
+            f"pick a batch_tile that divides {b}"
+            if batch_tile
+            else "use a batch size that is a multiple of 4096 (or ≤ 4096)"
+        )
+        raise ValueError(
+            f"batch size {b} has no usable tile divisor near {target} "
+            f"for the pallas impl; {hint}"
+        )
+    return nb, tb
+
+
 def _delta_pallas(
     flat: jnp.ndarray,
     rank: jnp.ndarray,
@@ -186,43 +333,17 @@ def _delta_pallas(
     batch_tile: int | None = None,
 ) -> SketchDelta:
     b = flat.shape[0]
-    # Tile the batch axis so VMEM holds one tile, not the whole batch;
-    # the grid accumulates tiles into one delta (see _delta_kernel).
-    # 4096 keeps the [TB, chunk] compare intermediates comfortably under
-    # the 16M scoped-VMEM limit at any total B (8192 tiles sat at
-    # 16.04M — over by 40K — once the grid's double buffering counted).
-    target = min(b, batch_tile or 4096)
-    # Pick the LARGEST divisor tile ≤ target (fewest grid steps), not a
-    # power-of-two shrink: every grid step re-sweeps all sketch cell
-    # tiles, so a degenerate tile (e.g. 16 for b=6000) would be a
-    # silent orders-of-magnitude cliff. Refuse instead of degrading.
-    nb = -(-b // target)  # ceil
-    while nb <= b and b % nb:
-        nb += 1
-    tb = b // nb
-    if tb < min(target, 256):
-        hint = (
-            f"pick a batch_tile that divides {b}"
-            if batch_tile
-            else "use a batch size that is a multiple of 4096 (or ≤ 4096)"
-        )
-        raise ValueError(
-            f"batch size {b} has no usable tile divisor near {target} "
-            f"for the pallas impl; {hint}"
-        )
+    nb, tb = _batch_tiling(b, batch_tile)
     sr = num_services * hll_regs
     wide = nb > 1  # multi-tile grid: pipelined sweeps want wide chunks
     c_hll = _cell_chunk(sr, 2 * tb, wide=wide)  # 2*: double-buffer headroom
-    # Under shard_map the per-shard delta varies across every mesh axis
-    # any input varies across (batch-sharded lanes, sketch-localised
-    # ids); pallas_call can't infer that, so propagate the union.
-    vma = frozenset().union(
-        *(jax.typeof(x).vma for x in (flat, rank, cidx_t, weight, svc, feats))
-    )
-    out_shape = (
-        jax.ShapeDtypeStruct((sr // c_hll, c_hll), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((cms_depth, cms_width), jnp.int32, vma=vma),
-        jax.ShapeDtypeStruct((4, num_services), jnp.float32, vma=vma),
+    out_shape = _out_structs(
+        [
+            ((sr // c_hll, c_hll), jnp.int32),
+            ((cms_depth, cms_width), jnp.int32),
+            ((4, num_services), jnp.float32),
+        ],
+        (flat, rank, cidx_t, weight, svc, feats),
     )
     d = cidx_t.shape[1]
 
@@ -347,6 +468,177 @@ def sketch_batch_delta(
         num_services=num_services,
         hll_regs=r,
         cms_depth=d,
+        cms_width=cms_width,
+        interpret=(impl == "interpret"),
+        batch_tile=batch_tile,
+    )
+
+
+def _update_pallas(
+    flat: jnp.ndarray,
+    rank: jnp.ndarray,
+    cidx_t: jnp.ndarray,
+    weight: jnp.ndarray,
+    svc: jnp.ndarray,
+    feats: jnp.ndarray,
+    hll_cur: jnp.ndarray,  # int32[W, S, R]
+    cms_cur: jnp.ndarray,  # int32[W, D, Wc]
+    *,
+    num_services: int,
+    hll_regs: int,
+    cms_depth: int,
+    cms_width: int,
+    interpret: bool = False,
+    batch_tile: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b = flat.shape[0]
+    nb, tb = _batch_tiling(b, batch_tile)
+    sr = num_services * hll_regs
+    n_windows = hll_cur.shape[0]
+    wide = nb > 1
+    c_hll = _cell_chunk(sr, 2 * tb, wide=wide)
+    # Row-stack the window banks into 2D blocks (same [rows, lanes]
+    # shape discipline as the delta kernel — 3D blocks would force the
+    # mosaic tiler onto an untested layout for no bandwidth gain).
+    hll2 = hll_cur.reshape(n_windows * (sr // c_hll), c_hll)
+    cms2 = cms_cur.reshape(n_windows * cms_depth, cms_width)
+    out_shape = _out_structs(
+        [
+            (hll2.shape, jnp.int32),
+            (cms2.shape, jnp.int32),
+            ((4, num_services), jnp.float32),
+        ],
+        (flat, rank, cidx_t, weight, svc, feats, hll2, cms2),
+    )
+    d = cidx_t.shape[1]
+
+    def col_tile(i):  # [B, k] inputs: tile the batch (row) axis
+        return (i, 0)
+
+    def feats_tile(i):  # [4, B] input: tile the lane (col) axis
+        return (0, i)
+
+    def whole(i):  # banks/outputs: same full block every grid step
+        return (0, 0)
+
+    hll_new, cms_new, stats = pl.pallas_call(
+        functools.partial(
+            _update_kernel, wide=wide, n_windows=n_windows
+        ),
+        grid=(nb,),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        out_shape=out_shape,
+        in_specs=[
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, d), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, tb), feats_tile, memory_space=pltpu.VMEM),
+            pl.BlockSpec(hll2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(cms2.shape, whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(hll2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(cms2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, num_services), whole, memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(
+        flat.reshape(b, 1),
+        rank.reshape(b, 1),
+        cidx_t,
+        weight.reshape(b, 1),
+        svc.reshape(b, 1),
+        feats,
+        hll2,
+        cms2,
+    )
+    return (
+        hll_new.reshape(n_windows, num_services, hll_regs),
+        cms_new.reshape(n_windows, cms_depth, cms_width),
+        stats,
+    )
+
+
+def sketch_batch_update(
+    hll_cur: jnp.ndarray,  # int32[W, S, R] — current window banks
+    cms_cur: jnp.ndarray,  # int32[W, D, Wc] — current window banks
+    svc: jnp.ndarray,  # int32[B] — local service ids (may be out of range)
+    log_lat: jnp.ndarray,  # float32[B]
+    is_error: jnp.ndarray,  # float32[B]
+    trace_hi: jnp.ndarray,  # uint32[B]
+    trace_lo: jnp.ndarray,  # uint32[B]
+    cidx: jnp.ndarray,  # int32[D, B] — CMS row indices (global hashes)
+    valid: jnp.ndarray,  # bool[B]
+    *,
+    num_services: int,
+    hll_p: int = hll.HLL_P,
+    cms_width: int = cms.CMS_WIDTH,
+    impl: str = "xla",  # "xla" | "pallas" | "interpret"
+    batch_tile: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One-pass batch absorption: ``(hll_banks', cms_banks', stats)``.
+
+    The single-chip fast path of the ingest spine: instead of
+    materializing a :class:`SketchDelta` and broadcast-merging it into
+    every tumbling window bank as a second step, the batch's effect is
+    folded into ALL ``W`` current banks inside one program — the Pallas
+    kernel keeps banks + batch tile VMEM-resident and never writes the
+    intermediate delta to HBM; the ``xla`` reference expresses the same
+    fold as delta+merge in one traced scope (XLA fuses the broadcast
+    into the delta's epilogue). Integer monoids (HLL max, CMS add) make
+    every impl bit-identical to the two-step form — pinned by
+    tests/test_fused.py.
+
+    NOT for the mesh path: under ``shard_map`` the per-shard DELTA must
+    cross the batch-axis collectives before any bank merge, so
+    ``detector_step`` uses this only when ``comm is NO_COMM``.
+    """
+    r = 1 << hll_p
+    svc = svc.astype(jnp.int32)
+    in_slice = (svc >= 0) & (svc < num_services)
+    bucket, rank = hll.hll_indices(trace_hi, trace_lo, p=hll_p)
+    rank = jnp.where(valid & in_slice, rank, 0)
+    flat = jnp.where(in_slice, svc, 0) * r + bucket
+
+    if impl == "xla":
+        delta = sketch_batch_delta(
+            svc, log_lat, is_error, trace_hi, trace_lo, cidx, valid,
+            num_services=num_services, hll_p=hll_p, cms_width=cms_width,
+            impl="xla",
+        )
+        return (
+            jnp.maximum(hll_cur, delta.hll[None]),
+            cms_cur + delta.cms[None],
+            delta.stats,
+        )
+
+    valid_f = valid.astype(jnp.float32)
+    log_lat = log_lat.astype(jnp.float32) * valid_f
+    feats = jnp.stack(
+        [
+            valid_f,
+            log_lat,
+            log_lat * log_lat,
+            is_error.astype(jnp.float32) * valid_f,
+        ],
+        axis=0,
+    )  # [4, B]
+    return _update_pallas(
+        flat,
+        rank,
+        cidx.T,
+        valid.astype(jnp.int32),
+        jnp.where(valid & in_slice, svc, num_services),
+        feats,
+        hll_cur,
+        cms_cur,
+        num_services=num_services,
+        hll_regs=r,
+        cms_depth=cidx.shape[0],
         cms_width=cms_width,
         interpret=(impl == "interpret"),
         batch_tile=batch_tile,

@@ -59,6 +59,14 @@ pass):
   ``ANOMALY_INGEST_COALESCE`` (max requests per batched decode+flush,
   default 64), ``ANOMALY_INGEST_MAX_PENDING`` (bounded request queue
   ahead of the pool, default 512; full = retryable 429)
+- Device-put spine knobs (one registry: ``utils.config.SPINE_KNOBS``;
+  engine: ``runtime.spine`` — the staging ring between batch assembly
+  and the donated device step): ``ANOMALY_SPINE_RING`` (pre-allocated
+  host staging buffers, default 2 = double buffering; 0 = spine off,
+  pack+put inline on the pump thread), ``ANOMALY_SPINE_OVERLAP``
+  (1 = overlap batch k+1's host→device put with batch k's in-flight
+  step; anomaly_spine_put_overlap_ratio reports the hit rate),
+  ``ANOMALY_SPINE_CHUNK_ROWS`` (pack copy block rows, 0 = whole batch)
 - Hot-standby replication knobs (one registry:
   ``utils.config.REPLICATION_KNOBS``; engine: ``runtime.replication``):
   ``ANOMALY_ROLE`` (``primary`` serves + ships state deltas,
@@ -140,6 +148,7 @@ from ..utils.config import (
     overload_config,
     query_config,
     replication_config,
+    spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
 from . import checkpoint, replication
@@ -378,6 +387,16 @@ class DetectorDaemon:
             "(1.0 = the pool itself is the bottleneck: add workers)",
         )
         self.registry.describe(
+            tele_metrics.ANOMALY_SPINE_PUT_OVERLAP,
+            "Fraction of dispatched batches whose host->device put "
+            "completed entirely behind the in-flight step (1.0 = "
+            "transfer fully hidden by compute)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SPINE_RING_DEPTH,
+            "Configured device-put staging ring depth (0 = spine off)",
+        )
+        self.registry.describe(
             tele_metrics.ANOMALY_ROLE,
             "1 on the series matching this process's replication role",
         )
@@ -482,6 +501,14 @@ class DetectorDaemon:
             ov = overload_config()
         except ConfigError as e:
             raise SystemExit(str(e)) from e
+        # Device-put spine (knob registry: utils.config.SPINE_KNOBS;
+        # engine: runtime.spine): staging ring + stager thread so the
+        # host→device put of batch k+1 overlaps batch k's in-flight
+        # donated step. Ring 0 restores the inline pack+put path.
+        try:
+            sp = spine_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -510,6 +537,10 @@ class DetectorDaemon:
             # and the recently-seen candidate keys top-k scores.
             exemplar_ring=self._query_exemplars,
             hh_candidates=self._query_candidates,
+            # Device-put spine (SPINE_KNOBS; runtime.spine).
+            spine_ring=sp["ANOMALY_SPINE_RING"],
+            spine_overlap=bool(int(sp["ANOMALY_SPINE_OVERLAP"])),
+            spine_chunk_rows=sp["ANOMALY_SPINE_CHUNK_ROWS"],
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -1254,6 +1285,7 @@ class DetectorDaemon:
             self._brownout_seen = brownout
         if self.ingest_pool is not None:
             self._export_pool_stats()
+        self._export_spine_stats()
         self._export_fence_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
@@ -1311,6 +1343,23 @@ class DetectorDaemon:
         )
         seen["busy_s"] = st["busy_s"]
         seen["wall_t"] = now
+
+    def _export_spine_stats(self) -> None:
+        """anomaly_spine_* gauges: is the host→device transfer actually
+        hidden behind compute (overlap ratio), at what ring depth."""
+        st = self.pipeline.spine_stats()
+        if st is None:
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_SPINE_RING_DEPTH, 0.0
+            )
+            return
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_SPINE_RING_DEPTH, float(st["ring_depth"])
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_SPINE_PUT_OVERLAP,
+            float(st["overlap_ratio"]),
+        )
 
     # -- replication: standby step / promotion / fencing ----------------
 

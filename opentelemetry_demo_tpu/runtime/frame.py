@@ -593,6 +593,34 @@ SPAN_SCHEMA = schema_hash(
 )
 
 
+def span_column_crcs(cols) -> dict[str, int]:
+    """Per-column CRC32Cs over a ColumnarSpans' (scratch-view) memory.
+
+    The zero-copy ingest spine's integrity manifest: computed from the
+    decode-scratch views the moment decode finishes, then re-checked by
+    :func:`verify_span_columns` when the scratch's ticket is scavenged
+    (ingest_pool.ScratchPool) — a buffer that was scribbled while its
+    rows were still referenced by the pipeline fails the re-check, the
+    same divergence the frame round trip's copy-out CRCs caught, now
+    without the per-flush copy."""
+    return {
+        name: crc32c(np.ascontiguousarray(getattr(cols, name)))
+        for name, _t in SPAN_COLUMNS
+    }
+
+
+def verify_span_columns(cols, crcs: dict[str, int]) -> list[str]:
+    """Names of columns whose memory no longer matches ``crcs``
+    (empty = intact). The scavenge-time half of the zero-copy
+    integrity contract."""
+    return [
+        name
+        for name, _t in SPAN_COLUMNS
+        if crc32c(np.ascontiguousarray(getattr(cols, name)))
+        != int(crcs[name])
+    ]
+
+
 def encode_spans(cols, version: int | None = None) -> bytes:
     """native.ColumnarSpans → one frame; the encode IS the copy-out of
     the pooled decode scratch (CRC source views, then memcpy)."""

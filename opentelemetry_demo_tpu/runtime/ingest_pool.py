@@ -20,16 +20,18 @@ each of those per-REQUEST costs by making them per-FLUSH:
   the whole batch. Per-payload verdicts ride back in ``payload_rows``,
   so a malformed request still answers 400 for exactly that request
   while its batchmates proceed.
-- **Pooled zero-copy output buffers** — decode writes into a
-  :class:`ScratchPool` freelist of column arrays sized by
-  high-watermark: steady-state decode performs zero numpy allocations.
-  The coalesce step moves rows out of the scratch as ONE verified
-  columnar frame (``runtime.frame``): encoding CRCs the scratch views
-  and copies the bytes into a self-owned buffer before the scratch is
-  released, and the flush verifies the frame before the pipeline sees
-  it — a recycled buffer that scribbled over in-flight rows now fails
-  a column CRC (counted + quarantined, flush dies server-side) instead
-  of aliasing rows still queued in the pipeline
+- **Pooled zero-copy output buffers with ticketed release** — decode
+  writes into a :class:`ScratchPool` freelist of column arrays sized
+  by high-watermark: steady-state decode performs zero numpy
+  allocations. The flush hands the pipeline VIEWS into the scratch —
+  no per-flush copy-out at all (the r7 frame round trip copied every
+  row once per flush; the spine removes it). Safety is the ticket: a
+  scratch whose views escaped is PARKED, re-entering the freelist only
+  once no pipeline reference to its memory remains, and its
+  decode-time CRC manifest (``frame.span_column_crcs``) is re-checked
+  at recycle — a buffer scribbled while rows were live surfaces as
+  ``anomaly_frame_corrupt_total{hop="ingest"}`` + quarantine evidence
+  instead of silently feeding the sketches another request's rows
   (tests/test_ingest_pool.py + tests/test_frame.py pin this).
 - **One tensorize + one merge per flush** — a single intern pass over
   the batch-wide service list and a single
@@ -62,10 +64,11 @@ the k8s generator; scripts/sanitycheck.py pins the correspondence.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from collections import deque
-from typing import Callable, Sequence
+from typing import Callable, NamedTuple, Sequence
 
 from . import frame, native
 from .otlp import MONITORED_ATTR_KEYS, decode_export_request
@@ -128,22 +131,119 @@ class DecodeTicket:
             raise self._error
 
 
+class _ParkedScratch(NamedTuple):
+    """A ticketed scratch: held OUT of the freelist until no pipeline
+    view references its memory, then CRC-verified and recycled."""
+
+    scratch: object  # native.DecodeScratch
+    cols: object  # native.ColumnarSpans — the decode views, retained
+    crcs: dict  # frame.span_column_crcs manifest from decode time
+
+
 class ScratchPool:
     """Freelist of :class:`native.DecodeScratch` buffer sets, sized by
     high-watermark: the first few flushes grow the dims, after which
     every acquire is a pop — zero allocator churn on the hot path. At
     most ``keep`` sets are retained (one per worker is enough; an
-    occasional burst allocates and is dropped on release)."""
+    occasional burst allocates and is dropped on release).
+
+    **Ticketed release** (the zero-copy ingest spine): a flush that
+    handed SCRATCH VIEWS to the pipeline parks its scratch instead of
+    releasing it. A parked scratch re-enters the freelist only once no
+    outside reference to its column memory remains — checked by
+    refcount under the GIL: each retained decode view holds exactly one
+    reference to its backing array, and every pipeline slice holds one
+    more (numpy collapses ``view.base`` to the owning array), so a
+    quiescent lane shows exactly the pool's own references. Before
+    recycling, the decode-time CRC manifest is re-verified against the
+    scratch memory: a mismatch means something scribbled the buffer
+    while rows were still live — the aliasing bug class the old
+    frame-copy-out caught per flush — and the scratch is discarded with
+    the evidence queued for the owner to count + quarantine. A scratch
+    whose views outlive demand simply stays parked; ``acquire`` then
+    allocates fresh (visible in ``allocations``) rather than ever
+    recycling live memory.
+    """
 
     def __init__(self, keep: int = 4):
         self._free: list = []
         self._lock = threading.Lock()
         self._keep = keep
         self._hw = (0, 0, 0)
+        self._parked: list[_ParkedScratch] = []
         self.allocations = 0  # how often acquire had to allocate
+        self.tickets_parked = 0  # flushes that handed out scratch views
+        self.tickets_recycled = 0  # parked scratches returned to the freelist
+        # Scavenged entries whose memory no longer matched the decode
+        # manifest: (cols, bad_column_names) for the owner to count and
+        # quarantine (detection is at recycle time — after the rows were
+        # consumed — so this is an audit trail, not a gate). The deque
+        # bounds EVIDENCE retention only; corrupt_total is the honest
+        # monotone count (an event storm past the deque bound must not
+        # undercount the counter the audit trail exists to feed).
+        self.corrupt: deque = deque(maxlen=16)
+        self.corrupt_total = 0
+
+    @staticmethod
+    def _quiescent(entry: _ParkedScratch) -> bool:
+        """True when no reference outside the parked entry can reach
+        the scratch memory (CPython refcounts, checked under the GIL).
+
+        Per retained view: the entry's cols tuple plus this frame's
+        local are the only holders (refcount 3 incl. the getrefcount
+        temp); per backing array: the scratch namedtuple, that one
+        view's ``.base`` slot and this frame's local (refcount 4 incl.
+        temp) — any pipeline slice of a handed-out view keeps a base
+        reference to the backing array and shows up here. Another
+        thread mid-read merely elevates a count for one round — the
+        check is conservative, never unsafe. Iterates every ARRAY
+        field of the ColumnarSpans (the trailing ``services`` string
+        list has no ``.dtype``), so a future column can't silently
+        escape the quiescence check."""
+        for i in range(len(entry.cols)):
+            view = entry.cols[i]
+            if not hasattr(view, "dtype"):
+                continue  # services list, not a column array
+            if sys.getrefcount(view) > 3:
+                return False
+            base = view.base
+            if base is not None and sys.getrefcount(base) > 4:
+                return False
+        return True
+
+    def _scavenge_locked(self) -> None:
+        still: list[_ParkedScratch] = []
+        for entry in self._parked:
+            if not self._quiescent(entry):
+                still.append(entry)
+                continue
+            bad = frame.verify_span_columns(entry.cols, entry.crcs)
+            if bad:
+                # Scribbled while parked: never recycle the buffer,
+                # surface the evidence (drained by the ingest pool
+                # into anomaly_frame_corrupt_total{hop="ingest"}).
+                self.corrupt_total += 1
+                self.corrupt.append((entry.cols, bad))
+            else:
+                self.tickets_recycled += 1
+                if len(self._free) < self._keep:
+                    self._free.append(entry.scratch)
+        self._parked = still
+
+    def parked(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    def park(self, scratch, cols, crcs: dict) -> None:
+        """Ticketed release: hold ``scratch`` until the pipeline drops
+        every view into it (see class doc), then verify + recycle."""
+        with self._lock:
+            self._parked.append(_ParkedScratch(scratch, cols, crcs))
+            self.tickets_parked += 1
 
     def acquire(self, cap: int, svc_cap: int, rs_cap: int):
         with self._lock:
+            self._scavenge_locked()
             self._hw = (
                 max(self._hw[0], cap),
                 max(self._hw[1], svc_cap),
@@ -254,11 +354,17 @@ class IngestPool:
         self.coalesced_requests = 0
         self.decode_errors = 0
         self.worker_failures = 0  # server-side flush failures (per flush)
-        # Scratch→pipeline frames that failed verification (recycled-
-        # buffer races, memory corruption): quarantined, flush dies as
-        # a server fault, sketches untouched. Exported as
-        # anomaly_frame_corrupt_total{hop="ingest"}.
+        # Parked-scratch CRC mismatches (lifecycle bugs, memory
+        # corruption): counted + quarantined at scavenge time.
+        # Exported as anomaly_frame_corrupt_total{hop="ingest"}.
         self.frames_corrupt = 0
+        # Per-phase flush wall time (decode / verify / tensorize /
+        # submit) — the attribution the spine's win is measured by
+        # (ingestbench phase breakdown).
+        self.phase_s = {
+            "decode": 0.0, "verify": 0.0, "tensorize": 0.0, "submit": 0.0,
+        }
+        self._scratch_corrupt_seen = 0
         self.busy_s = 0.0  # summed across workers
         self._started = time.monotonic()
         # Drain accounting: jobs submitted but not yet fully processed.
@@ -377,17 +483,26 @@ class IngestPool:
             else:
                 parts += self._decode_python(payload_jobs, errors)
         if record_jobs:
+            t0 = time.perf_counter()
             merged: list[SpanRecord] = []
             for records, _t in record_jobs:
                 merged.extend(records)
             parts.append(self.tensorizer.columns_from_records(merged))
+            self._phase("tensorize", time.perf_counter() - t0)
         cols = SpanColumns.concat(parts) if parts else None
-        if cols is not None and cols.rows:
+        n_rows = cols.rows if cols is not None else 0
+        if n_rows:
+            t0 = time.perf_counter()
             self.submit_columns(cols)
+            self._phase("submit", time.perf_counter() - t0)
+        del parts, cols  # drop the worker's view refs: the rows stay
+        # alive exactly as long as the PIPELINE holds them (the ticket
+        # discipline the parked-scratch scavenge keys on)
+        self._drain_scratch_corruption()
         with self._stats_lock:
             self.flushes += 1
             self.coalesced_requests += len(batch)
-            self.flushed_spans += cols.rows if cols is not None else 0
+            self.flushed_spans += n_rows
             self.decode_errors += len(errors)
         # Tickets resolve AFTER submit_columns: a 200 means the rows
         # are enqueued (the serial path's contract), and error-lane
@@ -402,9 +517,11 @@ class IngestPool:
     def _decode_native(self, payload_jobs, errors) -> list[SpanColumns]:
         payloads = [p for p, _t in payload_jobs]
         total = sum(len(p) for p in payloads)
+        t0 = time.perf_counter()
         scratch = self._scratch.acquire(
             *native.scratch_dims(total, len(payloads))
         )
+        parked = False
         try:
             cols, payload_rows = native.decode_otlp_many(
                 payloads, self.attr_keys, scratch
@@ -412,37 +529,76 @@ class IngestPool:
             for i, rows in enumerate(payload_rows):
                 if rows < 0:
                     errors[i] = ValueError("malformed OTLP payload")
+            # Phase sample BEFORE the empty-flush return: an all-
+            # malformed flood burns real decode time and the
+            # attribution must show it.
+            self._phase("decode", time.perf_counter() - t0)
             if not cols.duration_us.shape[0]:
                 return []
-            # The frame IS the copy-out of the pooled scratch (the ONE
-            # columnar format, runtime.frame): per-column CRC32Cs are
-            # computed from the scratch VIEWS, then the bytes are
-            # copied into a self-owned buffer — so a scratch recycled
-            # while rows were still in flight (the aliasing hazard the
-            # old copy=True guarded by convention) now FAILS the column
-            # CRC at the verify below instead of silently feeding the
-            # pipeline another request's rows.
-            buf = frame.encode_spans(cols)
+            # Zero-copy hand-off (the ingest spine): the pipeline
+            # receives VIEWS into the decode scratch — the per-flush
+            # frame-buffer copy-out is gone. Integrity moves from
+            # copy-then-verify to ticketed release: the decode views'
+            # CRC manifest is taken NOW (frame.span_column_crcs, the
+            # same native crc32c the frame trailer used), the scratch
+            # is PARKED instead of released, and it re-enters the
+            # freelist only once no pipeline view references it — at
+            # which point the manifest is re-checked, so a buffer that
+            # was scribbled while rows were live still surfaces as
+            # anomaly_frame_corrupt_total{hop="ingest"} + quarantine
+            # evidence (see ScratchPool). The recycled-early race the
+            # old copy guarded against cannot happen: a still-
+            # referenced scratch is simply never handed out again.
+            t0 = time.perf_counter()
+            crcs = frame.span_column_crcs(cols)
+            self._phase("verify", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            out = self.tensorizer.columns_from_columnar(cols, copy=False)
+            self._phase("tensorize", time.perf_counter() - t0)
+            if cols.duration_us.base is scratch.duration:
+                self._scratch.park(scratch, cols, crcs)
+                parked = True
+            # else: decode grew past the pooled scratch mid-call and
+            # returned views into a bigger private buffer (or copies)
+            # — plain GC owns that memory; OUR scratch saw no views
+            # and goes straight back to the freelist.
+            return [out]
         finally:
-            self._scratch.release(scratch)
-        try:
-            verified = frame.decode_spans(buf)
-        except frame.FrameError as e:
+            if not parked:
+                self._scratch.release(scratch)
+
+    def _phase(self, name: str, dt: float) -> None:
+        """Accumulate per-phase flush time (decode / verify /
+        tensorize / submit) — how an operator attributes a flush's
+        wall time between the native decoder, the integrity manifest,
+        the intern/column pass and the pipeline merge."""
+        with self._stats_lock:
+            self.phase_s[name] += dt
+
+    def _drain_scratch_corruption(self) -> None:
+        """Surface parked-scratch CRC mismatches (see ScratchPool):
+        count anomaly_frame_corrupt_total{hop="ingest"} and write the
+        frame-encoded rows aside as quarantine evidence. Detection is
+        at recycle time — after consumption — so this is the audit
+        trail for a lifecycle bug, not an admission gate. The COUNT
+        comes from the monotone corrupt_total (evidence past the
+        bounded deque still counts); the deque holds what forensics
+        gets."""
+        total = self._scratch.corrupt_total  # int read: GIL-atomic
+        delta = total - self._scratch_corrupt_seen
+        if delta > 0:
+            self._scratch_corrupt_seen = total
             with self._stats_lock:
-                self.frames_corrupt += 1
-            evidence = frame.quarantine(buf, "ingest")
-            # A server-side fault by definition (the client's bytes
-            # decoded fine; OUR copy-out diverged): the flush dies as
-            # an IngestWorkerError → 5xx/INTERNAL, never a 400, and
-            # nothing reaches the sketches.
-            raise IngestWorkerError(
-                "ingest frame failed verification"
-                + (f" (evidence at {evidence})" if evidence else "")
-                + f": {e}"
-            ) from e
-        # Zero-copy hand-off: the views own the frame buffer (their
-        # .base), so no further copy is needed before the pipeline.
-        return [self.tensorizer.columns_from_columnar(verified, copy=False)]
+                self.frames_corrupt += delta
+        while True:
+            try:
+                cols, _bad = self._scratch.corrupt.popleft()
+            except IndexError:
+                return
+            try:
+                frame.quarantine(frame.encode_spans(cols), "ingest")
+            except Exception:  # noqa: BLE001 — forensics must never
+                pass  # compound the fault (same rule as quarantine())
 
     def _decode_python(self, payload_jobs, errors) -> list[SpanColumns]:
         """No-compiler fallback: per-request wire decode, still ONE
@@ -507,6 +663,10 @@ class IngestPool:
                 "worker_failures": self.worker_failures,
                 "frames_corrupt": self.frames_corrupt,
                 "busy_s": self.busy_s,
+                "phase_s": dict(self.phase_s),
+                "tickets_parked": self._scratch.tickets_parked,
+                "tickets_recycled": self._scratch.tickets_recycled,
+                "scratch_parked": self._scratch.parked(),
                 "workers": self.workers,
                 # Lifetime busy fraction; the daemon exports a windowed
                 # delta-based gauge on top of busy_s/wall.

@@ -146,6 +146,9 @@ class DetectorPipeline:
         retry_after_s: float = 1.0,
         exemplar_ring: int = 8,
         hh_candidates: int = 64,
+        spine_ring: int = 0,
+        spine_overlap: bool = True,
+        spine_chunk_rows: int = 0,
     ):
         self.detector = detector
         self.flags = flags or FlagEvaluator()
@@ -153,6 +156,24 @@ class DetectorPipeline:
         self.tensorizer = SpanTensorizer(
             num_services=detector.config.num_services, batch_size=batch_size
         )
+        # Device-put spine (runtime.spine; knob registry:
+        # utils.config.SPINE_KNOBS): pack+put move off the pump thread
+        # onto a stager working a ring of ``spine_ring`` pre-allocated
+        # host buffers, so batch k+1's host→device transfer overlaps
+        # batch k's in-flight donated step. 0 = the classic in-tick
+        # pack+put path. Dispatch itself NEVER moves: the spine owns no
+        # detector state and every state touch stays on the pump thread
+        # under _dispatch_lock (the donation-race contract).
+        self._spine = None
+        if spine_ring > 0:
+            from .spine import DevicePutSpine
+
+            self._spine = DevicePutSpine(
+                self.tensorizer,
+                depth=spine_ring,
+                overlap=spine_overlap,
+                chunk_rows=spine_chunk_rows,
+            )
         self.max_wait_s = max_wait_s
         # Device→host readback cadence. 0 = harvest a report every pump
         # (max report fidelity). On topologies where readback RTT is the
@@ -464,6 +485,10 @@ class DetectorPipeline:
                 self.stats.dropped_disabled += self._pending_rows
                 self._pending.clear()
                 self._pending_rows = 0
+            if self._spine is not None:
+                # Staged-but-undispatched batches are pending work too:
+                # the off switch drops them with the queue.
+                self.stats.dropped_disabled += self._spine.discard_pending()
             self._admission_update(0)
             return
         # Assemble up to one batch of rows from the columnar queue;
@@ -519,16 +544,58 @@ class DetectorPipeline:
         # the low watermark must reopen the gate THIS pump, not next.
         self._admission_update(rows_after)
         if not parts:
-            # Nothing to dispatch — but an idle pump must still fetch
-            # due in-flight reports (outside the pending lock: the
-            # fetch blocks for an RTT and submitters must not): a
-            # report that only ever harvests on the NEXT batch's pump
-            # carries one extra batch interval of detection lag.
-            self._maybe_sync_harvest(keep=0)
-            return
-        cols = SpanColumns.concat(parts)
-        self._capture_candidates(cols)
-        batch = self.tensorizer.pack_columns(cols, width=width)
+            # Nothing new to assemble — but a staged batch from an
+            # earlier pump may be ready now (its put rode behind the
+            # previous step): dispatch it before the idle harvest.
+            if self._spine is not None and self._pump_spine():
+                pass
+            else:
+                # Nothing to dispatch — but an idle pump must still
+                # fetch due in-flight reports (outside the pending
+                # lock: the fetch blocks for an RTT and submitters
+                # must not): a report that only ever harvests on the
+                # NEXT batch's pump carries one extra batch interval
+                # of detection lag.
+                self._maybe_sync_harvest(keep=0)
+                return
+        else:
+            cols = SpanColumns.concat(parts)
+            self._capture_candidates(cols)
+            if self._spine is not None:
+                # Spine path: hand the columns to the stager (pack +
+                # async device put off the pump thread) and dispatch
+                # whatever staged batch is ready — typically the one
+                # whose transfer just overlapped the in-flight step.
+                # Ring bound first: past `depth` undispatched batches
+                # the pump wait-dispatches the head — the ring IS the
+                # backpressure, and the pump is the only consumer.
+                while self._spine.pending() >= self._spine.depth:
+                    self._pump_spine(force_wait=True)
+                self._spine.stage(cols, width, t_now, t_oldest)
+                self._pump_spine()
+            else:
+                batch = self.tensorizer.pack_columns(cols, width=width)
+                self._dispatch_batch(
+                    batch, t_now, t_oldest, cols, batch.num_valid
+                )
+        if self.harvest_async:
+            self._harvest_wake.set()
+        else:
+            # Adaptive overlap: with more batches queued, leave the
+            # newest dispatch in flight (device compute overlaps the
+            # fetch — the throughput regime); with the queue drained,
+            # fetch everything now (the low-rate regime, where a kept
+            # report would wait a whole batch interval).
+            with self._pending_lock:
+                keep = 1 if self._pending else 0
+            self._maybe_sync_harvest(keep=keep)
+
+    def _dispatch_batch(
+        self, batch, t_now, t_oldest, cols, n_valid: int
+    ) -> None:
+        """Dispatch ONE packed batch (host- or device-resident) into
+        the donated step — the single place detector state advances
+        from the pump path, always under ``_dispatch_lock``."""
         self._last_dispatch = time.monotonic()
         # Packed dispatch: the report comes back as ONE device vector so
         # harvest is a single transfer instead of one per report leaf.
@@ -541,7 +608,7 @@ class DetectorPipeline:
         except AttributeError:  # non-jax.Array stand-ins in tests
             pass
         self.stats.batches += 1
-        self.stats.spans += batch.num_valid
+        self.stats.spans += n_valid
         with self._inflight_lock:
             # Lag clock = the oldest row's enqueue time, not dispatch
             # time: under the adaptive accumulate-hold rows can wait up
@@ -557,17 +624,38 @@ class DetectorPipeline:
                 self._inflight.popleft()
                 self.stats.reports_skipped += 1
                 self._note_outcome(skipped=True)
-        if self.harvest_async:
-            self._harvest_wake.set()
-        else:
-            # Adaptive overlap: with more batches queued, leave the
-            # newest dispatch in flight (device compute overlaps the
-            # fetch — the throughput regime); with the queue drained,
-            # fetch everything now (the low-rate regime, where a kept
-            # report would wait a whole batch interval).
-            with self._pending_lock:
-                keep = 1 if self._pending else 0
-            self._maybe_sync_harvest(keep=keep)
+
+    def _pump_spine(self, force_wait: bool = False) -> bool:
+        """Dispatch the oldest staged batch if available (spine path).
+
+        Overlap discipline: with a step already in flight the pump
+        takes only a batch whose put has COMPLETED (a not-ready batch
+        dispatches next tick — its transfer keeps riding behind the
+        running step, which is the whole point); with the device idle,
+        under drain, at the ring bound, or with overlap disabled it
+        waits — the low-rate regime must not defer a lone batch a
+        whole pump interval."""
+        with self._inflight_lock:
+            idle = not self._inflight
+        must_wait = (
+            force_wait
+            or not self._spine.overlap
+            or self._harvest_flush
+            or idle
+        )
+        staged = self._spine.take(wait=must_wait)
+        if staged is None:
+            return False
+        # n_valid from the host row count: the device batch's own
+        # valid.sum() would force a device sync on the dispatch path.
+        self._dispatch_batch(
+            staged.batch,
+            staged.t_now,
+            staged.t_oldest,
+            staged.cols,
+            staged.cols.rows,
+        )
+        return True
 
     def _maybe_sync_harvest(self, keep: int) -> None:
         """One due-cadence synchronous harvest (no-op in async mode)."""
@@ -584,7 +672,9 @@ class DetectorPipeline:
         # drain itself.
         self._harvest_flush = True
         try:
-            while self._pending:
+            while self._pending or (
+                self._spine is not None and self._spine.pending()
+            ):
                 self.pump()
             if self.harvest_async:
                 self._drain_async()
@@ -616,11 +706,18 @@ class DetectorPipeline:
     def close(self) -> None:
         """Stop the background harvester (if any) after a final drain."""
         self.drain()
+        if self._spine is not None:
+            self._spine.close()
         if self._harvest_thread is not None:
             self._harvest_stop = True
             self._harvest_wake.set()
             self._harvest_thread.join(timeout=5.0)
             self._harvest_thread = None
+
+    def spine_stats(self) -> dict | None:
+        """The spine's put/overlap counters (None when the spine is
+        off) — the daemon's anomaly_spine_* export reads this."""
+        return None if self._spine is None else self._spine.stats()
 
     # -- supervision hooks --------------------------------------------
 

@@ -1,0 +1,285 @@
+"""Device-put spine: staged host ring + async double-buffered puts.
+
+The last host-side hop of the ingest spine (ROADMAP item 1): between
+the pipeline's batch assembly and the donated ``observe_packed`` step
+sits a pack (pad + hash) and a host→device transfer. Without the
+spine both run on the pump thread inside the dispatch tick, so the
+transfer of batch *k+1* cannot begin until batch *k*'s dispatch tick
+is over. This module moves pack+put onto a dedicated **stager thread**
+working through a small ring of pre-allocated host staging buffers:
+
+- ``stage(cols, width, ...)`` (pump thread) enqueues the assembled
+  columns and returns immediately; the stager packs them into ring
+  slot ``seq % depth`` (``SpanTensorizer.pack_columns_into`` — zero
+  allocations, stable host memory) and issues ``jax.device_put`` for
+  every lane. ``device_put`` is asynchronous on real accelerators, so
+  the transfer of batch *k+1* rides the wire WHILE the device executes
+  batch *k*'s donated step — the overlap the e2e SLO measures.
+- ``take(wait=...)`` (pump thread) pops the oldest staged batch. With
+  a step in flight the pump takes only batches whose put already
+  completed (``overlap_hits``); with the device idle — or under
+  ``drain()`` — it waits (``overlap_misses``), so the low-rate regime
+  pays no added latency beyond the put itself.
+- **Double-buffer discipline**: a ring slot is repacked only after the
+  device arrays created from its PREVIOUS use are ready
+  (``jax.block_until_ready`` — i.e. the transfer consumed the host
+  bytes). Depth 2 is classic double buffering: pack k+1 while k
+  transfers; deeper rings absorb put-latency jitter.
+
+The spine owns NO detector state: dispatch (and every
+``detector.state`` touch) stays on the pump thread under the
+pipeline's ``_dispatch_lock``, so the PR 7 donation-race pass has
+nothing new to flag — the stager only ever touches its own ring and
+the host column views (whose lifetime the ingest pool's scratch
+tickets already manage). tests/test_spine.py hammers dispatch-vs-put
+concurrency under donation to pin that.
+
+Knobs ride ``utils.config.SPINE_KNOBS`` (ring depth / overlap /
+chunk rows), threaded daemon → compose → k8s like every family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from .tensorize import SpanColumns, SpanTensorizer, TensorBatch
+
+
+class SpineError(RuntimeError):
+    """A staging job failed (pack or device put) — surfaced to the
+    dispatcher that tries to take the batch, never swallowed."""
+
+
+class StagedBatch:
+    """One assembled batch riding the spine: host columns in, device
+    arrays out once the stager's put has been issued."""
+
+    __slots__ = (
+        "cols", "width", "t_now", "t_oldest", "batch", "error", "ready",
+    )
+
+    def __init__(self, cols: SpanColumns, width: int, t_now, t_oldest):
+        self.cols = cols
+        self.width = width
+        self.t_now = t_now
+        self.t_oldest = t_oldest
+        self.batch: TensorBatch | None = None  # device arrays
+        self.error: BaseException | None = None
+        self.ready = threading.Event()
+
+
+class DevicePutSpine:
+    """Staging ring + stager thread (see module doc)."""
+
+    def __init__(
+        self,
+        tensorizer: SpanTensorizer,
+        depth: int = 2,
+        overlap: bool = True,
+        chunk_rows: int = 0,
+        device_put=None,
+    ):
+        if depth < 1:
+            raise ValueError(f"spine ring depth must be >= 1 (got {depth})")
+        self.tensorizer = tensorizer
+        self.depth = int(depth)
+        self.overlap = bool(overlap)
+        self.chunk_rows = int(chunk_rows)
+        self._device_put = device_put
+        # Ring slots: per-slot {width: host TensorBatch} (the adaptive
+        # controller moves along a pow2 width ladder; each width's
+        # buffers are allocated once and reused).
+        self._slots: list[dict[int, TensorBatch]] = [
+            {} for _ in range(self.depth)
+        ]
+        # Device arrays from each slot's previous use: the transfer
+        # that must complete before the slot's host memory is repacked.
+        self._slot_prev: list[TensorBatch | None] = [None] * self.depth
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._jobs: deque[StagedBatch] = deque()
+        self._staged: deque[StagedBatch] = deque()
+        self._stop = False
+        # Stats (read by the daemon's scrape via stats()).
+        self.puts_total = 0
+        self.overlap_hits = 0  # take() found the put already complete
+        self.overlap_misses = 0  # take() had to wait on the put
+        self.stage_s = 0.0  # stager: pack + put issue + slot wait
+        self.take_wait_s = 0.0  # pump: time blocked in waiting takes
+        self._thread = threading.Thread(
+            target=self._run, name="spine-stager", daemon=True
+        )
+        self._thread.start()
+
+    # -- pump-thread API ----------------------------------------------
+
+    def stage(self, cols: SpanColumns, width: int, t_now, t_oldest) -> None:
+        """Enqueue one assembled batch for pack+put (never blocks —
+        the PUMP enforces the ring bound by wait-dispatching the head
+        before staging past ``depth``; the pump thread is the spine's
+        only consumer, so blocking here would deadlock it against
+        itself)."""
+        staged = StagedBatch(cols, int(width), t_now, t_oldest)
+        with self._work:
+            if self._stop:
+                raise SpineError("spine is closed")
+            self._jobs.append(staged)
+            self._staged.append(staged)
+            self._work.notify_all()
+
+    def take(
+        self, wait: bool, timeout: float = 30.0
+    ) -> StagedBatch | None:
+        """Oldest staged batch, device-resident — or None when nothing
+        is ready and ``wait`` is False (the overlap regime: the pump
+        dispatches it next tick, after the put finished behind the
+        in-flight step)."""
+        with self._lock:
+            staged = self._staged[0] if self._staged else None
+        if staged is None:
+            return None
+        if staged.ready.is_set():
+            hit = True
+        elif not wait:
+            return None
+        else:
+            hit = False
+            t0 = time.perf_counter()
+            if not staged.ready.wait(timeout):
+                raise SpineError(
+                    f"staged batch not ready after {timeout}s "
+                    "(stager dead or device put wedged)"
+                )
+            with self._lock:
+                self.take_wait_s += time.perf_counter() - t0
+        with self._work:
+            # Still the head (single consumer — the pump thread).
+            if self._staged and self._staged[0] is staged:
+                self._staged.popleft()
+            if hit:
+                self.overlap_hits += 1
+            else:
+                self.overlap_misses += 1
+            self._work.notify_all()
+        if staged.error is not None:
+            raise SpineError(
+                f"staging failed: {type(staged.error).__name__}: "
+                f"{staged.error}"
+            ) from staged.error
+        return staged
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    def discard_pending(self) -> int:
+        """Drop every undispatched staged batch (detector flag turned
+        off mid-stream), returning the row count dropped — the
+        pipeline counts them beside its own pending-queue drop.
+        Non-blocking: unstarted jobs are cancelled outright, and a
+        batch the stager is packing RIGHT NOW simply completes into an
+        orphan (its put is wasted, nothing references it) — waiting on
+        a wedged put here would stall the pump's disabled branch."""
+        with self._work:
+            dropped = list(self._staged)
+            self._staged.clear()
+            gone = {id(s) for s in dropped}
+            self._jobs = deque(
+                j for j in self._jobs if id(j) not in gone
+            )
+            self._work.notify_all()
+        return sum(s.cols.rows for s in dropped)
+
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop
+
+    def close(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            puts = self.puts_total
+            hits = self.overlap_hits
+            misses = self.overlap_misses
+            taken = hits + misses
+            return {
+                "ring_depth": self.depth,
+                "staged": len(self._staged),
+                "puts_total": puts,
+                "overlap_hits": hits,
+                "overlap_misses": misses,
+                # Of the batches dispatched so far, the fraction whose
+                # host→device put completed entirely behind the
+                # in-flight step — transfer hidden by compute.
+                "overlap_ratio": (hits / taken) if taken else 0.0,
+                "stage_s": self.stage_s,
+                "take_wait_s": self.take_wait_s,
+            }
+
+    # -- stager thread -------------------------------------------------
+
+    def _host_slot(self, idx: int, width: int) -> TensorBatch:
+        slot = self._slots[idx].get(width)
+        if slot is None:
+            slot = self._slots[idx][width] = self.tensorizer.alloc_batch(
+                width
+            )
+        return slot
+
+    def _put(self, host: TensorBatch) -> TensorBatch:
+        if self._device_put is not None:
+            return TensorBatch(*(self._device_put(a) for a in host))
+        import jax
+
+        return TensorBatch(*(jax.device_put(a) for a in host))
+
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                while not self._jobs and not self._stop:
+                    self._work.wait(0.05)
+                if self._stop:
+                    # Fail any batch nobody will ever put: a waiting
+                    # take()/discard must not hang on a dead stager.
+                    for staged in self._jobs:
+                        staged.error = SpineError("spine closed mid-stage")
+                        staged.ready.set()
+                    self._jobs.clear()
+                    return
+                staged = self._jobs.popleft()
+            t0 = time.perf_counter()
+            try:
+                idx = self._seq % self.depth
+                self._seq += 1
+                prev = self._slot_prev[idx]
+                if prev is not None:
+                    # Double-buffer guard: never repack host memory a
+                    # previous put may still be reading. block_until_
+                    # ready on PUT arrays waits for the transfer only
+                    # (they are inputs, not computation results).
+                    import jax
+
+                    jax.block_until_ready(tuple(prev))
+                slot = self._host_slot(idx, staged.width)
+                host = self.tensorizer.pack_columns_into(
+                    slot, staged.cols, chunk_rows=self.chunk_rows
+                )
+                dev = self._put(host)
+                self._slot_prev[idx] = dev
+                staged.batch = dev
+                with self._lock:
+                    self.puts_total += 1
+                    self.stage_s += time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — surfaced via
+                # staged.error to the taking dispatcher; the stager
+                # thread itself must survive (it is the only producer
+                # of ready events and close() joins it).
+                staged.error = e
+            finally:
+                staged.ready.set()

@@ -1,0 +1,251 @@
+"""End-to-end ingest-spine benchmark: OTLP payload → flagged report.
+
+The bench trajectory had two ISOLATED numbers — pooled host ingest
+(~8M spans/s, ingestbench) and the device sketch kernel (~66M spans/s,
+bench.py's matrix) — and an 8× gap between them that ROADMAP item 1
+exists to close. This module measures the number that actually matters:
+sustained spans/sec from raw OTLP protobuf bytes, through the parallel
+decode pool (zero-copy ticketed scratch), the pipeline's bounded
+admission, the device-put spine's staged ring, the donated one-pass
+device step, and back out as harvested detector reports. One
+methodology, two callers: ``make spinebench`` (the standalone sweep:
+workers × ring depth) and ``bench.py`` (the ``e2e_spans_per_sec`` /
+``e2e_ok`` artifact fields, gated at ≥90% of
+``min(host_ingest, kernel)`` — transfer provably hidden behind
+compute, not just asserted).
+
+The pump runs on its own thread at a tight cadence while the driver
+thread offers payloads as fast as admission accepts them — the daemon
+loop's shape, minus the sockets (the receivers' HTTP/gRPC framing is
+measured elsewhere; this is the Kafka/collector-facing span path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..models.detector import AnomalyDetector, DetectorConfig
+from .ingest_pool import IngestPool, IngestPoolSaturated
+from .ingestbench import make_payloads
+from .pipeline import DetectorPipeline
+
+
+def measure_kernel_ref(
+    config: DetectorConfig, batch: int, steps: int = 120
+) -> float:
+    """Device-only spans/s at the SAME geometry and batch width the
+    e2e run dispatches — the matched-basis denominator for
+    ``e2e_vs_kernel`` (bench.py's headline kernel runs default
+    geometry at BENCH_BATCH; comparing e2e against THAT would mix
+    apples and oranges). Slope-of-two-regions with a terminating
+    fetch, the repo's honest-timing rule (bench.py module doc)."""
+    import numpy as np
+
+    from .lagbench import make_columns
+
+    det = AnomalyDetector(config)
+    rng = np.random.default_rng(0)
+    from .tensorize import SpanTensorizer
+
+    tens = SpanTensorizer(
+        num_services=config.num_services, batch_size=batch
+    )
+    packed = tens.pack_columns(make_columns(rng, batch), width=batch)
+    t = 0.0
+    det.observe_packed(packed, t)  # compile
+
+    def region(k: int, t0: float) -> tuple[float, float]:
+        start = time.perf_counter()
+        t_local = t0
+        for _ in range(k):
+            t_local += 0.05
+            rep = det.observe_packed(packed, t_local)
+        import jax
+
+        jax.device_get(rep)  # fetch forces the chain
+        return time.perf_counter() - start, t_local
+
+    k1 = max(steps // 4, 8)
+    k2 = 3 * k1
+    t1, t = region(k1, t)
+    t2, t = region(k2, t)
+    per_step = max((t2 - t1) / (k2 - k1), 1e-9)
+    return batch / per_step
+
+
+def measure_e2e(
+    workers: int = 2,
+    ring: int = 2,
+    seconds: float = 4.0,
+    batch: int = 2048,
+    overlap: bool = True,
+    num_services: int = 16,
+    hll_p: int = 8,
+    cms_width: int = 1024,
+    n_requests: int = 32,
+    spans_per_request: int = 256,
+    payloads: list[bytes] | None = None,
+    kernel_ref: bool = True,
+) -> dict | None:
+    """One configuration's e2e rate, or None without the native decoder.
+
+    Geometry defaults are CI-friendly (the protocol and overlap, not
+    the kernel plateau, are under test here — bench.py reports the
+    kernel's own rate beside this); on a real TPU pass the production
+    geometry. Returns spans/sec measured payload-submit → report-
+    harvest (everything drained before the clock stops), plus the
+    attribution the spine's win is judged by: pool phase shares and
+    the put-overlap ratio.
+    """
+    from . import native
+
+    if not native.available():
+        return None
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    config = DetectorConfig(
+        num_services=num_services, hll_p=hll_p, cms_width=cms_width
+    )
+    det = AnomalyDetector(config)
+    reports = [0]
+    pipe = DetectorPipeline(
+        det,
+        on_report=lambda t, r, flagged: reports.__setitem__(
+            0, reports[0] + 1
+        ),
+        batch_size=batch,
+        spine_ring=ring,
+        spine_overlap=overlap,
+    )
+    pool = IngestPool(
+        pipe.submit_columns,
+        pipe.tensorizer,
+        workers=workers,
+        coalesce_max=64,
+        max_pending=max(4 * n_requests, 256),
+    )
+    stop = threading.Event()
+
+    def pump_loop() -> None:
+        while not stop.is_set():
+            pipe.pump()
+            time.sleep(0.0005)
+
+    pump = threading.Thread(target=pump_loop, name="e2e-pump", daemon=True)
+    try:
+        # Warmup: size the scratch + compile the step off the clock.
+        pool.submit(payloads[0]).result()
+        pool.drain()
+        pipe.pump()
+        pipe.drain()
+        pump.start()
+        t0 = time.perf_counter()
+        deadline = t0 + seconds
+        while time.perf_counter() < deadline:
+            for p in payloads:
+                try:
+                    pool.submit(p)
+                except IngestPoolSaturated:
+                    time.sleep(0.001)  # bounded admission: back off
+        pool.drain()
+        stop.set()
+        pump.join(timeout=10.0)
+        pipe.drain()
+        elapsed = time.perf_counter() - t0
+    finally:
+        stop.set()
+        pool.close()
+        pipe.close()
+    st = pool.stats()
+    phase = st["phase_s"]
+    phase_total = sum(phase.values()) or 1.0
+    spine = pipe.spine_stats()
+    # Matched-basis kernel reference: device-only rate at THIS
+    # geometry and batch width (measured after the e2e run so the
+    # timed region never shares the machine with the pool threads).
+    kernel_rate = (
+        measure_kernel_ref(config, batch) if kernel_ref else None
+    )
+    return {
+        "spans_per_sec": pipe.stats.spans / max(elapsed, 1e-9),
+        "kernel_spans_per_sec": (
+            round(kernel_rate, 1) if kernel_rate else None
+        ),
+        "spans": pipe.stats.spans,
+        "batches": pipe.stats.batches,
+        "reports": reports[0],
+        "elapsed_s": round(elapsed, 3),
+        "workers": workers,
+        "ring": ring,
+        "overlap_ratio": (
+            round(spine["overlap_ratio"], 4) if spine else None
+        ),
+        "tickets_parked": st["tickets_parked"],
+        "tickets_recycled": st["tickets_recycled"],
+        "frames_corrupt": st["frames_corrupt"],
+        # Flush-time attribution (fractions of pool wall time): the
+        # zero-copy win shows as decode dominating; a fat tensorize/
+        # submit share means the host glue is the bottleneck again.
+        "phase_share": {
+            k: round(v / phase_total, 4) for k, v in phase.items()
+        },
+    }
+
+
+def measure_sweep(
+    workers_list=(1, 2), rings=(0, 2, 4), seconds: float = 2.0,
+    **kw,
+) -> dict[str, float]:
+    """workers × ring-depth grid of e2e rates ({} without native) —
+    the ``make spinebench`` matrix: ring 0 is the inline pack+put
+    BEFORE number, so the spine's delta is in the same artifact."""
+    payloads = kw.pop("payloads", None) or make_payloads(
+        kw.get("n_requests", 32), kw.get("spans_per_request", 256)
+    )
+    out: dict[str, float] = {}
+    for w in workers_list:
+        for r in rings:
+            got = measure_e2e(
+                workers=w, ring=r, seconds=seconds, payloads=payloads,
+                kernel_ref=False, **kw,
+            )
+            if got is None:
+                return {}
+            out[f"w{w}r{r}"] = round(got["spans_per_sec"], 1)
+    return out
+
+
+def main() -> None:
+    import json
+
+    from ..utils.config import BENCH_KNOBS, env_float
+
+    seconds = env_float(
+        "BENCH_SPINE_SECONDS", BENCH_KNOBS["BENCH_SPINE_SECONDS"][1]
+    )
+    headline = measure_e2e(seconds=seconds)
+    sweep = measure_sweep(seconds=max(seconds / 3, 1.0))
+    print(
+        json.dumps(
+            {
+                "metric": "e2e_ingest_spine",
+                "e2e_spans_per_sec": (
+                    round(headline["spans_per_sec"], 1) if headline else None
+                ),
+                "unit": "spans/sec",
+                "e2e_overlap_ratio": (
+                    headline.get("overlap_ratio") if headline else None
+                ),
+                "e2e_phase_share": (
+                    headline.get("phase_share") if headline else None
+                ),
+                "e2e_reports": headline.get("reports") if headline else None,
+                "sweep": sweep or None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -309,6 +309,72 @@ class SpanTensorizer:
             width=width,
         )
 
+    def alloc_batch(self, width: int | None = None) -> TensorBatch:
+        """Pre-allocated width-sized host arrays for
+        :meth:`pack_columns_into` — one spine ring slot. Allocated once
+        per (slot, width) and reused for every staged batch, so the
+        steady-state pack performs zero numpy allocations and the
+        device put always reads from stable host memory."""
+        b = width if width is not None else self.batch_size
+        return TensorBatch(
+            np.zeros(b, np.int32),
+            np.zeros(b, np.float32),
+            np.zeros(b, np.float32),
+            np.zeros(b, np.uint32),
+            np.zeros(b, np.uint32),
+            np.zeros(b, np.uint32),
+            np.zeros(b, np.uint32),
+            np.zeros(b, bool),
+        )
+
+    def pack_columns_into(
+        self, out: TensorBatch, cols: SpanColumns, chunk_rows: int = 0
+    ) -> TensorBatch:
+        """:meth:`pack_columns` into PRE-ALLOCATED arrays, bit-for-bit.
+
+        The spine's staging pack: rows are hashed + copied into the
+        ring slot ``out`` (optionally in ``chunk_rows`` blocks — cache
+        blocking for the copy loop), the tail is padded exactly as
+        :meth:`pack_arrays` pads (masked lanes carry the hash of the
+        zero key, valid=False), and no width-sized array is allocated.
+        tests/test_spine.py pins equality against pack_columns.
+        """
+        n = cols.rows
+        b = out.svc.shape[0]
+        if n > b:
+            raise ValueError(f"chunk of {n} exceeds batch width {b}")
+        step = int(chunk_rows) if chunk_rows and chunk_rows > 0 else max(n, 1)
+        for s0 in range(0, n, step):
+            sl = slice(s0, min(s0 + step, n))
+            out.svc[sl] = cols.svc[sl]
+            out.lat_us[sl] = cols.lat_us[sl]
+            out.is_error[sl] = cols.is_error[sl]
+            key = cols.attr_crc[sl].astype(np.uint64) | (
+                cols.svc[sl].astype(np.uint64) << np.uint64(32)
+            )
+            t_hi, t_lo = split_hi_lo_np(splitmix64_np(cols.trace_key[sl]))
+            a_hi, a_lo = split_hi_lo_np(splitmix64_np(key))
+            out.trace_hi[sl] = t_hi
+            out.trace_lo[sl] = t_lo
+            out.attr_hi[sl] = a_hi
+            out.attr_lo[sl] = a_lo
+            out.valid[sl] = True
+        # Pad tail: numeric lanes zero; hash lanes carry the zero-key
+        # hash (pack_arrays hashes AFTER padding, so parity demands it).
+        tail = slice(n, b)
+        out.svc[tail] = 0
+        out.lat_us[tail] = 0.0
+        out.is_error[tail] = 0.0
+        z_hi, z_lo = split_hi_lo_np(
+            splitmix64_np(np.zeros(1, np.uint64))
+        )
+        out.trace_hi[tail] = z_hi[0]
+        out.trace_lo[tail] = z_lo[0]
+        out.attr_hi[tail] = z_hi[0]
+        out.attr_lo[tail] = z_lo[0]
+        out.valid[tail] = False
+        return out
+
     def pack_arrays(
         self,
         svc: np.ndarray,

@@ -233,6 +233,11 @@ ANOMALY_INGEST_POOL_FLUSHES = "anomaly_ingest_pool_flushes_total"
 ANOMALY_INGEST_POOL_SPANS = "anomaly_ingest_pool_spans_total"
 ANOMALY_INGEST_POOL_REQUESTS = "anomaly_ingest_pool_requests_total"
 ANOMALY_INGEST_POOL_UTILIZATION = "anomaly_ingest_pool_worker_utilization"
+# Device-put spine (runtime.spine: the staging ring between batch
+# assembly and the donated device step): whether host→device transfer
+# is actually hidden behind compute, and how deep the ring runs.
+ANOMALY_SPINE_PUT_OVERLAP = "anomaly_spine_put_overlap_ratio"
+ANOMALY_SPINE_RING_DEPTH = "anomaly_spine_ring_depth"
 # Sender-queue visibility for the OTLP exporters (otlp_export.py):
 # the drop-oldest path and its backlog, per signal.
 ANOMALY_EXPORT_DROPPED = "anomaly_export_dropped_total"  # {signal=}

@@ -380,6 +380,38 @@ DAEMON_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Device-put spine knobs (runtime.spine: the staging ring between the
+# pipeline's batch assembly and the donated device step — pack + async
+# device puts on a stager thread, overlapping batch k+1's host→device
+# transfer with batch k's in-flight compute; runtime/daemon.py threads
+# these into the pipeline). Same ONE-registry discipline as every
+# other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+SPINE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_SPINE_RING": (
+        "int", 2,
+        "device-put staging ring depth: pre-allocated host batch "
+        "buffers a stager thread packs + asynchronously puts through, "
+        "so batch k+1's transfer rides behind batch k's in-flight "
+        "donated step (2 = classic double buffering; 0 = spine off — "
+        "pack+put inline on the pump thread, the pre-spine path)",
+    ),
+    "ANOMALY_SPINE_OVERLAP": (
+        "int", 1,
+        "1 = with a step in flight, dispatch only batches whose put "
+        "already completed (transfer hidden behind compute; "
+        "anomaly_spine_put_overlap_ratio tracks the hit rate); 0 = "
+        "always wait for the put synchronously (A/B debugging)",
+    ),
+    "ANOMALY_SPINE_CHUNK_ROWS": (
+        "int", 0,
+        "rows per copy block when packing into a staging slot (cache "
+        "blocking for the host pack loop); 0 = whole batch in one pass",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -388,7 +420,7 @@ DAEMON_KNOBS: dict[str, tuple[str, object, str]] = {
 # proxy or a bench driver has no business in the fleet compose file.
 DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
-    "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
+    "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
 )
 
 
@@ -448,6 +480,10 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
     "BENCH_LAG_STRESS": ("int", 1, "0 skips the lag stress leg"),
     "BENCH_LAG_RATE": ("float", 2000.0, "lag bench offered spans/s"),
     "BENCH_LAG_SECONDS": ("float", 12.0, "lag bench duration"),
+    "BENCH_SPINE": ("int", 1, "0 skips the e2e ingest-spine bench"),
+    "BENCH_SPINE_SECONDS": (
+        "float", 6.0, "e2e spine bench duration per configuration",
+    ),
 }
 
 
@@ -531,6 +567,24 @@ def query_config() -> dict[str, int | float]:
         raise ConfigError(
             "ANOMALY_QUERY_MAX_STALENESS_S="
             f"{out['ANOMALY_QUERY_MAX_STALENESS_S']} must be > 0"
+        )
+    return out
+
+
+def spine_config() -> dict[str, int | float]:
+    """Resolve every SPINE_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the shapes — a
+    negative ring depth or copy block must refuse to boot."""
+    out = _resolve(SPINE_KNOBS)
+    if int(out["ANOMALY_SPINE_RING"]) < 0:
+        raise ConfigError(
+            f"ANOMALY_SPINE_RING={out['ANOMALY_SPINE_RING']} must be "
+            ">= 0 (0 disables the spine)"
+        )
+    if int(out["ANOMALY_SPINE_CHUNK_ROWS"]) < 0:
+        raise ConfigError(
+            "ANOMALY_SPINE_CHUNK_ROWS="
+            f"{out['ANOMALY_SPINE_CHUNK_ROWS']} must be >= 0"
         )
     return out
 

@@ -46,10 +46,18 @@ def main():
         return
     print(f"native-serial:         {nat/1e3:10.1f} k spans/s  (r5 path)")
     for w in workers:
-        rate = ingestbench.measure_pooled(workers=w, payloads=payloads)
+        got = ingestbench.measure_pooled_detail(
+            workers=w, payloads=payloads
+        )
+        rate = got["spans_per_sec"]
+        share = got["phase_share"]
+        phases = " ".join(
+            f"{name}={share.get(name, 0.0):.0%}"
+            for name in ("decode", "verify", "tensorize", "submit")
+        )
         print(
             f"pool workers={w}:        {rate/1e3:10.1f} k spans/s"
-            f"  ({rate/nat:4.2f}x serial)"
+            f"  ({rate/nat:4.2f}x serial)  [{phases}]"
         )
 
 

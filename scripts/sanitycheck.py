@@ -166,7 +166,7 @@ def main() -> int:
                     and t.id in (
                         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
-                        "QUERY_KNOBS",
+                        "QUERY_KNOBS", "SPINE_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -174,6 +174,7 @@ def main() -> int:
     for reg_name in (
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
+        "SPINE_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")

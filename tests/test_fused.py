@@ -157,6 +157,77 @@ class TestSketchBatchDelta:
             fused.resolve_impl("cuda")
 
 
+class TestSketchBatchUpdate:
+    """The one-pass spine update (delta + fold into every window bank
+    in one program) must be bit-identical to delta-then-merge — the
+    integer-monoid contract detector_step's NO_COMM branch relies on."""
+
+    def _banks(self, rng, nw, s, p, d, w):
+        hll_cur = jnp.asarray(
+            rng.integers(0, 20, size=(nw, s, 1 << p)), jnp.int32
+        )
+        cms_cur = jnp.asarray(
+            rng.integers(0, 1000, size=(nw, d, w)), jnp.int32
+        )
+        return hll_cur, cms_cur
+
+    @pytest.mark.parametrize("impl", ["xla", "interpret"])
+    @pytest.mark.parametrize(
+        "b,s,p,d,w", [(256, 32, 8, 4, 1024), (128, 8, 10, 2, 512)]
+    )
+    def test_update_matches_delta_then_merge(self, rng, impl, b, s, p, d, w):
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        hll_cur, cms_cur = self._banks(rng, 3, s, p, d, w)
+        delta = fused.sketch_batch_delta(*batch.values(), impl="xla", **kw)
+        want_hll = jnp.maximum(hll_cur, delta.hll[None])
+        want_cms = cms_cur + delta.cms[None]
+        got_hll, got_cms, got_stats = fused.sketch_batch_update(
+            hll_cur, cms_cur, *batch.values(), impl=impl, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(want_hll), np.asarray(got_hll))
+        np.testing.assert_array_equal(np.asarray(want_cms), np.asarray(got_cms))
+        np.testing.assert_allclose(
+            np.asarray(delta.stats), np.asarray(got_stats),
+            rtol=1e-5, atol=1e-4,
+        )
+
+    @pytest.mark.parametrize("batch_tile", [64, 128])
+    def test_update_batch_grid_tiling(self, rng, batch_tile):
+        """Multi-step grids must seed the fold from the incoming banks
+        exactly once (first step) and accumulate after — the same
+        revisit-the-block discipline as the delta kernel."""
+        b, s, p, d, w = 512, 16, 8, 4, 1024
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        hll_cur, cms_cur = self._banks(rng, 3, s, p, d, w)
+        ref_hll, ref_cms, ref_stats = fused.sketch_batch_update(
+            hll_cur, cms_cur, *batch.values(), impl="xla", **kw
+        )
+        got_hll, got_cms, got_stats = fused.sketch_batch_update(
+            hll_cur, cms_cur, *batch.values(), impl="interpret",
+            batch_tile=batch_tile, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(ref_hll), np.asarray(got_hll))
+        np.testing.assert_array_equal(np.asarray(ref_cms), np.asarray(got_cms))
+        np.testing.assert_allclose(
+            np.asarray(ref_stats), np.asarray(got_stats),
+            rtol=1e-5, atol=1e-4,
+        )
+
+    def test_all_invalid_lanes_leave_banks_untouched(self, rng):
+        kw = dict(num_services=8, hll_p=8, cms_width=512)
+        batch = _batch(rng, 64, 8, 4, 512)
+        batch["valid"] = jnp.zeros(64, bool)
+        hll_cur, cms_cur = self._banks(rng, 3, 8, 8, 4, 512)
+        got_hll, got_cms, got_stats = fused.sketch_batch_update(
+            hll_cur, cms_cur, *batch.values(), impl="interpret", **kw
+        )
+        np.testing.assert_array_equal(np.asarray(hll_cur), np.asarray(got_hll))
+        np.testing.assert_array_equal(np.asarray(cms_cur), np.asarray(got_cms))
+        np.testing.assert_allclose(np.asarray(got_stats), 0.0)
+
+
 class TestDetectorWithFusedKernel:
     def test_detector_step_identical_across_impls(self, rng):
         """The full flagship step must not care which impl ran."""

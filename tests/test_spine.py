@@ -1,0 +1,271 @@
+"""Device-resident ingest spine: ring, overlap, donation safety.
+
+What must hold (the r9 spine tentpole):
+
+- **Bit parity** (``test_spine_parity_with_inline_path``): the staged
+  ring + async puts change WHEN bytes move, never WHAT the detector
+  computes — spine-on and spine-off runs produce identical report
+  sequences over the same virtual-time stream.
+- **Pack parity** (``test_pack_columns_into_matches_pack_columns``):
+  the zero-allocation slot pack is bit-identical to ``pack_columns``,
+  chunked or not, including the padded tail's zero-key hashes.
+- **No donation race** (``test_dispatch_vs_put_hammer_under_donation``):
+  the stager's puts run concurrently with donated dispatches and
+  state-snapshot readers (the PR 6 refresh-vs-dispatch shape) at ring
+  depth 2 — no "Array has been deleted", no corrupted reports.
+- **Ring discipline** (``test_ring_slots_are_reused``): slot buffers
+  are allocated once per (slot, width) and reused — the staging pack
+  performs zero width-sized allocations in steady state.
+- **Lifecycle** (``test_drain_flushes_staged_batches``, flag-off drop,
+  knob validation): nothing staged is ever lost on drain, the off
+  switch drops staged rows with the queue, and malformed spine knobs
+  refuse to boot.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime.lagbench import make_columns
+from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
+from opentelemetry_demo_tpu.runtime.spine import DevicePutSpine
+from opentelemetry_demo_tpu.runtime.tensorize import SpanTensorizer
+from opentelemetry_demo_tpu.utils.config import ConfigError, spine_config
+
+pytestmark = pytest.mark.spine
+
+SMALL = dict(num_services=8, hll_p=8, cms_width=512)
+
+
+def _run_stream(spine_ring: int, n_batches: int = 40, seed: int = 7):
+    det = AnomalyDetector(DetectorConfig(**SMALL))
+    reports = []
+    pipe = DetectorPipeline(
+        det,
+        on_report=lambda t, r, flagged: reports.append((t, r, tuple(flagged))),
+        batch_size=256,
+        spine_ring=spine_ring,
+    )
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    for _ in range(n_batches):
+        pipe.submit_columns(make_columns(rng, 256))
+        pipe.pump(t)
+        t += 0.05
+    pipe.close()
+    return reports, pipe
+
+
+class TestParity:
+    def test_spine_parity_with_inline_path(self):
+        ref, p0 = _run_stream(spine_ring=0)
+        got, p1 = _run_stream(spine_ring=2)
+        assert p0.stats.batches == p1.stats.batches
+        assert len(ref) == len(got) > 0
+        for (ta, ra, fa), (tb, rb, fb) in zip(ref, got):
+            assert ta == tb and fa == fb
+            for name, x, y in zip(ra._fields, ra, rb):
+                np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y), err_msg=name
+                )
+
+    @pytest.mark.parametrize("chunk_rows", [0, 7, 64, 1000])
+    def test_pack_columns_into_matches_pack_columns(self, chunk_rows):
+        tz = SpanTensorizer(num_services=8, batch_size=256)
+        rng = np.random.default_rng(3)
+        cols = make_columns(rng, 200)
+        ref = tz.pack_columns(cols, width=256)
+        slot = tz.alloc_batch(256)
+        got = tz.pack_columns_into(slot, cols, chunk_rows=chunk_rows)
+        for name, x, y in zip(ref._fields, ref, got):
+            np.testing.assert_array_equal(x, y, err_msg=name)
+        # The slot really is the output (no hidden allocation).
+        assert got.svc is slot.svc and got.valid is slot.valid
+
+    def test_pack_into_overflow_refused(self):
+        tz = SpanTensorizer(num_services=8, batch_size=64)
+        slot = tz.alloc_batch(64)
+        cols = make_columns(np.random.default_rng(0), 65)
+        with pytest.raises(ValueError, match="exceeds batch width"):
+            tz.pack_columns_into(slot, cols)
+
+
+class TestRing:
+    def test_ring_slots_are_reused(self):
+        tz = SpanTensorizer(num_services=8, batch_size=128)
+        spine = DevicePutSpine(tz, depth=2)
+        rng = np.random.default_rng(5)
+        try:
+            seen_hosts = set()
+            for i in range(8):
+                spine.stage(make_columns(rng, 128), 128, float(i), float(i))
+                staged = spine.take(wait=True)
+                assert staged is not None and staged.batch is not None
+            for slot in spine._slots:
+                assert list(slot) == [128]  # one width, allocated once
+                seen_hosts.add(id(slot[128].svc))
+            assert len(seen_hosts) == 2  # depth distinct host buffers
+            st = spine.stats()
+            assert st["puts_total"] == 8 and st["ring_depth"] == 2
+        finally:
+            spine.close()
+
+    def test_take_nonblocking_returns_none_until_ready(self):
+        # A spine with a wedged device_put must not block a
+        # non-waiting take (the overlap regime's contract).
+        gate = threading.Event()
+
+        def slow_put(a):
+            gate.wait(5.0)
+            return jax.device_put(a)
+
+        tz = SpanTensorizer(num_services=8, batch_size=64)
+        spine = DevicePutSpine(tz, depth=2, device_put=slow_put)
+        try:
+            spine.stage(
+                make_columns(np.random.default_rng(0), 64), 64, 0.0, 0.0
+            )
+            assert spine.take(wait=False) is None
+            gate.set()
+            staged = spine.take(wait=True)
+            assert staged is not None and staged.batch is not None
+            st = spine.stats()
+            assert st["overlap_misses"] >= 1
+        finally:
+            gate.set()
+            spine.close()
+
+    def test_spine_knob_validation(self):
+        with pytest.raises(ValueError):
+            DevicePutSpine(SpanTensorizer(), depth=0)
+        import os
+
+        os.environ["ANOMALY_SPINE_RING"] = "-1"
+        try:
+            with pytest.raises(ConfigError):
+                spine_config()
+        finally:
+            del os.environ["ANOMALY_SPINE_RING"]
+        assert spine_config()["ANOMALY_SPINE_RING"] == 2  # registry default
+
+
+class TestDonationSafety:
+    def test_dispatch_vs_put_hammer_under_donation(self):
+        """Hammer the spine path (stager thread putting batch k+1)
+        against donated dispatches on the main thread WHILE background
+        readers snapshot detector state under the dispatch lock — the
+        PR 6 refresh-vs-dispatch shape extended with the put thread.
+        Without the lock discipline (or with a ring slot recycled
+        under an in-flight transfer) this dies with 'Array has been
+        deleted' or a corrupted report."""
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        harvested = []
+        pipe = DetectorPipeline(
+            det,
+            on_report=lambda t, r, f: harvested.append(r),
+            batch_size=256,
+            spine_ring=2,
+        )
+        rng = np.random.default_rng(11)
+        stop = threading.Event()
+        failures: list[str] = []
+
+        def snapshot_reader() -> None:
+            # The replication/warm-widths shape: tree-copy the live
+            # state under the dispatch lock, never unlocked.
+            while not stop.is_set():
+                try:
+                    with pipe._dispatch_lock:
+                        copied = jax.tree_util.tree_map(
+                            jnp.copy, det.state
+                        )
+                    jax.block_until_ready(copied.step_idx)
+                except Exception as e:  # noqa: BLE001 — collected
+                    failures.append(repr(e))
+                    return
+
+        readers = [
+            threading.Thread(target=snapshot_reader, daemon=True)
+            for _ in range(3)
+        ]
+        for th in readers:
+            th.start()
+        t = 0.0
+        try:
+            for _ in range(150):
+                # Two chunks per pump keeps a backlog: the overlap
+                # path (dispatch k while putting k+1) stays engaged.
+                pipe.submit_columns(make_columns(rng, 256))
+                pipe.submit_columns(make_columns(rng, 256))
+                pipe.pump(t)
+                t += 0.05
+        finally:
+            stop.set()
+            for th in readers:
+                th.join(timeout=10.0)
+            pipe.close()
+        assert not failures, failures
+        st = pipe.spine_stats()
+        assert st["puts_total"] == pipe.stats.batches == 300
+        # The hammer must actually have exercised the overlap regime.
+        assert st["overlap_hits"] > 0
+        # Every harvested report is finite — a scribbled staging slot
+        # would surface as garbage z-scores long before a crash.
+        for rep in harvested:
+            assert np.isfinite(np.asarray(rep.lat_z)).all()
+
+
+class TestLifecycle:
+    def test_drain_flushes_staged_batches(self):
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        pipe = DetectorPipeline(det, batch_size=128, spine_ring=3)
+        rng = np.random.default_rng(2)
+        for _ in range(5):
+            pipe.submit_columns(make_columns(rng, 128))
+        pipe.drain()
+        assert pipe.pending_rows() == 0
+        assert pipe._spine.pending() == 0
+        assert pipe.stats.batches == 5
+        assert pipe.stats.spans == 5 * 128
+        pipe.close()
+
+    def test_flag_off_drops_staged_rows(self):
+        from opentelemetry_demo_tpu.utils.flags import FlagEvaluator
+
+        flags = FlagEvaluator()
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        pipe = DetectorPipeline(
+            det, flags=flags, batch_size=128, spine_ring=2
+        )
+        rng = np.random.default_rng(4)
+        # Stage one batch (dispatched or held staged — both count).
+        pipe.submit_columns(make_columns(rng, 128))
+        pipe.pump(0.0)
+        pipe.submit_columns(make_columns(rng, 128))
+        flags.replace({
+            "flags": {
+                "anomalyDetectorEnabled": {
+                    "state": "ENABLED",
+                    "variants": {"on": True, "off": False},
+                    "defaultVariant": "off",
+                }
+            }
+        })
+        pipe.pump(0.05)
+        assert pipe._spine.pending() == 0
+        dispatched = pipe.stats.spans
+        assert dispatched + pipe.stats.dropped_disabled == 2 * 128
+        pipe.close()
+
+    def test_spine_stats_surface(self):
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        pipe = DetectorPipeline(det, batch_size=128)  # spine off
+        assert pipe.spine_stats() is None
+        pipe.close()
