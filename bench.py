@@ -257,6 +257,20 @@ def main():
         except Exception:  # noqa: BLE001 — artifact field is optional
             e2e = {}
 
+    # ---- self-telemetry overhead (the ISSUE 10 canary) ---------------
+    # Tracer-on vs tracer-off spinebench A/B with the full production
+    # wiring (sampled batch traces + phase histograms): the detector
+    # watching itself must cost ≤3% of the path it watches, proven per
+    # run, not asserted. {} on failure — additive fields.
+    selftrace_ab = {}
+    if os.environ.get("BENCH_SELFTRACE", "1") != "0":
+        from opentelemetry_demo_tpu.runtime import spinebench
+
+        try:
+            selftrace_ab = spinebench.measure_selftrace_overhead() or {}
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            selftrace_ab = {}
+
     # ---- hot-standby failover (the replication tentpole) -------------
     # Real replication link, real kill: failover_ttd_s is the blind
     # window a primary host loss costs (watchdog fire → promoted), and
@@ -371,6 +385,12 @@ def main():
             bool(e2e_rate >= 0.9 * e2e_bound)
             if e2e_rate is not None and e2e_bound is not None else None
         ),
+        # Self-telemetry verdict: the batch-lifecycle tracer + phase
+        # histograms must cost ≤3% of e2e spine throughput.
+        "selftrace_overhead_ok": (
+            bool(selftrace_ab["ratio"] <= 1.03)
+            if selftrace_ab.get("ratio") is not None else None
+        ),
     }
 
     print(
@@ -458,6 +478,13 @@ def main():
                     "the gate is meaningful only with a real "
                     "accelerator"
                 ) if e2e else None,
+                "selftrace_overhead_ratio": selftrace_ab.get("ratio"),
+                "selftrace_spans_per_sec_on": selftrace_ab.get(
+                    "spans_per_sec_on"
+                ),
+                "selftrace_traces_exported": selftrace_ab.get(
+                    "traces_exported"
+                ),
                 "query_p99_ms": queryq.get("query_p99_ms"),
                 "query_p50_ms": queryq.get("query_p50_ms"),
                 "query_qps": queryq.get("query_qps"),

@@ -90,6 +90,16 @@ pass):
   (1 = a standby serves queries from its replicated mirror while
   remaining promotable), ``ANOMALY_QUERY_MAX_STALENESS_S`` (snapshot
   cache budget; every answer reports its staleness)
+- Self-telemetry knobs (one registry: ``utils.config.SELFTRACE_KNOBS``;
+  engines: ``runtime.selftrace`` + ``runtime.flightrec``):
+  ``ANOMALY_SELFTRACE_ENABLE`` (batch-lifecycle tracer, default 1),
+  ``ANOMALY_SELFTRACE_SAMPLE`` (deterministic splitmix64 head-sampling
+  rate, default 0.01), ``ANOMALY_SELFTRACE_ENDPOINT`` (OTLP endpoint
+  for the detector's own traces; empty = encode-only),
+  ``ANOMALY_SELFTRACE_FLIGHT_RING`` (flight-recorder ring size,
+  default 512), ``ANOMALY_SELFTRACE_FLIGHT_DIR`` (evidence-dump
+  directory written on DEGRADED/SATURATED/FENCED/PROMOTING
+  transitions; empty = ring-only)
 - Verified-frame knobs (one registry: ``utils.config.FRAME_KNOBS``;
   engine: ``runtime.frame`` — the ONE checksummed columnar format that
   ingest scratch→pipeline, replication payloads and checkpoint files
@@ -135,6 +145,7 @@ boot degrades to a cold start. Component state is visible as
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -148,11 +159,13 @@ from ..utils.config import (
     overload_config,
     query_config,
     replication_config,
+    selftrace_config,
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint, replication
+from . import checkpoint, replication, selftrace
 from . import frame as frame_fmt
+from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
@@ -243,6 +256,47 @@ class DetectorDaemon:
         self._query_max_staleness_s = float(
             qk["ANOMALY_QUERY_MAX_STALENESS_S"]
         )
+
+        # Detector self-telemetry (knob registry:
+        # utils.config.SELFTRACE_KNOBS; engines: runtime.selftrace +
+        # runtime.flightrec). Parsed before the pipeline below — the
+        # tracer and the phase-observe hook are pipeline/pool
+        # constructor arguments, and the flight recorder must exist
+        # before any boot-time transition (a boot-fenced primary is
+        # the first event worth recording).
+        try:
+            st = selftrace_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.flight = FlightRecorder(
+            size=int(st["ANOMALY_SELFTRACE_FLIGHT_RING"]),
+            dump_dir=str(st["ANOMALY_SELFTRACE_FLIGHT_DIR"]),
+        )
+        self.selftrace = None
+        self._selftrace_poster = None
+        if int(st["ANOMALY_SELFTRACE_ENABLE"]):
+            endpoint = str(st["ANOMALY_SELFTRACE_ENDPOINT"])
+            submit = None
+            if endpoint:
+                # The ONE network leg of self-tracing: the shared
+                # background poster (encode on the harvester, POST on
+                # the sender thread — never the pump).
+                self._selftrace_poster = selftrace.make_exporter(endpoint)
+                submit = self._selftrace_poster.submit
+            self.selftrace = selftrace.SelfTracer(
+                submit=submit,
+                sample=float(st["ANOMALY_SELFTRACE_SAMPLE"]),
+            )
+        self.flight.record(
+            "boot", role=self.role,
+            selftrace=bool(int(st["ANOMALY_SELFTRACE_ENABLE"])),
+            sample=float(st["ANOMALY_SELFTRACE_SAMPLE"]),
+        )
+        # Transition-edge state for the flight recorder's health wiring.
+        self._flight_last_state: str | None = None
+        self._flight_last_brownout = 0
+        self._flight_fence_seen = 0
+        self._spine_overlap_seen = (0, 0)  # (hits, taken) window base
 
         flagd_file = str(dk["FLAGD_FILE"]) or None
         ofrep = str(dk["OFREP_URL"]) or None
@@ -471,6 +525,50 @@ class DetectorDaemon:
         self.registry.counter_add(
             tele_metrics.ANOMALY_EXEMPLARS_CAPTURED, 0.0
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_PHASE_SECONDS,
+            "Batch-lifecycle phase latency (decode/verify/tensorize/"
+            "stage/dispatch/harvest/flag) — the promoted per-phase "
+            "timers, one observation per flush/batch",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SPINE_PUT_WAIT,
+            "Seconds the pump waited on a staged batch's device put "
+            "(0 = the transfer hid entirely behind the in-flight step)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_HARVEST_LAG,
+            "Submit-to-harvest detection lag per fetched report (the "
+            "p99 the lag SLO gates, now Prometheus-owned)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SPINE_OVERLAP_WINDOW,
+            "Windowed put-overlap ratio (one observation per scrape "
+            "window) — the histogram companion to the lifetime gauge",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_QUERY_STALENESS_HIST,
+            "Per-answer query staleness bound (histogram companion to "
+            "the anomaly_query_staleness_seconds gauge)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SELFTRACE_TRACES,
+            "Sampled batch-lifecycle traces exported by the self-tracer",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_SELFTRACE_SPANS,
+            "Spans exported inside self-trace batch traces",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLIGHT_EVENTS,
+            "Flight-recorder events recorded, by kind (role moves, "
+            "shed/brownout steps, fence hits, quarantines, snapshots)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLIGHT_DUMPS,
+            "Flight-recorder evidence dumps written, by transition "
+            "reason (each one is a postmortem file on disk)",
+        )
         self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
@@ -489,6 +587,7 @@ class DetectorDaemon:
             self.registry.counter_add(
                 tele_metrics.ANOMALY_FRAME_CORRUPT, 1.0, hop="checkpoint"
             )
+            self.flight.record("quarantine", hop="checkpoint", frames=1)
         # The supervision tree: restart hooks + probes are registered
         # for each ingest leg; passive (run_step-guarded) components
         # register here, thread/server-backed ones in start().
@@ -541,6 +640,10 @@ class DetectorDaemon:
             spine_ring=sp["ANOMALY_SPINE_RING"],
             spine_overlap=bool(int(sp["ANOMALY_SPINE_OVERLAP"])),
             spine_chunk_rows=sp["ANOMALY_SPINE_CHUNK_ROWS"],
+            # Self-telemetry (SELFTRACE_KNOBS; runtime.selftrace): the
+            # promoted phase histograms + sampled batch-lifecycle traces.
+            phase_observe=self._observe_phase,
+            selftrace=self.selftrace,
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -601,6 +704,8 @@ class DetectorDaemon:
                 workers=ing["ANOMALY_INGEST_WORKERS"],
                 coalesce_max=ing["ANOMALY_INGEST_COALESCE"],
                 max_pending=ing["ANOMALY_INGEST_MAX_PENDING"],
+                phase_observe=self._observe_phase,
+                selftrace=self.selftrace,
             )
             self._supervisor.register(
                 "ingest-pool", base_backoff_s=0.1, max_backoff_s=5.0,
@@ -745,6 +850,9 @@ class DetectorDaemon:
                 max_staleness_s=self._query_max_staleness_s,
                 timeline_depth=self._query_timeline,
                 topk_default=self._query_topk,
+                # /query/flight serves THIS process's event ring — the
+                # on-demand half of the flight-recorder surface.
+                flight_fn=self.flight.snapshot,
             )
             self.query_service = QueryService(
                 self.query_engine, registry=self.registry,
@@ -901,6 +1009,115 @@ class DetectorDaemon:
             "epoch": self._fence.epoch,
         }
         return ("ok" if state == UP else state), detail
+
+    # -- self-telemetry -------------------------------------------------
+
+    # Windowed overlap-ratio buckets: the interesting band is the top
+    # end (is the put hidden or not), so the ladder is top-heavy.
+    _OVERLAP_BUCKETS = (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0)
+
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        """The pipeline/pool phase hook → promoted histograms: each
+        lifecycle phase lands in anomaly_phase_seconds{phase=}, except
+        the two with their own dedicated series (put-wait, harvest
+        lag). Phase labels come from the runtime.selftrace constant
+        table — the trace-discipline pass fences the call sites."""
+        if phase == selftrace.PHASE_HARVEST_LAG:
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_HARVEST_LAG, seconds,
+                selftrace.PHASE_BUCKETS,
+            )
+        elif phase == selftrace.PHASE_PUT_WAIT:
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_SPINE_PUT_WAIT, seconds,
+                selftrace.PHASE_BUCKETS,
+            )
+        else:
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_PHASE_SECONDS, seconds,
+                selftrace.PHASE_BUCKETS, phase=phase,
+            )
+
+    def _flight_health_tick(self) -> None:
+        """Edge-detect health/brownout/fence movement into the flight
+        recorder; DEGRADED/SATURATED transitions dump evidence (role
+        transitions dump from their own paths — promote/_become_fenced
+        — so a standby that never saturates still leaves a trail)."""
+        from .supervision import DEGRADED, SATURATED
+
+        state = self._supervisor.overall_state()
+        if state != self._flight_last_state:
+            self.flight.record(
+                "health", state=state, prev=self._flight_last_state,
+                role=self.role, epoch=self._fence.epoch,
+            )
+            if state in (DEGRADED, SATURATED):
+                self.flight.dump(state)
+            self._flight_last_state = state
+        brownout = self.pipeline.brownout_level
+        if brownout != self._flight_last_brownout:
+            self.flight.record(
+                "brownout", level=brownout,
+                prev=self._flight_last_brownout,
+            )
+            self._flight_last_brownout = brownout
+        fence_total = sum(self._fence.fenced_by_path.values())
+        if fence_total != self._flight_fence_seen:
+            self.flight.record(
+                "fence", total=fence_total,
+                by_path=dict(self._fence.fenced_by_path),
+            )
+            self._flight_fence_seen = fence_total
+
+    def _selftrace_delta(self, metric: str, key: str, value: int,
+                         **labels) -> None:
+        """Delta export with a seen-map the REPLICATION restart path
+        never clears: the flight/tracer objects live for the process,
+        so sharing _repl_counters (cleared on a supervised replication
+        restart) would re-emit their full lifetime totals."""
+        if not hasattr(self, "_selftrace_seen"):
+            self._selftrace_seen = {}
+        delta = value - self._selftrace_seen.get(key, 0)
+        if delta > 0:
+            self.registry.counter_add(metric, float(delta), **labels)
+        self._selftrace_seen[key] = value
+
+    def _export_selftrace_stats(self) -> None:
+        """Flight/tracer counters → Prometheus (delta-based, like the
+        replication exports), plus the tracer poster's sender-queue
+        stats on the shared export family."""
+        events, dumps = self.flight.counts()
+        for kind, count in events.items():
+            self._selftrace_delta(
+                tele_metrics.ANOMALY_FLIGHT_EVENTS,
+                f"flight_ev_{kind}", count, kind=kind,
+            )
+        for reason, count in dumps.items():
+            self._selftrace_delta(
+                tele_metrics.ANOMALY_FLIGHT_DUMPS,
+                f"flight_dump_{reason}", count, reason=reason,
+            )
+        if self.selftrace is not None:
+            stats = self.selftrace.stats()
+            self._selftrace_delta(
+                tele_metrics.ANOMALY_SELFTRACE_TRACES,
+                "selftrace_traces", stats["traces_exported"],
+            )
+            self._selftrace_delta(
+                tele_metrics.ANOMALY_SELFTRACE_SPANS,
+                "selftrace_spans", stats["spans_exported"],
+            )
+        if self._selftrace_poster is not None:
+            self._selftrace_delta(
+                tele_metrics.ANOMALY_EXPORT_DROPPED,
+                "selftrace_dropped", self._selftrace_poster.dropped,
+                signal="selftrace",
+            )
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_EXPORT_QUEUE_DEPTH,
+                float(self._selftrace_poster.take_high_water()),
+                signal="selftrace",
+            )
 
     # -- report → metrics ---------------------------------------------
 
@@ -1220,6 +1437,8 @@ class DetectorDaemon:
                 time.monotonic() if t_now is None else t_now
             )
             self._export_fence_stats()
+            self._flight_health_tick()
+            self._export_selftrace_stats()
             if self.query_engine is not None and self._query_started:
                 self._export_query_stats()
             self._supervisor.tick()
@@ -1255,6 +1474,21 @@ class DetectorDaemon:
                 tele_metrics.ANOMALY_LOG_DOCS_STORED,
                 float(self.log_store.count()),
             )
+            # Trend context for any later transition dump: a compact
+            # 1 Hz snapshot of where batch time goes right now.
+            spine_st = self.pipeline.spine_stats()
+            self.flight.record(
+                "phase_snapshot",
+                pool_phase_s=(
+                    dict(self.ingest_pool.stats()["phase_s"])
+                    if self.ingest_pool is not None else None
+                ),
+                spine_overlap=(
+                    spine_st["overlap_ratio"] if spine_st else None
+                ),
+                pending_rows=self.pipeline.pending_rows(),
+                lag_p99_ms=self.pipeline.stats.lag_p99_ms(),
+            )
         # Overload gauges/counters every step (not the 1 s cadence):
         # saturation flips sub-second and the chaos tests scrape between
         # steps — a few dict writes, nothing device-side.
@@ -1275,6 +1509,7 @@ class DetectorDaemon:
                     lane=lane, cause="overflow",
                 )
                 self._shed_seen[lane] = shed[lane]
+                self.flight.record("shed", lane=lane, rows=int(delta))
         brownout = self.pipeline.stats.brownout_rows
         if brownout != self._brownout_seen:
             self.registry.counter_add(
@@ -1287,6 +1522,8 @@ class DetectorDaemon:
             self._export_pool_stats()
         self._export_spine_stats()
         self._export_fence_stats()
+        self._flight_health_tick()
+        self._export_selftrace_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
         if self.repl_primary is not None:
@@ -1331,6 +1568,9 @@ class DetectorDaemon:
                 hop="ingest",
             )
             seen["frames_corrupt"] = st["frames_corrupt"]
+            self.flight.record(
+                "quarantine", hop="ingest", frames=int(delta)
+            )
         # Windowed utilization: busy-seconds delta over wall delta,
         # normalized by worker count — the "is the pool the
         # bottleneck" gauge.
@@ -1360,6 +1600,20 @@ class DetectorDaemon:
             tele_metrics.ANOMALY_SPINE_PUT_OVERLAP,
             float(st["overlap_ratio"]),
         )
+        # Histogram companion on a per-window basis: the lifetime
+        # gauge flattens transients; one observation per scrape window
+        # lets Prometheus answer "what fraction of windows had the put
+        # hidden" as a quantile.
+        hits = int(st["overlap_hits"])
+        taken = hits + int(st["overlap_misses"])
+        seen_hits, seen_taken = self._spine_overlap_seen
+        if taken > seen_taken:
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_SPINE_OVERLAP_WINDOW,
+                (hits - seen_hits) / (taken - seen_taken),
+                self._OVERLAP_BUCKETS,
+            )
+            self._spine_overlap_seen = (hits, taken)
 
     # -- replication: standby step / promotion / fencing ----------------
 
@@ -1440,10 +1694,16 @@ class DetectorDaemon:
                 tele_metrics.ANOMALY_REPLICATION_FENCED, "fenced_sent",
                 st.fenced_sent, path="frame",
             )
+            corrupt_prev = self._repl_counters().get("frames_corrupt", 0)
             self._export_counter_delta(
                 tele_metrics.ANOMALY_FRAME_CORRUPT, "frames_corrupt",
                 st.frames_corrupt, hop="replication",
             )
+            if st.frames_corrupt > corrupt_prev:
+                self.flight.record(
+                    "quarantine", hop="replication",
+                    frames=int(st.frames_corrupt - corrupt_prev),
+                )
             if (
                 self.role == ROLE_STANDBY
                 and quiet_s > self._failover_timeout_s
@@ -1483,10 +1743,13 @@ class DetectorDaemon:
         epoch-stamped checkpoint makes the promotion durable — a
         promoted standby that crashes and restarts keeps outranking
         the old primary."""
-        import logging
-
         self.role = ROLE_PROMOTING
         epoch = self._fence.bump()
+        # Promotion steps land in the flight recorder AND dump an
+        # evidence file: a failover is exactly the moment an operator
+        # later asks "what did the daemon see".
+        self.flight.record("role", state=ROLE_PROMOTING, epoch=epoch)
+        self.flight.dump("promoting")
         try:
             # Everything fallible happens BEFORE the standby client is
             # stopped: if any step raises (wrong-shaped replicated
@@ -1563,6 +1826,10 @@ class DetectorDaemon:
                 pass  # not block the failover
         self.role = ROLE_PRIMARY
         self.registry.counter_add(tele_metrics.ANOMALY_FAILOVERS, 1.0)
+        self.flight.record(
+            "role", state=ROLE_PRIMARY, epoch=epoch,
+            offsets={str(k): v for k, v in self._offsets.items()},
+        )
         # Queries fail over WITH the role: the engine's role-dispatched
         # snapshot now reads live state (an already-serving read
         # replica needs no rewiring); a standby that booted with
@@ -1600,6 +1867,11 @@ class DetectorDaemon:
         self.registry.counter_add(
             tele_metrics.ANOMALY_REPLICATION_FENCED, 1.0, path="role",
         )
+        self.flight.record(
+            "role", state=ROLE_FENCED, at_boot=at_boot,
+            epoch=self._fence.epoch, observed=self._fence.observed,
+        )
+        self.flight.dump("fenced")
         if self.repl_primary is not None:
             try:
                 self.repl_primary.stop()
@@ -1621,8 +1893,6 @@ class DetectorDaemon:
                 pass
         self.receiver = None
         self.grpc_receiver = None
-        import logging
-
         logging.getLogger(__name__).error(
             "fenced%s: epoch %d superseded by %d — durable writes "
             "stopped (checkpoint/offset-commit/replication); redeploy "
@@ -1808,6 +2078,11 @@ class DetectorDaemon:
             # ex-primary's save would (correctly) raise — neither
             # writes a shutdown snapshot.
             self._checkpoint()
+        if self._selftrace_poster is not None:
+            # Ship whatever traces the drain produced, then stop the
+            # sender — bounded: shutdown never hangs on a dead sink.
+            self._selftrace_poster.flush(timeout_s=1.0)
+            self._selftrace_poster.close()
         self.exporter.stop()
 
 

@@ -72,6 +72,12 @@ from typing import Callable, NamedTuple, Sequence
 
 from . import frame, native
 from .otlp import MONITORED_ATTR_KEYS, decode_export_request
+from .selftrace import (
+    PHASE_DECODE,
+    PHASE_SUBMIT,
+    PHASE_TENSORIZE,
+    PHASE_VERIFY,
+)
 from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
 
@@ -336,6 +342,8 @@ class IngestPool:
         coalesce_max: int = 64,
         max_pending: int = 512,
         attr_keys: Sequence[str] = MONITORED_ATTR_KEYS,
+        phase_observe=None,
+        selftrace=None,
     ):
         if workers <= 0:
             raise ValueError("IngestPool needs workers >= 1 (0 = no pool)")
@@ -344,6 +352,14 @@ class IngestPool:
         self.workers = int(workers)
         self.coalesce_max = max(int(coalesce_max), 1)
         self.attr_keys = tuple(attr_keys)
+        # Self-telemetry (runtime.selftrace): ``phase_observe(phase,
+        # seconds)`` feeds the promoted anomaly_phase_seconds
+        # histograms per flush; ``selftrace.flush_segment`` records the
+        # same durations as an ingest segment the next sampled batch
+        # trace absorbs. Both optional and both cheap — one callback /
+        # one bounded append per FLUSH, never per request.
+        self.phase_observe = phase_observe
+        self.selftrace = selftrace
         self._q = _JobQueue(max_pending)
         self._scratch = ScratchPool(keep=self.workers + 1)
         # Stats (guarded by _stats_lock; read by the daemon's scrape).
@@ -362,7 +378,8 @@ class IngestPool:
         # submit) — the attribution the spine's win is measured by
         # (ingestbench phase breakdown).
         self.phase_s = {
-            "decode": 0.0, "verify": 0.0, "tensorize": 0.0, "submit": 0.0,
+            PHASE_DECODE: 0.0, PHASE_VERIFY: 0.0,
+            PHASE_TENSORIZE: 0.0, PHASE_SUBMIT: 0.0,
         }
         self._scratch_corrupt_seen = 0
         self.busy_s = 0.0  # summed across workers
@@ -477,24 +494,31 @@ class IngestPool:
         record_jobs = [(d, t) for kind, d, t in batch if kind == "records"]
         parts: list[SpanColumns] = []
         errors: dict[int, BaseException] = {}  # job index → decode error
+        # Per-flush phase ledger: the same durations feed the lifetime
+        # phase_s counters, the anomaly_phase_seconds histograms and
+        # (when a tracer rides along) the ingest segment of the next
+        # sampled batch trace — one measurement, three consumers.
+        seg: dict[str, float] = {}
         if payload_jobs:
             if native.available():
-                parts += self._decode_native(payload_jobs, errors)
+                parts += self._decode_native(payload_jobs, errors, seg)
             else:
-                parts += self._decode_python(payload_jobs, errors)
+                parts += self._decode_python(payload_jobs, errors, seg)
         if record_jobs:
             t0 = time.perf_counter()
             merged: list[SpanRecord] = []
             for records, _t in record_jobs:
                 merged.extend(records)
             parts.append(self.tensorizer.columns_from_records(merged))
-            self._phase("tensorize", time.perf_counter() - t0)
+            self._phase(PHASE_TENSORIZE, time.perf_counter() - t0, seg)
         cols = SpanColumns.concat(parts) if parts else None
         n_rows = cols.rows if cols is not None else 0
         if n_rows:
             t0 = time.perf_counter()
             self.submit_columns(cols)
-            self._phase("submit", time.perf_counter() - t0)
+            self._phase(PHASE_SUBMIT, time.perf_counter() - t0, seg)
+        if self.selftrace is not None and seg:
+            self.selftrace.flush_segment(seg)
         del parts, cols  # drop the worker's view refs: the rows stay
         # alive exactly as long as the PIPELINE holds them (the ticket
         # discipline the parked-scratch scavenge keys on)
@@ -514,7 +538,7 @@ class IngestPool:
             if ticket is not None:
                 ticket._resolve(None)
 
-    def _decode_native(self, payload_jobs, errors) -> list[SpanColumns]:
+    def _decode_native(self, payload_jobs, errors, seg) -> list[SpanColumns]:
         payloads = [p for p, _t in payload_jobs]
         total = sum(len(p) for p in payloads)
         t0 = time.perf_counter()
@@ -532,7 +556,7 @@ class IngestPool:
             # Phase sample BEFORE the empty-flush return: an all-
             # malformed flood burns real decode time and the
             # attribution must show it.
-            self._phase("decode", time.perf_counter() - t0)
+            self._phase(PHASE_DECODE, time.perf_counter() - t0, seg)
             if not cols.duration_us.shape[0]:
                 return []
             # Zero-copy hand-off (the ingest spine): the pipeline
@@ -551,10 +575,10 @@ class IngestPool:
             # referenced scratch is simply never handed out again.
             t0 = time.perf_counter()
             crcs = frame.span_column_crcs(cols)
-            self._phase("verify", time.perf_counter() - t0)
+            self._phase(PHASE_VERIFY, time.perf_counter() - t0, seg)
             t0 = time.perf_counter()
             out = self.tensorizer.columns_from_columnar(cols, copy=False)
-            self._phase("tensorize", time.perf_counter() - t0)
+            self._phase(PHASE_TENSORIZE, time.perf_counter() - t0, seg)
             if cols.duration_us.base is scratch.duration:
                 self._scratch.park(scratch, cols, crcs)
                 parked = True
@@ -567,13 +591,19 @@ class IngestPool:
             if not parked:
                 self._scratch.release(scratch)
 
-    def _phase(self, name: str, dt: float) -> None:
+    def _phase(self, name: str, dt: float, seg: dict | None = None) -> None:
         """Accumulate per-phase flush time (decode / verify /
         tensorize / submit) — how an operator attributes a flush's
         wall time between the native decoder, the integrity manifest,
-        the intern/column pass and the pipeline merge."""
+        the intern/column pass and the pipeline merge. Also fans the
+        sample out to the promoted histogram (``phase_observe``) and
+        the caller's per-flush segment ledger (``seg``)."""
         with self._stats_lock:
             self.phase_s[name] += dt
+        if seg is not None:
+            seg[name] = seg.get(name, 0.0) + dt
+        if self.phase_observe is not None:
+            self.phase_observe(name, dt)
 
     def _drain_scratch_corruption(self) -> None:
         """Surface parked-scratch CRC mismatches (see ScratchPool):
@@ -600,15 +630,17 @@ class IngestPool:
             except Exception:  # noqa: BLE001 — forensics must never
                 pass  # compound the fault (same rule as quarantine())
 
-    def _decode_python(self, payload_jobs, errors) -> list[SpanColumns]:
+    def _decode_python(self, payload_jobs, errors, seg) -> list[SpanColumns]:
         """No-compiler fallback: per-request wire decode, still ONE
         coalesced tensorize pass per flush."""
+        t0 = time.perf_counter()
         merged: list[SpanRecord] = []
         for i, (payload, _t) in enumerate(payload_jobs):
             try:
                 merged.extend(decode_export_request(payload))
             except Exception as e:  # noqa: BLE001 — per-request verdict
                 errors[i] = e
+        self._phase(PHASE_DECODE, time.perf_counter() - t0, seg)
         if not merged:
             return []
         return [self.tensorizer.columns_from_records(merged)]

@@ -414,6 +414,28 @@ class _ExporterBase:
         self._poster.close()
 
 
+def make_traces_poster(
+    endpoint: str, timeout_s: float = 2.0, queue_max: int = 64
+) -> BackgroundPoster:
+    """A BackgroundPoster shipping ExportTraceServiceRequest bodies to
+    an OTLP endpoint — ``grpc://host:port`` selects the gRPC
+    transport, anything else posts to ``/v1/traces``. The ONE
+    trace-transport selection, shared by the shop-side span exporter
+    and the detector's self-tracer (runtime.selftrace)."""
+    scheme, target = split_endpoint(endpoint)
+    if scheme == "grpc":
+        return BackgroundPoster(
+            target, "application/grpc", timeout_s, queue_max,
+            send=grpc_send(target, "traces", timeout_s),
+        )
+    target = target.rstrip("/")
+    if not target.endswith("/v1/traces"):
+        target += "/v1/traces"
+    return BackgroundPoster(
+        target, "application/x-protobuf", timeout_s, queue_max
+    )
+
+
 class OtlpHttpSpanExporter(_ExporterBase):
     """Subscribe on ``Collector.trace_exporters`` (or a gateway's
     ``on_spans``): ships each span batch to an OTLP ``/v1/traces``
@@ -421,19 +443,7 @@ class OtlpHttpSpanExporter(_ExporterBase):
     ship over OTLP/gRPC instead (same callable surface)."""
 
     def __init__(self, endpoint: str, timeout_s: float = 2.0, queue_max: int = 64):
-        scheme, target = split_endpoint(endpoint)
-        if scheme == "grpc":
-            self._poster = BackgroundPoster(
-                target, "application/grpc", timeout_s, queue_max,
-                send=grpc_send(target, "traces", timeout_s),
-            )
-        else:
-            target = target.rstrip("/")
-            if not target.endswith("/v1/traces"):
-                target += "/v1/traces"
-            self._poster = BackgroundPoster(
-                target, "application/x-protobuf", timeout_s, queue_max
-            )
+        self._poster = make_traces_poster(endpoint, timeout_s, queue_max)
 
     def __call__(self, now: float, records: list[SpanRecord]) -> None:
         if records:

@@ -48,6 +48,19 @@ import numpy as np
 from ..models.detector import AnomalyDetector, DetectorReport, report_unpack
 from ..ops.hashing import splitmix64_np
 from ..utils.flags import FlagEvaluator
+from .selftrace import (
+    PHASE_DISPATCH,
+    PHASE_FLAG,
+    PHASE_HARVEST,
+    PHASE_HARVEST_LAG,
+    PHASE_PUT_WAIT,
+    PHASE_STAGE,
+    SPAN_DISPATCH,
+    SPAN_FLAG,
+    SPAN_HARVEST,
+    SPAN_PUT,
+    SPAN_STAGE,
+)
 from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
 
 FLAG_ENABLED = "anomalyDetectorEnabled"
@@ -149,8 +162,18 @@ class DetectorPipeline:
         spine_ring: int = 0,
         spine_overlap: bool = True,
         spine_chunk_rows: int = 0,
+        phase_observe: Callable[[str, float], None] | None = None,
+        selftrace=None,
     ):
         self.detector = detector
+        # Self-telemetry (runtime.selftrace): ``phase_observe(phase,
+        # seconds)`` feeds the promoted per-phase histograms (dispatch/
+        # stage/put-wait/harvest/harvest-lag/flag) one sample per batch;
+        # ``selftrace`` (a SelfTracer or None) samples whole batch
+        # lifecycles into exported traces. Both default off and both
+        # cost nothing when None — the hot path pays one None check.
+        self.phase_observe = phase_observe
+        self._selftrace = selftrace
         self.flags = flags or FlagEvaluator()
         self.on_report = on_report
         self.tensorizer = SpanTensorizer(
@@ -561,6 +584,12 @@ class DetectorPipeline:
         else:
             cols = SpanColumns.concat(parts)
             self._capture_candidates(cols)
+            # Batch-lifecycle sampling gate: one splitmix64 + compare
+            # per batch; None rides the whole path for unsampled ones.
+            trace = (
+                self._selftrace.begin()
+                if self._selftrace is not None else None
+            )
             if self._spine is not None:
                 # Spine path: hand the columns to the stager (pack +
                 # async device put off the pump thread) and dispatch
@@ -571,12 +600,13 @@ class DetectorPipeline:
                 # backpressure, and the pump is the only consumer.
                 while self._spine.pending() >= self._spine.depth:
                     self._pump_spine(force_wait=True)
-                self._spine.stage(cols, width, t_now, t_oldest)
+                self._spine.stage(cols, width, t_now, t_oldest, trace=trace)
                 self._pump_spine()
             else:
                 batch = self.tensorizer.pack_columns(cols, width=width)
                 self._dispatch_batch(
-                    batch, t_now, t_oldest, cols, batch.num_valid
+                    batch, t_now, t_oldest, cols, batch.num_valid,
+                    trace=trace,
                 )
         if self.harvest_async:
             self._harvest_wake.set()
@@ -591,12 +621,13 @@ class DetectorPipeline:
             self._maybe_sync_harvest(keep=keep)
 
     def _dispatch_batch(
-        self, batch, t_now, t_oldest, cols, n_valid: int
+        self, batch, t_now, t_oldest, cols, n_valid: int, trace=None
     ) -> None:
         """Dispatch ONE packed batch (host- or device-resident) into
         the donated step — the single place detector state advances
         from the pump path, always under ``_dispatch_lock``."""
         self._last_dispatch = time.monotonic()
+        t0 = time.perf_counter()
         # Packed dispatch: the report comes back as ONE device vector so
         # harvest is a single transfer instead of one per report leaf.
         with self._dispatch_lock:
@@ -607,6 +638,12 @@ class DetectorPipeline:
             report.copy_to_host_async()
         except AttributeError:  # non-jax.Array stand-ins in tests
             pass
+        dispatch_dt = time.perf_counter() - t0
+        if self.phase_observe is not None:
+            self.phase_observe(PHASE_DISPATCH, dispatch_dt)
+        if trace is not None:
+            trace.span(SPAN_DISPATCH, dispatch_dt)
+            trace.attrs.append(("batch.rows", str(int(n_valid))))
         self.stats.batches += 1
         self.stats.spans += n_valid
         with self._inflight_lock:
@@ -615,8 +652,10 @@ class DetectorPipeline:
             # to hold_s before dispatch, and that wait IS detection lag.
             # The host-side columns ride along so the harvester can
             # capture exemplar trace ids AT FLAG TIME from the exact
-            # batch that flagged (bounded: ≤3 batches in flight).
-            self._inflight.append((t_now, t_oldest, report, cols))
+            # batch that flagged (bounded: ≤3 batches in flight). The
+            # batch's sampled self-trace (or None) rides too — the
+            # harvester finishes it after the flag decision.
+            self._inflight.append((t_now, t_oldest, report, cols, trace))
             # Bound the in-flight window: stale reports are dropped
             # unfetched (their batches already updated device state) so
             # readback RTT never throttles dispatch.
@@ -646,6 +685,15 @@ class DetectorPipeline:
         staged = self._spine.take(wait=must_wait)
         if staged is None:
             return False
+        if self.phase_observe is not None:
+            self.phase_observe(PHASE_STAGE, staged.stage_dur)
+            self.phase_observe(PHASE_PUT_WAIT, staged.wait_s)
+        if staged.trace is not None:
+            staged.trace.span(SPAN_STAGE, staged.stage_dur)
+            staged.trace.span(
+                SPAN_PUT, staged.wait_s,
+                attrs=(("overlap.hit", str(int(staged.wait_s == 0.0))),),
+            )
         # n_valid from the host row count: the device batch's own
         # valid.sum() would force a device sync on the dispatch path.
         self._dispatch_batch(
@@ -654,6 +702,7 @@ class DetectorPipeline:
             staged.t_oldest,
             staged.cols,
             staged.cols.rows,
+            trace=staged.trace,
         )
         return True
 
@@ -1023,7 +1072,7 @@ class DetectorPipeline:
 
     def _capture_exemplars(
         self, t_batch, cols, report, flags_np, threshold
-    ) -> None:
+    ) -> list[str]:
         """At flag time: link each flagged service to concrete trace
         ids from the batch that flagged it (harvester thread).
 
@@ -1036,9 +1085,14 @@ class DetectorPipeline:
 
         ``exemplar_ring=0`` disables only the trace-id capture (the
         privacy knob) — anomaly EVENTS still land in the ring, or
-        /query/anomalies and the Grafana annotations would go dark."""
+        /query/anomalies and the Grafana annotations would go dark.
+
+        Returns every trace-id hex captured across the flagged
+        services — the span links a sampled batch trace's flag span
+        carries (runtime.selftrace)."""
         if not flags_np.any():
-            return
+            return []
+        captured: list[str] = []
         cusum_thr = np.asarray(
             self.detector.config.cusum_thresholds, np.float32
         )
@@ -1077,6 +1131,7 @@ class DetectorPipeline:
                             {"trace_id": tid, "t": now, "signal": sig}
                         )
                 self.exemplars_captured += len(traces)
+                captured.extend(traces)
                 self._anomaly_ring.append({
                     "t": now,
                     "t_batch": float(t_batch),
@@ -1084,6 +1139,7 @@ class DetectorPipeline:
                     "signals": signals,
                     "exemplars": traces,
                 })
+        return captured
 
     def query_meta(self) -> dict:
         """JSON-able query-plane block: exemplar rings, recent anomaly
@@ -1153,14 +1209,23 @@ class DetectorPipeline:
     # -- report processing --------------------------------------------
 
     def _process_report(self, item) -> None:
-        t_batch, t_dispatch, dev_report, cols = item
+        t_batch, t_dispatch, dev_report, cols, trace = item
         self._note_outcome(skipped=False)
         probe = self._start_rtt_probe() if self.rtt_probe else None
         # Single-array fetch + host-side unpack (see pump()).
+        t_fetch = time.perf_counter()
         report = report_unpack(jax.device_get(dev_report), self.detector.config)
+        fetch_dt = time.perf_counter() - t_fetch
         flags_np = report.flags
         lag_ms = (time.monotonic() - t_dispatch) * 1e3
         self.stats.lag_ms.append(lag_ms)
+        if self.phase_observe is not None:
+            self.phase_observe(PHASE_HARVEST, fetch_dt)
+            # Submit→harvest lag is its own histogram (the detection-lag
+            # SLO's distribution), distinct from the fetch cost above.
+            self.phase_observe(PHASE_HARVEST_LAG, lag_ms / 1e3)
+        if trace is not None:
+            trace.span(SPAN_HARVEST, fetch_dt)
         if probe is not None:
             probe["thread"].join(timeout=10.0)
             self.stats.rtt_ms.append(probe["res"].get("rtt", float("nan")))
@@ -1186,16 +1251,36 @@ class DetectorPipeline:
             cusum_alarm = (report.cusum > cusum_thr[None, :]).any(axis=1)
             flags_np = (z > threshold) | cusum_alarm
         if flags_np.any():
+            t_flag = time.perf_counter()
             self.stats.flag_events += 1
             names = self.tensorizer.service_names
             flagged = [
                 names[i] if i < len(names) else f"svc-{i}"
                 for i in np.nonzero(flags_np)[0]
             ]
-            self._capture_exemplars(
+            links = self._capture_exemplars(
                 t_batch, cols, report, flags_np, threshold
             )
+            flag_dt = time.perf_counter() - t_flag
+            if self.phase_observe is not None:
+                self.phase_observe(PHASE_FLAG, flag_dt)
+            if trace is not None:
+                # The flag span carries span LINKS to the exemplar shop
+                # traces captured from THIS batch — a detector batch
+                # trace in Jaeger jumps straight to the evidence.
+                trace.span(
+                    SPAN_FLAG, flag_dt,
+                    attrs=(("flagged.services", ",".join(flagged)),),
+                    links=tuple(links),
+                )
         else:
             flagged = []
+        if trace is not None:
+            try:
+                self._selftrace.finish(trace)
+            except Exception:  # noqa: BLE001 — self-telemetry export
+                # must never fail the report path it observes: the
+                # trace is advisory, the report is the product.
+                pass
         if self.on_report is not None:
             self.on_report(t_batch, report, flagged)

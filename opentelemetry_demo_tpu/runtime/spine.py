@@ -54,13 +54,24 @@ class SpineError(RuntimeError):
 
 class StagedBatch:
     """One assembled batch riding the spine: host columns in, device
-    arrays out once the stager's put has been issued."""
+    arrays out once the stager's put has been issued.
+
+    ``trace`` carries the batch's sampled self-trace (runtime.selftrace
+    BatchTrace, or None) across the stage→take hand-off; ``stage_dur``
+    / ``wait_s`` are this batch's OWN pack+put-issue and take-side
+    put-wait seconds — the per-batch samples behind the
+    anomaly_phase_seconds{phase="stage"} and
+    anomaly_spine_put_wait_seconds histograms (the cumulative
+    ``stage_s``/``take_wait_s`` pool stats stay for the benches)."""
 
     __slots__ = (
         "cols", "width", "t_now", "t_oldest", "batch", "error", "ready",
+        "trace", "stage_dur", "wait_s",
     )
 
-    def __init__(self, cols: SpanColumns, width: int, t_now, t_oldest):
+    def __init__(
+        self, cols: SpanColumns, width: int, t_now, t_oldest, trace=None
+    ):
         self.cols = cols
         self.width = width
         self.t_now = t_now
@@ -68,6 +79,9 @@ class StagedBatch:
         self.batch: TensorBatch | None = None  # device arrays
         self.error: BaseException | None = None
         self.ready = threading.Event()
+        self.trace = trace
+        self.stage_dur = 0.0
+        self.wait_s = 0.0
 
 
 class DevicePutSpine:
@@ -116,13 +130,15 @@ class DevicePutSpine:
 
     # -- pump-thread API ----------------------------------------------
 
-    def stage(self, cols: SpanColumns, width: int, t_now, t_oldest) -> None:
+    def stage(
+        self, cols: SpanColumns, width: int, t_now, t_oldest, trace=None
+    ) -> None:
         """Enqueue one assembled batch for pack+put (never blocks —
         the PUMP enforces the ring bound by wait-dispatching the head
         before staging past ``depth``; the pump thread is the spine's
         only consumer, so blocking here would deadlock it against
-        itself)."""
-        staged = StagedBatch(cols, int(width), t_now, t_oldest)
+        itself). ``trace`` rides the StagedBatch to dispatch."""
+        staged = StagedBatch(cols, int(width), t_now, t_oldest, trace=trace)
         with self._work:
             if self._stop:
                 raise SpineError("spine is closed")
@@ -153,8 +169,9 @@ class DevicePutSpine:
                     f"staged batch not ready after {timeout}s "
                     "(stager dead or device put wedged)"
                 )
+            staged.wait_s = time.perf_counter() - t0
             with self._lock:
-                self.take_wait_s += time.perf_counter() - t0
+                self.take_wait_s += staged.wait_s
         with self._work:
             # Still the head (single consumer — the pump thread).
             if self._staged and self._staged[0] is staged:
@@ -273,9 +290,10 @@ class DevicePutSpine:
                 dev = self._put(host)
                 self._slot_prev[idx] = dev
                 staged.batch = dev
+                staged.stage_dur = time.perf_counter() - t0
                 with self._lock:
                     self.puts_total += 1
-                    self.stage_s += time.perf_counter() - t0
+                    self.stage_s += staged.stage_dur
             except Exception as e:  # noqa: BLE001 — surfaced via
                 # staged.error to the taking dispatcher; the stager
                 # thread itself must survive (it is the only producer

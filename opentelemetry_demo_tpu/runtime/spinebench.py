@@ -87,6 +87,8 @@ def measure_e2e(
     spans_per_request: int = 256,
     payloads: list[bytes] | None = None,
     kernel_ref: bool = True,
+    selftrace: bool = False,
+    selftrace_sample: float = 0.05,
 ) -> dict | None:
     """One configuration's e2e rate, or None without the native decoder.
 
@@ -109,6 +111,48 @@ def measure_e2e(
     )
     det = AnomalyDetector(config)
     reports = [0]
+    # Self-telemetry A/B leg (bench.py's selftrace_overhead_ratio):
+    # the FULL production wiring — sampled tracer + phase histograms
+    # into a real MetricRegistry — so the measured cost is what the
+    # daemon actually pays, not a strawman.
+    tracer = None
+    phase_observe = None
+    if selftrace:
+        from ..telemetry.metrics import (
+            ANOMALY_HARVEST_LAG,
+            ANOMALY_PHASE_SECONDS,
+            ANOMALY_SPINE_PUT_WAIT,
+            MetricRegistry,
+        )
+        from .selftrace import (
+            PHASE_BUCKETS,
+            PHASE_HARVEST_LAG,
+            PHASE_PUT_WAIT,
+            SelfTracer,
+        )
+
+        registry = MetricRegistry()
+        sink = {"n": 0, "bytes": 0}
+
+        def _submit(body: bytes) -> None:
+            sink["n"] += 1
+            sink["bytes"] += len(body)
+
+        tracer = SelfTracer(submit=_submit, sample=selftrace_sample)
+
+        def phase_observe(phase: str, seconds_: float) -> None:
+            metric = (
+                ANOMALY_HARVEST_LAG if phase == PHASE_HARVEST_LAG
+                else ANOMALY_SPINE_PUT_WAIT if phase == PHASE_PUT_WAIT
+                else ANOMALY_PHASE_SECONDS
+            )
+            if metric is ANOMALY_PHASE_SECONDS:
+                registry.histogram_observe(
+                    metric, seconds_, PHASE_BUCKETS, phase=phase
+                )
+            else:
+                registry.histogram_observe(metric, seconds_, PHASE_BUCKETS)
+
     pipe = DetectorPipeline(
         det,
         on_report=lambda t, r, flagged: reports.__setitem__(
@@ -117,6 +161,8 @@ def measure_e2e(
         batch_size=batch,
         spine_ring=ring,
         spine_overlap=overlap,
+        phase_observe=phase_observe,
+        selftrace=tracer,
     )
     pool = IngestPool(
         pipe.submit_columns,
@@ -124,6 +170,8 @@ def measure_e2e(
         workers=workers,
         coalesce_max=64,
         max_pending=max(4 * n_requests, 256),
+        phase_observe=phase_observe,
+        selftrace=tracer,
     )
     stop = threading.Event()
 
@@ -190,6 +238,45 @@ def measure_e2e(
         "phase_share": {
             k: round(v / phase_total, 4) for k, v in phase.items()
         },
+        "selftrace_traces": (
+            tracer.traces_exported if tracer is not None else None
+        ),
+    }
+
+
+def measure_selftrace_overhead(
+    seconds: float = 2.0, rounds: int = 2, **kw
+) -> dict | None:
+    """Tracer-on vs tracer-off spinebench A/B — the overhead canary.
+
+    Interleaved OFF/ON rounds on the SAME payload set (ABAB, so CPU
+    drift hits both arms), full production wiring on the ON arm
+    (sampled tracer + phase histograms into a real registry). Returns
+    ``ratio`` = off_rate / on_rate — 1.0 means free, and bench.py
+    gates it at ≤ 1.03. None without the native decoder."""
+    payloads = kw.pop("payloads", None) or make_payloads(
+        kw.get("n_requests", 32), kw.get("spans_per_request", 256)
+    )
+    rates = {True: [], False: []}
+    traces = 0
+    for _ in range(max(int(rounds), 1)):
+        for on in (False, True):
+            got = measure_e2e(
+                seconds=seconds, payloads=payloads, kernel_ref=False,
+                selftrace=on, **kw,
+            )
+            if got is None:
+                return None
+            rates[on].append(got["spans_per_sec"])
+            if on:
+                traces += got.get("selftrace_traces") or 0
+    rate_off = sum(rates[False]) / len(rates[False])
+    rate_on = sum(rates[True]) / len(rates[True])
+    return {
+        "ratio": round(rate_off / max(rate_on, 1e-9), 4),
+        "spans_per_sec_on": round(rate_on, 1),
+        "spans_per_sec_off": round(rate_off, 1),
+        "traces_exported": traces,
     }
 
 
@@ -226,6 +313,9 @@ def main() -> None:
     )
     headline = measure_e2e(seconds=seconds)
     sweep = measure_sweep(seconds=max(seconds / 3, 1.0))
+    selftrace_ab = measure_selftrace_overhead(
+        seconds=max(seconds / 3, 1.0)
+    )
     print(
         json.dumps(
             {
@@ -242,6 +332,10 @@ def main() -> None:
                 ),
                 "e2e_reports": headline.get("reports") if headline else None,
                 "sweep": sweep or None,
+                "selftrace_overhead_ratio": (
+                    selftrace_ab.get("ratio") if selftrace_ab else None
+                ),
+                "selftrace_overhead": selftrace_ab or None,
             }
         )
     )

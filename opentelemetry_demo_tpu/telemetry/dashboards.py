@@ -211,6 +211,44 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Anomaly exemplars captured",
                       Query("rate", "anomaly_exemplars_captured_total"),
                       "traces/s"),
+                # Detector self-telemetry (runtime.selftrace +
+                # runtime.flightrec): where a batch's wall time goes
+                # per lifecycle phase, whether the device put hid
+                # behind compute THIS window, how far behind harvest
+                # runs, and the tracer/recorder output rates — the
+                # detector watching itself with the same rigor it
+                # watches the shop.
+                Panel("Batch phase latency p99",
+                      Query("quantile", "anomaly_phase_seconds_bucket",
+                            by=("phase",), q=0.99), "s"),
+                Panel("Spine put-wait p99",
+                      Query("quantile",
+                            "anomaly_spine_put_wait_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Harvest lag p99 (Prometheus-owned)",
+                      Query("quantile",
+                            "anomaly_harvest_lag_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Put overlap ratio (windowed median)",
+                      Query("quantile",
+                            "anomaly_spine_put_overlap_window_ratio_bucket",
+                            q=0.5), "ratio"),
+                Panel("Query answer staleness p99",
+                      Query("quantile",
+                            "anomaly_query_answer_staleness_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Self-trace export rate",
+                      Query("rate", "anomaly_selftrace_traces_total"),
+                      "traces/s"),
+                Panel("Self-trace spans exported",
+                      Query("rate", "anomaly_selftrace_spans_total"),
+                      "spans/s"),
+                Panel("Flight-recorder events",
+                      Query("rate", "anomaly_flight_events_total",
+                            by=("kind",)), "events/s"),
+                Panel("Flight evidence dumps",
+                      Query("rate", "anomaly_flight_dumps_total",
+                            by=("reason",)), "dumps/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],
@@ -236,6 +274,8 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("sketch", "topk:frontend"), "count"),
                 Panel("Recent anomalies with exemplar traces",
                       Query("sketch", "anomalies"), "events"),
+                Panel("Flight recorder (live ring via /query/flight)",
+                      Query("sketch", "flight"), "events"),
             ],
         ),
     ]

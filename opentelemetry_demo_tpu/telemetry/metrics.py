@@ -277,6 +277,26 @@ ANOMALY_QUERY_REQUESTS = "anomaly_query_requests_total"  # {endpoint=, code=}
 ANOMALY_QUERY_LATENCY = "anomaly_query_latency_seconds"  # histogram
 ANOMALY_QUERY_STALENESS = "anomaly_query_staleness_seconds"
 ANOMALY_EXEMPLARS_CAPTURED = "anomaly_exemplars_captured_total"
+# Detector self-telemetry (runtime.selftrace batch-lifecycle tracer,
+# runtime.flightrec flight recorder, and the phase timers PROMOTED
+# from bench-only pool/spine counters into real histograms): where a
+# dispatched batch's wall time goes per lifecycle phase, whether the
+# device put actually hid behind compute THIS window, how far behind
+# harvest runs, and the tracer/recorder's own output rates.
+ANOMALY_PHASE_SECONDS = "anomaly_phase_seconds"  # histogram {phase=}
+ANOMALY_SPINE_PUT_WAIT = "anomaly_spine_put_wait_seconds"  # histogram
+ANOMALY_HARVEST_LAG = "anomaly_harvest_lag_seconds"  # histogram
+# Windowed histogram companion to the lifetime-ratio gauge
+# anomaly_spine_put_overlap_ratio: one observation per scrape window,
+# so overlap quantiles come from Prometheus instead of bench-only math.
+ANOMALY_SPINE_OVERLAP_WINDOW = "anomaly_spine_put_overlap_window_ratio"
+# Per-answer histogram companion to the anomaly_query_staleness_seconds
+# gauge (same Prometheus-owns-the-p99 promotion).
+ANOMALY_QUERY_STALENESS_HIST = "anomaly_query_answer_staleness_seconds"
+ANOMALY_SELFTRACE_TRACES = "anomaly_selftrace_traces_total"
+ANOMALY_SELFTRACE_SPANS = "anomaly_selftrace_spans_total"
+ANOMALY_FLIGHT_EVENTS = "anomaly_flight_events_total"  # {kind=}
+ANOMALY_FLIGHT_DUMPS = "anomaly_flight_dumps_total"  # {reason=}
 
 
 def export_metrics_report(

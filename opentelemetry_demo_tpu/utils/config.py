@@ -412,6 +412,51 @@ SPINE_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Detector self-telemetry knobs (runtime.selftrace: the batch-lifecycle
+# tracer exporting the daemon's OWN traces into the telemetry stack it
+# monitors; runtime.flightrec: the flight-recorder event ring dumped as
+# evidence on health/role transitions). Same ONE-registry discipline as
+# every other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+SELFTRACE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_SELFTRACE_ENABLE": (
+        "int", 1,
+        "1 = trace sampled batch lifecycles (decode→…→flag) and export "
+        "them through the background OTLP poster; 0 = tracer off "
+        "(phase histograms and the flight recorder stay on — they are "
+        "metrics/forensics, not traces)",
+    ),
+    "ANOMALY_SELFTRACE_SAMPLE": (
+        "float", 0.01,
+        "head-sampling rate in [0,1]: batch seq is hashed with "
+        "splitmix64 and sampled below rate*2^64 — deterministic, so "
+        "every replica and restart agrees which batches carry traces",
+    ),
+    "ANOMALY_SELFTRACE_ENDPOINT": (
+        "str", "",
+        "OTLP endpoint the detector's own traces export to "
+        "(http(s)://host:4318 or grpc://host:4317 — the collector the "
+        "shop already feeds, so detector batch traces land in the same "
+        "Jaeger); empty = encode-only (tests/bench read the bytes)",
+    ),
+    "ANOMALY_SELFTRACE_FLIGHT_RING": (
+        "int", 512,
+        "flight-recorder ring size (structured runtime events: role/"
+        "epoch moves, shed/brownout steps, fence hits, quarantines, "
+        "phase snapshots); the ring is the /query/flight body and the "
+        "dump payload",
+    ),
+    "ANOMALY_SELFTRACE_FLIGHT_DIR": (
+        "str", "",
+        "directory for flight-recorder evidence dumps written on every "
+        "DEGRADED/SATURATED/FENCED/PROMOTING transition "
+        "(flight-<reason>-<ms>.json, per-reason cooldown); empty = "
+        "ring-only, nothing written",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -421,6 +466,7 @@ SPINE_KNOBS: dict[str, tuple[str, object, str]] = {
 DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
+    "SELFTRACE_KNOBS",
 )
 
 
@@ -481,6 +527,11 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
     "BENCH_LAG_RATE": ("float", 2000.0, "lag bench offered spans/s"),
     "BENCH_LAG_SECONDS": ("float", 12.0, "lag bench duration"),
     "BENCH_SPINE": ("int", 1, "0 skips the e2e ingest-spine bench"),
+    "BENCH_SELFTRACE": (
+        "int", 1,
+        "0 skips the self-telemetry overhead A/B (tracer-on vs "
+        "tracer-off spinebench, gated <= 1.03)",
+    ),
     "BENCH_SPINE_SECONDS": (
         "float", 6.0, "e2e spine bench duration per configuration",
     ),
@@ -613,6 +664,25 @@ def daemon_config() -> dict[str, int | float | str]:
         raise ConfigError(
             "ANOMALY_CHECKPOINT_INTERVAL_S="
             f"{out['ANOMALY_CHECKPOINT_INTERVAL_S']} must be > 0"
+        )
+    return out
+
+
+def selftrace_config() -> dict[str, int | float | str]:
+    """Resolve every SELFTRACE_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the shapes — a
+    sampling rate outside [0,1] or a zero flight ring must refuse to
+    boot, not mis-sample silently."""
+    out = _resolve(SELFTRACE_KNOBS)
+    sample = float(out["ANOMALY_SELFTRACE_SAMPLE"])
+    if not 0.0 <= sample <= 1.0:
+        raise ConfigError(
+            f"ANOMALY_SELFTRACE_SAMPLE={sample} outside [0, 1]"
+        )
+    if int(out["ANOMALY_SELFTRACE_FLIGHT_RING"]) < 1:
+        raise ConfigError(
+            "ANOMALY_SELFTRACE_FLIGHT_RING="
+            f"{out['ANOMALY_SELFTRACE_FLIGHT_RING']} must be >= 1"
         )
     return out
 

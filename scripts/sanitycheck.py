@@ -166,7 +166,7 @@ def main() -> int:
                     and t.id in (
                         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
-                        "QUERY_KNOBS", "SPINE_KNOBS",
+                        "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -174,7 +174,7 @@ def main() -> int:
     for reg_name in (
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
-        "SPINE_KNOBS",
+        "SPINE_KNOBS", "SELFTRACE_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -341,7 +341,7 @@ def main() -> int:
         qtext = open(query_py).read()
         for marker in (
             "class QueryEngine", "class QueryService", "snapshot_fn",
-            "def dispatch", "/search", "/annotations",
+            "def dispatch", "/search", "/annotations", "/query/flight",
         ):
             check(marker in qtext, f"runtime/query.py declares {marker!r}")
         check(
@@ -370,6 +370,45 @@ def main() -> int:
             "test_grafana_datasource_contract",
         ):
             check(marker in qttext, f"query suite pins {marker}")
+
+    # 8) detector self-telemetry (runtime/selftrace.py +
+    #    runtime/flightrec.py): the span/phase vocabulary is declared
+    #    (the trace-discipline staticcheck pass polices its use), the
+    #    tracer samples deterministically, the flight recorder dumps
+    #    evidence, and the suite pins the proofs.
+    selftrace_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "selftrace.py"
+    )
+    check(os.path.exists(selftrace_py), "runtime/selftrace.py exists")
+    if os.path.exists(selftrace_py):
+        sttext = open(selftrace_py).read()
+        for marker in (
+            "class SelfTracer", "class BatchTrace", "def splitmix64",
+            "def sampled", "SPAN_BATCH", "SPAN_FLAG", "PHASE_DECODE",
+            "def encode_selftrace_request", "def decode_selftrace_request",
+        ):
+            check(marker in sttext, f"runtime/selftrace.py declares {marker}")
+    flight_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "flightrec.py"
+    )
+    check(os.path.exists(flight_py), "runtime/flightrec.py exists")
+    if os.path.exists(flight_py):
+        fltext = open(flight_py).read()
+        for marker in ("class FlightRecorder", "def record", "def dump"):
+            check(marker in fltext, f"runtime/flightrec.py declares {marker}")
+    selftrace_tests = os.path.join(ROOT, "tests", "test_selftrace.py")
+    check(os.path.exists(selftrace_tests), "tests/test_selftrace.py exists")
+    if os.path.exists(selftrace_tests):
+        stt = open(selftrace_tests).read()
+        for marker in (
+            "test_span_parent_and_links_round_trip",
+            "test_sampling_is_deterministic",
+            "test_flight_ring_is_bounded",
+            "test_dump_on_saturated_transition",
+            "test_phase_histograms_on_metrics",
+            "test_selftrace_overhead_canary",
+        ):
+            check(marker in stt, f"selftrace suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -293,10 +293,11 @@ def _load_passes() -> None:
         frame_monopoly,
         knobs,
         metric_surface,
+        trace_discipline,
     )
 
     for mod in (
-        donation, knobs, metric_surface,
+        donation, knobs, metric_surface, trace_discipline,
         frame_monopoly, concurrency, exception_status,
     ):
         PASSES[mod.PASS_ID] = (mod.run, mod.DESCRIPTION)

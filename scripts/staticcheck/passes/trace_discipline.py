@@ -1,0 +1,172 @@
+"""trace-discipline: one span/phase name table, dashboards in sync.
+
+The self-telemetry vocabulary (``runtime/selftrace.py``: ``SPAN_*``
+span names, ``PHASE_*`` phase labels) is what Jaeger searches, the
+``anomaly_phase_seconds{phase=}`` histogram series and the Grafana
+panels are written against — the metric-surface story, replayed for
+spans. Drift modes this pass closes (mirroring metric-surface):
+
+1. **Stray literal.** A span/phase recorded with an inline string
+   (``trace.span("detector.rogue", ...)`` /
+   ``self._phase("decode2", ...)``) bypasses the table: it can typo
+   silently, mint an undashboarded histogram series, and fork the
+   Jaeger vocabulary. Every call to a span/phase construction site
+   (``span`` / ``_phase`` / ``phase_observe`` / ``_observe_phase``)
+   under the detector's ``runtime/`` package (outside selftrace.py
+   itself) must reference a ``selftrace`` constant. Scoped to
+   ``runtime/`` deliberately: the SHOP SIMULATION's services emit
+   route-named spans (``services/base.py span()``) — that vocabulary
+   is the workload under test, unbounded by design, and none of this
+   pass's business.
+
+2. **Orphan.** A ``SPAN_*``/``PHASE_*`` constant nothing references is
+   a dead vocabulary entry — the tracer and its consumers have forked.
+
+3. **Dangling dashboard label.** A dashboard Query whose ``matchers``
+   pin a ``phase=`` value that no ``PHASE_*`` constant declares graphs
+   nothing, forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Repo, Violation, dotted
+
+PASS_ID = "trace-discipline"
+DESCRIPTION = (
+    "span/phase names come from runtime/selftrace.py constants; "
+    "no stray literals, no orphans, dashboard phase labels resolve"
+)
+
+SELFTRACE_REL = ("runtime", "selftrace.py")
+DASHBOARDS_REL = ("telemetry", "dashboards.py")
+# Call names that CONSTRUCT a span or phase sample (first positional
+# arg is the name/label). ``span`` is BatchTrace's recorder; ``_phase``
+# is the ingest pool's ledger; ``phase_observe``/``_observe_phase``
+# the histogram hook (callable attr or daemon method); ``flush_segment``
+# takes a dict keyed by phase labels — only its literal-keyed dict
+# displays are checkable and checked.
+CONSTRUCTORS = {"span", "_phase", "phase_observe", "_observe_phase"}
+PREFIXES = ("SPAN_", "PHASE_")
+
+
+def load_constants(repo: Repo) -> dict[str, str]:
+    """SPAN_*/PHASE_* name → string value from runtime/selftrace.py."""
+    rel = repo.pkg_path(*SELFTRACE_REL)
+    src = repo.source(rel) if rel else None
+    consts: dict[str, str] = {}
+    if src is None or src.tree is None:
+        return consts
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith(PREFIXES):
+                    consts[t.id] = node.value.value
+    return consts
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    if repo.package is None:
+        return out
+    consts = load_constants(repo)
+    if not consts:
+        return out  # no vocabulary declared — nothing to police
+    selftrace_rel = repo.pkg_path(*SELFTRACE_REL)
+    referenced: set[str] = set()
+
+    runtime_prefix = f"{repo.package}/runtime/"
+    for rel in repo.iter_py(repo.package):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            # Constant references anywhere (incl. selftrace.py's own
+            # SPAN_FOR_PHASE projection) count against the orphan rule.
+            if isinstance(node, ast.Attribute) and node.attr in consts:
+                referenced.add(node.attr)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in consts:
+                referenced.add(node.id)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in CONSTRUCTORS
+                and node.args
+            ):
+                continue
+            if rel == selftrace_rel or not rel.startswith(runtime_prefix):
+                # selftrace.py builds from locals; outside runtime/
+                # the shop simulation's route-named spans are the
+                # workload, not detector self-telemetry.
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append(Violation(
+                    PASS_ID, rel, node.lineno,
+                    f"span/phase name {arg.value!r} constructed from a "
+                    "string literal — names must come from the "
+                    "runtime/selftrace.py constant table (a typo here "
+                    "forks the Jaeger/histogram vocabulary silently)",
+                ))
+            else:
+                name = dotted(arg)
+                if name is not None:
+                    referenced.add(name.split(".")[-1])
+
+    # Orphans: a vocabulary entry nothing references.
+    src = repo.source(selftrace_rel) if selftrace_rel else None
+    const_line: dict[str, int] = {}
+    if src is not None and src.tree is not None:
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        const_line[t.id] = node.lineno
+    for cname in consts:
+        if cname not in referenced:
+            out.append(Violation(
+                PASS_ID, selftrace_rel, const_line.get(cname, 1),
+                f"{cname} ({consts[cname]!r}) is never referenced by "
+                "any span/phase construction site — a dead vocabulary "
+                "entry",
+            ))
+
+    # Dashboard phase labels must resolve against the table.
+    phase_values = {
+        v for k, v in consts.items() if k.startswith("PHASE_")
+    }
+    dash_rel = repo.pkg_path(*DASHBOARDS_REL)
+    dash_src = repo.source(dash_rel) if dash_rel else None
+    if dash_src is not None and dash_src.tree is not None:
+        for node in ast.walk(dash_src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Query"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "matchers" or not isinstance(
+                    kw.value, ast.Dict
+                ):
+                    continue
+                for key, val in zip(kw.value.keys, kw.value.values):
+                    if (
+                        isinstance(key, ast.Constant)
+                        and key.value == "phase"
+                        and isinstance(val, ast.Constant)
+                        and val.value not in phase_values
+                    ):
+                        out.append(Violation(
+                            PASS_ID, dash_rel, node.lineno,
+                            f"dashboard panel pins phase={val.value!r} "
+                            "but no runtime/selftrace.py PHASE_* "
+                            "constant declares it — the panel would "
+                            "graph nothing, forever",
+                        ))
+    return out

@@ -1,0 +1,377 @@
+"""Detector self-telemetry: tracer, flight recorder, phase histograms.
+
+Contract (ISSUE 10 acceptance):
+
+- **Span parent/link round-trip** — a flagged batch's trace decodes
+  back with every phase span parented under the ``detector.batch``
+  root, and the flag span's links are the 8-byte Jaeger prefixes of
+  trace ids that were ACTUALLY ingested for the flagged service (the
+  PR 6 exemplar capture, re-verified at the trace boundary).
+- **Deterministic sampling** — the splitmix64 head-sampler is
+  bit-identical to ``ops.hashing.splitmix64_np``, replicas agree, and
+  the rate is honored.
+- **Flight recorder** — the ring is bounded, a forced SATURATED flood
+  and a fencing event each dump a quarantine-style evidence file, and
+  ``/query/flight`` serves the live ring.
+- **Histogram exposition** — ``anomaly_phase_seconds`` buckets (and
+  the harvest-lag/put-wait/staleness companions) appear on /metrics
+  with the registered phase labels.
+- **Overhead canary** — tracer-on vs tracer-off through the real
+  pipeline stays within a generous CI bound (the tight ≤1.03 gate is
+  bench.py's ``selftrace_overhead_ok``, measured on the quieter
+  spinebench harness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models.detector import (
+    AnomalyDetector,
+    DetectorConfig,
+)
+from opentelemetry_demo_tpu.ops.hashing import splitmix64_np
+from opentelemetry_demo_tpu.runtime import selftrace
+from opentelemetry_demo_tpu.runtime.flightrec import FlightRecorder
+from opentelemetry_demo_tpu.runtime.pipeline import DetectorPipeline
+from opentelemetry_demo_tpu.runtime.tensorize import SpanColumns
+
+pytestmark = pytest.mark.selftrace
+
+SMALL = dict(num_services=8, cms_width=512, hll_p=8)
+NAMES = ["frontend", "cart", "checkout", "ad"]
+
+
+def make_columns(rng, n, services=4):
+    return SpanColumns(
+        svc=rng.integers(0, services, n).astype(np.int32),
+        lat_us=rng.gamma(4.0, 250.0, n).astype(np.float32),
+        is_error=(rng.random(n) < 0.02).astype(np.float32),
+        trace_key=rng.integers(0, 2**63, n, dtype=np.uint64),
+        attr_crc=rng.integers(0, 2**32, n, dtype=np.uint64),
+    )
+
+
+def drive_flagging_pipeline(tracer, phase_observe=None, batches=30):
+    """Warm a small detector, then blow up service 3's latency so the
+    flag path (and exemplar capture) fires; returns the ingested
+    trace-id prefixes for service 3."""
+    config = DetectorConfig(**SMALL, warmup_batches=2.0, z_warmup_batches=3.0)
+    pipe = DetectorPipeline(
+        AnomalyDetector(config), batch_size=64, exemplar_ring=4,
+        selftrace=tracer, phase_observe=phase_observe,
+    )
+    for name in NAMES:
+        pipe.tensorizer.service_id(name)
+    rng = np.random.default_rng(4)
+    submitted: set[str] = set()
+    t = 0.0
+    for i in range(batches):
+        cols = make_columns(rng, 64)
+        if i >= batches // 2:
+            cols.lat_us[cols.svc == 3] *= 10_000.0
+        for v in cols.trace_key[cols.svc == 3]:
+            submitted.add(int(v).to_bytes(8, "little").hex())
+        pipe.submit_columns(cols)
+        pipe.pump(t)
+        pipe.drain()
+        t += 0.25
+    assert pipe.exemplars_captured > 0
+    return submitted
+
+
+class TestSampling:
+    def test_splitmix64_matches_np_reference(self):
+        xs = np.array(
+            [0, 1, 2, 123456789, 2**63, 2**64 - 1], dtype=np.uint64
+        )
+        ref = splitmix64_np(xs)
+        for x, want in zip(xs, ref):
+            assert selftrace.splitmix64(int(x)) == int(want)
+
+    def test_sampling_is_deterministic(self):
+        a = [selftrace.sampled(i, 0.25) for i in range(4096)]
+        b = [selftrace.sampled(i, 0.25) for i in range(4096)]
+        assert a == b  # replicas/restarts agree per-batch
+        rate = sum(a) / len(a)
+        assert 0.18 < rate < 0.32  # honors the rate (hash-uniform)
+        assert all(selftrace.sampled(i, 1.0) for i in range(64))
+        assert not any(selftrace.sampled(i, 0.0) for i in range(64))
+
+    def test_unsampled_batch_returns_none(self):
+        tracer = selftrace.SelfTracer(sample=0.0)
+        assert tracer.begin() is None
+        assert tracer.traces_started == 0
+
+
+class TestSpanRoundTrip:
+    def test_span_parent_and_links_round_trip(self):
+        bodies: list[bytes] = []
+        tracer = selftrace.SelfTracer(submit=bodies.append, sample=1.0)
+        submitted = drive_flagging_pipeline(tracer)
+        assert tracer.traces_exported == tracer.traces_started > 0
+        # Every phase span parents under the root, same trace id.
+        spans = selftrace.decode_selftrace_request(bodies[-1])
+        roots = [s for s in spans if s["name"] == selftrace.SPAN_BATCH]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["trace_id"] == selftrace.BatchTrace(
+            int(root["attrs"]["batch.seq"])
+        ).trace_id.hex()  # deterministic ids: predictable from seq
+        for span in spans:
+            assert span["service"] == selftrace.SELF_SERVICE
+            if span is root:
+                continue
+            assert span["parent_span_id"] == root["span_id"]
+            assert span["trace_id"] == root["trace_id"]
+            assert span["start_ns"] <= span["end_ns"]
+        # Flag spans link to ACTUALLY-ingested shop trace prefixes —
+        # the Jaeger jump from detector batch to flagged evidence.
+        flag_spans = [
+            s for b in bodies
+            for s in selftrace.decode_selftrace_request(b)
+            if s["name"] == selftrace.SPAN_FLAG
+        ]
+        links = [link for s in flag_spans for link in s["links"]]
+        assert links, "a flagging run must produce linked flag spans"
+        for link in links:
+            assert len(link) == 32  # padded to a full 16-byte trace id
+            assert link[:16] in submitted
+
+    def test_ingest_segments_ride_the_next_sampled_batch(self):
+        tracer = selftrace.SelfTracer(submit=lambda b: None, sample=1.0)
+        tracer.flush_segment({
+            selftrace.PHASE_DECODE: 0.001,
+            selftrace.PHASE_VERIFY: 0.0002,
+            selftrace.PHASE_TENSORIZE: 0.0005,
+        })
+        trace = tracer.begin()
+        names = [s[0] for s in trace.spans]
+        assert names == [
+            selftrace.SPAN_DECODE, selftrace.SPAN_VERIFY,
+            selftrace.SPAN_TENSORIZE,
+        ]
+        assert tracer.stats()["segments_pending"] == 0
+
+
+class TestFlightRecorder:
+    def test_flight_ring_is_bounded(self):
+        rec = FlightRecorder(size=64)
+        for i in range(1000):
+            rec.record("tick", i=i)
+        events = rec.snapshot()
+        assert len(events) == 64
+        assert events[-1]["i"] == 999  # newest kept, oldest dropped
+        totals, _dumps = rec.counts()
+        assert totals["tick"] == 1000  # counters stay honest past the ring
+
+    def test_dump_writes_evidence_and_cooldown(self, tmp_path):
+        rec = FlightRecorder(
+            size=8, dump_dir=str(tmp_path), dump_cooldown_s=60.0
+        )
+        rec.record("role", state="fenced")
+        path = rec.dump("fenced")
+        assert path is not None and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        assert doc["reason"] == "fenced"
+        assert [e["kind"] for e in doc["events"]] == ["role"]
+        # Cooldown: an immediately flapping transition writes once.
+        assert rec.dump("fenced") is None
+        assert rec.dump("fenced", force=True) is not None
+        _totals, dumps = rec.counts()
+        assert dumps["fenced"] == 2
+
+    def test_dump_without_dir_is_ring_only(self):
+        rec = FlightRecorder(size=8)
+        rec.record("x")
+        assert rec.dump("saturated") is None
+
+
+def _daemon_env(monkeypatch, tmp_path, **extra):
+    monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+    monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "-1")
+    monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+    monkeypatch.setenv("ANOMALY_BATCH", "64")
+    monkeypatch.setenv("ANOMALY_NUM_SERVICES", "8")
+    monkeypatch.setenv("ANOMALY_CMS_WIDTH", "512")
+    monkeypatch.setenv("ANOMALY_HLL_P", "8")
+    monkeypatch.setenv("ANOMALY_ADAPTIVE_BATCH", "0")
+    monkeypatch.setenv("ANOMALY_INGEST_WORKERS", "0")
+    monkeypatch.setenv("ANOMALY_QUERY_PORT", "0")
+    monkeypatch.setenv("ANOMALY_QUERY_GRPC_PORT", "-1")
+    monkeypatch.setenv("ANOMALY_QUEUE_MAX_ROWS", "512")
+    monkeypatch.setenv("ANOMALY_BROWNOUT_HOLD_S", "0.05")
+    monkeypatch.setenv(
+        "ANOMALY_SELFTRACE_FLIGHT_DIR", str(tmp_path / "flight")
+    )
+    monkeypatch.setenv("ANOMALY_SELFTRACE_SAMPLE", "1.0")
+    for key, value in extra.items():
+        monkeypatch.setenv(key, value)
+
+
+class TestDaemonTransitions:
+    def test_dump_on_saturated_transition(self, monkeypatch, tmp_path):
+        """Flood past the high watermark: the health edge lands in the
+        flight ring AND writes a flight-saturated-*.json evidence
+        file; phase histograms appear on the registry render."""
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        _daemon_env(monkeypatch, tmp_path)
+        daemon = DetectorDaemon(DetectorConfig(**SMALL))
+        try:
+            rng = np.random.default_rng(7)
+            daemon.step()
+            # 5× the row budget in one burst → saturation edge.
+            for _ in range(10):
+                daemon.pipeline.submit_columns(make_columns(rng, 256))
+            assert daemon.pipeline.saturated
+            daemon.step()
+            kinds = [
+                ev["kind"] for ev in daemon.flight.snapshot()
+            ]
+            assert "health" in kinds and "boot" in kinds
+            dumps = os.listdir(tmp_path / "flight")
+            assert any(f.startswith("flight-saturated-") for f in dumps)
+            # The SATURATED health event is in the evidence file too.
+            path = sorted(
+                (tmp_path / "flight").glob("flight-saturated-*.json")
+            )[0]
+            doc = json.loads(open(path).read())
+            assert any(
+                ev["kind"] == "health" and ev["state"] == "saturated"
+                for ev in doc["events"]
+            )
+        finally:
+            daemon.shutdown()
+
+    def test_dump_on_fencing_event(self, monkeypatch, tmp_path):
+        """A primary that observes a newer epoch parks FENCED and
+        leaves a flight-fenced evidence file behind."""
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+        from opentelemetry_demo_tpu.runtime.replication import ROLE_FENCED
+
+        _daemon_env(monkeypatch, tmp_path)
+        daemon = DetectorDaemon(DetectorConfig(**SMALL))
+        try:
+            daemon.step()
+            daemon._fence.observe(5)  # someone promoted past us
+            daemon.step()
+            assert daemon.role == ROLE_FENCED
+            roles = [
+                ev for ev in daemon.flight.snapshot()
+                if ev["kind"] == "role"
+            ]
+            assert any(ev["state"] == ROLE_FENCED for ev in roles)
+            dumps = os.listdir(tmp_path / "flight")
+            assert any(f.startswith("flight-fenced-") for f in dumps)
+        finally:
+            daemon.shutdown()
+
+    def test_phase_histograms_on_metrics(self, monkeypatch, tmp_path):
+        """Driving real batches through the daemon lands
+        anomaly_phase_seconds buckets (registered phase labels only)
+        and the harvest-lag histogram on the Prometheus render."""
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        _daemon_env(monkeypatch, tmp_path)
+        daemon = DetectorDaemon(DetectorConfig(**SMALL))
+        try:
+            rng = np.random.default_rng(3)
+            for _ in range(4):
+                daemon.pipeline.submit_columns(make_columns(rng, 64))
+                daemon.step()
+            daemon.pipeline.drain()
+            daemon.step()
+            text = daemon.registry.render()
+            assert 'anomaly_phase_seconds_bucket{le="+Inf",phase="dispatch"}' in text
+            assert 'phase="harvest"' in text
+            assert "anomaly_harvest_lag_seconds_bucket" in text
+            assert "anomaly_harvest_lag_seconds_count" in text
+            assert "anomaly_selftrace_traces_total" in text
+            assert "anomaly_flight_events_total" in text
+        finally:
+            daemon.shutdown()
+
+    def test_query_flight_endpoint_serves_ring(self, monkeypatch, tmp_path):
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        _daemon_env(monkeypatch, tmp_path)
+        daemon = DetectorDaemon(DetectorConfig(**SMALL))
+        try:
+            daemon.start()
+            daemon.step()
+            port = daemon.query_service.port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/query/flight?limit=50",
+                timeout=5,
+            ) as resp:
+                doc = json.loads(resp.read())
+            kinds = [ev["kind"] for ev in doc["data"]["events"]]
+            assert "boot" in kinds
+            assert doc["meta"]["role"] == "primary"
+        finally:
+            daemon.shutdown()
+
+
+class TestHistogramWiring:
+    def test_phase_observe_sees_registered_labels_only(self):
+        phases: list[str] = []
+        tracer = selftrace.SelfTracer(submit=lambda b: None, sample=0.0)
+        drive_flagging_pipeline(
+            tracer, phase_observe=lambda n, dt: phases.append(n),
+            batches=20,
+        )
+        table = {
+            v for k, v in vars(selftrace).items()
+            if k.startswith("PHASE_")
+        }
+        assert set(phases) <= table
+        assert selftrace.PHASE_DISPATCH in phases
+        assert selftrace.PHASE_HARVEST_LAG in phases
+
+
+class TestOverheadCanary:
+    def test_selftrace_overhead_canary(self):
+        """Tracer-on vs tracer-off through the real pipeline. The
+        tight ≤1.03 gate lives in bench.py (spinebench A/B on a quiet
+        harness); here a generous CI bound catches a regression that
+        makes self-tracing grossly expensive (e.g. per-span work on
+        the hot path) without flaking on shared-runner noise."""
+        config = DetectorConfig(**SMALL)
+        rng = np.random.default_rng(11)
+        batches = [make_columns(rng, 256) for _ in range(8)]
+
+        def run(tracer) -> float:
+            pipe = DetectorPipeline(
+                AnomalyDetector(config), batch_size=256,
+                selftrace=tracer,
+            )
+            t = 0.0
+            for cols in batches:  # warm the compile off the clock
+                pipe.submit_columns(cols)
+                pipe.pump(t)
+                t += 0.05
+            pipe.drain()
+            t0 = time.perf_counter()
+            for _ in range(6):
+                for cols in batches:
+                    pipe.submit_columns(cols)
+                    pipe.pump(t)
+                    t += 0.05
+                pipe.drain()
+            return time.perf_counter() - t0
+
+        base = run(None)
+        traced = run(
+            selftrace.SelfTracer(submit=lambda b: None, sample=0.05)
+        )
+        assert traced < base * 1.5, (
+            f"self-tracing cost {traced / base:.2f}× the untraced "
+            "pipeline — the hot path is paying per-span work"
+        )

@@ -246,11 +246,51 @@ STATUS_CLEAN = {
     """,
 }
 
+TRACE_BAD = {
+    **BASE,
+    "pkg/runtime/selftrace.py": """
+        SPAN_BATCH = "detector.batch"
+        SPAN_DISPATCH = "detector.dispatch"
+        PHASE_DISPATCH = "dispatch"
+        PHASE_ORPHAN = "orphan_phase"
+    """,
+    "pkg/runtime/mod.py": """
+        from . import selftrace
+
+        def f(trace, pool):
+            trace.span("detector.rogue", 0.1)     # literal span name
+            pool._phase("dispatch2", 0.1)         # literal phase label
+            trace.span(selftrace.SPAN_DISPATCH, 0.1)
+            pool._phase(selftrace.PHASE_DISPATCH, 0.1)
+    """,
+}
+TRACE_CLEAN = {
+    **BASE,
+    "pkg/runtime/selftrace.py": """
+        SPAN_BATCH = "detector.batch"
+        SPAN_DISPATCH = "detector.dispatch"
+        PHASE_DISPATCH = "dispatch"
+
+        SPAN_FOR_PHASE = {PHASE_DISPATCH: SPAN_DISPATCH}
+
+        def root_name():
+            return SPAN_BATCH
+    """,
+    "pkg/runtime/mod.py": """
+        from . import selftrace
+
+        def f(trace, pool):
+            trace.span(selftrace.SPAN_DISPATCH, 0.1)
+            pool._phase(selftrace.PHASE_DISPATCH, 0.1)
+    """,
+}
+
 FIXTURES = [
     ("donation-race", DONATION_BAD, DONATION_CLEAN, 1),
     ("knob-discipline", KNOBS_BAD, KNOBS_CLEAN, 2),
     ("metric-surface", METRIC_BAD, METRIC_CLEAN, 3),
     ("frame-monopoly", FRAME_BAD, FRAME_CLEAN, 2),
+    ("trace-discipline", TRACE_BAD, TRACE_CLEAN, 3),
     ("concurrency", CONCURRENCY_BAD, CONCURRENCY_CLEAN, 2),
     ("exception-status", STATUS_BAD, STATUS_CLEAN, 4),
 ]
