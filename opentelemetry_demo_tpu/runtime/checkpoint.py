@@ -440,7 +440,11 @@ def peek_epoch(path: str) -> int | None:
             continue
         try:
             if suffix == SUFFIX:
-                _version, meta = frame.peek_file_meta(file)
+                # The shared header-only peek (frame.FramePeek) — the
+                # same read the history store's time index uses; the
+                # checkpoint-specific header-walking duplicate this
+                # branch once carried is retired.
+                meta = frame.peek_file_meta(file).meta
             else:
                 raw = frame.read_npz(file)
                 if "__meta__" not in raw:

@@ -108,6 +108,19 @@ pass):
   written, default 2; readers accept 1..2 — pin to 1 mid-rolling-
   upgrade), ``ANOMALY_FRAME_QUARANTINE_DIR`` (where corrupt frames are
   written aside for forensics; empty = count + drop)
+- Time-travel history knobs (one registry:
+  ``utils.config.HISTORY_KNOBS``; engine: ``runtime.history`` — the
+  compaction thread folding expiring window banks into an on-disk
+  retention ladder of verified frames, plus the range-query backend
+  and the replay corpus): ``ANOMALY_HISTORY_DIR`` (segment-log
+  directory; empty = tier off), ``ANOMALY_HISTORY_RUNGS`` (ladder
+  spans seconds, default ``1,60,3600``),
+  ``ANOMALY_HISTORY_RETENTION_S`` (per-rung caps),
+  ``ANOMALY_HISTORY_COMPACT_INTERVAL_S`` (compaction tick),
+  ``ANOMALY_HISTORY_SEGMENT_MB`` (segment roll size),
+  ``ANOMALY_HISTORY_SPANS`` (1 = capture dispatched span batches for
+  replaybench), ``ANOMALY_HISTORY_REPLAY_RATE`` (replaybench's
+  wall-clock speedup target)
 
 Replication / failover (runtime.replication; tests/test_replication.py):
 the daemon runs a role state machine — PRIMARY / STANDBY / PROMOTING
@@ -155,6 +168,7 @@ from ..utils.config import (
     ConfigError,
     daemon_config,
     frame_config,
+    history_config,
     ingest_config,
     overload_config,
     query_config,
@@ -163,7 +177,7 @@ from ..utils.config import (
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint, replication, selftrace
+from . import checkpoint, history, replication, selftrace
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -297,6 +311,45 @@ class DetectorDaemon:
         self._flight_last_brownout = 0
         self._flight_fence_seen = 0
         self._spine_overlap_seen = (0, 0)  # (hits, taken) window base
+
+        # Time-travel history tier knobs (registry:
+        # utils.config.HISTORY_KNOBS; engine: runtime.history). Parsed
+        # here; the store/writer themselves are constructed after the
+        # pipeline below (the writer snapshots through the same
+        # dispatch-lock helper replication uses, and its span capture
+        # is a pipeline hook) and BEFORE the boot fencing check (the
+        # log's on-disk epochs are fencing evidence like the
+        # checkpoint volume's).
+        try:
+            hk = history_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        from ..utils.config import history_ladder
+
+        self._history_dir = str(hk["ANOMALY_HISTORY_DIR"]) or None
+        # The SAME parse history_config() just validated with — not a
+        # re-implementation that could drift from it.
+        self._history_rungs, self._history_retention = history_ladder(
+            hk["ANOMALY_HISTORY_RUNGS"],
+            hk["ANOMALY_HISTORY_RETENTION_S"],
+        )
+        self._history_interval_s = float(
+            hk["ANOMALY_HISTORY_COMPACT_INTERVAL_S"]
+        )
+        self._history_segment_bytes = (
+            int(hk["ANOMALY_HISTORY_SEGMENT_MB"]) << 20
+        )
+        self._history_spans = bool(int(hk["ANOMALY_HISTORY_SPANS"]))
+        # Replay-rate target: consumed by replaybench against a
+        # recorded log; surfaced in the flight record below so a
+        # postmortem knows what the deployment promised.
+        self._history_replay_rate = float(
+            hk["ANOMALY_HISTORY_REPLAY_RATE"]
+        )
+        self.history_store: history.HistoryStore | None = None
+        self.history_writer: history.HistoryWriter | None = None
+        self.history_reader: history.HistoryReader | None = None
+        self._history_seen = {"compactions": 0, "frames_corrupt": 0}
 
         flagd_file = str(dk["FLAGD_FILE"]) or None
         ofrep = str(dk["OFREP_URL"]) or None
@@ -552,6 +605,30 @@ class DetectorDaemon:
             "the anomaly_query_staleness_seconds gauge)",
         )
         self.registry.describe(
+            tele_metrics.ANOMALY_HISTORY_SEGMENTS,
+            "Segment files in the on-disk history log (sealed + active)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_HISTORY_BYTES,
+            "Total bytes across history segments (bounded by the "
+            "per-rung retention caps)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_HISTORY_COMPACTIONS,
+            "Retention-ladder folds performed (N fine-rung records "
+            "monoid-merged into one coarse record)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_HISTORY_OLDEST,
+            "Age of the oldest history record — how far back time "
+            "travel reaches",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_HISTORY_READ_LATENCY,
+            "History range-read latency (seek + memcpy + verified "
+            "decode + monoid merge per query)",
+        )
+        self.registry.describe(
             tele_metrics.ANOMALY_SELFTRACE_TRACES,
             "Sampled batch-lifecycle traces exported by the self-tracer",
         )
@@ -572,7 +649,7 @@ class DetectorDaemon:
         self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
-        for hop in ("ingest", "replication", "checkpoint"):
+        for hop in ("ingest", "replication", "checkpoint", "history"):
             self.registry.counter_add(
                 tele_metrics.ANOMALY_FRAME_CORRUPT, 0.0, hop=hop
             )
@@ -800,6 +877,40 @@ class DetectorDaemon:
             self._supervisor.register(
                 "kafka-orders", base_backoff_s=0.5, max_backoff_s=15.0,
             )
+        # Time-travel tier (runtime.history): the store opens for every
+        # role that has the directory (range reads are disk-only); the
+        # COMPACTION WRITER is built here but started only by a serving
+        # role (start() on a primary, promote() on a standby). Opening
+        # the store OBSERVES the largest epoch already on disk — the
+        # fourth fencing path: a resurrected stale primary sharing the
+        # history volume learns it was superseded before the boot-fence
+        # check below, exactly like the checkpoint volume.
+        if self._history_dir:
+            self.history_store = history.HistoryStore(
+                self._history_dir,
+                segment_bytes=self._history_segment_bytes,
+                fence=self._fence,
+                retention_s=self._history_retention,
+            )
+            self.history_reader = history.HistoryReader(
+                self.history_store, rungs=self._history_rungs
+            )
+            self.history_writer = history.HistoryWriter(
+                self.history_store,
+                snapshot_fn=self._replication_snapshot,
+                rungs=self._history_rungs,
+                interval_s=self._history_interval_s,
+                capture_spans=self._history_spans,
+            )
+            if self._history_spans:
+                self.pipeline.history_capture = self.history_writer.capture
+            self.flight.record(
+                "history", dir=self._history_dir,
+                rungs=list(self._history_rungs),
+                retention_s=list(self._history_retention),
+                spans=self._history_spans,
+                replay_rate=self._history_replay_rate,
+            )
         if self.role == ROLE_PRIMARY and self._fence.stale():
             # Booted into a world that promoted past us (newer epoch on
             # the broker's commit tags or our own snapshot volume):
@@ -853,6 +964,11 @@ class DetectorDaemon:
                 # /query/flight serves THIS process's event ring — the
                 # on-demand half of the flight-recorder surface.
                 flight_fn=self.flight.snapshot,
+                # Time-travel range queries (from/to params + Grafana
+                # true ranges) answer from the on-disk log; every
+                # range read lands one latency observation.
+                history=self.history_reader,
+                read_observe=self._observe_history_read,
             )
             self.query_service = QueryService(
                 self.query_engine, registry=self.registry,
@@ -1187,6 +1303,7 @@ class DetectorDaemon:
             self.grpc_receiver.start()
         self.exporter.start()
         self._start_query_plane()
+        self._start_history_writer()
         self._register_serving_components()
         if self._repl_port >= 0:
             self._start_replication_primary()
@@ -1315,6 +1432,78 @@ class DetectorDaemon:
                 restart=self._restart_query_service,
             )
 
+    def _start_history_writer(self) -> None:
+        """Start + supervise the compaction thread (idempotent):
+        serving roles only — a standby's state is the primary's
+        mirror, and recording it too would double the log."""
+        if self.history_writer is None:
+            return
+        self.history_writer.start()
+        if not self._supervisor.registered("history"):
+            self._supervisor.register(
+                "history", base_backoff_s=1.0, max_backoff_s=30.0,
+                # A FENCED writer stopped on purpose; don't restart it.
+                probe=lambda: (
+                    self.role == ROLE_FENCED
+                    or self.history_writer is None
+                    or self.history_writer.alive()
+                ),
+                restart=self._restart_history_writer,
+            )
+
+    def _restart_history_writer(self) -> None:
+        if self.history_writer is None or self.role == ROLE_FENCED:
+            return
+        self.history_writer.start()  # idempotent while alive
+
+    def _observe_history_read(self, seconds: float) -> None:
+        from .query import LATENCY_BUCKETS
+
+        self.registry.histogram_observe(
+            tele_metrics.ANOMALY_HISTORY_READ_LATENCY, seconds,
+            LATENCY_BUCKETS,
+        )
+
+    def _export_history_stats(self) -> None:
+        """anomaly_history_* gauges/counters (delta-based like every
+        other family), plus corrupt records on the shared
+        anomaly_frame_corrupt_total{hop=history} series."""
+        store = self.history_store
+        if store is None:
+            return
+        st = store.stats()
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_HISTORY_SEGMENTS, float(st["segments"])
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_HISTORY_BYTES, float(st["bytes"])
+        )
+        oldest = st["oldest_t"]
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_HISTORY_OLDEST,
+            max(time.time() - oldest, 0.0) if oldest else 0.0,
+        )
+        seen = self._history_seen
+        if self.history_writer is not None:
+            comp = self.history_writer.compactions
+            if comp > seen["compactions"]:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_HISTORY_COMPACTIONS,
+                    float(comp - seen["compactions"]),
+                )
+                seen["compactions"] = comp
+        corrupt = st["frames_corrupt"]
+        if corrupt > seen["frames_corrupt"]:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FRAME_CORRUPT,
+                float(corrupt - seen["frames_corrupt"]), hop="history",
+            )
+            self.flight.record(
+                "quarantine", hop="history",
+                frames=int(corrupt - seen["frames_corrupt"]),
+            )
+            seen["frames_corrupt"] = corrupt
+
     def _restart_query_service(self) -> None:
         if self.query_service is None:
             return
@@ -1432,7 +1621,9 @@ class DetectorDaemon:
             # admitted (and keeps its health/metrics surface honest)
             # but performs no durable writes: no orders pump, no offset
             # commits, no checkpoints.
-            self.pipeline.pump(t_now)
+            self.pipeline.pump(
+                time.monotonic() if t_now is None else t_now
+            )
             self.metrics_feed.pump(
                 time.monotonic() if t_now is None else t_now
             )
@@ -1474,6 +1665,9 @@ class DetectorDaemon:
                 tele_metrics.ANOMALY_LOG_DOCS_STORED,
                 float(self.log_store.count()),
             )
+            # History-tier gauges on the same 1 s cadence (they walk
+            # the segment dir listing — not per-step work).
+            self._export_history_stats()
             # Trend context for any later transition dump: a compact
             # 1 Hz snapshot of where batch time goes right now.
             spine_st = self.pipeline.spine_stats()
@@ -1533,7 +1727,14 @@ class DetectorDaemon:
             # transport state no one anticipated) backs the pump off
             # and retries instead of killing the daemon loop.
             self._supervisor.run_step("kafka-orders", self._pump_orders)
-        self.pipeline.pump(t_now)
+        # The daemon is a WALL-CLOCK caller: pump(None) would reuse the
+        # pipeline's last timebase (the virtual-time contract for
+        # harness callers), freezing dt and window rotation for the
+        # whole serve-loop lifetime — tumbling windows would never
+        # expire, starving the cardinality head AND the history
+        # ladder. Resolve the clock here, like the metrics feed always
+        # has.
+        self.pipeline.pump(time.monotonic() if t_now is None else t_now)
         self.metrics_feed.pump(time.monotonic() if t_now is None else t_now)
         self._supervisor.tick()
         if (
@@ -1843,6 +2044,15 @@ class DetectorDaemon:
                 "promoted, but the query listener failed to start — "
                 "serving ingest without the read path"
             )
+        # The promoted daemon owns the compaction duty now (its
+        # appends stamp the bumped epoch — the old primary's are
+        # refused). Optional like the read path: ingest must live.
+        try:
+            self._start_history_writer()
+        except Exception:  # noqa: BLE001 — history is an optional tier; ingest must live
+            logging.getLogger(__name__).exception(
+                "promoted, but the history writer failed to start"
+            )
         if self.ckpt_path:
             # Durable promotion (and the first fencing artifact the old
             # primary can trip over on a shared volume).
@@ -1876,6 +2086,15 @@ class DetectorDaemon:
             try:
                 self.repl_primary.stop()
             except Exception:  # noqa: BLE001 — fenced teardown is best-effort; the daemon is exiting serving anyway
+                pass
+        if self.history_writer is not None:
+            # Deliberate stop (the supervised probe is role-gated):
+            # every further append would be fence-refused anyway, and
+            # sealing now keeps the log's tail durable for whoever owns
+            # the volume next.
+            try:
+                self.history_writer.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         # Stop SERVING too: a fenced replica that kept answering OTLP
         # would hold the orchestrator's readiness probes (the k8s
@@ -2073,6 +2292,11 @@ class DetectorDaemon:
             # the pipeline drains, so nothing in flight is lost.
             self.ingest_pool.close()
         self.pipeline.close()  # drain + stop the harvester thread if any
+        if self.history_writer is not None:
+            # After the pipeline drain (the last captured batches are
+            # in the queue) and before the final checkpoint: one last
+            # tick + seal so the log ends durable.
+            self.history_writer.close()
         if self.ckpt_path and self.role == ROLE_PRIMARY:
             # A standby's state is the primary's to persist; a fenced
             # ex-primary's save would (correctly) raise — neither

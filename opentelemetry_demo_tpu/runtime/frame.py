@@ -486,25 +486,49 @@ def _bad_columns(buf: bytes, cols: list, start: int) -> list[str]:
     return bad
 
 
-def peek_meta(buf: bytes) -> tuple[int, dict]:
-    """(version, meta) from the header ONLY — no payload verification.
+class FramePeek(NamedTuple):
+    """Header-only view of a frame: format version, schema hash, and
+    the meta block — everything a consumer can learn without touching
+    (or verifying) the column payload. Carries whatever the writer
+    stamped into meta: fencing epoch for checkpoints, seq/epoch/time
+    bounds for history records."""
 
-    For fencing-style peeks (checkpoint epoch on a shared volume) that
-    need evidence cheaply and treat unreadable as absent."""
-    version, _schema, _hlen, doc, _start = _parse_header(buf)
-    return version, doc.get("meta", {})
+    version: int
+    schema: int
+    meta: dict
 
 
-def peek_file_meta(path: str) -> tuple[int, dict]:
+def peek_meta(buf: bytes) -> FramePeek:
+    """:class:`FramePeek` from the header ONLY — no payload
+    verification, no column decode.
+
+    THE header-only peek for every caller that needs frame evidence
+    cheaply and treats unreadable as absent: save-time fencing peeks
+    (checkpoint epoch on a shared volume, via :func:`peek_file_meta`)
+    and the history store's time index (seq/epoch/time bounds per
+    record without decoding megabytes of sketch columns)."""
+    version, schema, _hlen, doc, _start = _parse_header(buf)
+    return FramePeek(version, schema, doc.get("meta", {}))
+
+
+def peek_stream_meta(f) -> FramePeek:
+    """Header-only peek at an open binary stream's CURRENT position
+    (the history store's record-meta reads: a frame at an arbitrary
+    offset inside a segment, peeked without touching its columns).
+    Leaves the stream positioned just past the header JSON."""
+    fixed = f.read(_FIXED.size)
+    if len(fixed) < _FIXED.size:
+        raise FrameCorrupt("frame shorter than its fixed header")
+    _magic, _version, _flags, _schema, hlen = _FIXED.unpack(fixed)
+    header = f.read(hlen)
+    return peek_meta(fixed + header + b"\0" * _TRAILER.size)
+
+
+def peek_file_meta(path: str) -> FramePeek:
     """Header-only read of a frame FILE: fixed header + JSON, never the
     payload — cheap enough for every save-time fencing peek."""
     with open(path, "rb") as f:
-        fixed = f.read(_FIXED.size)
-        if len(fixed) < _FIXED.size:
-            raise FrameCorrupt("frame file shorter than its fixed header")
-        _magic, _version, _flags, _schema, hlen = _FIXED.unpack(fixed)
-        header = f.read(hlen)
-    return peek_meta(fixed + header + b"\0" * _TRAILER.size)
+        return peek_stream_meta(f)
 
 
 # -- migration shims ---------------------------------------------------

@@ -164,8 +164,15 @@ class DetectorPipeline:
         spine_chunk_rows: int = 0,
         phase_observe: Callable[[str, float], None] | None = None,
         selftrace=None,
+        history_capture: Callable[[object, float], None] | None = None,
     ):
         self.detector = detector
+        # Time-travel span capture (runtime.history.HistoryWriter
+        # .capture, or None): every dispatched batch's host columns +
+        # virtual timebase, the replay corpus replaybench re-feeds.
+        # The callee copies and enqueues (bounded, drop-oldest) — the
+        # pump thread pays one memcpy, never an encode or a disk write.
+        self.history_capture = history_capture
         # Self-telemetry (runtime.selftrace): ``phase_observe(phase,
         # seconds)`` feeds the promoted per-phase histograms (dispatch/
         # stage/put-wait/harvest/harvest-lag/flag) one sample per batch;
@@ -627,6 +634,8 @@ class DetectorPipeline:
         the donated step — the single place detector state advances
         from the pump path, always under ``_dispatch_lock``."""
         self._last_dispatch = time.monotonic()
+        if self.history_capture is not None and cols is not None:
+            self.history_capture(cols, t_now)
         t0 = time.perf_counter()
         # Packed dispatch: the report comes back as ONE device vector so
         # harvest is a single transfer instead of one per report leaf.

@@ -1,0 +1,263 @@
+"""History replay harness: recorded frames → the real pipeline, N×.
+
+Detection-quality measurement (runtime.qualbench) was synthetic-only:
+every TTD/FP number came from generated traffic. The time-travel tier
+(runtime.history) turns the on-disk segment log into a REPLAY CORPUS —
+with ``ANOMALY_HISTORY_SPANS=1`` the writer records every dispatched
+span batch as a verified frame, and this module re-feeds those frames
+through a fresh, REAL ``DetectorPipeline`` (same admission, same
+tensorize/pack, same donated device step, same harvest) under
+virtual-time clock injection: ``pump(t)`` gets each batch's RECORDED
+timebase (the test_spine trick), so window rotation and EWMA dt replay
+exactly while wall-clock runs as fast as the machine allows.
+
+Two numbers come out, both in bench.py's artifact:
+
+- ``replay_speedup`` — recorded virtual seconds per wall second of
+  replay, gated ≥ the ``ANOMALY_HISTORY_REPLAY_RATE`` knob (10× on
+  CI): regression-testing a day of recorded incidents must cost
+  minutes, not a day.
+- **bit-identical verdict pinning** — the replayed run's per-batch
+  flag vectors must equal the recording run's exactly (the integer
+  sketch monoids and the float head arithmetic are deterministic on a
+  fixed platform; any divergence means the pipeline no longer treats
+  recorded bytes like live bytes).
+
+``measure_replay`` is self-contained for CI: it records a synthetic
+incident (a paymentFailure-shaped error burst over clean warmup
+traffic, the qualbench projection) into a temp store, then replays it.
+Against a production log the same ``replay()`` entry point re-runs a
+real recorded incident — every future detection head gets a backtest
+for free. ``history_range_query_p99_ms`` (range reads over the
+just-written ladder) rides along as the read path's cost number.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+
+import numpy as np
+
+from ..models.detector import AnomalyDetector, DetectorConfig
+from . import history
+from .pipeline import DetectorPipeline
+from .tensorize import SpanColumns
+
+# CI-friendly geometry: the protocol (record → replay equivalence), not
+# the kernels, is under test; qualbench owns quality numbers.
+S = 8
+B = 256
+DT_S = 0.25
+WARM_STEPS = 60
+FAULT_STEPS = 60
+FAULT_SVC = 5
+
+
+def _replay_config() -> DetectorConfig:
+    return DetectorConfig(num_services=S, hll_p=8, cms_width=256)
+
+
+def _make_cols(rng, step: int, faulted: bool) -> SpanColumns:
+    """One batch of shop-shaped traffic; past onset the faulted
+    service takes a 25% error burst plus a latency step — the
+    paymentFailure projection qualbench measures TTD on."""
+    svc = rng.integers(0, S, size=B).astype(np.int32)
+    lat = rng.gamma(4.0, 250.0, size=B).astype(np.float32)
+    err = (rng.random(B) < 0.01).astype(np.float32)
+    trace = (
+        rng.integers(0, 64, size=B, dtype=np.uint64) * np.uint64(2654435761)
+        + np.uint64(1)
+    )
+    attr = rng.zipf(1.5, size=B).astype(np.uint64)
+    if faulted:
+        hit = (rng.random(B) < 0.25).astype(np.float32)
+        err = np.where(svc == FAULT_SVC, np.maximum(err, hit), err)
+        lat = np.where(svc == FAULT_SVC, lat * 3.0, lat).astype(np.float32)
+    return SpanColumns(
+        svc=svc, lat_us=lat, is_error=err, trace_key=trace, attr_crc=attr
+    )
+
+
+def _make_pipeline(collect: dict) -> tuple[AnomalyDetector, DetectorPipeline]:
+    det = AnomalyDetector(_replay_config())
+
+    def on_report(t_batch, report, flagged):
+        collect[round(float(t_batch), 6)] = tuple(
+            bool(f) for f in np.asarray(report.flags)
+        )
+
+    pipe = DetectorPipeline(det, on_report=on_report, batch_size=B)
+    return det, pipe
+
+
+def record_incident(
+    directory: str,
+    seed: int = 0,
+    warm_steps: int = WARM_STEPS,
+    fault_steps: int = FAULT_STEPS,
+) -> dict:
+    """Drive the incident through a REAL pipeline while the history
+    writer records both the span corpus and the bank ladder; returns
+    the recording run's verdicts keyed by batch timebase."""
+    rng = np.random.default_rng(seed)
+    verdicts: dict = {}
+    det, pipe = _make_pipeline(verdicts)
+    store = history.HistoryStore(directory, retention_s=(86400.0, 86400.0))
+
+    def snapshot():
+        with pipe._dispatch_lock:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in det.state._asdict().items()
+            }
+            clock_t_prev = det.clock._t_prev
+        return arrays, {
+            "clock_t_prev": clock_t_prev,
+            "service_names": pipe.tensorizer.service_names,
+            "config": list(det.config._replace(sketch_impl=None)),
+            "query": pipe.query_meta(),
+        }
+
+    writer = history.HistoryWriter(
+        store, snapshot, rungs=(1.0, 60.0), capture_spans=True,
+        span_queue_max=4 * (warm_steps + fault_steps),
+    )
+    pipe.history_capture = writer.capture
+    wall0 = time.time()
+    for step in range(warm_steps + fault_steps):
+        t = step * DT_S
+        pipe.submit_columns(_make_cols(rng, step, step >= warm_steps))
+        pipe.pump(t)
+        writer.tick(now=wall0 + t)
+    pipe.drain()
+    writer.close()
+    pipe.close()
+    return verdicts
+
+
+def replay(directory: str) -> tuple[dict, float, float, int]:
+    """Re-feed the recorded span frames through a fresh real pipeline
+    at max speed under the RECORDED virtual clock; returns
+    (verdicts, virtual_span_s, wall_s, batches)."""
+    store = history.HistoryStore(directory)
+    reader = history.HistoryReader(store, rungs=(1.0, 60.0))
+    # Compile off the clock: a throwaway detector at the same geometry
+    # and batch width populates the XLA executable cache, so the timed
+    # loop measures REPLAY, not the one-time jit (the repo's
+    # warmup-before-timing rule; state is untouched — this detector is
+    # discarded).
+    warm_det, warm_pipe = _make_pipeline({})
+    warm_pipe.submit_columns(_make_cols(np.random.default_rng(1), 0, False))
+    warm_pipe.pump(0.0)
+    warm_pipe.close()
+    del warm_det
+    verdicts: dict = {}
+    _det, pipe = _make_pipeline(verdicts)
+    batches = 0
+    t_first = t_last = None
+    pending_t: float | None = None
+    wall0 = time.perf_counter()
+    for arrays, t_batch in reader.span_batches():
+        cols = SpanColumns(
+            **{
+                name: np.asarray(arrays[name])
+                for name in history.SPAN_CAPTURE_COLUMNS
+            }
+        )
+        # One-batch lookahead: batch k pumps while batch k+1 already
+        # sits in the queue, so the sync harvest keeps one report in
+        # flight (the pipeline's normal overlap regime) instead of
+        # round-tripping the device per batch. Verdicts are computed
+        # on device from (batch, t) alone — harvest timing cannot
+        # change them.
+        pipe.submit_columns(cols)
+        if pending_t is not None:
+            pipe.pump(pending_t)
+            batches += 1
+        pending_t = t_batch
+        t_first = t_batch if t_first is None else t_first
+        t_last = t_batch
+    if pending_t is not None:
+        pipe.pump(pending_t)
+        batches += 1
+    pipe.drain()
+    wall = time.perf_counter() - wall0
+    pipe.close()
+    virtual = (t_last - t_first + DT_S) if t_first is not None else 0.0
+    return verdicts, virtual, wall, batches
+
+
+def measure_range_queries(
+    directory: str, samples: int = 50, seed: int = 0
+) -> dict:
+    """p50/p99 ms of range reads over the just-written ladder — the
+    ``history_range_query_p99_ms`` artifact field."""
+    store = history.HistoryStore(directory)
+    reader = history.HistoryReader(store, rungs=(1.0, 60.0))
+    recs = store.records(kind=history.KIND_BANK, rung=0)
+    if not recs:
+        return {}
+    t0, t1 = recs[0].t_start, recs[-1].t_end
+    rng = np.random.default_rng(seed)
+    lat_ms = []
+    for _ in range(samples):
+        a, b = sorted(rng.uniform(t0, t1, size=2))
+        start = time.perf_counter()
+        reader.range_state(float(a), float(b) + 1.0)
+        lat_ms.append((time.perf_counter() - start) * 1e3)
+    lat = np.asarray(lat_ms)
+    return {
+        "history_range_query_p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "history_range_query_p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "history_range_query_samples": samples,
+    }
+
+
+def measure_replay(seed: int = 0, directory: str | None = None) -> dict:
+    """Record → replay → compare; ONE artifact dict (bench.py's
+    ``replay_*`` fields and the ``make replaybench`` line)."""
+    from ..utils.config import HISTORY_KNOBS, env_float
+
+    target = env_float(
+        "ANOMALY_HISTORY_REPLAY_RATE",
+        HISTORY_KNOBS["ANOMALY_HISTORY_REPLAY_RATE"][1],
+    )
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="replaybench-")
+        directory = tmp.name
+    try:
+        recorded = record_incident(directory, seed=seed)
+        replayed, virtual, wall, batches = replay(directory)
+        identical = recorded == replayed
+        speedup = virtual / max(wall, 1e-9)
+        out = {
+            "replay_speedup": round(speedup, 2),
+            "replay_rate_target": target,
+            "replay_ok": bool(identical and speedup >= target),
+            "replay_verdicts_identical": identical,
+            "replay_batches": batches,
+            "replay_virtual_s": round(virtual, 3),
+            "replay_wall_s": round(wall, 4),
+            "replay_flagged_batches": sum(
+                1 for flags in recorded.values() if any(flags)
+            ),
+        }
+        out.update(measure_range_queries(directory, seed=seed))
+        return out
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main() -> None:
+    import json
+
+    out = {"metric": "history_replay", "unit": "x_wall_clock"}
+    out.update(measure_replay())
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -249,6 +249,27 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Flight evidence dumps",
                       Query("rate", "anomaly_flight_dumps_total",
                             by=("reason",)), "dumps/s"),
+                # Time-travel history tier (runtime.history): how much
+                # recorded past exists, how far back it reaches, how
+                # often the retention ladder folds, and what a range
+                # read costs — beside the shared corrupt-frame panel's
+                # hop=history series.
+                Panel("History segments on disk",
+                      Query("instant", "anomaly_history_segments"),
+                      "segments"),
+                Panel("History bytes (retention-capped)",
+                      Query("instant", "anomaly_history_bytes"),
+                      "bytes"),
+                Panel("Time-travel reach (oldest record age)",
+                      Query("instant", "anomaly_history_oldest_seconds"),
+                      "s"),
+                Panel("Retention-ladder folds",
+                      Query("rate", "anomaly_history_compactions_total"),
+                      "folds/s"),
+                Panel("History range-read p99",
+                      Query("quantile",
+                            "anomaly_history_read_latency_seconds_bucket",
+                            q=0.99), "s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

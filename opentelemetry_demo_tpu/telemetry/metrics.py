@@ -293,6 +293,17 @@ ANOMALY_SPINE_OVERLAP_WINDOW = "anomaly_spine_put_overlap_window_ratio"
 # Per-answer histogram companion to the anomaly_query_staleness_seconds
 # gauge (same Prometheus-owns-the-p99 promotion).
 ANOMALY_QUERY_STALENESS_HIST = "anomaly_query_answer_staleness_seconds"
+# Time-travel history tier (runtime.history: compaction thread folding
+# expiring window banks into the on-disk retention ladder; the query
+# plane's range-read backend): how much history exists, how far back it
+# reaches, how often the ladder folds, and what a range read costs —
+# plus corrupt records surfacing on the shared frame-corruption family
+# as anomaly_frame_corrupt_total{hop="history"}.
+ANOMALY_HISTORY_SEGMENTS = "anomaly_history_segments"
+ANOMALY_HISTORY_BYTES = "anomaly_history_bytes"
+ANOMALY_HISTORY_COMPACTIONS = "anomaly_history_compactions_total"
+ANOMALY_HISTORY_OLDEST = "anomaly_history_oldest_seconds"
+ANOMALY_HISTORY_READ_LATENCY = "anomaly_history_read_latency_seconds"
 ANOMALY_SELFTRACE_TRACES = "anomaly_selftrace_traces_total"
 ANOMALY_SELFTRACE_SPANS = "anomaly_selftrace_spans_total"
 ANOMALY_FLIGHT_EVENTS = "anomaly_flight_events_total"  # {kind=}

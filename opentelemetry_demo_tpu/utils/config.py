@@ -457,6 +457,64 @@ SELFTRACE_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Time-travel history tier knobs (runtime.history: the compaction
+# thread folding expiring window banks into an on-disk retention
+# ladder of verified frames, the range-query read path, and the span
+# capture leg runtime.replaybench replays; runtime/daemon.py threads
+# them). Same ONE-registry discipline as every other family — daemon,
+# compose overlay, k8s generator and sanitycheck.py all consume this
+# dict. Values must stay literals (sanitycheck reads via
+# ast.literal_eval, without importing jax).
+HISTORY_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_HISTORY_DIR": (
+        "str", "",
+        "segment-log directory for the frame-native history store "
+        "(empty = time-travel tier off: no compaction thread, range "
+        "queries answer 404)",
+    ),
+    "ANOMALY_HISTORY_RUNGS": (
+        "str", "1,60,3600",
+        "retention-ladder rung spans in seconds, finest first; each "
+        "rung folds the previous one's records by the sketch monoids "
+        "(HLL max-merge, CMS add-merge; EWMA/CUSUM heads keep "
+        "last-value-per-rung), so every rung must divide the next",
+    ),
+    "ANOMALY_HISTORY_RETENTION_S": (
+        "str", "3600,86400,604800",
+        "per-rung retention caps in seconds (one entry per rung): "
+        "sealed segments whose newest record ages past the cap are "
+        "deleted oldest-first; span-capture records share rung 0's cap",
+    ),
+    "ANOMALY_HISTORY_COMPACT_INTERVAL_S": (
+        "float", 0.5,
+        "compaction-thread tick seconds: how often the writer "
+        "snapshots state (under the dispatch lock, same discipline as "
+        "replication) looking for a completed shortest-window bank to "
+        "fold into the ladder; keep below the shortest rung span or "
+        "completed windows are missed (counted, never mis-merged)",
+    ),
+    "ANOMALY_HISTORY_SEGMENT_MB": (
+        "int", 8,
+        "segment roll size in MiB: the active segment seals "
+        "(flush+fsync+rename, the checkpoint crash-safety discipline) "
+        "and a new one opens once it grows past this",
+    ),
+    "ANOMALY_HISTORY_SPANS": (
+        "int", 0,
+        "1 = also capture every dispatched span batch as a frame in "
+        "the log (the replay corpus runtime.replaybench re-feeds "
+        "through the real pipeline); costs one host-side column copy "
+        "per batch plus rung-0-retention disk",
+    ),
+    "ANOMALY_HISTORY_REPLAY_RATE": (
+        "float", 10.0,
+        "target wall-clock speedup for replaybench (virtual-time "
+        "clock injection re-feeds recorded frames at N x real time); "
+        "bench.py gates replay_speedup against this",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -466,7 +524,7 @@ SELFTRACE_KNOBS: dict[str, tuple[str, object, str]] = {
 DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
-    "SELFTRACE_KNOBS",
+    "SELFTRACE_KNOBS", "HISTORY_KNOBS",
 )
 
 
@@ -534,6 +592,12 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
     ),
     "BENCH_SPINE_SECONDS": (
         "float", 6.0, "e2e spine bench duration per configuration",
+    ),
+    "BENCH_REPLAY": (
+        "int", 1,
+        "0 skips the history replay bench (record a synthetic "
+        "incident, replay the recorded frames through the real "
+        "pipeline at N x wall clock, pin bit-identical verdicts)",
     ),
 }
 
@@ -683,6 +747,77 @@ def selftrace_config() -> dict[str, int | float | str]:
         raise ConfigError(
             "ANOMALY_SELFTRACE_FLIGHT_RING="
             f"{out['ANOMALY_SELFTRACE_FLIGHT_RING']} must be >= 1"
+        )
+    return out
+
+
+def history_ladder(
+    rungs_raw, retention_raw
+) -> tuple[tuple[float, ...], tuple[float, ...]]:
+    """Parsed ``(rung_spans_s, retention_s)`` from the two raw
+    comma-separated ladder knob values — the ONE parse, shared by the
+    validator below and the daemon (two copies of the split/float
+    could drift, and then the values validated would not be the
+    values used). Callers pass the knob values themselves so the
+    consuming subscripts stay visible to the knob-discipline pass."""
+    rungs = tuple(
+        float(r) for r in str(rungs_raw).split(",") if r.strip()
+    )
+    retention = tuple(
+        float(r) for r in str(retention_raw).split(",") if r.strip()
+    )
+    return rungs, retention
+
+
+def history_config() -> dict[str, int | float | str]:
+    """Resolve every HISTORY_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the ladder shape —
+    rungs must be positive, ascending, and each must divide the next
+    (a rung that doesn't divide its parent can never fold exactly N
+    child records into one parent record), with one retention cap per
+    rung. A ladder nobody can fold must refuse to boot."""
+    out = _resolve(HISTORY_KNOBS)
+    try:
+        rungs, retention = history_ladder(
+            out["ANOMALY_HISTORY_RUNGS"],
+            out["ANOMALY_HISTORY_RETENTION_S"],
+        )
+    except ValueError as e:
+        raise ConfigError(
+            "ANOMALY_HISTORY_RUNGS/RETENTION_S must be comma-separated "
+            f"numbers: {e}"
+        ) from e
+    if not rungs or any(r <= 0 for r in rungs):
+        raise ConfigError(
+            f"ANOMALY_HISTORY_RUNGS={out['ANOMALY_HISTORY_RUNGS']!r} "
+            "needs at least one positive rung span"
+        )
+    for fine, coarse in zip(rungs, rungs[1:]):
+        if coarse <= fine or (coarse / fine) != int(coarse / fine):
+            raise ConfigError(
+                f"ANOMALY_HISTORY_RUNGS={out['ANOMALY_HISTORY_RUNGS']!r}"
+                ": rungs must ascend and each must divide the next "
+                f"({fine} -> {coarse})"
+            )
+    if len(retention) != len(rungs):
+        raise ConfigError(
+            "ANOMALY_HISTORY_RETENTION_S needs one cap per rung "
+            f"({len(retention)} caps for {len(rungs)} rungs)"
+        )
+    if float(out["ANOMALY_HISTORY_COMPACT_INTERVAL_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_HISTORY_COMPACT_INTERVAL_S="
+            f"{out['ANOMALY_HISTORY_COMPACT_INTERVAL_S']} must be > 0"
+        )
+    if int(out["ANOMALY_HISTORY_SEGMENT_MB"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_HISTORY_SEGMENT_MB={out['ANOMALY_HISTORY_SEGMENT_MB']}"
+            " must be >= 1"
+        )
+    if float(out["ANOMALY_HISTORY_REPLAY_RATE"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_HISTORY_REPLAY_RATE="
+            f"{out['ANOMALY_HISTORY_REPLAY_RATE']} must be > 0"
         )
     return out
 

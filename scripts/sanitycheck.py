@@ -167,6 +167,7 @@ def main() -> int:
                         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
+                        "HISTORY_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -174,7 +175,7 @@ def main() -> int:
     for reg_name in (
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
-        "SPINE_KNOBS", "SELFTRACE_KNOBS",
+        "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -409,6 +410,103 @@ def main() -> int:
             "test_selftrace_overhead_canary",
         ):
             check(marker in stt, f"selftrace suite pins {marker}")
+
+    # 9) time-travel tier (runtime/history.py + runtime/replaybench.py):
+    #    the frame-native history store is the ONLY frame consumer
+    #    outside the live path. Pinned structurally: an AST scan of the
+    #    package's import statements must find `frame` imported by
+    #    EXACTLY the live-path owners (ingest scratch→pipeline,
+    #    replication link, checkpoint file, the daemon's boot-time
+    #    frame.configure) plus history.py — a sixth importer is a new
+    #    frame consumer nobody reviewed. Plus the subsystem's own
+    #    contract markers, the replay/requires_env marker registrations,
+    #    and the suite pins.
+    history_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "history.py"
+    )
+    check(os.path.exists(history_py), "runtime/history.py exists")
+    if os.path.exists(history_py):
+        htext = open(history_py).read()
+        for marker in (
+            "class HistoryStore", "class HistoryWriter",
+            "class HistoryReader", "def merge_record_arrays",
+            "RECORD_MAGIC", "fence.check", "quarantine",
+        ):
+            check(marker in htext, f"runtime/history.py declares {marker}")
+    frame_importers: set[str] = set()
+    pkg_root = os.path.join(ROOT, "opentelemetry_demo_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            fpath = os.path.join(dirpath, fname)
+            try:
+                tree = ast.parse(open(fpath).read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                names = []
+                if isinstance(node, ast.ImportFrom):
+                    if node.module and node.module.split(".")[-1] == "frame":
+                        names = ["frame"]
+                    else:
+                        names = [a.name for a in node.names]
+                elif isinstance(node, ast.Import):
+                    names = [a.name.split(".")[-1] for a in node.names]
+                if "frame" in names:
+                    frame_importers.add(
+                        os.path.relpath(fpath, pkg_root).replace(os.sep, "/")
+                    )
+    expected_frame_importers = {
+        "runtime/checkpoint.py",   # frames ON DISK (live durability)
+        "runtime/daemon.py",       # boot-time frame.configure()
+        "runtime/ingest_pool.py",  # scratch→pipeline hop (live)
+        "runtime/replication.py",  # primary→standby payloads (live)
+        "runtime/history.py",      # THE one consumer outside the live path
+    }
+    check(
+        frame_importers == expected_frame_importers,
+        "history.py is the only frame consumer outside the live path "
+        f"(importers {sorted(frame_importers)})",
+    )
+    replaybench_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "replaybench.py"
+    )
+    check(os.path.exists(replaybench_py), "runtime/replaybench.py exists")
+    check(
+        "replaybench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has a replaybench target",
+    )
+    pyproject = open(os.path.join(ROOT, "pyproject.toml")).read()
+    for marker_name in ("history:", "replay:", "requires_env(resource):"):
+        check(
+            marker_name in pyproject,
+            f"pyproject registers the {marker_name.rstrip(':')} marker",
+        )
+    for env_test in (
+        "test_graft_entry.py", "test_multihost.py",
+        "test_parallel.py", "test_tracetest.py",
+    ):
+        ttext = open(os.path.join(ROOT, "tests", env_test)).read()
+        check(
+            "requires_env" in ttext,
+            f"tests/{env_test} carries the requires_env marker "
+            "(its failures are env gaps, not regressions)",
+        )
+    history_tests = os.path.join(ROOT, "tests", "test_history.py")
+    check(os.path.exists(history_tests), "tests/test_history.py exists")
+    if os.path.exists(history_tests):
+        httext = open(history_tests).read()
+        for marker in (
+            "test_ladder_fold_bit_identical_to_direct_merge",
+            "test_corrupt_record_quarantined_and_skipped",
+            "test_stale_writer_append_refused",
+            "test_range_queries_serve_from_disk",
+            "test_replay_verdicts_bit_identical",
+            "test_grafana_range_honored",
+        ):
+            check(marker in httext, f"history suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -50,6 +50,7 @@ PROTOCOL_CODECS = (
     "runtime/kafka_wire.py",  # Kafka protocol encoding
     "runtime/structpb.py",    # protobuf wire primitives
     "runtime/replication.py", # session envelope header (state INSIDE is frames)
+    "runtime/history.py",     # segment-log record headers (state INSIDE is frames)
     "runtime/faultwire.py",   # chaos proxy fault plans
     "runtime/otlp_metrics.py",# OTLP fixed64/double fields
     "services/grpc_edge.py",  # HTTP/2 frame codec

@@ -278,7 +278,7 @@ class TestCorruptCheckpoint:
         # inside a column payload, the case whole-file truncation
         # tests can't see (peek_file_meta is the header-only read the
         # fencing path uses).
-        _v, meta_peek = frame.peek_file_meta(str(ckpt))
+        meta_peek = frame.peek_file_meta(str(ckpt)).meta
         assert meta_peek["config"]  # meta decodes fine
         det, meta, corrupt = checkpoint.load_resilient(
             str(tmp_path / "ckpt"), config

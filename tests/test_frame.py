@@ -168,14 +168,17 @@ class TestFrameFormat:
         arrays = _sample_arrays()
         p = tmp_path / "x.ckpt"
         p.write_bytes(frame.encode(arrays, meta={"epoch": 5}))
-        version, meta = frame.peek_file_meta(str(p))
-        assert version == frame.FRAME_VERSION and meta["epoch"] == 5
+        peek = frame.peek_file_meta(str(p))
+        assert peek.version == frame.FRAME_VERSION and peek.meta["epoch"] == 5
+        assert peek.schema == frame.schema_hash(
+            [(n, a.dtype.str, a.ndim) for n, a in arrays.items()]
+        )
         # Peek succeeds even when the PAYLOAD is corrupt (fencing wants
         # cheap evidence; full verification is the loader's job)…
         blob = bytearray(p.read_bytes())
         blob[-12] ^= 0xFF
         p.write_bytes(bytes(blob))
-        assert frame.peek_file_meta(str(p))[1]["epoch"] == 5
+        assert frame.peek_file_meta(str(p)).meta["epoch"] == 5
         # …but a truncated header is an error, not a guess.
         p.write_bytes(blob[:10])
         with pytest.raises(frame.FrameError):

@@ -24,6 +24,11 @@ def test_entry_compiles_and_runs():
     jax.block_until_ready(out)
 
 
+# requires_env (pinned in sanitycheck): the dry-run body imports the
+# parallel package, which needs top-level jax.shard_map — absent from
+# this CI's jax pin; the entry()/short-device tests above/below stay
+# unconditional.
+@pytest.mark.requires_env("jax.shard_map")
 def test_dryrun_in_process_on_cpu_mesh():
     # conftest gives this process an 8-device CPU backend, so the
     # in-process path (no fallback) is exercised here.
@@ -36,6 +41,7 @@ def test_dryrun_body_rejects_short_device_list():
         graft._dryrun_body(8, jax.devices()[:1])
 
 
+@pytest.mark.requires_env("jax.shard_map")
 def test_dryrun_subprocess_path():
     # The driver topology: default backend can't host the mesh → the dry
     # run must re-exec in a clean JAX_PLATFORMS=cpu interpreter and pass.

@@ -7,6 +7,8 @@ The suites under tracetesting/ are the framework's Tracetest analogue
 
 from pathlib import Path
 
+import pytest
+
 from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
 from opentelemetry_demo_tpu import tracetest as tt
 
@@ -56,6 +58,11 @@ def test_select_and_assert():
     assert not ok and "unknown metric" in detail
 
 
+# requires_env (pinned in sanitycheck): five gRPC suites shell out to
+# protoc for their request encoding; without it the full run can never
+# go green, so the live-gateway sweep skips with the reason instead of
+# reporting known-env noise. The unit checks above stay unconditional.
+@pytest.mark.requires_env("protoc")
 def test_all_suites_pass_against_live_gateway():
     suites = tt.load_suites(REPO / "tracetesting")
     # The reference tests 10 services (test/tracetesting/run.bash:10);
