@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench spinebench replbench replaybench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench spinebench replbench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -46,6 +46,9 @@ replbench:      ## hot-standby failover drill (ONE json line: replication lag p9
 
 replaybench:    ## history time-travel drill (ONE json line: record an incident, replay the segment log at N× wall clock, pin bit-identical verdicts, range-query p99)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replaybench
+
+mitigbench:     ## closed-loop auto-mitigation drill (ONE json line: time-to-mitigate per flagd scenario, rollback drill, no-oscillation gate)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.mitigbench
 
 querybench:     ## live query plane under concurrent ingest (ONE json line: query p99/qps, ingest interference ratio)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.querybench

@@ -336,6 +336,18 @@ def main():
     if os.environ.get("BENCH_QUALITY", "1") != "0":
         quality = measure_quality_subprocess()
 
+    # ---- closed-loop auto-mitigation (the remediation tentpole) ------
+    # Time-to-mitigate beside time-to-detect: the controller flips the
+    # scenario's mitigation flag through the live store, the injector
+    # (reading the same store) heals, and the controller VERIFIES the
+    # recovery with its own heads — per scenario, with the rollback
+    # drill (a mitigation that doesn't heal rolls back on deadline)
+    # and the no-oscillation gate (zero flag writes over a long clean
+    # run). Same CPU-subprocess methodology as quality. {} on failure.
+    mitig = {}
+    if os.environ.get("BENCH_MITIG", "1") != "0":
+        mitig = measure_mitigation_subprocess()
+
     # ---- stress config (BASELINE #4: 10× the Locust profile) ---------
     # Same methodology at 10× the rate with the async harvester (the
     # stress deployment shape); paired-RTT fields ride along.
@@ -414,6 +426,10 @@ def main():
         # the real pipeline must run ≥10× wall clock with verdicts
         # bit-identical to the recording run.
         "replay_ok": replay.get("replay_ok"),
+        # Auto-mitigation verdict: ≥3 scenarios with verified recovery,
+        # the rollback drill restoring the exact prior flag state, and
+        # ZERO flag writes over the long clean run (no oscillation).
+        "mitigation_ok": mitig.get("mitigation_ok"),
     }
 
     print(
@@ -523,6 +539,16 @@ def main():
                 "history_range_query_p50_ms": replay.get(
                     "history_range_query_p50_ms"
                 ),
+                "time_to_mitigate_s": mitig.get("time_to_mitigate_s"),
+                "mitigation_rollback_exercised": (
+                    mitig.get("rollback_drill", {}).get("rolled_back")
+                    if mitig else None
+                ),
+                "mitigation_no_oscillation": (
+                    mitig.get("no_oscillation", {}).get("ok")
+                    if mitig else None
+                ),
+                "mitigation_detail": mitig or None,
                 "failover_ttd_s": repl.get("failover_ttd_s"),
                 "replication_lag_p99_ms": repl.get(
                     "replication_lag_p99_ms"
@@ -545,10 +571,10 @@ def main():
     )
 
 
-def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
-    """Run runtime.qualbench in a pristine CPU interpreter; {} on failure
-    (the quality fields are additive — a broken CPU leg must not sink
-    the throughput/lag artifact)."""
+def _measure_module_subprocess(module: str, timeout_s: float) -> dict:
+    """Run a bench module in a pristine CPU interpreter; {} on failure
+    (these fields are additive — a broken CPU leg must not sink the
+    throughput/lag artifact)."""
     import subprocess
     import sys
 
@@ -559,7 +585,7 @@ def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "opentelemetry_demo_tpu.runtime.qualbench"],
+            [sys.executable, "-m", module],
             cwd=here, env=env, capture_output=True, text=True,
             timeout=timeout_s,
         )
@@ -569,6 +595,23 @@ def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError):
         return {}
+
+
+def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
+    """Detection-quality scenarios (runtime.qualbench) on CPU: the
+    per-step report fetches must not pay the tunneled-TPU RTT."""
+    return _measure_module_subprocess(
+        "opentelemetry_demo_tpu.runtime.qualbench", timeout_s
+    )
+
+
+def measure_mitigation_subprocess(timeout_s: float = 900.0) -> dict:
+    """Closed-loop mitigation drill (runtime.mitigbench) on CPU: the
+    same stepped-report methodology as qualbench, plus the remediation
+    controller acting through a live flag store."""
+    return _measure_module_subprocess(
+        "opentelemetry_demo_tpu.runtime.mitigbench", timeout_s
+    )
 
 
 def measure_fetch_rtt() -> float:

@@ -118,9 +118,30 @@ pass):
   ``ANOMALY_HISTORY_RETENTION_S`` (per-rung caps),
   ``ANOMALY_HISTORY_COMPACT_INTERVAL_S`` (compaction tick),
   ``ANOMALY_HISTORY_SEGMENT_MB`` (segment roll size),
-  ``ANOMALY_HISTORY_SPANS`` (1 = capture dispatched span batches for
-  replaybench), ``ANOMALY_HISTORY_REPLAY_RATE`` (replaybench's
-  wall-clock speedup target)
+  ``ANOMALY_HISTORY_SPANS`` ('1' = capture dispatched span batches
+  for replaybench; or a per-service sample-rate map
+  ``svc:rate[,*:rate]`` — record a mitigation drill's flagged service
+  at 100% without the quiet firehose), ``ANOMALY_HISTORY_REPLAY_RATE``
+  (replaybench's wall-clock speedup target)
+- Closed-loop auto-mitigation knobs (one registry:
+  ``utils.config.REMEDIATION_KNOBS``; engine: ``runtime.remediation``
+  — the supervised controller that subscribes to the pipeline's
+  per-service verdicts and, ONLY when opted in, flips flagd
+  mitigation flags + promotes the sampling policy, then verifies its
+  own action recovered the system): ``ANOMALY_REMEDIATION_ENABLE``
+  (default 0 — observe-only), ``ANOMALY_REMEDIATION_ACT_BATCHES`` /
+  ``ANOMALY_REMEDIATION_CLEAR_BATCHES`` (two-edge hysteresis),
+  ``ANOMALY_REMEDIATION_BUDGET`` /
+  ``ANOMALY_REMEDIATION_BUDGET_REFILL_S`` (token-bucket actuation
+  budget — a flapping detector freezes the flags instead of
+  oscillating them), ``ANOMALY_REMEDIATION_DEADLINE_S`` /
+  ``ANOMALY_REMEDIATION_ROLLBACK`` (verified recovery; a missed
+  deadline rolls the actuation back and parks MITIGATION_FAILED),
+  ``ANOMALY_REMEDIATION_FLAG_URL`` (remote flag-editor write surface;
+  empty = the daemon's own flag store),
+  ``ANOMALY_REMEDIATION_TIMEOUT_S`` (bounded per-write transport),
+  ``ANOMALY_REMEDIATION_SAMPLING`` (exemplar-seeded keep-100%
+  promotion of flagged services)
 
 Replication / failover (runtime.replication; tests/test_replication.py):
 the daemon runs a role state machine — PRIMARY / STANDBY / PROMOTING
@@ -169,15 +190,17 @@ from ..utils.config import (
     daemon_config,
     frame_config,
     history_config,
+    history_spans_policy,
     ingest_config,
     overload_config,
     query_config,
+    remediation_config,
     replication_config,
     selftrace_config,
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint, history, replication, selftrace
+from . import checkpoint, history, remediation, replication, selftrace
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -339,7 +362,11 @@ class DetectorDaemon:
         self._history_segment_bytes = (
             int(hk["ANOMALY_HISTORY_SEGMENT_MB"]) << 20
         )
-        self._history_spans = bool(int(hk["ANOMALY_HISTORY_SPANS"]))
+        # Span-capture policy: '0'/'1' or the per-service sample-rate
+        # map — the SAME parse history_config() just validated with.
+        self._history_spans, self._history_span_rates = (
+            history_spans_policy(hk["ANOMALY_HISTORY_SPANS"])
+        )
         # Replay-rate target: consumed by replaybench against a
         # recorded log; surfaced in the flight record below so a
         # postmortem knows what the deployment promised.
@@ -646,6 +673,35 @@ class DetectorDaemon:
             "Flight-recorder evidence dumps written, by transition "
             "reason (each one is a postmortem file on disk)",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_MITIGATION_ACTIONS,
+            "Mitigations actuated by the remediation controller, by "
+            "actuator (flagd flag flips / sampling-policy promotions)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_MITIGATION_ROLLBACKS,
+            "Actuations automatically rolled back after the verified-"
+            "recovery deadline expired",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_MITIGATION_VERIFIED,
+            "Mitigations whose recovery the controller VERIFIED with "
+            "its own detection heads (clean-streak within deadline)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_MITIGATION_FAILED,
+            "Mitigations that did not recover the system within the "
+            "deadline (service parked in MITIGATION_FAILED)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_MITIGATION_ACTIVE,
+            "Services currently under an active or failed mitigation",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_TIME_TO_MITIGATE,
+            "Fault-flagged to verified-recovery interval per mitigated "
+            "incident — time-to-mitigate beside time-to-detect",
+        )
         self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
@@ -901,6 +957,13 @@ class DetectorDaemon:
                 rungs=self._history_rungs,
                 interval_s=self._history_interval_s,
                 capture_spans=self._history_spans,
+                # Per-service capture rates (the map form of the spans
+                # knob); the remediation sampling actuator re-publishes
+                # over this live (flagged service → keep-100%).
+                span_sample=self._history_span_rates or None,
+                service_names_fn=(
+                    lambda: self.pipeline.tensorizer.service_names
+                ),
             )
             if self._history_spans:
                 self.pipeline.history_capture = self.history_writer.capture
@@ -910,6 +973,55 @@ class DetectorDaemon:
                 retention_s=list(self._history_retention),
                 spans=self._history_spans,
                 replay_rate=self._history_replay_rate,
+            )
+        # Closed-loop auto-mitigation (knob registry:
+        # utils.config.REMEDIATION_KNOBS; engine: runtime.remediation).
+        # Constructed for EVERY role — a standby observes episodes so a
+        # promotion inherits warm streaks — but only an enabled PRIMARY
+        # ever actuates, and every actuator write is fence-guarded
+        # (path="remediation", the fifth fenced write path).
+        try:
+            rk = remediation_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        rem_actuators: list = []
+        rem_url = str(rk["ANOMALY_REMEDIATION_FLAG_URL"])
+        rem_timeout_s = float(rk["ANOMALY_REMEDIATION_TIMEOUT_S"])
+        if rem_url or not isinstance(flags, OfrepClient):
+            # OFREP is evaluate-only: without a writable store a flagd
+            # actuator needs the remote flag-editor URL; with neither,
+            # only the sampling actuator runs.
+            rem_actuators.append(remediation.FlagdActuator(
+                store=None if rem_url else flags,
+                url=rem_url,
+                timeout_s=rem_timeout_s,
+            ))
+        if int(rk["ANOMALY_REMEDIATION_SAMPLING"]):
+            rem_actuators.append(remediation.SamplingActuator(
+                publish=self._publish_sampling_policy,
+                base_policy=dict(self._history_span_rates),
+                exemplar_fn=self._exemplars_for,
+            ))
+        self.remediation = remediation.RemediationController(
+            rem_actuators,
+            enabled=bool(int(rk["ANOMALY_REMEDIATION_ENABLE"])),
+            act_batches=int(rk["ANOMALY_REMEDIATION_ACT_BATCHES"]),
+            clear_batches=int(rk["ANOMALY_REMEDIATION_CLEAR_BATCHES"]),
+            budget=int(rk["ANOMALY_REMEDIATION_BUDGET"]),
+            budget_refill_s=float(
+                rk["ANOMALY_REMEDIATION_BUDGET_REFILL_S"]
+            ),
+            deadline_s=float(rk["ANOMALY_REMEDIATION_DEADLINE_S"]),
+            rollback=bool(int(rk["ANOMALY_REMEDIATION_ROLLBACK"])),
+            role_fn=lambda: self.role,
+            fence=self._fence,
+            flight=self.flight,
+        )
+        self._remediation_seen: dict[str, int] = {}
+        if self.remediation.enabled:
+            self.flight.record(
+                "mitigation", op="enabled",
+                actuators=[a.name for a in rem_actuators],
             )
         if self.role == ROLE_PRIMARY and self._fence.stale():
             # Booted into a world that promoted past us (newer epoch on
@@ -1123,6 +1235,14 @@ class DetectorDaemon:
             # — and what health_probe --role prints.
             "role": self.role,
             "epoch": self._fence.epoch,
+            # Auto-mitigation surface: what is mitigated right now and
+            # whether any mitigation FAILED (the DEGRADED-style state
+            # an operator triages before trusting the loop again).
+            "mitigation": {
+                "enabled": self.remediation.enabled,
+                "active": self.remediation.active_count(),
+                "failed": self.remediation.failed_services(),
+            },
         }
         return ("ok" if state == UP else state), detail
 
@@ -1245,8 +1365,85 @@ class DetectorDaemon:
         except Exception:  # noqa: BLE001 — warmup must never kill boot
             pass
 
+    # -- remediation wiring --------------------------------------------
+
+    def _exemplars_for(self, service: str) -> list[str]:
+        """Flag-time exemplar trace ids for one service (the sampling
+        actuator's policy seed; remediation worker thread — reads the
+        pipeline's query meta under its own query lock, never the
+        dispatch lock)."""
+        names = self.pipeline.tensorizer.service_names
+        if service not in names:
+            return []
+        idx = names.index(service)
+        block = self.pipeline.query_meta()
+        events = (block.get("exemplars") or {}).get(str(idx), [])
+        return [e.get("trace_id") for e in events if e.get("trace_id")]
+
+    def _publish_sampling_policy(self, policy, seeds) -> None:
+        """The sampling actuator's one push target: the history
+        writer's span-capture sampler when the time-travel tier is on
+        (flagged service records at 100% — the mitigation-drill
+        corpus), a flight-recorder note either way."""
+        if self.history_writer is not None:
+            self.history_writer.set_span_sample(policy)
+        self.flight.record(
+            "mitigation", op="sampling_policy", policy=dict(policy),
+            seeds={svc: len(ex) for svc, ex in (seeds or {}).items()},
+        )
+
+    def _export_remediation_stats(self) -> None:
+        """anomaly_mitigation_* (delta-based like every family) plus
+        the TTM histogram observations drained from the controller."""
+        st = self.remediation.stats()
+        seen = self._remediation_seen
+        for actuator, count in st["actions"].items():
+            key = f"act_{actuator}"
+            delta = count - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_MITIGATION_ACTIONS,
+                    float(delta), actuator=actuator,
+                )
+            seen[key] = count
+        for key, metric in (
+            ("rollbacks", tele_metrics.ANOMALY_MITIGATION_ROLLBACKS),
+            ("verified", tele_metrics.ANOMALY_MITIGATION_VERIFIED),
+            ("failed", tele_metrics.ANOMALY_MITIGATION_FAILED),
+        ):
+            delta = st[key] - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(metric, float(delta))
+            seen[key] = st[key]
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_MITIGATION_ACTIVE, float(st["active"])
+        )
+        for ttm, _act_to_recover in self.remediation.take_ttm_samples():
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_TIME_TO_MITIGATE, ttm,
+                remediation.TTM_BUCKETS,
+            )
+
+    # -- report export --------------------------------------------------
+
     def _on_report(self, t_batch, report, flagged) -> None:
         names = self.pipeline.tensorizer.service_names
+        # Close the loop: the controller sees the same per-service
+        # verdicts the query plane serves (hot path: streak bookkeeping
+        # under the controller's own lock, never I/O — actuator writes
+        # happen on its worker thread). getattr: the width-ladder
+        # warmup thread can deliver a report during __init__, before
+        # the controller block runs.
+        rem = getattr(self, "remediation", None)
+        if rem is not None:
+            try:
+                rem.observe(t_batch, flagged, services=names)
+            except Exception:  # noqa: BLE001 — the mitigation loop
+                # must never take down report export; a controller bug
+                # costs mitigations, not detection.
+                logging.getLogger(__name__).exception(
+                    "remediation observe failed"
+                )
         tele_metrics.export_report(self.registry, names, report, flagged)
         self.registry.gauge_set(
             tele_metrics.ANOMALY_LAG_P99, self.pipeline.stats.lag_p99_ms()
@@ -1630,6 +1827,14 @@ class DetectorDaemon:
             self._export_fence_stats()
             self._flight_health_tick()
             self._export_selftrace_stats()
+            # Deadlines/budget still advance (rollbacks of pre-fence
+            # actuations must fire), but every actuator WRITE is
+            # refused by fence.check(path="remediation") — the fenced
+            # daemon observes its loop, it never drives it.
+            self.remediation.tick(
+                time.monotonic() if t_now is None else t_now
+            )
+            self._export_remediation_stats()
             if self.query_engine is not None and self._query_started:
                 self._export_query_stats()
             self._supervisor.tick()
@@ -1718,6 +1923,12 @@ class DetectorDaemon:
         self._export_fence_stats()
         self._flight_health_tick()
         self._export_selftrace_stats()
+        # Remediation housekeeping on the pump cadence: the recovery
+        # deadline and the token-bucket refill must advance even when
+        # no report arrives (a wedged harvest must still roll back a
+        # mitigation whose deadline passed).
+        self.remediation.tick(time.monotonic() if t_now is None else t_now)
+        self._export_remediation_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
         if self.repl_primary is not None:
@@ -1875,6 +2086,7 @@ class DetectorDaemon:
         Kafka, no checkpoints — beyond serving reads, the standby's
         job is staying current and noticing the primary die."""
         self._export_fence_stats()
+        self._export_remediation_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
         st = self.repl_standby
@@ -2286,6 +2498,10 @@ class DetectorDaemon:
             self.grpc_receiver.stop()
         if self._orders is not None:
             self._orders.close()
+        # Stop the remediation worker before the pipeline drains: no
+        # new reports can arrive, and a queued actuation against a dead
+        # flagd must not pin shutdown past its bounded retries.
+        self.remediation.close()
         if self.ingest_pool is not None:
             # Receivers are stopped, so no new jobs: flush the decode
             # queue into the pipeline, then stop the workers — BEFORE

@@ -493,6 +493,8 @@ class HistoryWriter:
         capture_spans: bool = False,
         span_queue_max: int = 64,
         retention_every: int = 60,
+        span_sample: dict[str, float] | None = None,
+        service_names_fn: Callable[[], list[str]] | None = None,
     ):
         self.store = store
         self._snapshot_fn = snapshot_fn
@@ -500,10 +502,18 @@ class HistoryWriter:
         self.interval_s = float(interval_s)
         self.now_fn = now_fn
         self.capture_spans = bool(capture_spans)
+        # Per-service capture policy ({name: rate, '*': default-rate};
+        # None/{'*': 1.0} = record everything, today's behavior). Set
+        # at boot from ANOMALY_HISTORY_SPANS' map form and re-published
+        # live by the remediation sampling actuator (flagged service →
+        # 1.0) — swapped atomically under the span lock.
+        self._span_sample = dict(span_sample) if span_sample else None
+        self._service_names_fn = service_names_fn
         self._span_queue: deque = deque(maxlen=max(int(span_queue_max), 1))
         self._span_lock = threading.Lock()
         self.spans_dropped = 0
         self.spans_recorded = 0
+        self.spans_sampled_out = 0
         # Ladder state: per coarse rung, an (accumulator, t_start,
         # child count) triple; rung 0 feeds from the window clock.
         self._acc: list[dict | None] = [None] * len(self.rungs)
@@ -562,18 +572,74 @@ class HistoryWriter:
 
     # -- span capture (the replay corpus) --------------------------------
 
+    def set_span_sample(self, policy: dict[str, float] | None) -> None:
+        """Swap the per-service capture policy live (the remediation
+        sampling actuator's publish target; any thread)."""
+        with self._span_lock:
+            self._span_sample = dict(policy) if policy else None
+
+    def span_sample_policy(self) -> dict[str, float] | None:
+        with self._span_lock:
+            return dict(self._span_sample) if self._span_sample else None
+
+    def _sample_mask(self, cols, policy: dict[str, float]):
+        """Per-row keep mask under the per-service policy. Rows sample
+        DETERMINISTICALLY by trace key (splitmix64 threshold — the
+        selftrace head-sampling trick), so a replayed recording and a
+        rerun recording keep the same spans, and all spans of one
+        trace land or drop together."""
+        svc = np.asarray(cols.svc)
+        names = (
+            self._service_names_fn()
+            if self._service_names_fn is not None else []
+        )
+        default = float(policy.get("*", 0.0))
+        rates = np.full(max(len(names), int(svc.max(initial=-1)) + 1, 1),
+                        default, np.float64)
+        for i, name in enumerate(names[: rates.shape[0]]):
+            rates[i] = float(policy.get(name, default))
+        row_rate = rates[np.clip(svc, 0, rates.shape[0] - 1)]
+        # splitmix64 finalizer over the trace key → uniform in [0, 2^64).
+        x = (np.asarray(cols.trace_key, np.uint64)
+             + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+        threshold = (np.clip(row_rate, 0.0, 1.0) * float(2**64)).astype(
+            np.float64
+        )
+        return x.astype(np.float64) < threshold
+
     def capture(self, cols, t_batch: float) -> None:
         """Remember one dispatched batch (pump thread; bounded, never
         blocks). Columns are COPIED here: in the zero-copy ingest path
         they are views into pooled decode scratch that recycles the
-        moment the pipeline drops them."""
+        moment the pipeline drops them. A per-service sample policy
+        (``set_span_sample``) keeps only the sampled rows — the
+        mitigation-drill recorder that skips the quiet firehose."""
         if not self.capture_spans:
             return
-        arrays = {
-            name: np.array(getattr(cols, name), copy=True)
-            for name in SPAN_CAPTURE_COLUMNS
-        }
         with self._span_lock:
+            policy = self._span_sample
+        mask = None
+        if policy is not None and policy != {"*": 1.0}:
+            mask = self._sample_mask(cols, policy)
+            if not mask.any():
+                with self._span_lock:
+                    self.spans_sampled_out += int(mask.shape[0])
+                return
+        arrays = {}
+        for name in SPAN_CAPTURE_COLUMNS:
+            col = np.asarray(getattr(cols, name))
+            arrays[name] = (
+                np.array(col[mask], copy=True) if mask is not None
+                else np.array(col, copy=True)
+            )
+        with self._span_lock:
+            if mask is not None:
+                self.spans_sampled_out += int(
+                    mask.shape[0] - mask.sum()
+                )
             if len(self._span_queue) == self._span_queue.maxlen:
                 self.spans_dropped += 1
             self._span_queue.append((arrays, float(t_batch)))

@@ -1,0 +1,277 @@
+"""Closed-loop mitigation bench: time-to-mitigate beside time-to-detect.
+
+qualbench measures how fast the detector *sees* each flagd failure
+scenario; this harness measures how fast the remediation controller
+*fixes* it, through the real control seam: the fault is gated by a live
+flagd-schema flag (exactly how every shop service evaluates its fault
+flags), the controller's :class:`~.remediation.FlagdActuator` disables
+that flag through the store's atomic write path, and the loop then
+VERIFIES recovery with the detector's own heads.
+
+Per scenario (virtual time, ``DT_S`` per batch — the qualbench
+timebase, so TTM and TTD are directly comparable):
+
+- clean warmup → fault flag flips on → the fault shape from
+  ``qualbench.fault_shapes`` mutates the stream **while the flag
+  evaluates truthy**;
+- the controller acts after its flagged-batch hysteresis; mitigation
+  DISABLES the flag, the injector (reading the same store) stops, the
+  heads clear, and the clean-streak verification records
+  ``time_to_mitigate_s`` = fault-flagged → verified-recovery;
+- the **rollback drill** runs one scenario whose injector IGNORES the
+  mitigation (the flag flip does not heal the fault — a wrong runbook):
+  the recovery deadline expires, the actuation rolls back to the exact
+  prior flag state, and the service parks in ``MITIGATION_FAILED``;
+- the **no-oscillation gate** runs a long clean stream with remediation
+  ENABLED and every scenario flag present: zero actuator writes and
+  zero flag flips is the pass condition (a controller that trims flags
+  on quiet traffic is worse than no controller).
+
+``main`` prints ONE json line (`make mitigbench`); bench.py runs it in
+a CPU subprocess and lifts ``time_to_mitigate_s`` + the gates into the
+flagship artifact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.flags import FlagEvaluator
+from . import qualbench
+from .qualbench import B, DT_S, S, WARM_STEPS, _batch, _quality_config
+from .remediation import (
+    STATE_FAILED,
+    FlagdActuator,
+    RemediationController,
+    SamplingActuator,
+)
+
+# Mitigation loop horizon after fault onset: hysteresis + actuation +
+# clean-streak verification all happen inside it (virtual batches).
+MITIGATE_WINDOW_STEPS = 240
+QUIET_STEPS = 600
+
+# Bench-scale guardrails (virtual seconds): tight enough to finish in
+# the window, shaped like production's (act hysteresis > 1 batch,
+# verification clean-streak > 1 batch, deadline ≫ verify time).
+ACT_BATCHES = 2
+CLEAR_BATCHES = 4
+DEADLINE_S = 10.0
+ROLLBACK_DEADLINE_S = 4.0
+
+# The ≥3 scenarios measured with verified recovery (names must exist in
+# qualbench.fault_shapes), plus the rollback drill's scenario.
+HEALING_SCENARIOS = (
+    "paymentFailure", "recommendationCacheFailure", "kafkaQueueProblems",
+)
+ROLLBACK_SCENARIO = "paymentFailure"
+
+
+def _scenario_store(flag_keys) -> FlagEvaluator:
+    """A flagd-schema store carrying each scenario flag, fault OFF."""
+    return FlagEvaluator({
+        "flags": {
+            key: {
+                "state": "ENABLED",
+                "variants": {"on": True, "off": False},
+                "defaultVariant": "off",
+            }
+            for key in flag_keys
+        }
+    })
+
+
+def _set_fault(store: FlagEvaluator, key: str, on: bool) -> None:
+    doc = store.snapshot()
+    doc["flags"][key]["defaultVariant"] = "on" if on else "off"
+    store.replace(doc)
+
+
+def run_scenario(
+    name: str,
+    heal: bool = True,
+    seed: int = 0,
+    deadline_s: float = DEADLINE_S,
+) -> dict:
+    """One closed-loop drill; ``heal=False`` is the rollback drill
+    (the injector ignores the mitigation — flag flip does not heal)."""
+    from .tensorize import SpanTensorizer
+    from ..models import AnomalyDetector
+
+    rng = np.random.default_rng(seed)
+    shapes = qualbench.fault_shapes(rng)
+    fault_svc, mutate = shapes[name]
+    det = AnomalyDetector(_quality_config())
+    tz = SpanTensorizer(num_services=S, batch_size=B)
+    names = [f"svc{i}" for i in range(S)]
+    for n in names:
+        tz.service_id(n)
+    store = _scenario_store([name])
+    sampling_policy: dict = {}
+
+    def publish(policy, seeds):
+        sampling_policy.clear()
+        sampling_policy.update(policy)
+
+    flagd = FlagdActuator(
+        store=store, policy={names[fault_svc]: (name,)}
+    )
+    sampler = SamplingActuator(
+        publish=publish, base_policy={"*": 0.05},
+        exemplar_fn=lambda svc: ["00deadbeef"],
+    )
+    ctrl = RemediationController(
+        [flagd, sampler], enabled=True,
+        act_batches=ACT_BATCHES, clear_batches=CLEAR_BATCHES,
+        budget=4, budget_refill_s=1e9, deadline_s=deadline_s,
+        rollback=True,
+    )
+    out: dict = {
+        "ttd_s": None, "time_to_mitigate_s": None,
+        "act_to_recover_s": None, "verified": False,
+        "rolled_back": False, "sampling_promoted": False,
+    }
+    try:
+        for step in range(WARM_STEPS):
+            det.observe(_batch(rng, tz), step * DT_S)
+        _set_fault(store, name, True)
+        fault_steps = 0
+        for k in range(MITIGATE_WINDOW_STEPS):
+            t = (WARM_STEPS + k) * DT_S
+            active = True if not heal else bool(
+                store.evaluate(name, False)
+            )
+            if active:
+                batch = _batch(rng, tz, mutate=mutate, step=fault_steps)
+                fault_steps += 1
+            else:
+                batch = _batch(rng, tz)
+            report = det.observe(batch, t)
+            flags_np = np.asarray(report.flags)
+            flagged = [names[i] for i in np.nonzero(flags_np)[0]]
+            if out["ttd_s"] is None and flags_np[fault_svc]:
+                out["ttd_s"] = round((k + 1) * DT_S, 3)
+            ctrl.observe(t, flagged, services=names)
+            ctrl.drain(5.0)  # serialize actuator writes per batch
+            if sampling_policy.get(names[fault_svc]) == 1.0:
+                out["sampling_promoted"] = True
+            samples = ctrl.take_ttm_samples()
+            if samples:
+                ttm, act_to_recover = samples[0]
+                # TTM on the onset basis: fault ONSET→verified, like
+                # ttd_s is onset→first flag (the controller's own
+                # sample runs first-flag→verified; onset adds the TTD).
+                out["time_to_mitigate_s"] = round(
+                    ttm + (out["ttd_s"] or 0.0) - DT_S, 3
+                )
+                out["act_to_recover_s"] = round(act_to_recover, 3)
+                out["verified"] = True
+                break
+            if ctrl.state_of(names[fault_svc]) == STATE_FAILED:
+                out["rolled_back"] = True
+                break
+        ctrl.drain(5.0)
+        st = ctrl.stats()
+        out.update({
+            "flag_writes": flagd.writes,
+            "actions": st["actions"],
+            "failed": st["failed"],
+            "rollbacks": st["rollbacks"],
+            # The revert/rollback contract: the flag's spec is back to
+            # its pre-mitigation state (ENABLED — the doc the operator
+            # owns), proven, not assumed.
+            "flag_state_end": (store.flag_spec(name) or {}).get("state"),
+            "sampling_policy_end": dict(sampling_policy),
+        })
+    finally:
+        ctrl.close()
+    return out
+
+
+def measure_no_oscillation(seed: int = 1) -> dict:
+    """Long clean run with remediation ENABLED and every scenario flag
+    present: the pass condition is ZERO actuator writes (no flag ever
+    flips on quiet traffic) — the bench's anti-flap gate."""
+    from .tensorize import SpanTensorizer
+    from ..models import AnomalyDetector
+
+    rng = np.random.default_rng(seed)
+    det = AnomalyDetector(_quality_config())
+    tz = SpanTensorizer(num_services=S, batch_size=B)
+    names = [f"svc{i}" for i in range(S)]
+    for n in names:
+        tz.service_id(n)
+    all_flags = list(qualbench.fault_shapes(rng))
+    store = _scenario_store(all_flags)
+    doc_before = store.snapshot()
+    flagd = FlagdActuator(
+        store=store,
+        policy={names[i]: tuple(all_flags) for i in range(S)},
+    )
+    ctrl = RemediationController(
+        [flagd], enabled=True, act_batches=ACT_BATCHES,
+        clear_batches=CLEAR_BATCHES, budget=4, budget_refill_s=1e9,
+        deadline_s=DEADLINE_S, rollback=True,
+    )
+    flagged_batches = 0
+    try:
+        for step in range(WARM_STEPS + QUIET_STEPS):
+            t = step * DT_S
+            report = det.observe(_batch(rng, tz), t)
+            flags_np = np.asarray(report.flags)
+            if step >= WARM_STEPS and flags_np.any():
+                flagged_batches += 1
+            ctrl.observe(
+                t, [names[i] for i in np.nonzero(flags_np)[0]],
+                services=names,
+            )
+        ctrl.drain(5.0)
+    finally:
+        ctrl.close()
+    return {
+        "quiet_batches": QUIET_STEPS,
+        "flagged_batches": flagged_batches,
+        "flag_writes": flagd.writes,
+        "doc_unchanged": store.snapshot() == doc_before,
+        "ok": flagd.writes == 0 and store.snapshot() == doc_before,
+    }
+
+
+def measure_mitigation(seed: int = 0) -> dict:
+    scenarios = {}
+    ttm = {}
+    for name in HEALING_SCENARIOS:
+        res = run_scenario(name, heal=True, seed=seed)
+        scenarios[name] = res
+        ttm[name] = res["time_to_mitigate_s"]
+    rollback = run_scenario(
+        ROLLBACK_SCENARIO, heal=False, seed=seed,
+        deadline_s=ROLLBACK_DEADLINE_S,
+    )
+    no_osc = measure_no_oscillation(seed=seed + 1)
+    verified_n = sum(1 for r in scenarios.values() if r["verified"])
+    return {
+        "dt_s": DT_S,
+        "act_batches": ACT_BATCHES,
+        "clear_batches": CLEAR_BATCHES,
+        "time_to_mitigate_s": ttm,
+        "scenarios": scenarios,
+        "rollback_drill": rollback,
+        "no_oscillation": no_osc,
+        "mitigation_ok": bool(
+            verified_n >= 3
+            and rollback["rolled_back"]
+            and rollback["flag_state_end"] == "ENABLED"
+            and no_osc["ok"]
+        ),
+    }
+
+
+def main() -> None:
+    import json
+
+    print(json.dumps(measure_mitigation()))
+
+
+if __name__ == "__main__":
+    main()

@@ -270,6 +270,30 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("quantile",
                             "anomaly_history_read_latency_seconds_bucket",
                             q=0.99), "s"),
+                # Closed-loop auto-mitigation (runtime.remediation):
+                # what the controller DID (acts/verifies/rollbacks/
+                # failures), what is mitigated right now, and the
+                # loop's headline — time-to-mitigate p99 beside the
+                # detector's time-to-detect.
+                Panel("Mitigations actuated",
+                      Query("rate", "anomaly_mitigation_actions_total",
+                            by=("actuator",)), "acts/s"),
+                Panel("Mitigations verified recovered",
+                      Query("rate", "anomaly_mitigation_verified_total"),
+                      "verified/s"),
+                Panel("Mitigation rollbacks (deadline expired)",
+                      Query("rate", "anomaly_mitigation_rollbacks_total"),
+                      "rollbacks/s"),
+                Panel("Mitigations FAILED",
+                      Query("rate", "anomaly_mitigation_failed_total"),
+                      "failures/s"),
+                Panel("Active mitigations",
+                      Query("instant", "anomaly_mitigation_active"),
+                      "services"),
+                Panel("Time-to-mitigate p99",
+                      Query("quantile",
+                            "anomaly_time_to_mitigate_seconds_bucket",
+                            q=0.99), "s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

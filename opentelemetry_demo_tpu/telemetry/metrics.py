@@ -308,6 +308,18 @@ ANOMALY_SELFTRACE_TRACES = "anomaly_selftrace_traces_total"
 ANOMALY_SELFTRACE_SPANS = "anomaly_selftrace_spans_total"
 ANOMALY_FLIGHT_EVENTS = "anomaly_flight_events_total"  # {kind=}
 ANOMALY_FLIGHT_DUMPS = "anomaly_flight_dumps_total"  # {reason=}
+# Closed-loop auto-mitigation (runtime.remediation: the supervised
+# controller driving flagd mitigation flags + the sampling policy, then
+# verifying its own action recovered the system): every act / verified
+# recovery / rollback / failed mitigation leaves a number behind, the
+# active gauge shows what is currently mitigated, and the TTM histogram
+# is the loop's headline — time-to-mitigate beside time-to-detect.
+ANOMALY_MITIGATION_ACTIONS = "anomaly_mitigation_actions_total"  # {actuator=}
+ANOMALY_MITIGATION_ROLLBACKS = "anomaly_mitigation_rollbacks_total"
+ANOMALY_MITIGATION_VERIFIED = "anomaly_mitigation_verified_total"
+ANOMALY_MITIGATION_FAILED = "anomaly_mitigation_failed_total"
+ANOMALY_MITIGATION_ACTIVE = "anomaly_mitigation_active"
+ANOMALY_TIME_TO_MITIGATE = "anomaly_time_to_mitigate_seconds"  # histogram
 
 
 def export_metrics_report(

@@ -500,17 +500,102 @@ HISTORY_KNOBS: dict[str, tuple[str, object, str]] = {
         "and a new one opens once it grows past this",
     ),
     "ANOMALY_HISTORY_SPANS": (
-        "int", 0,
-        "1 = also capture every dispatched span batch as a frame in "
-        "the log (the replay corpus runtime.replaybench re-feeds "
-        "through the real pipeline); costs one host-side column copy "
-        "per batch plus rung-0-retention disk",
+        "str", "0",
+        "span-batch capture policy for the replay corpus "
+        "runtime.replaybench re-feeds through the real pipeline: "
+        "'0' = off, '1' = capture every dispatched batch (one "
+        "host-side column copy per batch plus rung-0-retention disk), "
+        "or a per-service sample-rate map 'svc:rate,svc2:rate[,*:rate]' "
+        "(rates in [0,1]; '*' is the default for unlisted services, 0 "
+        "when absent) — record a mitigation drill's flagged service at "
+        "100% without capturing the full firehose; rows sample "
+        "deterministically by trace key, so reruns keep the same spans",
     ),
     "ANOMALY_HISTORY_REPLAY_RATE": (
         "float", 10.0,
         "target wall-clock speedup for replaybench (virtual-time "
         "clock injection re-feeds recorded frames at N x real time); "
         "bench.py gates replay_speedup against this",
+    ),
+}
+
+
+# Closed-loop auto-mitigation knobs (runtime.remediation: the
+# supervised controller that subscribes to the pipeline's per-service
+# anomaly verdicts and — ONLY when opted in — drives the flagd
+# mitigation flags and the sampling policy, then verifies its own
+# action recovered the system). Same ONE-registry discipline as every
+# other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+REMEDIATION_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_REMEDIATION_ENABLE": (
+        "int", 0,
+        "1 = the controller ACTS (flips mitigation flags / promotes "
+        "sampling) on a PRIMARY; 0 (the default — auto-mitigation is "
+        "strictly opt-in) = observe-only: the controller tracks "
+        "episodes and exports metrics but never writes an actuator",
+    ),
+    "ANOMALY_REMEDIATION_ACT_BATCHES": (
+        "int", 3,
+        "hysteresis, acting half: consecutive flagged batches a "
+        "service must accrue before the controller actuates (one "
+        "noisy batch must never flip a production flag)",
+    ),
+    "ANOMALY_REMEDIATION_CLEAR_BATCHES": (
+        "int", 8,
+        "hysteresis, clearing half: consecutive clean batches after "
+        "an actuation before recovery is VERIFIED and the actuation "
+        "reverts (also how long a MITIGATION_FAILED service stays "
+        "sticky before the episode resets)",
+    ),
+    "ANOMALY_REMEDIATION_BUDGET": (
+        "int", 4,
+        "token-bucket capacity: maximum actuations in flight-window "
+        "burst; a flapping detector exhausts the bucket and the flags "
+        "STAY in their last state instead of oscillating",
+    ),
+    "ANOMALY_REMEDIATION_BUDGET_REFILL_S": (
+        "float", 60.0,
+        "seconds per token refill (observed-timebase): the sustained "
+        "actuation rate ceiling, 1 action per this many seconds",
+    ),
+    "ANOMALY_REMEDIATION_DEADLINE_S": (
+        "float", 30.0,
+        "verified-recovery deadline (observed-timebase seconds after "
+        "acting): no clean-streak verification within it rolls the "
+        "actuation back and parks the service in MITIGATION_FAILED",
+    ),
+    "ANOMALY_REMEDIATION_ROLLBACK": (
+        "int", 1,
+        "1 = automatically roll the actuation back when the recovery "
+        "deadline expires (restore the flag's prior state); 0 = leave "
+        "the mitigation in place and only mark MITIGATION_FAILED "
+        "(for mitigations an operator prefers sticky, e.g. load shed)",
+    ),
+    "ANOMALY_REMEDIATION_FLAG_URL": (
+        "str", "",
+        "remote flag-write base URL (the flag editor mounted on the "
+        "shop gateway, e.g. http://gateway:8080/feature — the "
+        "actuator calls its GET /api/read-file + POST "
+        "/api/write-to-file routes); when set it wins over the local "
+        "FLAGD_FILE store — every write is bounded-timeout with "
+        "capped jittered retry, and a dead/slow endpoint queues or "
+        "fails the ACTION, never the ingest path",
+    ),
+    "ANOMALY_REMEDIATION_TIMEOUT_S": (
+        "float", 1.0,
+        "per-actuator-write transport bound (connect/read); with the "
+        "bounded retry count this caps what one sick flagd write can "
+        "cost the worker thread",
+    ),
+    "ANOMALY_REMEDIATION_SAMPLING": (
+        "int", 1,
+        "1 = the sampling-policy actuator runs beside the flagd one: "
+        "a flagged service is promoted to keep-100% span capture "
+        "(seeded with its flag-time exemplar trace ids) while quiet "
+        "services keep the configured ANOMALY_HISTORY_SPANS policy; "
+        "0 = flagd actuator only",
     ),
 }
 
@@ -524,7 +609,7 @@ HISTORY_KNOBS: dict[str, tuple[str, object, str]] = {
 DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
-    "SELFTRACE_KNOBS", "HISTORY_KNOBS",
+    "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
 )
 
 
@@ -598,6 +683,12 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "0 skips the history replay bench (record a synthetic "
         "incident, replay the recorded frames through the real "
         "pipeline at N x wall clock, pin bit-identical verdicts)",
+    ),
+    "BENCH_MITIG": (
+        "int", 1,
+        "0 skips the closed-loop mitigation bench (runtime.mitigbench:"
+        " time-to-mitigate beside time-to-detect per flagd scenario, "
+        "rollback drill, no-oscillation gate over a long clean run)",
     ),
 }
 
@@ -769,6 +860,59 @@ def history_ladder(
     return rungs, retention
 
 
+def history_spans_policy(raw) -> tuple[bool, dict[str, float]]:
+    """Parsed ``(capture_on, {service: rate})`` from the raw
+    ``ANOMALY_HISTORY_SPANS`` knob value — the ONE parse, shared by
+    :func:`history_config`'s validator and the daemon (the same
+    no-drift rule as :func:`history_ladder`).
+
+    ``'0'``/``''`` → off; ``'1'`` → capture everything
+    (``{'*': 1.0}``); otherwise a comma map ``svc:rate[,*:rate]`` with
+    rates in [0, 1] (``'*'`` is the default rate for unlisted
+    services; absent = 0, so a map names exactly what it records).
+    Raises ``ConfigError`` on malformed entries or out-of-range rates.
+    """
+    text = str(raw).strip()
+    if text in ("", "0"):
+        return False, {}
+    if text == "1":
+        return True, {"*": 1.0}
+    rates: dict[str, float] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ConfigError(
+                f"ANOMALY_HISTORY_SPANS entry {part!r} is not "
+                "'service:rate' (or the literal '0'/'1')"
+            )
+        name, rate_raw = part.rsplit(":", 1)
+        name = name.strip()
+        try:
+            rate = float(rate_raw)
+        except ValueError as e:
+            raise ConfigError(
+                f"ANOMALY_HISTORY_SPANS rate {rate_raw!r} for "
+                f"{name!r} is not a number"
+            ) from e
+        if not 0.0 <= rate <= 1.0:
+            raise ConfigError(
+                f"ANOMALY_HISTORY_SPANS rate {rate} for {name!r} "
+                "outside [0, 1]"
+            )
+        if not name:
+            raise ConfigError(
+                "ANOMALY_HISTORY_SPANS has an empty service name"
+            )
+        rates[name] = rate
+    if not rates:
+        raise ConfigError(
+            f"ANOMALY_HISTORY_SPANS={text!r} parsed to an empty map"
+        )
+    return True, rates
+
+
 def history_config() -> dict[str, int | float | str]:
     """Resolve every HISTORY_KNOBS entry from the environment (same
     contract as :func:`overload_config`); validates the ladder shape —
@@ -818,6 +962,49 @@ def history_config() -> dict[str, int | float | str]:
         raise ConfigError(
             "ANOMALY_HISTORY_REPLAY_RATE="
             f"{out['ANOMALY_HISTORY_REPLAY_RATE']} must be > 0"
+        )
+    # Span-capture policy: validate the map shape here (the parse the
+    # daemon reuses) — a policy nobody can apply must refuse to boot.
+    history_spans_policy(out["ANOMALY_HISTORY_SPANS"])
+    return out
+
+
+def remediation_config() -> dict[str, int | float | str]:
+    """Resolve every REMEDIATION_KNOBS entry from the environment
+    (same contract as :func:`overload_config`); validates the
+    guardrail shapes — a controller with zero hysteresis, a zero
+    budget or a non-positive deadline could flip production flags on
+    one noisy batch, and must refuse to boot instead."""
+    out = _resolve(REMEDIATION_KNOBS)
+    if int(out["ANOMALY_REMEDIATION_ACT_BATCHES"]) < 1:
+        raise ConfigError(
+            "ANOMALY_REMEDIATION_ACT_BATCHES="
+            f"{out['ANOMALY_REMEDIATION_ACT_BATCHES']} must be >= 1"
+        )
+    if int(out["ANOMALY_REMEDIATION_CLEAR_BATCHES"]) < 1:
+        raise ConfigError(
+            "ANOMALY_REMEDIATION_CLEAR_BATCHES="
+            f"{out['ANOMALY_REMEDIATION_CLEAR_BATCHES']} must be >= 1"
+        )
+    if int(out["ANOMALY_REMEDIATION_BUDGET"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_REMEDIATION_BUDGET="
+            f"{out['ANOMALY_REMEDIATION_BUDGET']} must be >= 1"
+        )
+    if float(out["ANOMALY_REMEDIATION_BUDGET_REFILL_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_REMEDIATION_BUDGET_REFILL_S="
+            f"{out['ANOMALY_REMEDIATION_BUDGET_REFILL_S']} must be > 0"
+        )
+    if float(out["ANOMALY_REMEDIATION_DEADLINE_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_REMEDIATION_DEADLINE_S="
+            f"{out['ANOMALY_REMEDIATION_DEADLINE_S']} must be > 0"
+        )
+    if float(out["ANOMALY_REMEDIATION_TIMEOUT_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_REMEDIATION_TIMEOUT_S="
+            f"{out['ANOMALY_REMEDIATION_TIMEOUT_S']} must be > 0"
         )
     return out
 

@@ -22,11 +22,9 @@ Works against either flag store flavour:
 from __future__ import annotations
 
 import json
-import os
-import tempfile
 from html import escape
 
-from .flags import FlagEvaluator, FlagFileStore
+from .flags import FlagEvaluator, FlagFileStore, atomic_write_doc
 
 
 class FlagValidationError(ValueError):
@@ -72,21 +70,11 @@ class FlagEditorUI:
     def _write_doc(self, doc: dict) -> None:
         validate_flag_doc(doc)
         if isinstance(self.store, FlagFileStore):
-            # Atomic replace: services hot-reload on mtime and must never
-            # observe a torn write (FlagFileStore tolerates one, but the
-            # editor shouldn't produce one in the first place).
-            dir_ = os.path.dirname(os.path.abspath(self.store.path))
-            fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(doc, f, indent=2)
-                os.replace(tmp, self.store.path)
-            except BaseException:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            # Atomic replace (flags.atomic_write_doc — the ONE
+            # flag-file write primitive, shared with the remediation
+            # actuator): services hot-reload on mtime and must never
+            # observe a torn write.
+            atomic_write_doc(self.store.path, doc)
             self.store._maybe_reload(force=True)
         else:
             self.store.replace(doc)

@@ -27,9 +27,49 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import tempfile
+import time
+import urllib.error
 import urllib.request
 import zlib
 from typing import Any
+
+
+def capped_jitter_backoff(attempt: int, base_s: float, cap_s: float) -> float:
+    """Capped exponential backoff with full jitter — the
+    ``otlp_export`` sender discipline as ONE shared formula:
+    ``min(base * 2^attempt, cap) * uniform[0.5, 1.5)``. Used by the
+    OFREP client's transient retries and the remediation worker's
+    actuator retries, so the flag plane's retry shape cannot drift
+    between its two transports."""
+    base = min(base_s * (2.0 ** attempt), cap_s)
+    return base * (0.5 + random.random())
+
+
+def atomic_write_doc(path: str, doc: dict) -> None:
+    """THE flag-file write primitive: tmp file + ``os.replace``.
+
+    Services hot-reload the flagd file on mtime and must never observe
+    a torn write (``FlagFileStore`` *tolerates* one — it keeps serving
+    the previous snapshot — but no writer in this repo may produce one
+    in the first place). Every flag-store writer goes through here:
+    the flag editor UI (``flag_ui.py``) and the remediation
+    controller's flagd actuator (``runtime/remediation.py``) — and
+    scripts/sanitycheck.py pins that closed set, so a third writer is
+    a reviewed decision, not drift."""
+    dir_ = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class FlagEvaluator:
@@ -185,21 +225,78 @@ class OfrepClient:
     ``evaluate`` degrades to the default on any transport error so the
     detector never hard-depends on the flag service being up — matching
     the OpenFeature SDK's error-default semantics.
+
+    Transport hardening (the remediation controller evaluates through
+    this client on its verification path, so a sick flagd must cost a
+    bounded, known amount): every request carries a bounded
+    connect/read timeout, and TRANSIENT failures (connection refused /
+    reset / timeout / 5xx / 429) are retried up to ``retries`` times
+    with capped exponential backoff and full jitter — the
+    ``otlp_export`` sender discipline. Definitive answers (404 — flag
+    genuinely absent — and other 4xx) return the default immediately:
+    retrying a NOT_FOUND would only triple the latency of a correct
+    answer.
+
+    Circuit half: the pipeline pump evaluates the detector's gating
+    flag through this client ONCE PER BATCH, so a sustained outage
+    must not pay the retry burst on every call. After an evaluate
+    fails all its attempts the client enters a ``failure_cooldown_s``
+    window in which each evaluate makes a SINGLE bounded attempt (the
+    pre-hardening per-call cost); the first success closes the
+    window. Worst case per call is therefore one timeout during an
+    outage, and ``retries`` × timeout + capped backoff only at the
+    outage's first detection — never an unbounded hang.
     """
 
-    def __init__(self, base_url: str, timeout_s: float = 1.0):
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 0.5
+
+    def __init__(self, base_url: str, timeout_s: float = 1.0,
+                 retries: int = 2, failure_cooldown_s: float = 5.0):
         self.base_url = base_url.rstrip("/")
-        self.timeout_s = timeout_s
+        self.timeout_s = float(timeout_s)
+        self.retries = max(int(retries), 0)
+        self.failure_cooldown_s = float(failure_cooldown_s)
+        self.transient_failures = 0  # retried transport faults, lifetime
+        self._down_until = 0.0  # monotonic: single-attempt mode window
+
+    def _backoff_s(self, attempt: int) -> float:
+        return capped_jitter_backoff(
+            attempt, self.BACKOFF_BASE_S, self.BACKOFF_CAP_S
+        )
 
     def evaluate(self, key: str, default: Any, targeting_key: str = "") -> Any:
         url = f"{self.base_url}/ofrep/v1/evaluate/flags/{key}"
         body = json.dumps({"context": {"targetingKey": targeting_key}}).encode()
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"}
+        attempts = (
+            1 if time.monotonic() < self._down_until
+            else self.retries + 1
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                payload = json.load(resp)
-            return payload.get("value", default)
-        except Exception:
-            return default
+        for attempt in range(attempts):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    payload = json.load(resp)
+                self._down_until = 0.0  # circuit closes on success
+                return payload.get("value", default)
+            except urllib.error.HTTPError as e:
+                if e.code < 500 and e.code != 429:
+                    # Definitive refusal (404 flag-not-found et al):
+                    # the default IS the answer, retrying buys nothing.
+                    self._down_until = 0.0
+                    return default
+                self.transient_failures += 1
+            except Exception:  # noqa: BLE001 — transport fault
+                # (refused/reset/timeout/DNS): the OpenFeature
+                # error-default contract — degrade, never raise into
+                # the evaluating service.
+                self.transient_failures += 1
+            if attempt + 1 < attempts:
+                time.sleep(self._backoff_s(attempt))
+        self._down_until = time.monotonic() + self.failure_cooldown_s
+        return default

@@ -167,7 +167,7 @@ def main() -> int:
                         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
-                        "HISTORY_KNOBS",
+                        "HISTORY_KNOBS", "REMEDIATION_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -176,6 +176,7 @@ def main() -> int:
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
+        "REMEDIATION_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -507,6 +508,98 @@ def main() -> int:
             "test_grafana_range_honored",
         ):
             check(marker in httext, f"history suite pins {marker}")
+
+    # 10) closed-loop auto-mitigation (runtime/remediation.py): the
+    #     controller exists with its guardrail surface, auto-mitigation
+    #     defaults OFF (opt-in is a hard product decision, not a knob
+    #     default someone can drift), the FLAG-WRITER MONOPOLY holds —
+    #     the atomic flag-file write primitive (flags.atomic_write_doc)
+    #     is imported by EXACTLY the flag editor UI and the remediation
+    #     actuator (an AST import scan, closed set, same discipline as
+    #     the frame-importer pin: a third flag writer is a reviewed
+    #     decision, not drift) — and the chaos suite pins the proofs.
+    remediation_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "remediation.py"
+    )
+    check(os.path.exists(remediation_py), "runtime/remediation.py exists")
+    if os.path.exists(remediation_py):
+        rmtext = open(remediation_py).read()
+        for marker in (
+            "class RemediationController", "class FlagdActuator",
+            "class SamplingActuator", "class TokenBucket",
+            'path="remediation"', "STATE_FAILED", "rollback",
+        ):
+            check(marker in rmtext, f"runtime/remediation.py declares {marker}")
+    rem_knobs = registries.get("REMEDIATION_KNOBS") or {}
+    enable_spec = rem_knobs.get("ANOMALY_REMEDIATION_ENABLE")
+    check(
+        enable_spec is not None and enable_spec[1] == 0,
+        "auto-mitigation defaults OFF (ANOMALY_REMEDIATION_ENABLE=0)",
+    )
+    flag_writers: set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            fpath = os.path.join(dirpath, fname)
+            try:
+                tree = ast.parse(open(fpath).read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                names = []
+                if isinstance(node, ast.ImportFrom):
+                    names = [a.name for a in node.names]
+                elif isinstance(node, ast.Import):
+                    names = [a.name.split(".")[-1] for a in node.names]
+                if "atomic_write_doc" in names:
+                    flag_writers.add(
+                        os.path.relpath(fpath, pkg_root).replace(os.sep, "/")
+                    )
+    expected_flag_writers = {
+        "utils/flag_ui.py",        # the flagd-ui editor surface
+        "runtime/remediation.py",  # the mitigation actuator
+    }
+    check(
+        flag_writers == expected_flag_writers,
+        "remediation.py + flag_ui.py are the only flag-store writers "
+        f"(atomic_write_doc importers {sorted(flag_writers)})",
+    )
+    mitigbench_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "mitigbench.py"
+    )
+    check(os.path.exists(mitigbench_py), "runtime/mitigbench.py exists")
+    check(
+        "mitigbench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has a mitigbench target",
+    )
+    check(
+        "remediation:" in pyproject,
+        "pyproject registers the remediation marker",
+    )
+    remediation_tests = os.path.join(ROOT, "tests", "test_remediation.py")
+    check(
+        os.path.exists(remediation_tests), "tests/test_remediation.py exists"
+    )
+    if os.path.exists(remediation_tests):
+        rttext = open(remediation_tests).read()
+        for marker in (
+            "test_flapping_detector_cannot_oscillate_flags",
+            "test_degraded_flagd_never_blocks_the_hot_path",
+            "test_standby_observes_but_never_actuates",
+            "test_fenced_daemon_actuation_refused",
+            "test_rollback_on_failed_recovery",
+            "test_flight_evidence_on_act_revert_rollback",
+        ):
+            check(marker in rttext, f"remediation suite pins {marker}")
+    flag_ui_tests = os.path.join(ROOT, "tests", "test_flag_ui.py")
+    if os.path.exists(flag_ui_tests):
+        fut = open(flag_ui_tests).read()
+        check(
+            "test_torn_flag_file_write_never_corrupts_live_store" in fut,
+            "flag suite pins the torn-write regression",
+        )
 
     # no imports from the read-only reference tree
     bad = []

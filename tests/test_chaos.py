@@ -567,10 +567,24 @@ class TestOrdersChaos:
                 # mid-frame; live ones are RST both ways.
                 proxy.truncate_after = 20
                 proxy.kill_connections()
-                deadline = time.monotonic() + 3.0
+                # Deadline-polled condition, not a fixed sleep window
+                # (the PR 11 in-suite flake): conns_killed only moves
+                # when kill_connections() catches a LIVE pair, and
+                # under full-suite load the consumer can be between
+                # polls — holding a dead socket, no pair to kill — at
+                # the single kill moment, leaving the counter at 0 no
+                # matter how long a fixed window sleeps. Step the
+                # daemon (driving reconnects through the truncating
+                # proxy) and re-kill until a session has provably been
+                # RST mid-life, bounded by a generous deadline.
+                deadline = time.monotonic() + 30.0
                 t = 200.0
-                while time.monotonic() < deadline:
+                while (
+                    proxy.conns_killed < 1
+                    and time.monotonic() < deadline
+                ):
                     daemon.step(t)  # must not raise
+                    proxy.kill_connections()
                     t += 0.25
                     time.sleep(0.02)
                 assert proxy.conns_killed >= 1

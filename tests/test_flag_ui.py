@@ -101,6 +101,50 @@ def test_file_backed_write_hot_reloads(tmp_path):
     assert list(tmp_path.iterdir()) == [path]  # no leftover temp files
 
 
+def test_torn_flag_file_write_never_corrupts_live_store(tmp_path):
+    """The flag_ui.py comment's scenario, pinned as a regression: a
+    torn/partial in-place rewrite of the flagd file (a crashed writer,
+    a non-atomic editor) must neither corrupt the live store — every
+    read keeps serving the last good snapshot — nor crash the
+    evaluator's mtime reload hook on any public read path; and the
+    next good (atomic) write recovers cleanly."""
+    import os
+
+    path = tmp_path / "demo.flagd.json"
+    path.write_text(json.dumps(GOOD_DOC))
+    store = FlagFileStore(str(path))
+    assert store.evaluate("paymentFailure", -1.0) == 0.0
+
+    # Torn write: truncated mid-JSON, mtime moved (the hot-reload
+    # trigger) — what a crashed in-place rewriter leaves behind.
+    full = json.dumps(GOOD_DOC)
+    path.write_text(full[: len(full) // 2])
+    os.utime(path, (1e9, 1e9))
+    # Every public read path runs the reload hook and survives, still
+    # answering from the previous snapshot.
+    assert store.evaluate("paymentFailure", -1.0) == 0.0
+    assert store.flag_keys() == ["paymentFailure"]
+    assert store.flag_spec("paymentFailure")["defaultVariant"] == "off"
+    assert store.snapshot() == GOOD_DOC
+    assert store.resolve("paymentFailure")[0] == 0.0
+    assert store.poll_version() >= 0
+
+    # Empty file (the worst torn write) is equally survivable.
+    path.write_text("")
+    os.utime(path, (1.1e9, 1.1e9))
+    assert store.evaluate("paymentFailure", -1.0) == 0.0
+
+    # The next ATOMIC write (the editor/remediation path) recovers:
+    # the store reloads the new doc on its next read.
+    from opentelemetry_demo_tpu.utils.flags import atomic_write_doc
+
+    fixed = json.loads(json.dumps(GOOD_DOC))
+    fixed["flags"]["paymentFailure"]["defaultVariant"] = "on"
+    atomic_write_doc(str(path), fixed)
+    assert store.evaluate("paymentFailure", -1.0) == 1.0
+    assert list(tmp_path.iterdir()) == [path]  # no leftover temp files
+
+
 def test_mounted_behind_gateway_flips_live_behaviour():
     import urllib.error
     import urllib.request

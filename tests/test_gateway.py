@@ -268,6 +268,33 @@ def test_ofrep_evaluate_round_trip(rig):
     assert exc.value.code == 400
 
 
+def test_ofrep_client_transient_retry_is_bounded(rig):
+    """Transport hardening: a TRANSIENT fault (refused connect) is
+    retried with capped jittered backoff — counted, bounded in time —
+    and still degrades to the default; a definitive 404 answers
+    immediately without burning a single retry."""
+    import time as _time
+
+    from opentelemetry_demo_tpu.utils.flags import OfrepClient
+
+    shop, gw, sink = rig
+    # Nobody listens on port 1: every connect fails transiently.
+    dead = OfrepClient("http://127.0.0.1:1", timeout_s=0.2, retries=2)
+    t0 = _time.monotonic()
+    assert dead.evaluate("anyFlag", "fallback") == "fallback"
+    elapsed = _time.monotonic() - t0
+    assert dead.transient_failures == 3  # initial try + 2 retries
+    # Bounded: 3 fast refusals + 2 capped backoffs, nowhere near an
+    # unbounded hang.
+    assert elapsed < 3.0
+    # Definitive NOT_FOUND: no retries, no transient count.
+    live = OfrepClient(
+        f"http://127.0.0.1:{gw.port}", timeout_s=1.0, retries=2
+    )
+    assert live.evaluate("noSuchFlag", "fb") == "fb"
+    assert live.transient_failures == 0
+
+
 def test_cart_latency_histogram_exported(rig):
     shop, gw, sink = rig
     _post(gw, "/api/cart", {"userId": "u1", "item": {"productId": "TEL-DOB-10", "quantity": 1}})

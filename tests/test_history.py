@@ -553,6 +553,95 @@ class TestDaemonWiring:
             daemon.shutdown()
 
 
+class TestSpanSamplePolicy:
+    """Per-service capture rates (the ANOMALY_HISTORY_SPANS map form):
+    record a mitigation drill's flagged service at 100% without
+    capturing the quiet firehose."""
+
+    def _writer(self, tmp_path, policy):
+        store = history.HistoryStore(
+            str(tmp_path), fence=EpochFence(0)
+        )
+        writer = history.HistoryWriter(
+            store, snapshot_fn=lambda: ({}, {}),
+            capture_spans=True, span_sample=policy,
+            service_names_fn=lambda: ["frontend", "cart", "payment"],
+        )
+        return store, writer
+
+    def _cols(self, n=90):
+        from opentelemetry_demo_tpu.runtime.tensorize import SpanColumns
+
+        rng = np.random.default_rng(7)
+        return SpanColumns(
+            svc=np.repeat(np.arange(3, dtype=np.int32), n // 3),
+            lat_us=rng.gamma(4.0, 250.0, n).astype(np.float32),
+            is_error=np.zeros(n, np.float32),
+            trace_key=rng.integers(0, 2**63, n, dtype=np.uint64),
+            attr_crc=rng.integers(1, 99, n).astype(np.uint64),
+        )
+
+    def test_promoted_service_kept_quiet_services_sampled_out(
+        self, tmp_path
+    ):
+        store, writer = self._writer(
+            tmp_path, {"frontend": 1.0, "*": 0.0}
+        )
+        try:
+            cols = self._cols()
+            writer.capture(cols, 1.0)
+            writer.tick(now=100.0)
+            recs = store.records(kind=history.KIND_SPANS)
+            assert len(recs) == 1
+            arrays = store.read_frame(recs[0]).arrays
+            # Only frontend rows survived, every one of them.
+            assert (np.asarray(arrays["svc"]) == 0).all()
+            assert arrays["svc"].shape[0] == 30
+            assert writer.spans_sampled_out == 60
+        finally:
+            writer.close()
+
+    def test_sampling_is_deterministic_by_trace_key(self, tmp_path):
+        store, writer = self._writer(tmp_path, {"*": 0.5})
+        store2, writer2 = self._writer(tmp_path / "b", {"*": 0.5})
+        try:
+            cols = self._cols()
+            m1 = writer._sample_mask(cols, {"*": 0.5})
+            m2 = writer2._sample_mask(cols, {"*": 0.5})
+            assert (m1 == m2).all()
+            assert 0 < m1.sum() < m1.shape[0]
+        finally:
+            writer.close()
+            writer2.close()
+
+    def test_all_sampled_out_batch_records_nothing(self, tmp_path):
+        store, writer = self._writer(tmp_path, {"*": 0.0})
+        try:
+            writer.capture(self._cols(), 1.0)
+            writer.tick(now=100.0)
+            assert not store.records(kind=history.KIND_SPANS)
+            assert writer.spans_recorded == 0
+            assert writer.spans_sampled_out == 90
+        finally:
+            writer.close()
+
+    def test_live_policy_swap_promotes_service(self, tmp_path):
+        """The remediation sampling actuator's publish target: swapping
+        the policy live changes what the next capture records."""
+        store, writer = self._writer(tmp_path, {"*": 0.0})
+        try:
+            writer.capture(self._cols(), 1.0)
+            writer.set_span_sample({"cart": 1.0, "*": 0.0})
+            writer.capture(self._cols(), 2.0)
+            writer.tick(now=100.0)
+            recs = store.records(kind=history.KIND_SPANS)
+            assert len(recs) == 1
+            arrays = store.read_frame(recs[0]).arrays
+            assert (np.asarray(arrays["svc"]) == 1).all()
+        finally:
+            writer.close()
+
+
 @pytest.mark.replay
 class TestReplay:
     def test_replay_verdicts_bit_identical(self, tmp_path):

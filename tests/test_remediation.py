@@ -1,0 +1,669 @@
+"""Closed-loop auto-mitigation: controller guardrails under chaos.
+
+The contract under test (runtime/remediation.py): a control loop that
+may touch production flags must be UNABLE to make an outage worse —
+hysteresis (no single-batch actions), a token-bucket budget (a
+flapping detector cannot oscillate flags), role/epoch gating (standby
+observes, fenced refuses — the fifth fenced write path), verified
+recovery with automatic rollback on a missed deadline, and hard
+fail-safety (a dead/slow/RST/torn flagd costs queued actions, never a
+hot-path stall). Every act/revert/rollback leaves flight-recorder
+evidence.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from opentelemetry_demo_tpu.runtime.faultwire import FaultWire
+from opentelemetry_demo_tpu.runtime.flightrec import FlightRecorder
+from opentelemetry_demo_tpu.runtime.remediation import (
+    STATE_ACTIVE,
+    STATE_FAILED,
+    STATE_IDLE,
+    STATE_PENDING,
+    FlagdActuator,
+    RemediationController,
+    SamplingActuator,
+    TokenBucket,
+)
+from opentelemetry_demo_tpu.runtime.replication import EpochFence
+from opentelemetry_demo_tpu.utils.flags import FlagEvaluator
+
+pytestmark = pytest.mark.remediation
+
+FLAG = "recommendationCacheFailure"
+SVC = "recommendation"
+
+
+def _store(default="on") -> FlagEvaluator:
+    return FlagEvaluator({
+        "flags": {
+            FLAG: {
+                "state": "ENABLED",
+                "variants": {"on": True, "off": False},
+                "defaultVariant": default,
+            }
+        }
+    })
+
+
+def _controller(actuators, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("act_batches", 3)
+    kw.setdefault("clear_batches", 4)
+    kw.setdefault("budget", 4)
+    kw.setdefault("budget_refill_s", 1e9)
+    kw.setdefault("deadline_s", 30.0)
+    return RemediationController(actuators, **kw)
+
+
+def _observe_n(ctrl, n, flagged, t0=0.0, dt=0.25):
+    t = t0
+    for _ in range(n):
+        ctrl.observe(t, flagged, services=[SVC])
+        t += dt
+    return t
+
+
+class TestGuardrails:
+    def test_hysteresis_no_single_batch_action(self):
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        ctrl = _controller([flagd])
+        try:
+            # Two flagged batches (below act_batches=3): no action.
+            _observe_n(ctrl, 2, [SVC])
+            assert ctrl.drain()
+            assert flagd.writes == 0
+            assert ctrl.state_of(SVC) == STATE_PENDING
+            # A clean streak abandons the episode entirely.
+            _observe_n(ctrl, 4, [], t0=0.5)
+            assert ctrl.state_of(SVC) == STATE_IDLE
+        finally:
+            ctrl.close()
+
+    def test_act_verify_revert_roundtrip(self):
+        store = _store()
+        policy_log = []
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        sampler = SamplingActuator(
+            publish=lambda pol, seeds: policy_log.append((pol, seeds)),
+            base_policy={"*": 0.1},
+            exemplar_fn=lambda svc: ["aabbccdd"],
+        )
+        flight = FlightRecorder()
+        ctrl = _controller([flagd, sampler], flight=flight)
+        try:
+            t = _observe_n(ctrl, 3, [SVC])
+            assert ctrl.drain()
+            # Mitigation applied: fault flag DISABLED, sampling
+            # promoted to keep-100% seeded with the exemplars.
+            assert store.flag_spec(FLAG)["state"] == "DISABLED"
+            assert store.evaluate(FLAG, False) is False
+            assert policy_log[-1][0][SVC] == 1.0
+            assert policy_log[-1][1] == {SVC: ["aabbccdd"]}
+            assert ctrl.state_of(SVC) == STATE_ACTIVE
+            # Clean streak: verified, TTM recorded, actuation reverted
+            # to the EXACT prior flag state.
+            _observe_n(ctrl, 4, [], t0=t)
+            assert ctrl.drain()
+            assert ctrl.verified_total == 1
+            samples = ctrl.take_ttm_samples()
+            assert len(samples) == 1
+            ttm, act_to_recover = samples[0]
+            assert ttm > 0 and act_to_recover >= 0 and ttm >= act_to_recover
+            spec = store.flag_spec(FLAG)
+            assert spec["state"] == "ENABLED"
+            assert spec["defaultVariant"] == "on"
+            assert policy_log[-1][0].get(SVC, 0.1) == 0.1  # demoted
+            assert ctrl.state_of(SVC) == STATE_IDLE
+            kinds = [
+                ev.get("op") for ev in flight.snapshot()
+                if ev["kind"] == "mitigation"
+            ]
+            assert "act" in kinds and "verified" in kinds
+        finally:
+            ctrl.close()
+
+    def test_flapping_detector_cannot_oscillate_flags(self):
+        """The anti-flap theorem, bounded: a detector alternating
+        flagged/clean forever can flip the flag at most BUDGET times —
+        the bucket exhausts and the flag state FREEZES (stable, not
+        oscillating) while the refusals are counted."""
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        ctrl = _controller(
+            [flagd], act_batches=2, clear_batches=2, budget=2,
+            budget_refill_s=1e9,
+        )
+        try:
+            t = 0.0
+            for _cycle in range(20):  # flap: flag 2, clear 2, repeat
+                t = _observe_n(ctrl, 2, [SVC], t0=t)
+                t = _observe_n(ctrl, 2, [], t0=t)
+            assert ctrl.drain()
+            st = ctrl.stats()
+            # Exactly budget acts ever happened; each verified cycle
+            # reverts, so writes = 2 * budget, then the bucket is dry.
+            assert st["actions"]["flagd"] == 2
+            assert flagd.writes <= 4
+            assert st["budget_exhausted"] > 0
+            assert st["tokens"] < 1.0
+            # The doc ends in a STABLE state (the operator's original).
+            assert store.flag_spec(FLAG)["state"] == "ENABLED"
+            # And keeps refusing: more flapping moves nothing.
+            writes_before = flagd.writes
+            for _cycle in range(5):
+                t = _observe_n(ctrl, 2, [SVC], t0=t)
+                t = _observe_n(ctrl, 2, [], t0=t)
+            assert ctrl.drain()
+            assert flagd.writes == writes_before
+        finally:
+            ctrl.close()
+
+    def test_budget_refills_over_observed_time(self):
+        bucket = TokenBucket(2, refill_s=10.0)
+        bucket.advance(0.0)
+        assert bucket.take() and bucket.take() and not bucket.take()
+        bucket.advance(10.0)
+        assert bucket.take() and not bucket.take()
+
+    def test_rollback_on_failed_recovery(self, tmp_path):
+        """No recovery within the deadline: the actuation rolls back
+        to the exact prior flag state, the service parks in the
+        DEGRADED-style MITIGATION_FAILED, and a flight evidence file
+        lands on disk — the postmortem artifact."""
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        flight = FlightRecorder(dump_dir=str(tmp_path))
+        ctrl = _controller([flagd], deadline_s=2.0, flight=flight)
+        try:
+            t = _observe_n(ctrl, 3, [SVC])
+            assert ctrl.drain()
+            assert store.flag_spec(FLAG)["state"] == "DISABLED"
+            # Still flagged past the deadline (the mitigation did not
+            # heal): rollback fires from the deadline scan.
+            t = _observe_n(ctrl, 12, [SVC], t0=t)
+            assert ctrl.drain()
+            assert ctrl.state_of(SVC) == STATE_FAILED
+            assert ctrl.failed_total == 1 and ctrl.rollbacks_total == 1
+            spec = store.flag_spec(FLAG)
+            assert spec["state"] == "ENABLED"
+            assert spec["defaultVariant"] == "on"
+            dumps = list(tmp_path.glob("flight-mitigation-failed-*.json"))
+            assert len(dumps) == 1
+            doc = json.loads(dumps[0].read_text())
+            assert doc["service"] == SVC and doc["rolled_back"] is True
+            # FAILED is sticky until a full clean streak passes.
+            _observe_n(ctrl, 2, [], t0=t)
+            assert ctrl.state_of(SVC) == STATE_FAILED
+            _observe_n(ctrl, 4, [], t0=t + 1)
+            assert ctrl.state_of(SVC) == STATE_IDLE
+        finally:
+            ctrl.close()
+
+    def test_flight_evidence_on_act_revert_rollback(self, tmp_path):
+        """Every act/revert/rollback leaves structured flight events
+        (the act→recover interval rides the verified record)."""
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        flight = FlightRecorder(dump_dir=str(tmp_path))
+        ctrl = _controller(
+            [flagd], deadline_s=2.0, clear_batches=2, flight=flight,
+        )
+        try:
+            t = _observe_n(ctrl, 3, [SVC])         # act
+            t = _observe_n(ctrl, 2, [], t0=t)      # verified (+revert)
+            t = _observe_n(ctrl, 3, [SVC], t0=t)   # act again
+            t = _observe_n(ctrl, 12, [SVC], t0=t)  # deadline → rollback
+            assert ctrl.drain()
+            events = [
+                ev for ev in flight.snapshot()
+                if ev["kind"] == "mitigation"
+            ]
+            ops = [ev["op"] for ev in events]
+            assert ops.count("act") == 2
+            assert "verified" in ops and "rollback" in ops
+            verified = next(e for e in events if e["op"] == "verified")
+            assert "act_to_recover_s" in verified
+            assert "time_to_mitigate_s" in verified
+        finally:
+            ctrl.close()
+
+
+class TestActuatorSafety:
+    def test_transient_revert_failure_keeps_the_token(self):
+        """A revert that fails its first transport attempt must retry
+        WITH the token — popping it up front would turn the retry into
+        a silent no-op and leave the mitigation in place forever."""
+
+        class FlakyActuator:
+            name = "flaky"
+
+            def __init__(self):
+                self.revert_calls: list = []
+
+            def apply(self, service):
+                return {"token": "T"}
+
+            def revert(self, service, token):
+                self.revert_calls.append(token)
+                if len(self.revert_calls) == 1:
+                    raise OSError("transient RST")
+
+        act = FlakyActuator()
+        ctrl = _controller(
+            [act], act_batches=2, clear_batches=2,
+            retry_attempts=3, backoff_cap_s=0.02,
+        )
+        try:
+            t = _observe_n(ctrl, 2, [SVC])
+            _observe_n(ctrl, 2, [], t0=t)
+            assert ctrl.drain()
+            # First attempt failed, second attempt got the SAME token.
+            assert act.revert_calls == [{"token": "T"}, {"token": "T"}]
+            assert ctrl.stats()["actuator_errors"] == 0
+        finally:
+            ctrl.close()
+
+    def test_failed_apply_mints_no_phantom_action_and_refunds(self):
+        """An apply that exhausts every retry actuated NOTHING: no
+        action is counted (the dashboards' headline number must not
+        lie), the budget token refunds, and the episode falls back to
+        PENDING — no phantom rollback can fire later."""
+
+        class DeadActuator:
+            name = "dead"
+
+            def apply(self, service):
+                raise OSError("blackholed")
+
+            def revert(self, service, token):
+                raise OSError("blackholed")
+
+        ctrl = _controller(
+            [DeadActuator()], act_batches=2, budget=2,
+            retry_attempts=2, backoff_cap_s=0.01,
+        )
+        try:
+            _observe_n(ctrl, 2, [SVC])
+            assert ctrl.drain()
+            st = ctrl.stats()
+            assert st["actions"] == {}          # nothing landed
+            assert st["actuator_errors"] == 1
+            assert st["tokens"] == 2.0          # token refunded
+            assert ctrl.state_of(SVC) == STATE_PENDING
+            assert st["rollbacks"] == 0 and st["failed"] == 0
+        finally:
+            ctrl.close()
+
+    def test_shared_flag_released_only_by_last_holder(self):
+        """Two services mapping the SAME fault flag (checkout and
+        fraud-detection both own kafkaQueueProblems): the first
+        verified recovery must NOT re-enable a flag the other episode
+        still holds — it restores only when the last hold releases."""
+        store = FlagEvaluator({
+            "flags": {
+                "kafkaQueueProblems": {
+                    "state": "ENABLED",
+                    "variants": {"on": 100, "off": 0},
+                    "defaultVariant": "on",
+                }
+            }
+        })
+        flagd = FlagdActuator(store=store, policy={
+            "checkout": ("kafkaQueueProblems",),
+            "fraud-detection": ("kafkaQueueProblems",),
+        })
+        ctrl = _controller([flagd], act_batches=2, clear_batches=2)
+        try:
+            t = 0.0
+            for _ in range(2):  # both services flagged → both act
+                ctrl.observe(
+                    t, ["checkout", "fraud-detection"],
+                    services=["checkout", "fraud-detection"],
+                )
+                t += 0.25
+            assert ctrl.drain()
+            assert store.flag_spec("kafkaQueueProblems")["state"] == "DISABLED"
+            # checkout clears first: its revert must NOT flip the flag
+            # back while fraud-detection's episode is still flagged.
+            for _ in range(2):
+                ctrl.observe(
+                    t, ["fraud-detection"],
+                    services=["checkout", "fraud-detection"],
+                )
+                t += 0.25
+            assert ctrl.drain()
+            assert ctrl.verified_total == 1
+            assert store.flag_spec("kafkaQueueProblems")["state"] == "DISABLED"
+            # fraud-detection clears: the LAST hold releases and the
+            # flag restores to the exact prior state.
+            for _ in range(2):
+                ctrl.observe(
+                    t, [], services=["checkout", "fraud-detection"],
+                )
+                t += 0.25
+            assert ctrl.drain()
+            assert ctrl.verified_total == 2
+            spec = store.flag_spec("kafkaQueueProblems")
+            assert spec["state"] == "ENABLED"
+            assert spec["defaultVariant"] == "on"
+        finally:
+            ctrl.close()
+
+
+class TestRoleAndFencing:
+    def test_standby_observes_but_never_actuates(self):
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        ctrl = _controller([flagd], role_fn=lambda: "standby")
+        try:
+            _observe_n(ctrl, 10, [SVC])
+            assert ctrl.drain()
+            assert flagd.writes == 0
+            assert store.flag_spec(FLAG)["state"] == "ENABLED"
+            st = ctrl.stats()
+            assert st["refused_role"] == 1
+            # The episode IS tracked (a promotion inherits warm state).
+            assert ctrl.state_of(SVC) == STATE_PENDING
+        finally:
+            ctrl.close()
+
+    def test_observe_only_mode_never_actuates(self):
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        ctrl = _controller([flagd], enabled=False)
+        try:
+            _observe_n(ctrl, 10, [SVC])
+            assert ctrl.drain()
+            assert flagd.writes == 0
+            assert ctrl.stats()["actions"] == {}
+        finally:
+            ctrl.close()
+
+    def test_fenced_daemon_actuation_refused(self):
+        """The fifth fenced write path: a daemon that OBSERVED a newer
+        epoch gets every actuator write refused by
+        fence.check(path="remediation") — flags untouched, refusal
+        counted on the shared fencing audit trail."""
+        store = _store()
+        flagd = FlagdActuator(store=store, policy={SVC: (FLAG,)})
+        fence = EpochFence(0)
+        fence.observe(5)  # superseded: stale before any write
+        ctrl = _controller([flagd], fence=fence)
+        try:
+            _observe_n(ctrl, 5, [SVC])
+            assert ctrl.drain()
+            assert flagd.writes == 0
+            assert store.flag_spec(FLAG)["state"] == "ENABLED"
+            assert ctrl.stats()["refused_fenced"] >= 1
+            assert fence.fenced_by_path.get("remediation", 0) >= 1
+        finally:
+            ctrl.close()
+
+
+class _SlowFlagServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+
+def _garbage_flag_server():
+    """An HTTP server whose /api/read-file answers torn JSON — the
+    corrupt-flagd shape for the url-mode actuator."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            body = b'{"flags": {"recomm'  # torn mid-document
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802 (http.server API)
+            self.send_response(500)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    return _SlowFlagServer(("127.0.0.1", 0), Handler)
+
+
+@pytest.mark.chaos
+class TestFlagdChaos:
+    def _hot_path_latency(self, ctrl, n=200):
+        """Max observe() wall latency while the worker is (possibly)
+        wedged on a sick actuator — the hot-path-stall probe."""
+        worst = 0.0
+        t = 0.0
+        for _ in range(n):
+            t0 = time.perf_counter()
+            ctrl.observe(t, [SVC], services=[SVC])
+            worst = max(worst, time.perf_counter() - t0)
+            t += 0.25
+        return worst
+
+    def test_degraded_flagd_never_blocks_the_hot_path(self):
+        """flagd dead (RST), slow (blackhole→timeout) and corrupt
+        (torn JSON): actions queue or fail closed — counted, retried
+        with capped backoff, bounded — and observe() stays microsecond
+        -cheap throughout (zero ingest stalls)."""
+        # --- RST: every connect reset instantly ----------------------
+        proxy = FaultWire("127.0.0.1", 1)  # upstream nobody listens on
+        proxy.rst_connects = True
+        proxy.start()
+        try:
+            flagd = FlagdActuator(
+                url=f"http://127.0.0.1:{proxy.port}", timeout_s=0.2,
+            )
+            ctrl = _controller(
+                [flagd], retry_attempts=2, backoff_cap_s=0.05,
+            )
+            try:
+                worst = self._hot_path_latency(ctrl)
+                assert worst < 0.05, f"observe() stalled {worst:.3f}s"
+                assert ctrl.drain(10.0)
+                assert ctrl.stats()["actuator_errors"] >= 1
+            finally:
+                ctrl.close()
+        finally:
+            proxy.stop()
+
+        # --- blackhole: accepts, never answers (timeout path) --------
+        proxy = FaultWire("127.0.0.1", 1)
+        proxy.blackhole = True
+        proxy.start()
+        try:
+            flagd = FlagdActuator(
+                url=f"http://127.0.0.1:{proxy.port}", timeout_s=0.2,
+            )
+            ctrl = _controller(
+                [flagd], retry_attempts=2, backoff_cap_s=0.05,
+            )
+            try:
+                worst = self._hot_path_latency(ctrl, n=100)
+                assert worst < 0.05, f"observe() stalled {worst:.3f}s"
+                assert ctrl.drain(10.0)
+                assert ctrl.stats()["actuator_errors"] >= 1
+            finally:
+                ctrl.close()
+        finally:
+            proxy.stop()
+
+        # --- corrupt: answers torn JSON ------------------------------
+        server = _garbage_flag_server()
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            port = server.server_address[1]
+            flagd = FlagdActuator(
+                url=f"http://127.0.0.1:{port}", timeout_s=0.5,
+            )
+            ctrl = _controller(
+                [flagd], retry_attempts=2, backoff_cap_s=0.05,
+            )
+            try:
+                worst = self._hot_path_latency(ctrl, n=100)
+                assert worst < 0.05, f"observe() stalled {worst:.3f}s"
+                assert ctrl.drain(10.0)
+                assert ctrl.stats()["actuator_errors"] >= 1
+            finally:
+                ctrl.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_action_queue_bounded_fail_closed(self):
+        """A wedged actuator cannot grow an unbounded action queue:
+        overflow drops the action and counts it (fail closed)."""
+        proxy = FaultWire("127.0.0.1", 1)
+        proxy.blackhole = True
+        proxy.start()
+        try:
+            flagd = FlagdActuator(
+                url=f"http://127.0.0.1:{proxy.port}", timeout_s=0.5,
+                policy={f"svc{i}": (FLAG,) for i in range(64)},
+            )
+            ctrl = _controller(
+                [flagd], act_batches=1, budget=1000,
+                budget_refill_s=0.001, queue_max=4, retry_attempts=3,
+                backoff_cap_s=0.2,
+            )
+            try:
+                t = 0.0
+                for _ in range(40):
+                    ctrl.observe(
+                        t, [f"svc{i}" for i in range(64)],
+                        services=[f"svc{i}" for i in range(64)],
+                    )
+                    t += 0.25
+                st = ctrl.stats()
+                assert st["queue_depth"] <= 4
+                assert st["queue_dropped"] > 0
+            finally:
+                ctrl.close()
+        finally:
+            proxy.stop()
+
+
+class TestDaemonWiring:
+    def _env(self, monkeypatch, tmp_path, **extra):
+        monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+        monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "-1")
+        monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+        monkeypatch.setenv("ANOMALY_QUERY_PORT", "-1")
+        monkeypatch.setenv("ANOMALY_BATCH", "256")
+        monkeypatch.delenv("KAFKA_ADDR", raising=False)
+        for k, v in extra.items():
+            monkeypatch.setenv(k, v)
+
+    def test_daemon_defaults_off_and_threads_knobs(
+        self, monkeypatch, tmp_path
+    ):
+        from opentelemetry_demo_tpu.models import DetectorConfig
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        flag_path = tmp_path / "demo.flagd.json"
+        flag_path.write_text(json.dumps({
+            "flags": {
+                FLAG: {
+                    "state": "ENABLED",
+                    "variants": {"on": True, "off": False},
+                    "defaultVariant": "on",
+                }
+            }
+        }))
+        self._env(
+            monkeypatch, tmp_path,
+            FLAGD_FILE=str(flag_path),
+            ANOMALY_REMEDIATION_ACT_BATCHES="2",
+            ANOMALY_REMEDIATION_DEADLINE_S="5.5",
+        )
+        daemon = DetectorDaemon(
+            DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        )
+        try:
+            # Opt-in default: constructed, observing, NOT acting.
+            assert daemon.remediation.enabled is False
+            assert daemon.remediation.act_batches == 2
+            assert daemon.remediation.deadline_s == 5.5
+            # Both actuators wired: flagd over the daemon's own store,
+            # sampling publishing toward the history writer.
+            names = [a.name for a in daemon.remediation.actuators]
+            assert names == ["flagd", "sampling"]
+            # The health surface carries the mitigation block.
+            _status, detail = daemon._healthz()
+            assert detail["mitigation"] == {
+                "enabled": False, "active": 0, "failed": [],
+            }
+            daemon.step(0.0)
+            text = daemon.registry.render()
+            assert "anomaly_mitigation_active 0.0" in text
+        finally:
+            daemon.shutdown()
+
+    def test_daemon_enabled_closed_loop_flips_and_reverts_flag(
+        self, monkeypatch, tmp_path
+    ):
+        """Daemon-level closed loop: reports flag a service → the
+        controller (enabled, primary) disables the mapped flag in the
+        daemon's OWN file store → on the clean streak it verifies and
+        restores — metrics move at each step."""
+        from opentelemetry_demo_tpu.models import DetectorConfig
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        flag_path = tmp_path / "demo.flagd.json"
+        flag_path.write_text(json.dumps({
+            "flags": {
+                FLAG: {
+                    "state": "ENABLED",
+                    "variants": {"on": True, "off": False},
+                    "defaultVariant": "on",
+                }
+            }
+        }))
+        self._env(
+            monkeypatch, tmp_path,
+            FLAGD_FILE=str(flag_path),
+            ANOMALY_REMEDIATION_ENABLE="1",
+            ANOMALY_REMEDIATION_ACT_BATCHES="2",
+            ANOMALY_REMEDIATION_CLEAR_BATCHES="2",
+        )
+        daemon = DetectorDaemon(
+            DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        )
+        try:
+            # Map the detector's interned service name onto the flag.
+            svc = SVC
+            daemon.pipeline.tensorizer.service_id(svc)
+            for act in daemon.remediation.actuators:
+                if act.name == "flagd":
+                    act.policy = {svc: (FLAG,)}
+            # Drive the controller through the daemon's own report
+            # hook (the pipeline path the pump uses).
+            for i in range(2):
+                daemon.remediation.observe(i * 0.25, [svc], [svc])
+            assert daemon.remediation.drain(5.0)
+            store = daemon.pipeline.flags
+            assert store.flag_spec(FLAG)["state"] == "DISABLED"
+            assert (
+                json.loads(flag_path.read_text())["flags"][FLAG]["state"]
+                == "DISABLED"
+            )
+            for i in range(2, 4):
+                daemon.remediation.observe(i * 0.25, [], [svc])
+            assert daemon.remediation.drain(5.0)
+            assert store.flag_spec(FLAG)["state"] == "ENABLED"
+            daemon.step(10.0)
+            text = daemon.registry.render()
+            assert (
+                'anomaly_mitigation_actions_total{actuator="flagd"} 1.0'
+                in text
+            )
+            assert "anomaly_mitigation_verified_total 1.0" in text
+            assert "anomaly_time_to_mitigate_seconds_count 1.0" in text
+        finally:
+            daemon.shutdown()

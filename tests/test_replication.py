@@ -509,7 +509,9 @@ class TestDaemonRoles:
         env["PYTHONUNBUFFERED"] = "1"
         env.update({
             "ANOMALY_OTLP_PORT": "0",
-            "ANOMALY_OTLP_GRPC_PORT": "-1",
+            # gRPC leg ON (ephemeral): its grpc.health.v1 service is
+            # the standby's pre-promotion double-check target below.
+            "ANOMALY_OTLP_GRPC_PORT": "0",
             "ANOMALY_METRICS_PORT": "0",
             "ANOMALY_BATCH": "128",
             "ANOMALY_PUMP_INTERVAL_S": "0.05",
@@ -547,8 +549,9 @@ class TestDaemonRoles:
                     break
             assert line, "primary never announced"
             otlp_port = int(re.search(r"otlp-http :(\d+)", line).group(1))
+            grpc_port = int(re.search(r"otlp-grpc :(\d+)", line).group(1))
             repl_port = int(re.search(r"repl :(\d+)", line).group(1))
-            assert repl_port > 0
+            assert repl_port > 0 and grpc_port > 0
 
             # Live load on both legs: orders into the broker, spans
             # over OTLP/HTTP at the primary.
@@ -579,7 +582,19 @@ class TestDaemonRoles:
                 monkeypatch, tmp_path, "standby",
                 ANOMALY_ROLE="standby",
                 ANOMALY_REPLICATION_TARGET=f"127.0.0.1:{repl_port}",
-                ANOMALY_FAILOVER_TIMEOUT_S="1.0",
+                ANOMALY_FAILOVER_TIMEOUT_S="2.0",
+                # The pre-promotion health double-check — the
+                # product's own spurious-promotion guard, and the
+                # reason this drill is deterministic in-suite: the
+                # primary's FIRST jitted dispatch can hold its
+                # dispatch lock for many seconds under full-suite CPU
+                # contention, starving the replication shipper (it
+                # snapshots under that lock) past any reasonable
+                # silence watchdog. A silence + SERVING health answer
+                # resets the watchdog instead of split-braining;
+                # after the SIGKILL below the probe fails and
+                # promotion proceeds.
+                ANOMALY_PRIMARY_HEALTH_ADDR=f"127.0.0.1:{grpc_port}",
                 ANOMALY_INGEST_WORKERS="0",
                 KAFKA_ADDR=f"127.0.0.1:{broker.port}",
             )
