@@ -307,6 +307,25 @@ def main():
         except Exception:  # noqa: BLE001 — artifact field is optional
             repl = {}
 
+    # ---- sharded-fleet reshard drill (the fleet tentpole) ------------
+    # Kill one of three shards under deterministic load beside an
+    # UNKILLED witness fleet: shard_reshard_ttd_s is kill → a survivor
+    # answering the victim's keys from its adopted replicated frame,
+    # fleet_ok gates the witness-pinned bit-exactness, the blackholed-
+    # shard labeled-partial answer, and the noisy-tenant quota
+    # isolation. (The live SIGKILL-a-daemon leg runs under `make
+    # fleetbench`; the in-proc leg here keeps the flagship line fast.)
+    fleet_drill = {}
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        from opentelemetry_demo_tpu.runtime.replbench import (
+            measure_reshard,
+        )
+
+        try:
+            fleet_drill = measure_reshard()
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            fleet_drill = {}
+
     # ---- live query plane (the read-path tentpole) -------------------
     # Real HTTP query service hammered beside live ingest in one
     # process: query_p99_ms is the dashboard-refresh cost over live
@@ -554,6 +573,19 @@ def main():
                     "replication_lag_p99_ms"
                 ),
                 "failover_converged_exact": repl.get("converged_exact"),
+                "shard_reshard_ttd_s": fleet_drill.get(
+                    "shard_reshard_ttd_s"
+                ),
+                "fleet_ok": fleet_drill.get("fleet_ok"),
+                "fleet_reshard_bitexact": fleet_drill.get(
+                    "reshard_bitexact"
+                ),
+                "fleet_partial_answer_ok": fleet_drill.get(
+                    "partial_answer_ok"
+                ),
+                "fleet_noisy_tenant_isolated": fleet_drill.get(
+                    "noisy_tenant_isolated"
+                ),
                 "sketch_impl_matrix": matrix,
                 "lag_note": (
                     "gross p99 is submit-to-harvest through the real "

@@ -1,0 +1,522 @@
+"""Scatter-gather read tier over the sharded detector fleet.
+
+The thin aggregator behind the EXISTING ``/query/*`` API: global reads
+fan out to every shard's query plane (runtime.query over each shard's
+own snapshot), the shard frames merge per endpoint, and the answer
+comes back in one envelope labeled with
+``shards_answered``/``shards_total`` — a blackholed, slow or dead
+shard is ANNOTATED and the result degrades to a labeled PARTIAL
+answer, never a crashed query and never a 5xx for a partial loss.
+
+Merge semantics per endpoint:
+
+- ``/query/services`` — union of the shard service lists (sorted);
+- ``/query/topk`` / ``/query/cardinality`` / ``/query/zscore`` —
+  service-keyed: the ring routes the read to the keyspace OWNER when a
+  ring is wired (post-reshard that is the survivor that adopted the
+  victim's frame); without a ring the fan-out keeps the shard that
+  actually answered 200 (non-owners answer 404 for a service they
+  never interned);
+- ``/query/anomalies`` — events concatenated newest-first across
+  shards, exemplar rings merged by service.
+
+CONTRACT (pinned by scripts/sanitycheck.py, the runtime.query
+discipline): this module NEVER touches detector state — no detector
+import, no dispatch-lock reference, no snapshot function. It speaks
+only HTTP to shard query planes, so it can run anywhere (its own
+container: the ``anomaly-aggregator`` compose/k8s service) and the
+loss of any shard can never take the global read surface down with
+it.
+
+Run standalone::
+
+    ANOMALY_FLEET_SHARDS=3 \\
+    ANOMALY_FLEET_QUERY_PEERS=shard0:9465,shard1:9465,shard2:9465 \\
+    ANOMALY_AGGREGATOR_PORT=9470 \\
+    python -m opentelemetry_demo_tpu.runtime.aggregator
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import NamedTuple
+from urllib.parse import parse_qs, urlencode, urlparse
+
+from ..telemetry import metrics as tele_metrics
+from .fleet import HashRing, tenant_of
+
+# The endpoints the aggregator understands (a strict subset of the
+# shard query plane's vocabulary — flight/Grafana targets stay
+# per-shard surfaces: a flight ring is process-local evidence).
+AGG_ENDPOINTS = frozenset({
+    "/", "/query/services", "/query/topk", "/query/cardinality",
+    "/query/zscore", "/query/anomalies",
+})
+
+SERVICE_KEYED = frozenset({
+    "/query/topk", "/query/cardinality", "/query/zscore",
+})
+
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+class ShardAnswer(NamedTuple):
+    shard: str
+    status: int | None     # None = transport failure/timeout
+    doc: dict | None
+    error: str | None
+    elapsed_s: float
+
+
+def _fetch(
+    shard: str, base: str, path: str, params: dict, timeout_s: float
+) -> ShardAnswer:
+    """One shard GET with a hard per-shard deadline. Every failure
+    shape (refused, blackholed, RST mid-body, torn JSON) collapses to
+    an annotated miss — the fan-out's promise is that no shard fault
+    becomes an aggregator fault."""
+    import http.client
+
+    host, _, port = base.rpartition(":")
+    t0 = time.perf_counter()
+    try:
+        conn = http.client.HTTPConnection(
+            host or "127.0.0.1", int(port), timeout=timeout_s
+        )
+        try:
+            query = urlencode(
+                {k: v for k, v in params.items() if v is not None}
+            )
+            conn.request("GET", path + ("?" + query if query else ""))
+            resp = conn.getresponse()
+            body = resp.read()
+            doc = json.loads(body.decode()) if body else {}
+            return ShardAnswer(
+                shard, resp.status, doc, None,
+                time.perf_counter() - t0,
+            )
+        finally:
+            conn.close()
+    except Exception as e:  # noqa: BLE001 — every transport/parse
+        # failure is one annotated missing shard, never a crash
+        return ShardAnswer(
+            shard, None, None,
+            f"{type(e).__name__}: {e}", time.perf_counter() - t0,
+        )
+
+
+class FleetAggregator:
+    """Fans one query out to the shard query planes and merges.
+
+    ``shards``: shard-id → query-plane base address (host:port).
+    ``ring``/``tenant_map``: optional ownership routing for
+    service-keyed endpoints (ring members must use the same shard
+    ids). ``live_fn``: optional membership filter — shards it reports
+    dead are skipped (annotated, not waited on).
+    """
+
+    def __init__(
+        self,
+        shards: dict[str, str],
+        *,
+        timeout_s: float = 1.0,
+        ring: HashRing | None = None,
+        tenant_map: dict[str, str] | None = None,
+        live_fn=None,
+    ):
+        self.shards = dict(shards)
+        self.timeout_s = float(timeout_s)
+        self.ring = ring
+        self.tenant_map = dict(tenant_map or {})
+        self._live_fn = live_fn
+
+    def close(self) -> None:
+        pass  # fan-out threads are per-request daemons; nothing held
+
+    # -- fan-out --------------------------------------------------------
+
+    def _targets(self) -> dict[str, str]:
+        if self._live_fn is None:
+            return dict(self.shards)
+        try:
+            live = set(self._live_fn())
+        except Exception:  # noqa: BLE001 — a broken membership view
+            return dict(self.shards)  # degrades to full fan-out
+        return {s: a for s, a in self.shards.items() if s in live}
+
+    def _scatter(
+        self, path: str, params: dict,
+        skip: frozenset[str] = frozenset(),
+    ) -> list[ShardAnswer]:
+        """Fan out with a HARD wall-clock deadline.
+
+        http.client's timeout bounds each socket operation, not the
+        exchange: a shard trickling one byte per interval would keep
+        every recv() under the limit and hang the query unboundedly.
+        Dedicated daemon threads per request + a bounded join make the
+        deadline real — a shard still mid-trickle at the deadline is
+        annotated and abandoned (its thread dies with its next socket
+        timeout), and no shared pool exists for a slow shard to clog."""
+        targets = {
+            s: a for s, a in self._targets().items() if s not in skip
+        }
+        results: dict[str, ShardAnswer] = {}
+
+        def run(shard: str, base: str) -> None:
+            results[shard] = _fetch(
+                shard, base, path, params, self.timeout_s
+            )
+
+        threads = [
+            threading.Thread(
+                target=run, args=(shard, base),
+                name=f"agg-fanout-{shard}", daemon=True,
+            )
+            for shard, base in targets.items()
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 2.0 * self.timeout_s + 0.25
+        for th in threads:
+            th.join(max(deadline - time.monotonic(), 0.0))
+        answers = []
+        for shard in targets:
+            got = results.get(shard)
+            answers.append(got if got is not None else ShardAnswer(
+                shard, None, None, "deadline exceeded", self.timeout_s,
+            ))
+        answers += [
+            ShardAnswer(s, None, None, "membership: dead", 0.0)
+            for s in self.shards
+            if s not in targets and s not in skip
+        ]
+        return answers
+
+    def _fetch_bounded(
+        self, shard: str, base: str, path: str, params: dict
+    ) -> ShardAnswer:
+        """One shard fetch under the same hard deadline as _scatter —
+        the owner-routed path must not be the one place a trickling
+        shard can hang a query."""
+        box: dict[str, ShardAnswer] = {}
+
+        def run() -> None:
+            box[shard] = _fetch(
+                shard, base, path, params, self.timeout_s
+            )
+
+        th = threading.Thread(
+            target=run, name=f"agg-fanout-{shard}", daemon=True
+        )
+        th.start()
+        th.join(2.0 * self.timeout_s + 0.25)
+        return box.get(shard) or ShardAnswer(
+            shard, None, None, "deadline exceeded", self.timeout_s
+        )
+
+    # -- merge ----------------------------------------------------------
+
+    def _fleet_meta(self, answers: list[ShardAnswer]) -> dict:
+        ok = [a for a in answers if a.status == 200]
+        per_shard = {}
+        for a in sorted(answers):
+            entry: dict = {"ok": a.status == 200}
+            if a.status is not None:
+                entry["status"] = a.status
+            if a.error:
+                entry["error"] = a.error
+            if a.doc and isinstance(a.doc.get("meta"), dict):
+                m = a.doc["meta"]
+                for k in ("role", "epoch", "seq", "staleness_s"):
+                    if k in m:
+                        entry[k] = m[k]
+            per_shard[a.shard] = entry
+        meta = {
+            "shards_total": len(self.shards),
+            "shards_answered": len(ok),
+            "partial": len(ok) < len(self.shards),
+            "shards": per_shard,
+        }
+        if self.ring is not None:
+            meta["ring_version"] = self.ring.version()
+        return meta
+
+    def dispatch(self, path: str, params: dict) -> tuple[int, dict]:
+        """Route + merge one fleet query; (status, document). Never
+        raises; a partial fleet answers 200 with ``partial: true``."""
+        try:
+            if path == "/":
+                return 200, {
+                    "status": "ok",
+                    "tier": "aggregator",
+                    "endpoints": sorted(AGG_ENDPOINTS - {"/"}),
+                    "shards": sorted(self.shards),
+                }
+            if path not in AGG_ENDPOINTS:
+                return 404, {"error": f"no such endpoint {path!r}"}
+            if path in SERVICE_KEYED:
+                return self._service_keyed(path, params)
+            answers = self._scatter(path, params)
+            meta = self._fleet_meta(answers)
+            ok = [a for a in answers if a.status == 200]
+            if path == "/query/services":
+                names: set = set()
+                for a in ok:
+                    names.update(
+                        (a.doc.get("data") or {}).get("services") or []
+                    )
+                data = {"services": sorted(names)}
+            else:  # /query/anomalies
+                events: list = []
+                rings: dict = {}
+                for a in ok:
+                    d = a.doc.get("data") or {}
+                    events.extend(d.get("events") or [])
+                    for svc, ring in (d.get("exemplars") or {}).items():
+                        merged = rings.setdefault(svc, [])
+                        for tid in ring:
+                            if tid not in merged:
+                                merged.append(tid)
+                events.sort(key=lambda e: -(e.get("t") or 0.0))
+                limit = _int_param(params, "limit", 20)
+                data = {"events": events[:limit], "exemplars": rings}
+            if not ok:
+                # TOTAL loss is the one honest 503 (nothing answered);
+                # any partial answer stays 200 + labeled.
+                return 503, {
+                    "error": "no shard answered", "meta": meta,
+                }
+            return 200, {"data": data, "meta": meta}
+        except Exception:  # noqa: BLE001 — an aggregator bug must
+            # answer 500 like the shard plane's dispatch() does,
+            # never tear down the keep-alive thread
+            return 500, {"error": "internal aggregator error"}
+
+    def _service_keyed(self, path: str, params: dict) -> tuple[int, dict]:
+        service = params.get("service")
+        if not service:
+            return 400, {"error": "service parameter required"}
+        owner = None
+        if self.ring is not None:
+            tenant = params.get("tenant") or tenant_of(
+                service, self.tenant_map
+            )
+            try:
+                owner = self.ring.owner_of(service, tenant)
+            except RuntimeError:
+                owner = None
+        owner_answer = None
+        if owner is not None and owner in self.shards:
+            # Owner-routed: one shard holds this keyspace cell (after
+            # a reshard, that is the survivor that adopted the
+            # victim's frame). Fall through to fan-out if the owner
+            # itself cannot answer — partial beats crashed.
+            owner_answer = self._fetch_bounded(
+                owner, self.shards[owner], path, params
+            )
+            if owner_answer.status == 200:
+                meta = self._fleet_meta([owner_answer])
+                meta["shards_total"] = len(self.shards)
+                meta["partial"] = False
+                meta["owner"] = owner
+                return 200, {
+                    "data": (owner_answer.doc or {}).get("data"),
+                    "meta": meta,
+                }
+        # Fallback fan-out: the owner already spent its deadline —
+        # carry its answer over instead of paying the dead shard's
+        # timeout a second time.
+        answers = self._scatter(
+            path, params,
+            skip=frozenset([owner]) if owner_answer is not None
+            else frozenset(),
+        )
+        if owner_answer is not None:
+            answers.append(owner_answer)
+        meta = self._fleet_meta(answers)
+        if owner is not None:
+            meta["owner"] = owner
+        ok = [a for a in answers if a.status == 200]
+        if ok:
+            # Deterministic pick: lowest shard id that answered (two
+            # shards both answering a service happens transiently
+            # right after a reshard merge — both hold the cell).
+            best = sorted(ok)[0]
+            return 200, {
+                "data": (best.doc or {}).get("data"), "meta": meta,
+            }
+        not_found = [a for a in answers if a.status == 404]
+        if len(not_found) == len(answers) and answers:
+            return 404, {
+                "error": f"unknown service {service!r}", "meta": meta,
+            }
+        # The owner (and everyone else) is unreachable/erroring: the
+        # keyspace slice is browned out — a labeled partial answer
+        # with no data, NOT a 5xx (the fleet contract: losing a shard
+        # browns out its slice, it never crashes the read surface).
+        return 200, {"data": None, "meta": meta}
+
+
+def _int_param(params: dict, key: str, default: int) -> int:
+    try:
+        return int(params.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# -- HTTP surface -------------------------------------------------------
+
+
+class AggregatorService:
+    """HTTP server for the aggregator tier (GET-only; the shard query
+    planes keep the Grafana/POST surfaces — dashboards point at a
+    shard or at this tier interchangeably for the /query/* family)."""
+
+    def __init__(
+        self,
+        aggregator: FleetAggregator,
+        registry=None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.aggregator = aggregator
+        self.registry = registry
+        self._host = host
+        self._port_req = port
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                url = urlparse(self.path)
+                params = {
+                    k: v[0] for k, v in parse_qs(url.query).items()
+                }
+                t0 = time.perf_counter()
+                status, doc = service.aggregator.dispatch(
+                    url.path, params
+                )
+                try:
+                    body = json.dumps(doc).encode()
+                except (TypeError, ValueError):
+                    status = 500
+                    body = b'{"error": "internal aggregator error"}'
+                try:
+                    self.send_response(status)
+                    self.send_header(
+                        "Content-Type", "application/json"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header(
+                        "Access-Control-Allow-Origin", "*"
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-answer
+                service._observe(
+                    url.path, status, time.perf_counter() - t0
+                )
+
+            def log_message(self, *args):
+                pass
+
+        self._handler = Handler
+        self._server = None
+        self._thread = None
+        self._started = False
+
+    def _observe(self, endpoint: str, status: int, seconds: float) -> None:
+        if self.registry is None:
+            return
+        label = endpoint if endpoint in AGG_ENDPOINTS else "other"
+        self.registry.counter_add(
+            tele_metrics.ANOMALY_QUERY_REQUESTS, 1.0,
+            endpoint=f"agg:{label}", code=str(status),
+        )
+        self.registry.histogram_observe(
+            tele_metrics.ANOMALY_QUERY_LATENCY, seconds,
+            LATENCY_BUCKETS,
+        )
+
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return self._server.server_address[1]
+        return self._port_req
+
+    def start(self) -> None:
+        self._server = ThreadingHTTPServer(
+            (self._host, self._port_req), self._handler
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="aggregator-http",
+            daemon=True,
+        )
+        self._thread.start()
+        self._started = True
+
+    def alive(self) -> bool:
+        return not self._started or self._thread.is_alive()
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        if self._thread.is_alive():
+            self._server.shutdown()
+        self._server.server_close()
+        self.aggregator.close()
+
+
+def main() -> None:
+    """Standalone aggregator tier (the compose/k8s
+    ``anomaly-aggregator`` service entry point)."""
+    from ..utils.config import fleet_config, fleet_tenant_map
+    from .fleet import parse_peer_list
+
+    fl = fleet_config()
+    shards = int(fl["ANOMALY_FLEET_SHARDS"])
+    port = int(fl["ANOMALY_AGGREGATOR_PORT"])
+    if shards < 2 or port < 0:
+        raise SystemExit(
+            "aggregator needs ANOMALY_FLEET_SHARDS >= 2 and "
+            "ANOMALY_AGGREGATOR_PORT >= 0"
+        )
+    # Index-aligned query addresses; the aggregator is NOT a shard, so
+    # self_index=-1 keeps every slot.
+    addrs = parse_peer_list(
+        str(fl["ANOMALY_FLEET_QUERY_PEERS"]), shards, self_index=-1
+    )
+    ring = HashRing(
+        [f"shard-{i}" for i in range(shards)],
+        vnodes=int(fl["ANOMALY_FLEET_VNODES"]),
+    )
+    aggregator = FleetAggregator(
+        addrs,
+        timeout_s=float(fl["ANOMALY_AGGREGATOR_TIMEOUT_S"]),
+        ring=ring,
+        tenant_map=fleet_tenant_map(fl["ANOMALY_FLEET_TENANTS"]),
+    )
+    service = AggregatorService(aggregator, port=port)
+    service.start()
+    print(
+        f"anomaly-aggregator: query :{service.port} "
+        f"shards {sorted(addrs)} ring {ring.version():#x}",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

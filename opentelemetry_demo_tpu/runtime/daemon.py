@@ -188,6 +188,8 @@ from ..telemetry import metrics as tele_metrics
 from ..utils.config import (
     ConfigError,
     daemon_config,
+    fleet_config,
+    fleet_tenant_map,
     frame_config,
     history_config,
     history_spans_policy,
@@ -200,7 +202,7 @@ from ..utils.config import (
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint, history, remediation, replication, selftrace
+from . import checkpoint, fleet, history, remediation, replication, selftrace
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -702,6 +704,37 @@ class DetectorDaemon:
             "Fault-flagged to verified-recovery interval per mitigated "
             "incident — time-to-mitigate beside time-to-detect",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLEET_SHARDS_LIVE,
+            "Shards this member currently believes alive (itself "
+            "included) — N means full fleet, less means a keyspace "
+            "slice is browned out or resharded",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLEET_RING_VERSION,
+            "Stable digest of the current ring member set (all live "
+            "members agree on this value; disagreement = a ring split)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLEET_FROZEN,
+            "1 while the reshard budget is exhausted: the ring holds "
+            "its last state and membership changes are refused",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_RESHARDS,
+            "Ring membership changes APPLIED (leave + join), each one "
+            "a keyspace reassignment",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_RESHARDS_REFUSED,
+            "Membership changes REFUSED by the exhausted reshard "
+            "budget — the flapping-shard audit trail",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_FLEET_SHARD_SPANS,
+            "Spans ingested by this shard, labeled with its shard id "
+            "(the per-shard ingest-rate panel)",
+        )
         self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
@@ -741,6 +774,47 @@ class DetectorDaemon:
             sp = spine_config()
         except ConfigError as e:
             raise SystemExit(str(e)) from e
+        # Sharded-fleet knobs (registry: utils.config.FLEET_KNOBS;
+        # engines: runtime.fleet membership/ring + runtime.aggregator
+        # scatter-gather). Parsed before the pipeline below — the
+        # per-tenant quota and tenant map are pipeline constructor
+        # knobs; the membership leg itself is built after the health
+        # surface exists (its heartbeats poll peer /healthz).
+        try:
+            fl = fleet_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self._fleet_shards = int(fl["ANOMALY_FLEET_SHARDS"])
+        self._fleet_index = int(fl["ANOMALY_FLEET_SHARD_INDEX"])
+        self._fleet_peers_raw = str(fl["ANOMALY_FLEET_PEERS"])
+        self._fleet_query_peers_raw = str(fl["ANOMALY_FLEET_QUERY_PEERS"])
+        self._fleet_vnodes = int(fl["ANOMALY_FLEET_VNODES"])
+        self._fleet_services = [
+            s.strip()
+            for s in str(fl["ANOMALY_FLEET_SERVICES"]).split(",")
+            if s.strip()
+        ]
+        self._fleet_heartbeat_s = float(fl["ANOMALY_FLEET_HEARTBEAT_S"])
+        self._fleet_dead_after_s = float(fl["ANOMALY_FLEET_DEAD_AFTER_S"])
+        self._fleet_rejoin_after_s = float(
+            fl["ANOMALY_FLEET_REJOIN_AFTER_S"]
+        )
+        self._fleet_reshard_budget = int(
+            fl["ANOMALY_FLEET_RESHARD_BUDGET"]
+        )
+        self._fleet_reshard_refill_s = float(
+            fl["ANOMALY_FLEET_RESHARD_REFILL_S"]
+        )
+        self._tenant_map = fleet_tenant_map(fl["ANOMALY_FLEET_TENANTS"])
+        self._tenant_quota_rows_s = float(
+            fl["ANOMALY_FLEET_TENANT_QUOTA_ROWS_S"]
+        )
+        self._aggregator_port_req = int(fl["ANOMALY_AGGREGATOR_PORT"])
+        self._aggregator_timeout_s = float(
+            fl["ANOMALY_AGGREGATOR_TIMEOUT_S"]
+        )
+        self.fleet = None
+        self.aggregator_service = None
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -777,6 +851,13 @@ class DetectorDaemon:
             # promoted phase histograms + sampled batch-lifecycle traces.
             phase_observe=self._observe_phase,
             selftrace=self.selftrace,
+            # Per-tenant namespaces (FLEET_KNOBS; runtime.fleet): one
+            # noisy tenant sheds alone, ahead of the shared ladder.
+            tenant_of=(
+                (lambda name: fleet.tenant_of(name, self._tenant_map))
+                if self._tenant_quota_rows_s > 0 else None
+            ),
+            tenant_quota_rows_s=self._tenant_quota_rows_s,
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -816,6 +897,15 @@ class DetectorDaemon:
                 name="width-ladder-warmup", daemon=True,
             ).start()
         for name in restored_names:  # re-intern in checkpoint order
+            self.pipeline.tensorizer.service_id(name)
+        for name in self._fleet_services:
+            # Fleet mode pre-interns ONE shared service table in knob
+            # order on every shard: CMS cells fold the service id into
+            # the key hash, so cross-shard frame adoption (reshard) is
+            # bit-exact only when the tables agree —
+            # fleet.merge_shard_arrays refuses drifted tables. A
+            # checkpoint restored above already carries the same order
+            # (interning an existing name is a no-op).
             self.pipeline.tensorizer.service_id(name)
 
         # Parallel host-ingest engine (runtime.ingest_pool): N decode
@@ -1022,6 +1112,73 @@ class DetectorDaemon:
             self.flight.record(
                 "mitigation", op="enabled",
                 actuators=[a.name for a in rem_actuators],
+            )
+        # Sharded fleet membership (knob registry:
+        # utils.config.FLEET_KNOBS; engine: runtime.fleet): a
+        # supervised heartbeat loop over the peer shards' /healthz
+        # surfaces feeding the consistent-hash ring, with the
+        # double-check + hysteresis + reshard-budget guardrails. Every
+        # ROLE runs it (a standby's view of the fleet must be warm at
+        # promotion). The optional embedded aggregator serves the
+        # fleet-global /query/* scatter-gather tier from this process
+        # (ANOMALY_AGGREGATOR_PORT >= 0; the compose/k8s
+        # anomaly-aggregator service runs it standalone instead).
+        if self._fleet_shards > 1:
+            peer_addrs = fleet.parse_peer_list(
+                self._fleet_peers_raw, self._fleet_shards,
+                self._fleet_index,
+            )
+            self.fleet = fleet.FleetMember(
+                f"shard-{self._fleet_index}",
+                peer_addrs,
+                heartbeat_s=self._fleet_heartbeat_s,
+                vnodes=self._fleet_vnodes,
+                dead_after_s=self._fleet_dead_after_s,
+                rejoin_after_s=self._fleet_rejoin_after_s,
+                reshard_budget=self._fleet_reshard_budget,
+                reshard_refill_s=self._fleet_reshard_refill_s,
+                on_reshard=self._on_reshard,
+            )
+            self._supervisor.register(
+                "fleet", base_backoff_s=0.5, max_backoff_s=15.0,
+                restart=self._restart_fleet,
+                probe=lambda: (
+                    self.fleet is None or self.fleet.alive()
+                ),
+            )
+            if self._aggregator_port_req >= 0:
+                from .aggregator import (
+                    AggregatorService,
+                    FleetAggregator,
+                )
+
+                query_addrs = fleet.parse_peer_list(
+                    self._fleet_query_peers_raw, self._fleet_shards,
+                    self_index=-1,
+                )
+                self.aggregator_service = AggregatorService(
+                    FleetAggregator(
+                        query_addrs,
+                        timeout_s=self._aggregator_timeout_s,
+                        ring=self.fleet.membership.ring,
+                        tenant_map=self._tenant_map,
+                        live_fn=self._fleet_live_shards,
+                    ),
+                    registry=self.registry,
+                    port=self._aggregator_port_req,
+                )
+        self._fleet_seen = {"reshards": 0, "refused": 0, "spans": 0}
+        self._tenant_shed_seen: dict[str, int] = {}
+        if self.fleet is not None:
+            # Mint the fleet counters at zero (the shed-lane habit):
+            # "no reshard ever happened" must be a visible 0.
+            self.registry.counter_add(tele_metrics.ANOMALY_RESHARDS, 0.0)
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_RESHARDS_REFUSED, 0.0
+            )
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FLEET_SHARD_SPANS, 0.0,
+                shard=f"shard-{self._fleet_index}",
             )
         if self.role == ROLE_PRIMARY and self._fence.stale():
             # Booted into a world that promoted past us (newer epoch on
@@ -1244,6 +1401,12 @@ class DetectorDaemon:
                 "failed": self.remediation.failed_services(),
             },
         }
+        if self.fleet is not None:
+            # Fleet block (health_probe --shard reads this): ring
+            # version, member set, peer liveness, reshard counters —
+            # how an operator tells "one shard browned out" from "the
+            # fleet is splitting".
+            detail["fleet"] = self.fleet.snapshot()
         return ("ok" if state == UP else state), detail
 
     # -- self-telemetry -------------------------------------------------
@@ -1474,6 +1637,14 @@ class DetectorDaemon:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
+        # Fleet membership + the optional embedded aggregator come up
+        # for EVERY role: heartbeats are reads, the aggregator mutates
+        # nothing, and a standby that boots with a cold membership
+        # table would misjudge the fleet at promotion time.
+        if self.fleet is not None:
+            self.fleet.start()
+            if self.aggregator_service is not None:
+                self.aggregator_service.start()
         if self.role == ROLE_STANDBY:
             # A standby serves only its metrics/health surface and the
             # replication client; ingest legs come up at promotion.
@@ -1805,6 +1976,9 @@ class DetectorDaemon:
 
     def step(self, t_now: float | None = None) -> None:
         """One pump + housekeeping tick (public for tests/sims)."""
+        # Fleet gauges for EVERY role (a standby's membership view
+        # must be scrapeable too — it inherits the ring at promotion).
+        self._export_fleet_stats()
         if self.role in (ROLE_STANDBY, ROLE_PROMOTING):
             self._standby_step()
             return
@@ -1917,6 +2091,23 @@ class DetectorDaemon:
                 lane="ok", cause="brownout",
             )
             self._brownout_seen = brownout
+        # Per-tenant quota shed (the fleet's noisy-tenant isolation):
+        # anomaly_shed_rows_total{tenant=} — one series per tenant
+        # that ever shed, so "this tenant's loss" is a number an
+        # operator can alert on in isolation.
+        for tenant, total in list(
+            self.pipeline.stats.shed_rows_tenant.items()
+        ):
+            delta = total - self._tenant_shed_seen.get(tenant, 0)
+            if delta:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_SHED_ROWS, float(delta),
+                    lane="ok", cause="tenant-quota", tenant=tenant,
+                )
+                self._tenant_shed_seen[tenant] = total
+                self.flight.record(
+                    "shed", lane="ok", tenant=tenant, rows=int(delta),
+                )
         if self.ingest_pool is not None:
             self._export_pool_stats()
         self._export_spine_stats()
@@ -2026,6 +2217,82 @@ class DetectorDaemon:
                 self._OVERLAP_BUCKETS,
             )
             self._spine_overlap_seen = (hits, taken)
+
+    # -- sharded fleet ---------------------------------------------------
+
+    def _on_reshard(self, event: dict) -> None:
+        """Membership applied a ring change (leave/join): evidence in
+        the flight recorder — the postmortem question after any
+        reshard is 'who moved, when, at what ring version'."""
+        self.flight.record(
+            "reshard", op=event.get("op"), shard=event.get("shard"),
+            ring_version=event.get("ring_version"),
+            members=event.get("members"),
+        )
+
+    def _restart_fleet(self) -> None:
+        if self.fleet is None:
+            return
+        try:
+            self.fleet.stop()
+        except Exception:  # noqa: BLE001 — a wedged loop may half-stop
+            pass
+        self.fleet.start()
+
+    def _fleet_live_shards(self) -> list[str]:
+        """The embedded aggregator's membership filter: fan out only
+        to shards the heartbeat table believes alive (plus self)."""
+        if self.fleet is None:
+            return []
+        snap = self.fleet.snapshot()
+        live = [
+            peer for peer, st in snap["peers"].items() if st["alive"]
+        ]
+        live.append(snap["shard"])
+        return live
+
+    def _export_fleet_stats(self) -> None:
+        """anomaly_fleet_* gauges/counters from the membership table
+        (delta-based counters, the shed/quarantine discipline)."""
+        if self.fleet is None:
+            return
+        snap = self.fleet.snapshot()
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_FLEET_SHARDS_LIVE,
+            float(snap["shards_live"]),
+        )
+        # Prometheus gauges are floats: fold the 64-bit digest into 31
+        # bits so the exposition round-trips exactly (the comparison
+        # across shards only needs equality, not the full digest).
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_FLEET_RING_VERSION,
+            float(snap["ring_version"] % (1 << 31)),
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_FLEET_FROZEN,
+            1.0 if snap["frozen"] else 0.0,
+        )
+        seen = self._fleet_seen
+        for key, metric in (
+            ("reshards", tele_metrics.ANOMALY_RESHARDS),
+            ("refused", tele_metrics.ANOMALY_RESHARDS_REFUSED),
+        ):
+            value = snap[
+                "reshards_total" if key == "reshards"
+                else "reshards_refused"
+            ]
+            delta = value - seen[key]
+            if delta > 0:
+                self.registry.counter_add(metric, float(delta))
+                seen[key] = value
+        spans = int(self.pipeline.stats.spans)
+        delta = spans - seen["spans"]
+        if delta > 0:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FLEET_SHARD_SPANS, float(delta),
+                shard=f"shard-{self._fleet_index}",
+            )
+            seen["spans"] = spans
 
     # -- replication: standby step / promotion / fencing ----------------
 
@@ -2484,6 +2751,10 @@ class DetectorDaemon:
         self._stop.set()
 
     def shutdown(self) -> None:
+        if self.fleet is not None:
+            self.fleet.stop()
+        if self.aggregator_service is not None:
+            self.aggregator_service.stop()
         if self.repl_standby is not None:
             self.repl_standby.stop()
         if self.repl_primary is not None:

@@ -42,10 +42,10 @@ from . import wire
 from .grpc_health import SERVING
 
 
-def probe_role(addr: str, timeout_s: float = 3.0) -> tuple[str, int] | None:
-    """(role, epoch) from the daemon's /healthz, or None when
-    unreachable/old (a pre-replication daemon omits the fields —
-    reported as primary at epoch 0, which is exactly what it is)."""
+def _healthz_doc(addr: str, timeout_s: float) -> dict | None:
+    """The daemon's /healthz JSON, or None when unreachable. A 503
+    (degraded) still carries the body — a degraded daemon's role and
+    fleet view must stay readable (that IS the triage question)."""
     import json
     import urllib.error
     import urllib.request
@@ -54,18 +54,36 @@ def probe_role(addr: str, timeout_s: float = 3.0) -> tuple[str, int] | None:
         with urllib.request.urlopen(
             f"http://{addr}/healthz", timeout=timeout_s
         ) as resp:
-            doc = json.loads(resp.read().decode())
+            return json.loads(resp.read().decode())
     except urllib.error.HTTPError as e:
-        # 503 = degraded, which still carries the JSON body — a
-        # degraded primary's role must stay readable (that IS the
-        # triage question).
         try:
-            doc = json.loads(e.read().decode())
-        except Exception:  # noqa: BLE001 — unreadable body = role unknown
+            return json.loads(e.read().decode())
+        except Exception:  # noqa: BLE001 — unreadable body = unknown
             return None
     except Exception:  # noqa: BLE001 — any transport/parse failure is
-        return None  # "role unreadable" to the caller
+        return None  # "unreadable" to the caller
+
+
+def probe_role(addr: str, timeout_s: float = 3.0) -> tuple[str, int] | None:
+    """(role, epoch) from the daemon's /healthz, or None when
+    unreachable/old (a pre-replication daemon omits the fields —
+    reported as primary at epoch 0, which is exactly what it is)."""
+    doc = _healthz_doc(addr, timeout_s)
+    if doc is None:
+        return None
     return str(doc.get("role", "primary")), int(doc.get("epoch", 0))
+
+
+def probe_shard(addr: str, timeout_s: float = 3.0) -> dict | None:
+    """The /healthz ``fleet`` block (runtime.fleet membership: ring
+    version, member set, peer liveness, reshard counters) from the
+    daemon's metrics port, or None when unreachable / not a fleet
+    member (single-shard daemons carry no fleet block)."""
+    doc = _healthz_doc(addr, timeout_s)
+    if doc is None:
+        return None
+    fleet = doc.get("fleet")
+    return fleet if isinstance(fleet, dict) else None
 
 
 def probe(addr: str, service: str = "", timeout_s: float = 3.0) -> bool:
@@ -101,8 +119,34 @@ def main() -> None:
         help="print the replication role + epoch from /healthz on the "
         "metrics port (point --addr at host:9464, not the gRPC ingress)",
     )
+    parser.add_argument(
+        "--shard", action="store_true",
+        help="print the fleet block from /healthz on the metrics port "
+        "(shard id, ring version, live/total shards, per-peer "
+        "liveness, reshard counters, frozen flag); exit 0 iff the "
+        "block was readable",
+    )
     parser.add_argument("--timeout", type=float, default=3.0)
     args = parser.parse_args()
+    if args.shard:
+        fleet = probe_shard(args.addr, args.timeout)
+        if fleet is None:
+            print("fleet view unreadable (not a fleet member?)",
+                  file=sys.stderr)
+            sys.exit(1)
+        peers = ", ".join(
+            f"{p}={'up' if st.get('alive') else 'DOWN'}"
+            for p, st in sorted(fleet.get("peers", {}).items())
+        ) or "none"
+        print(
+            f"{fleet.get('shard', '?').upper()} "
+            f"ring={fleet.get('ring_version', 0):#x} "
+            f"live={fleet.get('shards_live')}/{fleet.get('shards_total')} "
+            f"reshards={fleet.get('reshards_total')} "
+            f"refused={fleet.get('reshards_refused')} "
+            f"frozen={fleet.get('frozen')} peers: {peers}"
+        )
+        sys.exit(0)
     if args.role:
         role_epoch = probe_role(args.addr, args.timeout)
         if role_epoch is None:

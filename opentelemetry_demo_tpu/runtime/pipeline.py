@@ -107,6 +107,12 @@ class PipelineStats:
     # zero-error-lane-loss invariant is an asserted number, not an
     # absence — it must stay 0 (SHED_LANES).
     shed_rows: dict = field(default_factory=lambda: {"ok": 0, "error": 0})
+    # Per-tenant quota shed (the fleet's noisy-tenant isolation,
+    # ANOMALY_FLEET_TENANT_QUOTA_ROWS_S): OK-lane rows a tenant lost
+    # to ITS OWN bucket, keyed by tenant — exported as
+    # anomaly_shed_rows_total{tenant=}. Other tenants' admission is
+    # untouched by construction (one bucket per tenant).
+    shed_rows_tenant: dict = field(default_factory=dict)
     # OK-lane rows dropped by the brownout head-sampler (deliberate,
     # deterministic degradation — distinct from the overflow shed).
     brownout_rows: int = 0
@@ -165,6 +171,8 @@ class DetectorPipeline:
         phase_observe: Callable[[str, float], None] | None = None,
         selftrace=None,
         history_capture: Callable[[object, float], None] | None = None,
+        tenant_of: Callable[[str], str] | None = None,
+        tenant_quota_rows_s: float = 0.0,
     ):
         self.detector = detector
         # Time-travel span capture (runtime.history.HistoryWriter
@@ -337,6 +345,19 @@ class DetectorPipeline:
         # the same data. Guarded by its own lock: writers are the pump
         # thread (candidates) and the harvester (exemplars), readers
         # the replication/query snapshot threads.
+        # Per-tenant sketch-namespace quota (the fleet tier's
+        # noisy-tenant isolation; knob registry: utils.config
+        # FLEET_KNOBS): one token bucket per tenant — capacity = one
+        # second's quota, refill = quota rows/s — consulted in
+        # submit_columns AHEAD of the global row budget, so a tenant
+        # over quota sheds its OWN OK-lane rows (error lane always
+        # passes, SHED_LANES discipline) while every other tenant's
+        # admission, brownout state and TTD are untouched. tenant_of
+        # maps a service NAME to its tenant (the ANOMALY_FLEET_TENANTS
+        # map); quota 0 = the path costs one comparison.
+        self._tenant_of = tenant_of
+        self.tenant_quota_rows_s = float(tenant_quota_rows_s)
+        self._tenant_buckets: dict[str, tuple[float, float]] = {}
         self._exemplar_ring = int(exemplar_ring)
         self._hh_cand_max = int(hh_candidates)
         self._query_lock = threading.Lock()
@@ -360,6 +381,10 @@ class DetectorPipeline:
     def submit_columns(self, cols: SpanColumns) -> None:
         if not cols.rows:
             return
+        if self.tenant_quota_rows_s > 0:
+            cols = self._tenant_quota_sample(cols)
+            if not cols.rows:
+                return
         level = self._brownout_level
         if level:
             cols = self._brownout_sample(cols, level)
@@ -374,6 +399,59 @@ class DetectorPipeline:
         self._admission_update(rows)
 
     # -- bounded admission / brownout ----------------------------------
+
+    def _tenant_quota_sample(self, cols: SpanColumns) -> SpanColumns:
+        """Per-tenant admission quota (token bucket, 1 s burst).
+
+        Runs AHEAD of the global row budget and the brownout ladder so
+        one noisy tenant is clipped to its quota BEFORE it can push
+        the shared queue toward saturation — the isolation is per
+        tenant by construction (one bucket each), so a quiet tenant's
+        rows are admitted untouched whatever its neighbors do. Error-
+        lane rows always pass (the SHED_LANES discipline: incident
+        evidence is never droppable telemetry). Shed rows land in
+        ``stats.shed_rows_tenant[tenant]``, exported as
+        anomaly_shed_rows_total{tenant=}.
+        """
+        quota = self.tenant_quota_rows_s
+        now = time.monotonic()
+        names = self.tensorizer.service_names
+        svc = cols.svc
+        ok = ~(cols.is_error > 0.0)
+        # Group the batch's service ids by tenant (a tenant may own
+        # several services; the bucket is per TENANT).
+        by_tenant: dict[str, list[int]] = {}
+        for sid in np.unique(svc):
+            sid = int(sid)
+            name = names[sid] if sid < len(names) else f"svc-{sid}"
+            tenant = (
+                self._tenant_of(name)
+                if self._tenant_of is not None else "default"
+            )
+            by_tenant.setdefault(tenant, []).append(sid)
+        drop = np.zeros(cols.rows, dtype=bool)
+        with self._admission_lock:  # buckets are shared across
+            # receiver threads; refill+consume is read-modify-write
+            for tenant, sids in by_tenant.items():
+                tokens, t_last = self._tenant_buckets.get(
+                    tenant, (quota, now)
+                )
+                tokens = min(tokens + (now - t_last) * quota, quota)
+                mask = np.isin(svc, np.asarray(sids, svc.dtype)) & ok
+                n = int(mask.sum())
+                allow = min(n, int(tokens))
+                if allow < n:
+                    # Keep the OLDEST rows within the quota (rows are
+                    # enqueue-ordered): head-of-line fairness, and a
+                    # deterministic choice two replicas agree on.
+                    rank = np.cumsum(mask)
+                    drop |= mask & (rank > allow)
+                    shed = self.stats.shed_rows_tenant
+                    shed[tenant] = shed.get(tenant, 0) + (n - allow)
+                self._tenant_buckets[tenant] = (tokens - allow, now)
+        if not drop.any():
+            return cols
+        return cols.compress(~drop)
 
     def _brownout_sample(self, cols: SpanColumns, level: int) -> SpanColumns:
         """Deterministic head sampling: keep 1/2^level of OK-lane rows.

@@ -1,4 +1,4 @@
-"""Failover drill driver: one methodology, bench + tests.
+"""Failover + fleet-reshard drill driver: one methodology, bench + tests.
 
 The overloadbench/lagbench sibling for the replication fault class:
 run a REAL detector as primary with a live replication link, a standby
@@ -14,10 +14,30 @@ contract end to end:
 - convergence — the promoted state's HLL/CMS equal the primary's last
   acked state exactly (merge semantics, not replay).
 
-``tests/test_replication.py`` asserts on this dict (the acceptance
-bar); ``make replbench`` prints it as ONE json line, the bench.py
-habit. ``bench.py`` lifts ``failover_ttd_s`` / ``replication_lag_p99_ms``
-into the flagship artifact.
+The FLEET drill (``--fleet`` / ``make fleetbench``; runtime.fleet +
+runtime.aggregator) scales the same methodology to the N-way sharded
+tier:
+
+- :func:`measure_reshard` — an in-proc 3-shard fleet under
+  deterministic virtual-time load beside an UNKILLED WITNESS fleet
+  fed identically; kill one shard (RST, the SIGKILL shape), let
+  membership declare it dead (health double-check + hysteresis),
+  reshard its keyspace by monoid-merging its last replicated frame
+  into the survivors, and pin every post-reshard ``/query/*`` answer
+  for the victim's keys BIT-EXACT against the witness. Also drives
+  the aggregator's partial-answer contract (one shard blackholed via
+  runtime.faultwire → labeled partial 200, never 5xx) and the
+  noisy-tenant quota isolation.
+- :func:`measure_reshard_live` — the live-fire leg: the victim is a
+  REAL daemon subprocess under live Kafka + OTLP load, SIGKILLed
+  mid-stream; ``shard_reshard_ttd_s`` is kill → the survivor
+  answering queries for the victim's keys from the adopted frame.
+
+``tests/test_replication.py`` / ``tests/test_fleet.py`` assert on
+these dicts (the acceptance bars); ``make replbench`` /
+``make fleetbench`` print ONE json line each, the bench.py habit.
+``bench.py`` lifts ``failover_ttd_s`` / ``replication_lag_p99_ms`` /
+``shard_reshard_ttd_s`` / ``fleet_ok`` into the flagship artifact.
 """
 
 from __future__ import annotations
@@ -153,9 +173,628 @@ def measure_failover(
     }
 
 
+# -- the N-way fleet reshard drill (runtime.fleet) ----------------------
+
+FLEET_SERVICES = (
+    "frontend", "cart", "checkout", "currency", "payment", "email",
+)
+FLEET_TENANTS = {
+    "frontend": "web", "cart": "web", "checkout": "web",
+    "currency": "platform", "payment": "platform", "email": "platform",
+}
+
+
+def _fleet_records(rng: np.ndarray, service: str, n: int) -> list:
+    """Deterministic per-service span records: the fleet shard and its
+    witness twin are fed byte-identical streams."""
+    from .tensorize import SpanRecord
+
+    return [
+        SpanRecord(
+            service=service,
+            duration_us=float(200.0 + 50.0 * rng.random()),
+            trace_id=rng.bytes(8),
+            is_error=bool(rng.random() < 0.02),
+            attr=f"a{int(rng.integers(0, 8))}",
+        )
+        for _ in range(n)
+    ]
+
+
+class _Shard:
+    """One in-proc fleet member: detector + pipeline with the SHARED
+    pre-interned service table, plus a live replication primary so a
+    mirror of its state exists to adopt after its death."""
+
+    def __init__(self, name: str, config: DetectorConfig, batch: int,
+                 interval_s: float):
+        self.name = name
+        self.detector = AnomalyDetector(config)
+        self.pipe = DetectorPipeline(self.detector, batch_size=batch)
+        for svc in FLEET_SERVICES:  # the shared-table contract
+            self.pipe.tensorizer.service_id(svc)
+        self.fence = EpochFence(0)
+        self.primary = ReplicationPrimary(
+            self._snapshot, self.fence, interval_s=interval_s
+        )
+        self.primary.start()
+
+    def _snapshot(self):
+        with self.pipe._dispatch_lock:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in self.detector.state._asdict().items()
+            }
+            clock_t_prev = self.detector.clock._t_prev
+        return arrays, {
+            "offsets": {},
+            "service_names": self.pipe.tensorizer.service_names,
+            "clock_t_prev": clock_t_prev,
+            "config": list(
+                self.detector.config._replace(sketch_impl=None)
+            ),
+        }
+
+    def arrays(self) -> dict:
+        return self._snapshot()[0]
+
+    def stop(self) -> None:
+        self.primary.stop()
+
+
+def _query_docs(arrays: dict, meta: dict, services) -> dict:
+    """The /query/* answer set for a service list over one state —
+    the bit-comparable unit the witness pin asserts on (same pure
+    numpy read fns both the shard plane and a read replica run)."""
+    from . import query as q
+
+    return {
+        svc: {
+            "cardinality": q.cardinality(arrays, meta, svc),
+            "topk": q.topk_heavy_hitters(arrays, meta, svc, k=5),
+            "zscore": q.zscore_state(arrays, meta, svc),
+        }
+        for svc in services
+    }
+
+
+def measure_reshard(
+    seconds: float = 1.5,
+    batch: int = 256,
+    interval_s: float = 0.05,
+    dead_after_s: float = 0.35,
+    pump_interval_s: float = 0.05,
+    rows_per_service: int = 24,
+    seed: int = 7,
+    config: DetectorConfig | None = None,
+) -> dict:
+    """The in-proc shard-kill → reshard drill (module docstring).
+
+    3 shards + a 3-shard WITNESS fleet fed byte-identical virtual-time
+    streams; kill shard-1's replication abruptly, detect death through
+    the membership guardrails, monoid-merge its mirror frame into the
+    survivors, and pin the post-reshard answers for the victim's keys
+    bit-exact against the witness fleet merged the same way.
+    """
+    from . import query as q  # noqa: F401 — via _query_docs
+    from .fleet import (
+        FleetMembership,
+        HashRing,
+        merge_shard_arrays,
+        service_row_mask,
+        shard_key,
+        tenant_of,
+    )
+
+    config = config or DetectorConfig(
+        num_services=8, hll_p=8, cms_width=512
+    )
+    shard_ids = ["shard-0", "shard-1", "shard-2"]
+    victim_id = "shard-1"
+    ring = HashRing(shard_ids, vnodes=128)
+    owner_of = {
+        svc: ring.owner(shard_key(svc, tenant_of(svc, FLEET_TENANTS)))
+        for svc in FLEET_SERVICES
+    }
+    # The ring must actually give the victim a slice for the drill to
+    # mean anything; with 6 keys × 128 vnodes it always does, but
+    # assert rather than assume.
+    victim_services = [s for s, o in owner_of.items() if o == victim_id]
+    if not victim_services:
+        raise RuntimeError("ring assigned the victim no keyspace")
+
+    fleet = {s: _Shard(s, config, batch, interval_s) for s in shard_ids}
+    witness = {s: _Shard(s, config, batch, interval_s) for s in shard_ids}
+    # The victim's hot mirror: the frame the survivors adopt. (In the
+    # deployed fleet every shard has one — its standby; the drill
+    # mirrors only the shard it will kill.)
+    mirror_fence = EpochFence(0)
+    mirror = ReplicationStandby(
+        f"127.0.0.1:{fleet[victim_id].primary.port}", mirror_fence,
+        config_fingerprint=list(config._replace(sketch_impl=None)),
+    )
+    mirror.start()
+    try:
+        if not mirror.wait_for_state(10.0):
+            raise RuntimeError("victim mirror never bootstrapped")
+
+        # Virtual-time load, routed by ring ownership, fed IDENTICALLY
+        # to fleet and witness (one rng stream per (service, step)).
+        steps = max(int(seconds / pump_interval_s), 8)
+        t = 0.0
+        for i in range(steps):
+            for svc in FLEET_SERVICES:
+                rng = np.random.default_rng(
+                    seed * 100003 + i * 131 + hash_stable(svc)
+                )
+                records = _fleet_records(rng, svc, rows_per_service)
+                fleet[owner_of[svc]].pipe.submit(records)
+                witness[owner_of[svc]].pipe.submit(records)
+            for shard in (*fleet.values(), *witness.values()):
+                shard.pipe.pump(t)
+            t += pump_interval_s
+        for shard in (*fleet.values(), *witness.values()):
+            shard.pipe.drain()
+
+        # Quiesce: the mirror must carry the victim's final state (the
+        # documented replication bound — under live flow the adopted
+        # frame lags by ≤ one interval; the BIT-EXACT pin needs the
+        # acked frame to BE the final state, as in measure_failover).
+        final_victim = fleet[victim_id].arrays()
+        deadline = time.monotonic() + max(10 * interval_s, 2.0)
+        while time.monotonic() < deadline:
+            arrs, _m = mirror.snapshot()
+            if arrs and (
+                arrs["cms_bank"] == final_victim["cms_bank"]
+            ).all() and (
+                arrs["hll_bank"] == final_victim["hll_bank"]
+            ).all():
+                break
+            time.sleep(interval_s / 2)
+
+        # Membership over the fleet, with the health double-check the
+        # chaos tests reuse (a serving shard is never declared dead).
+        alive = {s: True for s in shard_ids}
+        membership = FleetMembership(
+            "shard-0", [s for s in shard_ids if s != "shard-0"],
+            vnodes=128, dead_after_s=dead_after_s,
+            rejoin_after_s=1.0, reshard_budget=4,
+            reshard_refill_s=60.0,
+            health_check=lambda s: alive[s],
+        )
+        for s in shard_ids[1:]:
+            membership.observe(s)
+
+        # KILL: RST every replication session + health goes dark — the
+        # SIGKILL shape (the live-fire leg does the real SIGKILL).
+        t_kill = time.monotonic()
+        fleet[victim_id].primary.kill()
+        alive[victim_id] = False
+        events: list = []
+        give_up = t_kill + dead_after_s * 20 + 5.0
+        while time.monotonic() < give_up and not events:
+            events = membership.tick()
+            if not events:
+                membership.observe("shard-2")  # survivor stays fresh
+                time.sleep(0.02)
+        if not any(
+            e["op"] == "leave" and e["shard"] == victim_id
+            for e in events
+        ):
+            raise RuntimeError("membership never declared the victim dead")
+
+        # RESHARD: adopt the victim's last replicated frame into every
+        # survivor (reads route by ownership, so the add-merge can
+        # never double-count an answer), then answer for its keys.
+        mirror_arrays, mirror_meta = mirror.snapshot()
+        survivors = [s for s in shard_ids if s != victim_id]
+        merged: dict[str, dict] = {}
+        for s in survivors:
+            dst = fleet[s].arrays()
+            mask = service_row_mask(
+                list(mirror_meta.get("service_names") or []),
+                fleet[s].pipe.tensorizer.service_names,
+                int(dst["lat_mean"].shape[0]),
+                owned=victim_services,
+            )
+            merged[s] = merge_shard_arrays(dst, mirror_arrays, mask)
+        # TTD: kill → a survivor answering the victim's keys.
+        meta = {
+            "service_names": list(FLEET_SERVICES),
+            "config": list(config._replace(sketch_impl=None)),
+        }
+        post_owner = {
+            svc: membership.ring.owner(
+                shard_key(svc, tenant_of(svc, FLEET_TENANTS))
+            )
+            for svc in victim_services
+        }
+        answers = {
+            svc: _query_docs(merged[post_owner[svc]], meta, [svc])[svc]
+            for svc in victim_services
+        }
+        ttd_s = time.monotonic() - t_kill
+        answered = all(
+            max(a["cardinality"]["estimate"]) > 0.0
+            for a in answers.values()
+        )
+
+        # WITNESS PIN: the unkilled witness fleet, merged identically,
+        # must answer bit-exactly for every service on every survivor.
+        witness_merged: dict[str, dict] = {}
+        for s in survivors:
+            dst = witness[s].arrays()
+            mask = service_row_mask(
+                witness[victim_id].pipe.tensorizer.service_names,
+                witness[s].pipe.tensorizer.service_names,
+                int(dst["lat_mean"].shape[0]),
+                owned=victim_services,
+            )
+            witness_merged[s] = merge_shard_arrays(
+                dst, witness[victim_id].arrays(), mask
+            )
+        bitexact = True
+        for s in survivors:
+            got = _query_docs(merged[s], meta, FLEET_SERVICES)
+            want = _query_docs(witness_merged[s], meta, FLEET_SERVICES)
+            if got != want:
+                bitexact = False
+            for name in ("hll_bank", "cms_bank"):
+                if not (merged[s][name] == witness_merged[s][name]).all():
+                    bitexact = False
+    finally:
+        mirror.stop()
+        for shard in (*fleet.values(), *witness.values()):
+            shard.stop()
+
+    partial = _measure_partial_answer(config, batch)
+    tenant = _measure_tenant_isolation(config, batch)
+    fleet_ok = bool(
+        answered and bitexact
+        and partial["partial_answer_ok"]
+        and tenant["noisy_tenant_isolated"]
+    )
+    return {
+        "shard_reshard_ttd_s": round(ttd_s, 4),
+        "fleet_shards": len(shard_ids),
+        "victim": victim_id,
+        "victim_services": victim_services,
+        "reshards_applied": membership.reshards_total,
+        "reshard_bitexact": bitexact,
+        "survivor_answers_victim_keys": answered,
+        "dead_after_s": dead_after_s,
+        **partial,
+        **tenant,
+        "fleet_ok": fleet_ok,
+    }
+
+
+def hash_stable(s: str) -> int:
+    """Deterministic small int from a string (NOT hash(): the drill's
+    rng seeds must not change across processes)."""
+    from .fleet import key_hash64
+
+    return key_hash64(s) % 65521
+
+
+def _measure_partial_answer(config: DetectorConfig, batch: int) -> dict:
+    """Aggregator degradation leg: two real shard query planes, one
+    BLACKHOLED via runtime.faultwire — the merged answer must come
+    back 200, labeled partial, never 5xx."""
+    from .aggregator import FleetAggregator
+    from .faultwire import FaultWire
+    from .query import QueryEngine, QueryService
+
+    shards = {}
+    services = []
+    wire = None
+    aggregator = None
+    try:
+        for name in ("shard-0", "shard-1"):
+            det = AnomalyDetector(config)
+            pipe = DetectorPipeline(det, batch_size=batch)
+            for svc in FLEET_SERVICES:
+                pipe.tensorizer.service_id(svc)
+            rng = np.random.default_rng(11)
+            pipe.submit_columns(make_columns(rng, batch))
+            pipe.pump(0.0)
+            pipe.drain()
+
+            def snapshot(det=det, pipe=pipe):
+                with pipe._dispatch_lock:
+                    arrays = {
+                        k: np.asarray(v)
+                        for k, v in det.state._asdict().items()
+                    }
+                return arrays, {
+                    "service_names": pipe.tensorizer.service_names,
+                    "config": list(
+                        det.config._replace(sketch_impl=None)
+                    ),
+                    "query": pipe.query_meta(),
+                }
+
+            engine = QueryEngine(snapshot_fn=snapshot)
+            service = QueryService(engine, host="127.0.0.1", port=0)
+            service.start()
+            services.append(service)
+            shards[name] = f"127.0.0.1:{service.port}"
+        # Blackhole shard-1 behind a faultwire proxy: accepted
+        # connections, every byte dropped — the half-open worst case.
+        wire = FaultWire("127.0.0.1", services[1].port)
+        wire.blackhole = True
+        wire.start()
+        shards["shard-1"] = f"127.0.0.1:{wire.port}"
+        aggregator = FleetAggregator(shards, timeout_s=0.5)
+        status, doc = aggregator.dispatch("/query/services", {})
+        meta = doc.get("meta") or {}
+        ok = (
+            status == 200
+            and meta.get("partial") is True
+            and meta.get("shards_answered") == 1
+            and meta.get("shards_total") == 2
+            and not meta.get("shards", {}).get("shard-1", {}).get("ok")
+            and (doc.get("data") or {}).get("services")
+        )
+        return {
+            "partial_answer_ok": bool(ok),
+            "partial_shards_answered": meta.get("shards_answered"),
+        }
+    finally:
+        if aggregator is not None:
+            aggregator.close()
+        if wire is not None:
+            wire.stop()
+        for service in services:
+            service.stop()
+
+
+def _measure_tenant_isolation(config: DetectorConfig, batch: int) -> dict:
+    """Noisy-tenant leg: one tenant floods far past its quota — ONLY
+    its rows shed (anomaly_shed_rows_total{tenant=} isolated), the
+    quiet tenant's rows all admitted."""
+    from .fleet import tenant_of
+
+    det = AnomalyDetector(config)
+    pipe = DetectorPipeline(
+        det, batch_size=batch,
+        tenant_of=lambda name: tenant_of(name, FLEET_TENANTS),
+        tenant_quota_rows_s=500.0,
+    )
+    for svc in FLEET_SERVICES:
+        pipe.tensorizer.service_id(svc)
+    rng = np.random.default_rng(3)
+    # The web tenant floods (frontend), platform stays modest (payment).
+    for _ in range(6):
+        pipe.submit(_fleet_records(rng, "frontend", 400))
+        pipe.submit(_fleet_records(rng, "payment", 40))
+    shed = dict(pipe.stats.shed_rows_tenant)
+    pipe.pump(0.0)
+    pipe.drain()
+    isolated = bool(
+        shed.get("web", 0) > 0 and shed.get("platform", 0) == 0
+    )
+    return {
+        "noisy_tenant_isolated": isolated,
+        "tenant_shed_rows": shed,
+    }
+
+
+def measure_reshard_live(
+    dead_after_s: float = 2.0,
+    batch: int = 128,
+) -> dict:
+    """Live-fire reshard: the victim shard is a REAL daemon subprocess
+    under live Kafka + OTLP load, SIGKILLed mid-stream; an in-proc
+    survivor adopts its replicated frame once membership (heartbeating
+    the victim's real /healthz, with the double-check) declares it
+    dead. ``shard_reshard_ttd_s`` here is the deployment-shaped
+    number: real process death, real health silence, real frame
+    adoption."""
+    import http.client
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+
+    from .fleet import (
+        FleetMembership,
+        http_health_alive,
+        merge_shard_arrays,
+        service_row_mask,
+    )
+    from .kafka_broker import KafkaBroker
+    from .kafka_orders import Order, encode_order
+    from .otlp_export import encode_export_request
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    config = DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+    broker = KafkaBroker()
+    broker.start()
+    broker.ensure_topic("orders")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update({
+        "ANOMALY_OTLP_PORT": "0",
+        "ANOMALY_OTLP_GRPC_PORT": "-1",
+        "ANOMALY_METRICS_PORT": "0",
+        "ANOMALY_BATCH": str(batch),
+        "ANOMALY_PUMP_INTERVAL_S": "0.05",
+        "ANOMALY_NUM_SERVICES": "8",
+        "ANOMALY_CMS_WIDTH": "512",
+        "ANOMALY_HLL_P": "8",
+        "ANOMALY_INGEST_WORKERS": "0",
+        "ANOMALY_ROLE": "primary",
+        "ANOMALY_REPLICATION_PORT": "0",
+        "ANOMALY_REPLICATION_INTERVAL_S": "0.1",
+        "ANOMALY_FLEET_SERVICES": ",".join(FLEET_SERVICES),
+        "KAFKA_ADDR": f"127.0.0.1:{broker.port}",
+    })
+    env.pop("ANOMALY_CHECKPOINT", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "opentelemetry_demo_tpu.runtime.daemon"],
+        cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    mirror = None
+    try:
+        line = None
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            out = proc.stdout.readline()
+            if not out:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"victim shard exited rc={proc.returncode}"
+                    )
+                time.sleep(0.05)
+                continue
+            if "anomaly-detector:" in out:
+                line = out
+                break
+        if not line:
+            raise RuntimeError("victim shard never announced")
+        otlp_port = int(re.search(r"otlp-http :(\d+)", line).group(1))
+        repl_port = int(re.search(r"repl :(\d+)", line).group(1))
+        metrics_port = int(
+            re.search(r"metrics :(\d+)", line).group(1)
+        )
+
+        # Live load on both legs: orders into the broker + spans over
+        # OTLP at the victim.
+        for i in range(8):
+            broker.append("orders", encode_order(Order(
+                order_id=f"ord-{i}", tracking_id=f"trk-{i}",
+                shipping_cost_units=5.0, item_count=1,
+                product_ids=("EYE-PLO-25",), total_quantity=1,
+            )))
+        rng = np.random.default_rng(5)
+        body = encode_export_request(
+            _fleet_records(rng, "payment", 64)
+            + _fleet_records(rng, "frontend", 64)
+        )
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", otlp_port, timeout=10.0
+        )
+        conn.request(
+            "POST", "/v1/traces", body=body,
+            headers={"Content-Type": "application/x-protobuf"},
+        )
+        if conn.getresponse().status != 200:
+            raise RuntimeError("victim refused OTLP load")
+
+        # The survivor's mirror of the victim (its standby, in-proc).
+        mirror_fence = EpochFence(0)
+        mirror = ReplicationStandby(
+            f"127.0.0.1:{repl_port}", mirror_fence,
+            config_fingerprint=list(
+                config._replace(sketch_impl=None)
+            ),
+        )
+        mirror.start()
+        if not mirror.wait_for_state(60.0):
+            raise RuntimeError("mirror never bootstrapped")
+        # Wait until the mirror has actually absorbed the span load.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            arrs, _m = mirror.snapshot()
+            if arrs and float(np.asarray(arrs["span_total"]).sum()) > 0:
+                break
+            time.sleep(0.1)
+
+        # The survivor shard, in-proc, with the SHARED service table.
+        survivor = _Shard("shard-0", config, batch, interval_s=0.1)
+        membership = FleetMembership(
+            "shard-0", ["shard-1"],
+            dead_after_s=dead_after_s, rejoin_after_s=2.0,
+            reshard_budget=4, reshard_refill_s=60.0,
+            # The REAL double-check: the victim's live /healthz.
+            health_check=lambda s: http_health_alive(
+                f"127.0.0.1:{metrics_port}", timeout_s=2.0
+            ),
+        )
+        membership.observe("shard-1")
+
+        # SIGKILL, the real thing, mid-load.
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        t_kill = time.monotonic()
+        events: list = []
+        give_up = t_kill + dead_after_s * 20 + 30.0
+        while time.monotonic() < give_up and not events:
+            events = membership.tick()
+            if not events:
+                time.sleep(0.05)
+        if not events:
+            raise RuntimeError("membership never declared the victim dead")
+        mirror_arrays, mirror_meta = mirror.snapshot()
+        dst = survivor.arrays()
+        mask = service_row_mask(
+            list(mirror_meta.get("service_names") or []),
+            survivor.pipe.tensorizer.service_names,
+            int(dst["lat_mean"].shape[0]),
+        )
+        merged = merge_shard_arrays(dst, mirror_arrays, mask)
+        meta = {
+            "service_names": list(FLEET_SERVICES),
+            "config": list(config._replace(sketch_impl=None)),
+        }
+        docs = _query_docs(merged, meta, ["payment", "frontend"])
+        ttd_s = time.monotonic() - t_kill
+        answered = all(
+            max(d["cardinality"]["estimate"]) > 0.0
+            for d in docs.values()
+        )
+        # Adoption exactness, pinned INDEPENDENTLY of the merge
+        # implementation: the survivor ingested nothing in this leg,
+        # so the post-merge answers for the victim's services must
+        # equal the answers computed from the mirror frame ALONE —
+        # the unkilled witness for the live leg. (Recomputing the
+        # max/add here would just re-run merge_shard_arrays' own
+        # arithmetic and could never fail.)
+        witness_docs = _query_docs(
+            mirror_arrays, meta, ["payment", "frontend"]
+        )
+        exact = docs == witness_docs
+        survivor.stop()
+        return {
+            "live_sigkill_ttd_s": round(ttd_s, 4),
+            "live_survivor_answers": answered,
+            "live_adoption_exact": exact,
+            "live_reshards_applied": membership.reshards_total,
+        }
+    finally:
+        if mirror is not None:
+            mirror.stop()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=15)
+        broker.stop()
+
+
 def main() -> None:
     import json
+    import sys
 
+    if "--fleet" in sys.argv[1:]:
+        out = measure_reshard()
+        # The live-fire SIGKILL leg (slow: a real daemon subprocess
+        # boots + compiles); skip with --no-live for quick iterations.
+        if "--no-live" not in sys.argv[1:]:
+            out.update(measure_reshard_live())
+            out["fleet_ok"] = bool(
+                out["fleet_ok"]
+                and out["live_survivor_answers"]
+                and out["live_adoption_exact"]
+            )
+        print(json.dumps(out))
+        return
     print(json.dumps(measure_failover()))
 
 

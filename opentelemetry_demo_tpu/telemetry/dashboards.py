@@ -294,6 +294,36 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("quantile",
                             "anomaly_time_to_mitigate_seconds_bucket",
                             q=0.99), "s"),
+                # Sharded fleet (runtime.fleet + runtime.aggregator):
+                # live member count vs N, the ring digest every shard
+                # should agree on (disagreement = split), applied vs
+                # REFUSED reshards (a refusal burst = a flapping shard
+                # hitting the frozen-ring guardrail), each shard's own
+                # ingest rate, and the per-tenant quota shed that
+                # proves one noisy tenant browns out alone.
+                Panel("Fleet shards live",
+                      Query("instant", "anomaly_fleet_shards_live"),
+                      "shards"),
+                Panel("Fleet ring version (split check)",
+                      Query("instant", "anomaly_fleet_ring_version"),
+                      "digest"),
+                Panel("Reshards applied",
+                      Query("rate", "anomaly_reshards_total"),
+                      "reshards/s"),
+                Panel("Reshards refused (budget exhausted)",
+                      Query("rate", "anomaly_reshards_refused_total"),
+                      "refusals/s"),
+                Panel("Fleet ring frozen",
+                      Query("instant", "anomaly_fleet_ring_frozen"),
+                      "bool"),
+                Panel("Per-shard ingest rate",
+                      Query("rate",
+                            "anomaly_fleet_shard_ingest_spans_total",
+                            by=("shard",)), "spans/s"),
+                Panel("Tenant-quota shed by tenant",
+                      Query("rate", "anomaly_shed_rows_total",
+                            matchers={"cause": "tenant-quota"},
+                            by=("tenant",)), "rows/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

@@ -218,7 +218,7 @@ ANOMALY_CHECKPOINT_CORRUPT = "anomaly_checkpoint_corrupt_total"
 # memory_limiter + sending_queue analogue; runtime.pipeline): the
 # flow-control loop is only trustworthy if every shed/throttle/backoff
 # decision leaves a number behind.
-ANOMALY_SHED_ROWS = "anomaly_shed_rows_total"  # {lane=, cause=}
+ANOMALY_SHED_ROWS = "anomaly_shed_rows_total"  # {lane=, cause=} (+ tenant= on the per-tenant quota shed)
 ANOMALY_QUEUE_ROWS = "anomaly_queue_rows"
 ANOMALY_QUEUE_WATERMARK = "anomaly_queue_watermark_rows"  # {mark=high|low}
 ANOMALY_BROWNOUT_LEVEL = "anomaly_brownout_level"
@@ -320,6 +320,18 @@ ANOMALY_MITIGATION_VERIFIED = "anomaly_mitigation_verified_total"
 ANOMALY_MITIGATION_FAILED = "anomaly_mitigation_failed_total"
 ANOMALY_MITIGATION_ACTIVE = "anomaly_mitigation_active"
 ANOMALY_TIME_TO_MITIGATE = "anomaly_time_to_mitigate_seconds"  # histogram
+# Sharded detector fleet (runtime.fleet membership + guardrailed
+# reshard; runtime.aggregator scatter-gather reads): who is on the
+# ring, how often the keyspace moved, how often a move was REFUSED by
+# the reshard budget (a flapping shard exhausting its bucket freezes
+# the ring — refusals are the audit trail), and each shard's own
+# ingest rate (the per-shard panel beside the fleet-global view).
+ANOMALY_FLEET_SHARDS_LIVE = "anomaly_fleet_shards_live"
+ANOMALY_FLEET_RING_VERSION = "anomaly_fleet_ring_version"
+ANOMALY_FLEET_FROZEN = "anomaly_fleet_ring_frozen"
+ANOMALY_RESHARDS = "anomaly_reshards_total"
+ANOMALY_RESHARDS_REFUSED = "anomaly_reshards_refused_total"
+ANOMALY_FLEET_SHARD_SPANS = "anomaly_fleet_shard_ingest_spans_total"  # {shard=}
 
 
 def export_metrics_report(

@@ -600,6 +600,121 @@ REMEDIATION_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Sharded-fleet knobs (runtime.fleet: consistent-hash keyspace
+# partitioning over (service × tenant) keys, heartbeat membership with
+# guardrailed reshard; runtime.aggregator: the scatter-gather read tier
+# behind the existing /query/* API). Same ONE-registry discipline as
+# every other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+FLEET_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_FLEET_SHARDS": (
+        "int", 0,
+        "detector shard count N (0/1 = fleet off: the classic single "
+        "primary + hot standby deployment); each shard is a FULL "
+        "daemon — its own epoch fence, standby, history, remediation "
+        "gating — consuming only its assigned Kafka partitions / "
+        "OTLP-routed slice of the keyspace",
+    ),
+    "ANOMALY_FLEET_SHARD_INDEX": (
+        "int", 0,
+        "this shard's index in 0..N-1 (its ring member id is "
+        "shard-<index>); Kafka partition assignment and the "
+        "collector's OTLP routing key off the same index",
+    ),
+    "ANOMALY_FLEET_PEERS": (
+        "str", "",
+        "comma list of PEER health addresses host:metrics_port, "
+        "index-aligned with the shard indices (this shard's own entry "
+        "may be present and is skipped): the membership heartbeat "
+        "polls each peer's /healthz on this address",
+    ),
+    "ANOMALY_FLEET_QUERY_PEERS": (
+        "str", "",
+        "comma list of shard QUERY-plane addresses host:query_port, "
+        "index-aligned like ANOMALY_FLEET_PEERS: the aggregator tier "
+        "fans /query/* out to these and merges the shard frames",
+    ),
+    "ANOMALY_FLEET_VNODES": (
+        "int", 128,
+        "virtual nodes per shard on the consistent-hash ring: more "
+        "vnodes = tighter balance (the fleet suite pins the balance "
+        "bound at this default) at O(N*vnodes) ring-build cost",
+    ),
+    "ANOMALY_FLEET_SERVICES": (
+        "str", "",
+        "comma list of service names PRE-INTERNED in this exact order "
+        "on every shard at boot — the shared service-id table that "
+        "makes cross-shard monoid merges (reshard frame adoption) "
+        "bit-exact: CMS cells fold the service id into the key hash, "
+        "so shards whose intern tables drift cannot exchange frames; "
+        "empty = dynamic interning (single-shard behavior)",
+    ),
+    "ANOMALY_FLEET_HEARTBEAT_S": (
+        "float", 1.0,
+        "membership heartbeat cadence seconds (one /healthz poll per "
+        "peer per tick)",
+    ),
+    "ANOMALY_FLEET_DEAD_AFTER_S": (
+        "float", 3.0,
+        "hysteresis, down edge: heartbeat silence seconds before a "
+        "peer is DECLARED dead and its key range reassigned — but "
+        "only after the health double-check below also fails (a "
+        "compile-stalled-but-serving shard is not dead)",
+    ),
+    "ANOMALY_FLEET_REJOIN_AFTER_S": (
+        "float", 5.0,
+        "hysteresis, up edge: a dead peer must answer heartbeats "
+        "continuously for this long before it REJOINS the ring (a "
+        "flapping shard cannot thrash the keyspace on every blip)",
+    ),
+    "ANOMALY_FLEET_RESHARD_BUDGET": (
+        "int", 4,
+        "token-bucket capacity on ring membership changes: a flapping "
+        "shard exhausts the bucket and the ring FREEZES in its last "
+        "state (reshards refused + counted) instead of thrashing — "
+        "the PR 2 brownout-ladder / PR 13 actuation-budget guardrail "
+        "construction",
+    ),
+    "ANOMALY_FLEET_RESHARD_REFILL_S": (
+        "float", 60.0,
+        "seconds per reshard-budget token refill: the sustained "
+        "membership-change rate ceiling, 1 reshard per this many "
+        "seconds",
+    ),
+    "ANOMALY_FLEET_TENANTS": (
+        "str", "",
+        "per-tenant sketch namespaces: a comma map "
+        "'service:tenant[,*:tenant]' assigning every service to a "
+        "tenant ('*' is the default for unlisted services; absent = "
+        "tenant 'default') — ring keys are tenant/service, and the "
+        "per-tenant quota below sheds one noisy tenant's rows alone "
+        "(anomaly_shed_rows_total{tenant=})",
+    ),
+    "ANOMALY_FLEET_TENANT_QUOTA_ROWS_S": (
+        "float", 0.0,
+        "per-tenant admission quota in rows/second (token bucket, 1 s "
+        "burst), folded into the backpressure ladder AHEAD of the "
+        "global row budget: a tenant over quota has its OK-lane rows "
+        "shed (error lane always passes) while other tenants' "
+        "admission and TTD are untouched; 0 = no per-tenant quota",
+    ),
+    "ANOMALY_AGGREGATOR_PORT": (
+        "int", -1,
+        "scatter-gather aggregator HTTP port (the fleet-global "
+        "/query/* surface; runtime.aggregator main): -1 = this "
+        "process serves no aggregator, 0 = ephemeral",
+    ),
+    "ANOMALY_AGGREGATOR_TIMEOUT_S": (
+        "float", 1.0,
+        "per-shard fan-out timeout seconds: a shard that cannot "
+        "answer within this is annotated missing and the merged "
+        "answer degrades to a labeled PARTIAL result "
+        "(shards_answered/shards_total) — never a crashed query",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -610,6 +725,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
+    "FLEET_KNOBS",
 )
 
 
@@ -689,6 +805,13 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "0 skips the closed-loop mitigation bench (runtime.mitigbench:"
         " time-to-mitigate beside time-to-detect per flagd scenario, "
         "rollback drill, no-oscillation gate over a long clean run)",
+    ),
+    "BENCH_FLEET": (
+        "int", 1,
+        "0 skips the sharded-fleet reshard drill (runtime.replbench "
+        "measure_reshard: kill a shard beside an unkilled witness "
+        "fleet, reshard TTD, witness-pinned bit-exact answers, "
+        "blackholed-shard partial answers, noisy-tenant isolation)",
     ),
 }
 
@@ -1006,6 +1129,144 @@ def remediation_config() -> dict[str, int | float | str]:
             "ANOMALY_REMEDIATION_TIMEOUT_S="
             f"{out['ANOMALY_REMEDIATION_TIMEOUT_S']} must be > 0"
         )
+    return out
+
+
+def fleet_tenant_map(raw) -> dict[str, str]:
+    """Parsed ``{service: tenant}`` from the raw
+    ``ANOMALY_FLEET_TENANTS`` knob value — the ONE parse, shared by
+    :func:`fleet_config`'s validator, the daemon and the fleet/
+    aggregator tiers (the same no-drift rule as
+    :func:`history_ladder`). ``'*'`` names the default tenant for
+    unlisted services; an empty knob means every service is tenant
+    ``'default'``. Raises ``ConfigError`` on malformed entries."""
+    text = str(raw).strip()
+    out: dict[str, str] = {}
+    if not text:
+        return out
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ConfigError(
+                f"ANOMALY_FLEET_TENANTS entry {part!r} is not "
+                "'service:tenant'"
+            )
+        name, tenant = part.rsplit(":", 1)
+        name, tenant = name.strip(), tenant.strip()
+        if not name or not tenant:
+            raise ConfigError(
+                f"ANOMALY_FLEET_TENANTS entry {part!r} has an empty "
+                "service or tenant name"
+            )
+        if "/" in tenant or "/" in name:
+            # '/' is the ring-key separator (tenant/service): letting
+            # it into either side would let two different (tenant,
+            # service) pairs collide on one ring key.
+            raise ConfigError(
+                f"ANOMALY_FLEET_TENANTS entry {part!r} contains '/' "
+                "(reserved as the ring-key separator)"
+            )
+        out[name] = tenant
+    if not out:
+        raise ConfigError(
+            f"ANOMALY_FLEET_TENANTS={text!r} parsed to an empty map"
+        )
+    return out
+
+
+def fleet_config() -> dict[str, int | float | str]:
+    """Resolve every FLEET_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the fleet shape —
+    an index outside 0..N-1, a zero heartbeat, inverted hysteresis
+    edges or an empty reshard budget could thrash or split the ring
+    and must refuse to boot instead."""
+    out = _resolve(FLEET_KNOBS)
+    shards = int(out["ANOMALY_FLEET_SHARDS"])
+    if shards < 0:
+        raise ConfigError(
+            f"ANOMALY_FLEET_SHARDS={shards} must be >= 0"
+        )
+    if shards > 1:
+        index = int(out["ANOMALY_FLEET_SHARD_INDEX"])
+        if not 0 <= index < shards:
+            raise ConfigError(
+                f"ANOMALY_FLEET_SHARD_INDEX={index} outside "
+                f"0..{shards - 1}"
+            )
+        # The peer lists are index-aligned: fewer entries than shards
+        # means some member can never be heartbeated (or queried) —
+        # every shard would build a partial ring and believe it owns
+        # keyspace it doesn't: a SILENT permanent ring split, the one
+        # failure mode this validator exists to refuse.
+        peers = [
+            a for a in str(out["ANOMALY_FLEET_PEERS"]).split(",")
+            if a.strip()
+        ]
+        if len(peers) < shards:
+            raise ConfigError(
+                f"ANOMALY_FLEET_PEERS lists {len(peers)} address(es) "
+                f"for ANOMALY_FLEET_SHARDS={shards}: every shard "
+                "index needs its health address (index-aligned)"
+            )
+        if int(out["ANOMALY_AGGREGATOR_PORT"]) >= 0:
+            qpeers = [
+                a
+                for a in str(out["ANOMALY_FLEET_QUERY_PEERS"]).split(",")
+                if a.strip()
+            ]
+            if len(qpeers) < shards:
+                raise ConfigError(
+                    "ANOMALY_FLEET_QUERY_PEERS lists "
+                    f"{len(qpeers)} address(es) for "
+                    f"ANOMALY_FLEET_SHARDS={shards}: the aggregator "
+                    "needs every shard's query address (index-aligned)"
+                )
+    if int(out["ANOMALY_FLEET_VNODES"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_FLEET_VNODES={out['ANOMALY_FLEET_VNODES']} "
+            "must be >= 1"
+        )
+    if float(out["ANOMALY_FLEET_HEARTBEAT_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_FLEET_HEARTBEAT_S="
+            f"{out['ANOMALY_FLEET_HEARTBEAT_S']} must be > 0"
+        )
+    if float(out["ANOMALY_FLEET_DEAD_AFTER_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_FLEET_DEAD_AFTER_S="
+            f"{out['ANOMALY_FLEET_DEAD_AFTER_S']} must be > 0"
+        )
+    if float(out["ANOMALY_FLEET_REJOIN_AFTER_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_FLEET_REJOIN_AFTER_S="
+            f"{out['ANOMALY_FLEET_REJOIN_AFTER_S']} must be > 0"
+        )
+    if int(out["ANOMALY_FLEET_RESHARD_BUDGET"]) < 1:
+        raise ConfigError(
+            "ANOMALY_FLEET_RESHARD_BUDGET="
+            f"{out['ANOMALY_FLEET_RESHARD_BUDGET']} must be >= 1"
+        )
+    if float(out["ANOMALY_FLEET_RESHARD_REFILL_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_FLEET_RESHARD_REFILL_S="
+            f"{out['ANOMALY_FLEET_RESHARD_REFILL_S']} must be > 0"
+        )
+    if float(out["ANOMALY_FLEET_TENANT_QUOTA_ROWS_S"]) < 0:
+        raise ConfigError(
+            "ANOMALY_FLEET_TENANT_QUOTA_ROWS_S="
+            f"{out['ANOMALY_FLEET_TENANT_QUOTA_ROWS_S']} must be >= 0"
+        )
+    if float(out["ANOMALY_AGGREGATOR_TIMEOUT_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_AGGREGATOR_TIMEOUT_S="
+            f"{out['ANOMALY_AGGREGATOR_TIMEOUT_S']} must be > 0"
+        )
+    # Tenant map: validate the shape here (the parse the daemon and
+    # the fleet tier reuse) — a map nobody can apply must refuse to
+    # boot.
+    fleet_tenant_map(out["ANOMALY_FLEET_TENANTS"])
     return out
 
 

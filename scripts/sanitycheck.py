@@ -168,6 +168,7 @@ def main() -> int:
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
                         "HISTORY_KNOBS", "REMEDIATION_KNOBS",
+                        "FLEET_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -176,7 +177,7 @@ def main() -> int:
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
-        "REMEDIATION_KNOBS",
+        "REMEDIATION_KNOBS", "FLEET_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -600,6 +601,77 @@ def main() -> int:
             "test_torn_flag_file_write_never_corrupts_live_store" in fut,
             "flag suite pins the torn-write regression",
         )
+
+    # 11) sharded detector fleet (runtime/fleet.py ring + membership +
+    #     guardrailed reshard; runtime/aggregator.py scatter-gather):
+    #     the aggregator NEVER touches detector state (the query-plane
+    #     no-direct-read discipline, pinned the same grep way), the
+    #     ring's placement hash is process-stable (no hash()), the
+    #     Makefile has the fleetbench drill, and the fleet suite pins
+    #     the property/chaos proofs.
+    fleet_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "fleet.py"
+    )
+    check(os.path.exists(fleet_py), "runtime/fleet.py exists")
+    if os.path.exists(fleet_py):
+        fleet_text = open(fleet_py).read()
+        for marker in (
+            "class HashRing", "class FleetMembership",
+            "def merge_shard_arrays", "def key_hash64",
+            "def shard_key", "TokenBucket", "health_check",
+        ):
+            check(marker in fleet_text, f"runtime/fleet.py declares {marker}")
+        check(
+            "blake2b" in fleet_text,
+            "fleet.py hashes ring keys with a process-stable digest",
+        )
+    agg_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "aggregator.py"
+    )
+    check(os.path.exists(agg_py), "runtime/aggregator.py exists")
+    if os.path.exists(agg_py):
+        agg_text = open(agg_py).read()
+        for marker in (
+            "class FleetAggregator", "class AggregatorService",
+            "shards_answered", "shards_total",
+        ):
+            check(marker in agg_text, f"runtime/aggregator.py declares {marker}")
+        check(
+            "detector.state" not in agg_text
+            and "_dispatch_lock" not in agg_text
+            and "snapshot_fn" not in agg_text,
+            "aggregator.py reads shards only over HTTP (no detector "
+            "state / dispatch lock / snapshot helper reference)",
+        )
+    check(
+        "fleetbench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has a fleetbench target",
+    )
+    check(
+        "fleet:" in pyproject,
+        "pyproject registers the fleet marker",
+    )
+    check(
+        "def measure_reshard" in open(os.path.join(
+            ROOT, "opentelemetry_demo_tpu", "runtime", "replbench.py"
+        )).read(),
+        "replbench.py grows the shard-kill -> reshard drill",
+    )
+    fleet_tests = os.path.join(ROOT, "tests", "test_fleet.py")
+    check(os.path.exists(fleet_tests), "tests/test_fleet.py exists")
+    if os.path.exists(fleet_tests):
+        fttext = open(fleet_tests).read()
+        for marker in (
+            "test_ring_balance_within_bound",
+            "test_minimal_key_movement_on_leave_and_join",
+            "test_placement_deterministic_across_processes",
+            "test_flapping_shard_freezes_ring_within_budget",
+            "test_stalled_but_serving_shard_not_declared_dead",
+            "test_blackholed_shard_degrades_to_labeled_partial",
+            "test_noisy_tenant_sheds_alone",
+            "test_reshard_converges_bit_exact",
+        ):
+            check(marker in fttext, f"fleet suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -189,14 +189,22 @@ class TestManifests:
 
     def test_yaml_round_trip(self, tmp_path):
         paths = k8s.write_manifests(str(tmp_path))
-        # 3 aggregates (full, minimal, sidecar) + one file per component.
-        assert len(paths) == 3 + len(k8s.component_bundles())
+        # 3 aggregates (full, minimal, sidecar) + one file per
+        # component + the component-only fleet aggregator bundle.
+        assert len(paths) == 3 + len(k8s.component_bundles()) + 1
         for p in paths:
             docs = list(yaml.safe_load_all(open(p)))
             assert all("apiVersion" in d and "kind" in d for d in docs)
         names = {p.split("/")[-1] for p in paths}
         assert {"kafka.yaml", "shop-gateway.yaml", "anomaly-detector.yaml",
-                "load-generator.yaml"} <= names
+                "load-generator.yaml", "anomaly-aggregator.yaml"} <= names
+        # The fleet tier is component-only: a default aggregator
+        # (SHARDS=0) in the standalone stack would just crash-loop.
+        standalone = {
+            d["metadata"]["name"]
+            for d in k8s.standalone_stack() if d["kind"] == "Deployment"
+        }
+        assert "anomaly-aggregator" not in standalone
 
     def test_flagd_configmap_carries_real_flags(self):
         cm = k8s._flagd_configmap()

@@ -1,0 +1,936 @@
+"""Sharded detector fleet: ring properties, membership guardrails,
+scatter-gather degradation, tenant isolation, reshard bit-exactness.
+
+The acceptance bars this suite proves (ISSUE 14):
+
+- **Ring properties** (``TestHashRing``): balance within bound at
+  N∈{2,4,8}, minimal key movement on join/leave (moved/total ≈ 1/N,
+  and ONLY the victim's keys move on a leave), deterministic placement
+  across processes with different ``PYTHONHASHSEED`` (no ``hash()``
+  randomization).
+- **Membership guardrails** (``TestMembership``): a flapping shard
+  causes at most BUDGET reshards and then a FROZEN ring; a
+  compile-stalled-but-serving shard is never declared dead (the PR 13
+  primary-health double-check pattern — the CI flake guard); rejoin
+  requires sustained heartbeats.
+- **Partial answers** (``TestAggregator``): one shard blackholed /
+  RST via runtime.faultwire → the fleet ``/query/*`` answer comes
+  back 200, labeled ``shards_answered/shards_total`` with the missing
+  shard annotated — never a 5xx for a partial loss.
+- **Noisy tenant** (``TestTenantQuota``): a tenant flooding past its
+  quota sheds ONLY its own OK-lane rows
+  (``anomaly_shed_rows_total{tenant=}`` isolated); the error lane and
+  other tenants are untouched.
+- **Reshard** (``test_reshard_converges_bit_exact``): the full
+  shard-kill drill — membership declares the victim dead, survivors
+  adopt its replicated frame by monoid merge, and every post-reshard
+  answer for the victim's keys is BIT-EXACT against an unkilled
+  witness fleet.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime.aggregator import (
+    AggregatorService,
+    FleetAggregator,
+)
+from opentelemetry_demo_tpu.runtime.faultwire import FaultWire
+from opentelemetry_demo_tpu.runtime.fleet import (
+    FleetMember,
+    FleetMembership,
+    HashRing,
+    ShardMergeError,
+    key_hash64,
+    merge_shard_arrays,
+    parse_peer_list,
+    service_row_mask,
+    shard_key,
+    tenant_of,
+)
+from opentelemetry_demo_tpu.runtime.query import QueryEngine, QueryService
+from opentelemetry_demo_tpu.utils.config import (
+    ConfigError,
+    fleet_config,
+    fleet_tenant_map,
+)
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _keys(n: int = 4000) -> list[str]:
+    return [shard_key(f"svc-{i}", f"tenant-{i % 7}") for i in range(n)]
+
+
+# --- consistent-hash ring properties ----------------------------------
+
+
+class TestHashRing:
+    def test_ring_balance_within_bound(self):
+        """At the default vnode count every member owns a fair share:
+        max/ideal ≤ 1.45 for N ∈ {2, 4, 8} over 4000 keys."""
+        keys = _keys()
+        for n in (2, 4, 8):
+            ring = HashRing(
+                [f"shard-{i}" for i in range(n)], vnodes=128
+            )
+            spread = ring.spread(keys)
+            ideal = len(keys) / n
+            assert len(spread) == n
+            assert max(spread.values()) <= 1.45 * ideal, (n, spread)
+            assert min(spread.values()) >= 0.55 * ideal, (n, spread)
+
+    def test_minimal_key_movement_on_leave_and_join(self):
+        """Consistent hashing's whole point: a leave moves EXACTLY the
+        victim's keys (everyone else's owner is untouched), a join
+        moves ≈ 1/N of the keyspace and only TO the joiner."""
+        keys = _keys()
+        for n in (2, 4, 8):
+            members = [f"shard-{i}" for i in range(n)]
+            ring = HashRing(members, vnodes=128)
+            before = ring.assignments(keys)
+            victim = members[n // 2]
+            ring.remove(victim)
+            after = ring.assignments(keys)
+            moved = [k for k in keys if before[k] != after[k]]
+            assert all(before[k] == victim for k in moved)
+            assert len(moved) == sum(
+                1 for k in keys if before[k] == victim
+            )
+            # Join: only keys moving TO the joiner change owner, and
+            # the moved fraction is ≈ 1/N of the keyspace.
+            ring.add(victim)
+            rejoined = ring.assignments(keys)
+            assert rejoined == before  # same members = same placement
+            joiner = "shard-new"
+            ring.add(joiner)
+            grown = ring.assignments(keys)
+            moved = [k for k in keys if before[k] != grown[k]]
+            assert all(grown[k] == joiner for k in moved)
+            frac = len(moved) / len(keys)
+            assert 0.4 / (n + 1) <= frac <= 1.8 / (n + 1), (n, frac)
+
+    def test_placement_deterministic_across_processes(self):
+        """The ring must place identically in a fresh interpreter with
+        a DIFFERENT hash seed — blake2b, not hash(), owns placement
+        (a randomized ring would reshard the fleet on every restart)."""
+        keys = _keys(256)
+        ring = HashRing(["a", "b", "c"], vnodes=64)
+        local = json.dumps(ring.assignments(keys), sort_keys=True)
+        code = (
+            "import json\n"
+            "from opentelemetry_demo_tpu.runtime.fleet import "
+            "HashRing, shard_key\n"
+            "keys = [shard_key(f'svc-{i}', f'tenant-{i % 7}') "
+            "for i in range(256)]\n"
+            "ring = HashRing(['a', 'b', 'c'], vnodes=64)\n"
+            "print(json.dumps(ring.assignments(keys), sort_keys=True))\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = "12345"  # adversarial seed
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert out.stdout.strip() == local
+
+    def test_ring_version_tracks_membership(self):
+        ring = HashRing(["a", "b"], vnodes=16)
+        v0 = ring.version()
+        assert v0 == HashRing(["b", "a"], vnodes=16).version()
+        ring.remove("b")
+        assert ring.version() != v0
+        ring.add("b")
+        assert ring.version() == v0
+        # vnode count is part of the identity: a fleet mixing vnode
+        # configs would place keys differently while "agreeing".
+        assert HashRing(["a", "b"], vnodes=32).version() != v0
+
+    def test_key_hash_is_stable_literal(self):
+        """Pin one literal digest: a silent hash-function change would
+        move every key in every deployed fleet on upgrade — that must
+        be a test failure someone reads, not a surprise reshard."""
+        assert key_hash64("tenant/service") == int.from_bytes(
+            __import__("hashlib").blake2b(
+                b"tenant/service", digest_size=8
+            ).digest(), "big",
+        )
+
+
+# --- membership guardrails --------------------------------------------
+
+
+class TestMembership:
+    def test_flapping_shard_freezes_ring_within_budget(self):
+        """A flapping peer spends the reshard budget and then the ring
+        FREEZES: ≤ budget membership changes EVER (until refill), the
+        refusals counted, the last ring state held."""
+        budget = 3
+        m = FleetMembership(
+            "self", ["flappy"],
+            dead_after_s=0.02, rejoin_after_s=0.02,
+            reshard_budget=budget, reshard_refill_s=3600.0,
+            health_check=lambda s: False,
+        )
+        t = 100.0
+        applied = []
+        for _ in range(40):  # many flap cycles
+            # silence past the dead edge
+            t += 0.05
+            applied += m.tick(t)
+            # comeback: sustained beats past the rejoin edge
+            for _ in range(4):
+                t += 0.01
+                m.observe("flappy", t)
+                applied += m.tick(t)
+        assert len(applied) <= budget
+        assert m.reshards_total <= budget
+        assert m.reshards_refused >= 1
+        assert m.frozen
+        frozen_version = m.ring.version()
+        t += 0.05
+        m.tick(t)
+        assert m.ring.version() == frozen_version  # held, not thrashed
+
+    def test_stalled_but_serving_shard_not_declared_dead(self):
+        """The CI flake guard (the PR 13 primary-health double-check
+        reused): heartbeats stall past the dead edge but the peer's
+        health surface still ANSWERS — the watchdog is credited and
+        the keyspace stays put. No spurious reshard mid-drill."""
+        serving = {"peer": True}
+        m = FleetMembership(
+            "self", ["peer"],
+            dead_after_s=0.02, rejoin_after_s=0.1,
+            reshard_budget=4, reshard_refill_s=3600.0,
+            health_check=lambda s: serving[s],
+        )
+        t = 10.0
+        m.observe("peer", t)
+        for _ in range(10):
+            t += 0.05  # silent past the edge, every tick
+            events = m.tick(t)
+            assert events == []
+        assert m.reshards_total == 0
+        assert "peer" in m.ring.members()
+        # The double-check failing too IS death.
+        serving["peer"] = False
+        t += 0.05
+        events = m.tick(t)
+        assert [e["op"] for e in events] == ["leave"]
+        assert "peer" not in m.ring.members()
+
+    def test_rejoin_requires_sustained_heartbeats(self):
+        """The up edge has hysteresis too: a dead peer must beat
+        continuously for rejoin_after_s before the ring takes it
+        back — one blip of life does not move the keyspace."""
+        m = FleetMembership(
+            "self", ["peer"],
+            dead_after_s=0.02, rejoin_after_s=0.5,
+            reshard_budget=8, reshard_refill_s=3600.0,
+            health_check=lambda s: False,
+        )
+        t = 5.0
+        m.observe("peer", t)
+        t += 0.1
+        assert [e["op"] for e in m.tick(t)] == ["leave"]
+        # One beat, then check immediately: not sustained yet.
+        m.observe("peer", t)
+        t += 0.01
+        assert m.tick(t) == []
+        # Sustained beats for the full rejoin window: back in.
+        for _ in range(60):
+            t += 0.01
+            m.observe("peer", t)
+            events = m.tick(t)
+            if events:
+                break
+        assert [e["op"] for e in events] == ["join"]
+        assert "peer" in m.ring.members()
+
+    def test_snapshot_shape(self):
+        m = FleetMembership("shard-0", ["shard-1", "shard-2"])
+        snap = m.snapshot()
+        assert snap["shard"] == "shard-0"
+        assert snap["shards_total"] == 3
+        assert snap["shards_live"] == 3
+        assert set(snap["peers"]) == {"shard-1", "shard-2"}
+        assert snap["reshards_total"] == 0
+        assert snap["frozen"] is False
+        assert snap["ring_version"] == m.ring.version()
+
+    def test_parse_peer_list_skips_self(self):
+        out = parse_peer_list("a:1, b:2 ,c:3", shards=3, self_index=1)
+        assert out == {"shard-0": "a:1", "shard-2": "c:3"}
+        assert parse_peer_list("a:1,b:2", shards=2, self_index=-1) == {
+            "shard-0": "a:1", "shard-1": "b:2",
+        }
+
+
+# --- reshard merge -----------------------------------------------------
+
+
+def _bank_arrays(seed: int, s: int = 4) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "hll_bank": rng.integers(
+            0, 20, (3, 2, s, 16), dtype=np.int32
+        ),
+        "cms_bank": rng.integers(
+            0, 50, (3, 2, 2, 32), dtype=np.int32
+        ),
+        "span_total": rng.random((3, 2)).astype(np.float32),
+        "lat_mean": rng.random((s, 3)).astype(np.float32),
+        "cusum": rng.random((s, 3)).astype(np.float32),
+        "obs_batches": rng.random(s).astype(np.float32),
+        "step_idx": np.int32(seed),
+    }
+
+
+class TestMergeShardArrays:
+    def test_merge_monoids_bit_exact(self):
+        dst, src = _bank_arrays(1), _bank_arrays(2)
+        mask = np.array([False, True, False, True])
+        out = merge_shard_arrays(dst, src, mask)
+        assert (
+            out["hll_bank"] == np.maximum(
+                dst["hll_bank"], src["hll_bank"]
+            )
+        ).all()
+        assert (
+            out["cms_bank"] == dst["cms_bank"] + src["cms_bank"]
+        ).all()
+        assert np.allclose(
+            out["span_total"], dst["span_total"] + src["span_total"]
+        )
+        for name in ("lat_mean", "cusum", "obs_batches"):
+            assert (out[name][mask] == src[name][mask]).all()
+            assert (out[name][~mask] == dst[name][~mask]).all()
+        assert int(out["step_idx"]) == 2
+        # Inputs untouched (the caller swaps under its own lock).
+        assert int(dst["step_idx"]) == 1
+
+    def test_geometry_mismatch_refused(self):
+        dst, src = _bank_arrays(1), _bank_arrays(2, s=6)
+        with pytest.raises(ShardMergeError):
+            merge_shard_arrays(dst, src, np.ones(4, bool))
+
+    def test_drifted_service_tables_refused(self):
+        """CMS cells bake the service id into the key hash: a frame
+        from a shard whose intern table disagrees CANNOT merge — it is
+        refused loudly, never mis-attributed silently."""
+        with pytest.raises(ShardMergeError):
+            service_row_mask(["a", "b"], ["a", "x"], 4)
+        mask = service_row_mask(
+            ["a", "b", "c"], ["a", "b"], 4, owned=["a", "c"]
+        )
+        assert mask.tolist() == [True, False, True, False]
+
+
+# --- per-tenant quota (pipeline integration) ---------------------------
+
+
+TENANTS = {"frontend": "web", "cart": "web", "payment": "platform"}
+
+
+class TestTenantQuota:
+    @pytest.fixture(scope="class")
+    def pipe(self):
+        from opentelemetry_demo_tpu.models import (
+            AnomalyDetector,
+            DetectorConfig,
+        )
+        from opentelemetry_demo_tpu.runtime.pipeline import (
+            DetectorPipeline,
+        )
+
+        det = AnomalyDetector(
+            DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        )
+        pipe = DetectorPipeline(
+            det, batch_size=256,
+            tenant_of=lambda name: tenant_of(name, TENANTS),
+            tenant_quota_rows_s=200.0,
+        )
+        for svc in TENANTS:
+            pipe.tensorizer.service_id(svc)
+        yield pipe
+        pipe.close()
+
+    def _records(self, service: str, n: int, error: bool = False):
+        from opentelemetry_demo_tpu.runtime.tensorize import SpanRecord
+
+        rng = np.random.default_rng(n)
+        return [
+            SpanRecord(
+                service=service, duration_us=300.0,
+                trace_id=rng.bytes(8), is_error=error, attr="k",
+            )
+            for _ in range(n)
+        ]
+
+    def test_noisy_tenant_sheds_alone(self, pipe):
+        """The web tenant floods 10× its bucket; platform trickles.
+        ONLY web rows shed (per-tenant counter isolated), and every
+        platform row is admitted — its TTD inputs are untouched."""
+        pending0 = pipe.pending_rows()
+        for _ in range(5):
+            pipe.submit(self._records("frontend", 800))
+            pipe.submit(self._records("payment", 30))
+        shed = dict(pipe.stats.shed_rows_tenant)
+        assert shed.get("web", 0) > 0
+        assert shed.get("platform", 0) == 0
+        # Every platform row admitted: 5×30, on top of web's quota cut.
+        admitted = pipe.pending_rows() - pending0
+        web_in = 5 * 800 - shed["web"]
+        assert admitted == web_in + 5 * 30
+
+    def test_error_lane_never_shed_by_quota(self, pipe):
+        """SHED_LANES discipline holds for the quota too: a flood of
+        ERROR rows passes whole — incident evidence is not droppable
+        telemetry, whatever the tenant's budget says."""
+        shed0 = dict(pipe.stats.shed_rows_tenant)
+        pending0 = pipe.pending_rows()
+        pipe.submit(self._records("cart", 900, error=True))
+        assert pipe.pending_rows() - pending0 == 900
+        assert dict(pipe.stats.shed_rows_tenant).get(
+            "web", 0
+        ) == shed0.get("web", 0)
+        assert pipe.stats.shed_rows["error"] == 0
+
+
+# --- scatter-gather aggregator -----------------------------------------
+
+
+def _shard_arrays(seed: int, s: int = 4) -> tuple[dict, dict]:
+    """A fabricated shard snapshot (numpy only, no jax): enough state
+    for services/cardinality/zscore/topk/anomalies answers."""
+    rng = np.random.default_rng(seed)
+    arrays = {
+        "hll_bank": rng.integers(0, 9, (3, 2, s, 16), np.int32),
+        "cms_bank": rng.integers(0, 30, (3, 2, 2, 64), np.int32),
+        "span_total": (rng.random((3, 2)) * 100).astype(np.float32),
+        "lat_mean": rng.random((s, 3)).astype(np.float32),
+        "lat_var": rng.random((s, 3)).astype(np.float32),
+        "err_mean": rng.random((s, 3)).astype(np.float32),
+        "rate_mean": rng.random((s, 3)).astype(np.float32),
+        "rate_var": rng.random((s, 3)).astype(np.float32),
+        "card_mean": rng.random((s, 3)).astype(np.float32),
+        "card_var": rng.random((s, 3)).astype(np.float32),
+        "obs_batches": rng.random(s).astype(np.float32),
+        "obs_windows": rng.random((s, 3)).astype(np.float32),
+        "cusum": rng.random((s, 3)).astype(np.float32),
+        "step_idx": np.int32(seed),
+    }
+    return arrays, {}
+
+
+class _ShardPlane:
+    """One real QueryService over a fabricated snapshot."""
+
+    def __init__(self, seed: int, services: list[str]):
+        arrays, _ = _shard_arrays(seed, s=len(services))
+        meta = {
+            "service_names": services,
+            "query": {
+                "anomalies": [
+                    {"t": 100.0 + seed, "service": i, "signals": ["z"],
+                     "exemplars": [f"tid-{seed}-{i}"]}
+                    for i in range(len(services))
+                ],
+                "exemplars": {
+                    str(i): [f"tid-{seed}-{i}"]
+                    for i in range(len(services))
+                },
+                "hh_candidates": {
+                    str(i): [7, 9] for i in range(len(services))
+                },
+            },
+        }
+        self.engine = QueryEngine(snapshot_fn=lambda: (arrays, meta))
+        self.service = QueryService(
+            self.engine, host="127.0.0.1", port=0
+        )
+        self.service.start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.service.port}"
+
+    def stop(self):
+        self.service.stop()
+
+
+class TestAggregator:
+    @pytest.fixture()
+    def planes(self):
+        a = _ShardPlane(1, ["frontend", "cart"])
+        b = _ShardPlane(2, ["payment", "email"])
+        yield a, b
+        a.stop()
+        b.stop()
+
+    def test_services_union(self, planes):
+        a, b = planes
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": b.addr}, timeout_s=2.0
+        )
+        try:
+            status, doc = agg.dispatch("/query/services", {})
+            assert status == 200
+            assert doc["data"]["services"] == [
+                "cart", "email", "frontend", "payment",
+            ]
+            assert doc["meta"]["shards_answered"] == 2
+            assert doc["meta"]["partial"] is False
+        finally:
+            agg.close()
+
+    def test_blackholed_shard_degrades_to_labeled_partial(self, planes):
+        """THE degradation bar: one shard blackholed via faultwire —
+        accepted connections, every byte dropped — and the fleet
+        answer is a 200 with shards_answered=1/2, the dead shard
+        annotated. Never a 5xx, never a hang past the timeout."""
+        a, b = planes
+        wire = FaultWire("127.0.0.1", b.service.port)
+        wire.blackhole = True
+        wire.start()
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": f"127.0.0.1:{wire.port}"},
+            timeout_s=0.4,
+        )
+        try:
+            for path, params in (
+                ("/query/services", {}),
+                ("/query/anomalies", {}),
+            ):
+                t0 = time.monotonic()
+                status, doc = agg.dispatch(path, params)
+                assert time.monotonic() - t0 < 3.0
+                assert status == 200
+                meta = doc["meta"]
+                assert meta["partial"] is True
+                assert meta["shards_answered"] == 1
+                assert meta["shards_total"] == 2
+                assert meta["shards"]["shard-1"]["ok"] is False
+                assert "error" in meta["shards"]["shard-1"]
+            # The answering half still carries data.
+            status, doc = agg.dispatch("/query/services", {})
+            assert doc["data"]["services"] == ["cart", "frontend"]
+        finally:
+            agg.close()
+            wire.stop()
+
+    def test_rst_shard_annotated_never_5xx(self, planes):
+        a, b = planes
+        wire = FaultWire("127.0.0.1", b.service.port)
+        wire.rst_connects = True
+        wire.start()
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": f"127.0.0.1:{wire.port}"},
+            timeout_s=0.5,
+        )
+        try:
+            status, doc = agg.dispatch(
+                "/query/cardinality", {"service": "frontend"}
+            )
+            assert status == 200
+            assert doc["data"]["service"] == "frontend"
+            assert doc["meta"]["shards"]["shard-1"]["ok"] is False
+        finally:
+            agg.close()
+            wire.stop()
+
+    def test_service_keyed_routes_to_owner(self, planes):
+        a, b = planes
+        ring = HashRing(["shard-0", "shard-1"], vnodes=64)
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": b.addr},
+            timeout_s=2.0, ring=ring,
+        )
+        try:
+            # Each shard only interned ITS services: the fan-out
+            # fallback proves the answer comes from the holder even
+            # when ring ownership disagrees with data placement.
+            for svc, holder in (
+                ("frontend", "shard-0"), ("payment", "shard-1"),
+            ):
+                status, doc = agg.dispatch(
+                    "/query/zscore", {"service": svc}
+                )
+                assert status == 200
+                assert doc["data"]["service"] == svc
+                assert doc["meta"]["shards"][holder]["ok"] is True
+        finally:
+            agg.close()
+
+    def test_unknown_service_404_and_param_400(self, planes):
+        a, b = planes
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": b.addr}, timeout_s=2.0
+        )
+        try:
+            status, doc = agg.dispatch(
+                "/query/topk", {"service": "nope"}
+            )
+            assert status == 404
+            status, _doc = agg.dispatch("/query/topk", {})
+            assert status == 400
+            status, _doc = agg.dispatch("/query/flight", {})
+            assert status == 404  # per-shard surface, not fleet-global
+        finally:
+            agg.close()
+
+    def test_total_loss_is_labeled_503(self, planes):
+        a, b = planes
+        agg = FleetAggregator(
+            {"shard-0": "127.0.0.1:1", "shard-1": "127.0.0.1:1"},
+            timeout_s=0.3,
+        )
+        try:
+            status, doc = agg.dispatch("/query/services", {})
+            assert status == 503
+            assert doc["meta"]["shards_answered"] == 0
+        finally:
+            agg.close()
+
+    def test_http_surface_serves_merged_answers(self, planes):
+        a, b = planes
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": b.addr}, timeout_s=2.0
+        )
+        service = AggregatorService(agg, host="127.0.0.1", port=0)
+        service.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", service.port, timeout=5.0
+            )
+            conn.request("GET", "/query/anomalies?limit=3")
+            resp = conn.getresponse()
+            doc = json.loads(resp.read().decode())
+            assert resp.status == 200
+            assert len(doc["data"]["events"]) == 3
+            assert doc["meta"]["shards_answered"] == 2
+            conn.request("GET", "/")
+            probe = json.loads(
+                conn.getresponse().read().decode()
+            )
+            assert probe["tier"] == "aggregator"
+            conn.close()
+        finally:
+            service.stop()
+
+
+# --- heartbeats through faultwire chaos --------------------------------
+
+
+class _HealthzServer:
+    """A minimal /healthz endpoint — the peer surface FleetMember
+    heartbeats poll, here placed behind a faultwire proxy so the
+    chaos leg exercises REAL sockets."""
+
+    def __init__(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                body = b'{"status": "ok"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestHeartbeatChaos:
+    def test_heartbeats_through_faultwire_rst_then_heal(self):
+        """Flapping-shard chaos on real sockets: RST every heartbeat
+        connect → the peer is declared dead ONCE (one reshard); heal
+        → it rejoins after the sustained-beat window; flap again with
+        the budget exhausted → the ring FREEZES (refusals counted,
+        membership held)."""
+        hz = _HealthzServer()
+        wire = FaultWire("127.0.0.1", hz.port)
+        wire.start()
+        member = FleetMember(
+            "shard-0", {"shard-1": f"127.0.0.1:{wire.port}"},
+            heartbeat_s=0.05, dead_after_s=0.25, rejoin_after_s=0.3,
+            reshard_budget=2, reshard_refill_s=3600.0,
+        )
+        member.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if member.snapshot()["peers"]["shard-1"]["alive"]:
+                    break
+                time.sleep(0.05)
+            assert member.snapshot()["peers"]["shard-1"]["alive"]
+
+            # RST the heartbeat path: connects die at the proxy.
+            wire.rst_connects = True
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                snap = member.snapshot()
+                if not snap["peers"]["shard-1"]["alive"]:
+                    break
+                time.sleep(0.05)
+            snap = member.snapshot()
+            assert not snap["peers"]["shard-1"]["alive"]
+            assert snap["reshards_total"] == 1
+            assert "shard-1" not in snap["members"]
+
+            # Heal: sustained beats bring it back (second token).
+            wire.rst_connects = False
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                snap = member.snapshot()
+                if "shard-1" in snap["members"]:
+                    break
+                time.sleep(0.05)
+            assert "shard-1" in member.snapshot()["members"]
+            assert member.snapshot()["reshards_total"] == 2
+
+            # Budget exhausted: the next flap FREEZES the ring.
+            wire.rst_connects = True
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if member.snapshot()["reshards_refused"] >= 1:
+                    break
+                time.sleep(0.05)
+            snap = member.snapshot()
+            assert snap["frozen"]
+            assert snap["reshards_refused"] >= 1
+            assert snap["reshards_total"] == 2  # held, not thrashed
+            assert "shard-1" in snap["members"]
+        finally:
+            member.stop()
+            wire.stop()
+            hz.stop()
+
+
+# --- the full reshard drill (replbench) --------------------------------
+
+
+def test_reshard_converges_bit_exact():
+    """The shard-kill → reshard drill end to end (the fleetbench
+    in-proc leg): membership declares the victim dead through the
+    guardrails, survivors adopt its replicated frame, every
+    post-reshard /query/* answer for the victim's keys is BIT-EXACT
+    vs the unkilled witness fleet, the blackholed-shard partial
+    answer is labeled, and the noisy tenant sheds alone."""
+    from opentelemetry_demo_tpu.runtime.replbench import measure_reshard
+
+    out = measure_reshard(seconds=0.6, rows_per_service=16)
+    assert out["reshard_bitexact"] is True
+    assert out["survivor_answers_victim_keys"] is True
+    assert out["partial_answer_ok"] is True
+    assert out["noisy_tenant_isolated"] is True
+    assert out["fleet_ok"] is True
+    assert out["reshards_applied"] == 1
+    assert out["shard_reshard_ttd_s"] < 10.0
+
+
+# --- config validation -------------------------------------------------
+
+
+class TestFleetConfig:
+    def test_defaults_resolve(self, monkeypatch):
+        for knob in (
+            "ANOMALY_FLEET_SHARDS", "ANOMALY_FLEET_SHARD_INDEX",
+            "ANOMALY_FLEET_TENANTS",
+        ):
+            monkeypatch.delenv(knob, raising=False)
+        out = fleet_config()
+        assert out["ANOMALY_FLEET_SHARDS"] == 0
+        assert out["ANOMALY_AGGREGATOR_PORT"] == -1
+
+    def test_bad_index_refused(self, monkeypatch):
+        monkeypatch.setenv("ANOMALY_FLEET_SHARDS", "3")
+        monkeypatch.setenv("ANOMALY_FLEET_SHARD_INDEX", "3")
+        with pytest.raises(ConfigError):
+            fleet_config()
+
+    def test_missing_peers_refused(self, monkeypatch):
+        """SHARDS=N with fewer than N peer addresses would boot every
+        shard into a partial ring believing it owns keyspace it
+        doesn't — a silent permanent split, refused at boot."""
+        monkeypatch.setenv("ANOMALY_FLEET_SHARDS", "3")
+        monkeypatch.setenv("ANOMALY_FLEET_SHARD_INDEX", "0")
+        monkeypatch.delenv("ANOMALY_FLEET_PEERS", raising=False)
+        with pytest.raises(ConfigError, match="PEERS"):
+            fleet_config()
+        monkeypatch.setenv("ANOMALY_FLEET_PEERS", "a:1,b:2")
+        with pytest.raises(ConfigError, match="PEERS"):
+            fleet_config()
+        monkeypatch.setenv("ANOMALY_FLEET_PEERS", "a:1,b:2,c:3")
+        assert fleet_config()["ANOMALY_FLEET_SHARDS"] == 3
+        # The aggregator additionally needs every QUERY address.
+        monkeypatch.setenv("ANOMALY_AGGREGATOR_PORT", "9470")
+        with pytest.raises(ConfigError, match="QUERY_PEERS"):
+            fleet_config()
+        monkeypatch.setenv(
+            "ANOMALY_FLEET_QUERY_PEERS", "a:4,b:5,c:6"
+        )
+        assert fleet_config()["ANOMALY_AGGREGATOR_PORT"] == 9470
+
+    def test_bad_tenant_map_refused(self, monkeypatch):
+        monkeypatch.setenv("ANOMALY_FLEET_TENANTS", "frontend")
+        with pytest.raises(ConfigError):
+            fleet_config()
+        monkeypatch.setenv("ANOMALY_FLEET_TENANTS", "a/b:t")
+        with pytest.raises(ConfigError):
+            fleet_config()
+
+    def test_tenant_map_parse(self):
+        m = fleet_tenant_map("frontend:web, cart:web ,*:bulk")
+        assert m == {"frontend": "web", "cart": "web", "*": "bulk"}
+        assert tenant_of("frontend", m) == "web"
+        assert tenant_of("quote", m) == "bulk"
+        assert tenant_of("quote", {}) == "default"
+
+    def test_zero_quota_refuses_negative(self, monkeypatch):
+        monkeypatch.setenv(
+            "ANOMALY_FLEET_TENANT_QUOTA_ROWS_S", "-1"
+        )
+        with pytest.raises(ConfigError):
+            fleet_config()
+
+
+# --- daemon integration ------------------------------------------------
+
+
+class TestDaemonFleet:
+    def test_daemon_fleet_block_probe_and_metrics(
+        self, monkeypatch, tmp_path
+    ):
+        """A fleet-knobbed daemon: pre-interned shared service table,
+        /healthz fleet block, anomaly_fleet_* on /metrics, and
+        health_probe --shard reading it all — then its (unreachable)
+        peer is declared dead and the reshard counter moves."""
+        from opentelemetry_demo_tpu.models import DetectorConfig
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+        from opentelemetry_demo_tpu.runtime.health_probe import (
+            probe_shard,
+        )
+
+        base = {
+            "ANOMALY_OTLP_PORT": "0",
+            "ANOMALY_OTLP_GRPC_PORT": "-1",
+            "ANOMALY_METRICS_PORT": "0",
+            "ANOMALY_BATCH": "128",
+            "ANOMALY_ADAPTIVE_BATCH": "0",
+            "ANOMALY_QUERY_PORT": "-1",
+            "ANOMALY_FLEET_SHARDS": "2",
+            "ANOMALY_FLEET_SHARD_INDEX": "0",
+            # A peer that never answers: port 1 is never listening.
+            "ANOMALY_FLEET_PEERS": "self:0,127.0.0.1:1",
+            "ANOMALY_FLEET_HEARTBEAT_S": "0.05",
+            "ANOMALY_FLEET_DEAD_AFTER_S": "0.3",
+            "ANOMALY_FLEET_SERVICES": "frontend,cart,payment",
+            "ANOMALY_FLEET_TENANTS": "frontend:web,*:bulk",
+            "ANOMALY_FLEET_TENANT_QUOTA_ROWS_S": "10000",
+        }
+        for k, v in base.items():
+            monkeypatch.setenv(k, v)
+        for k in (
+            "ANOMALY_CHECKPOINT", "KAFKA_ADDR", "ANOMALY_ROLE",
+            "ANOMALY_REPLICATION_PORT", "ANOMALY_REPLICATION_TARGET",
+        ):
+            monkeypatch.delenv(k, raising=False)
+        daemon = DetectorDaemon(
+            DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        )
+        daemon.start()
+        try:
+            # The shared table is pre-interned in knob order.
+            assert daemon.pipeline.tensorizer.service_names[:3] == [
+                "frontend", "cart", "payment",
+            ]
+            # Quota plumbing reached the pipeline.
+            assert daemon.pipeline.tenant_quota_rows_s == 10000.0
+            # Peer never answers → declared dead within the edges.
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                daemon.step(0.0)
+                snap = daemon.fleet.snapshot()
+                if snap["reshards_total"] >= 1:
+                    break
+                time.sleep(0.05)
+            snap = daemon.fleet.snapshot()
+            assert snap["reshards_total"] >= 1
+            assert snap["shards_live"] == 1
+            # /healthz carries the fleet block; --shard reads it.
+            fleet_doc = probe_shard(
+                f"127.0.0.1:{daemon.exporter.port}"
+            )
+            assert fleet_doc is not None
+            assert fleet_doc["shard"] == "shard-0"
+            assert fleet_doc["shards_total"] == 2
+            # /metrics carries the fleet family.
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", daemon.exporter.port, timeout=5.0
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            assert "anomaly_fleet_shards_live 1.0" in text
+            assert "anomaly_reshards_total 1.0" in text
+            assert "anomaly_fleet_ring_version" in text
+            assert (
+                'anomaly_fleet_shard_ingest_spans_total{'
+                'shard="shard-0"}' in text
+            )
+        finally:
+            daemon.shutdown()
+
+    def test_single_shard_daemon_has_no_fleet_block(
+        self, monkeypatch, tmp_path
+    ):
+        from opentelemetry_demo_tpu.models import DetectorConfig
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+
+        for k in (
+            "ANOMALY_FLEET_SHARDS", "ANOMALY_FLEET_PEERS",
+            "ANOMALY_CHECKPOINT", "KAFKA_ADDR", "ANOMALY_ROLE",
+            "ANOMALY_REPLICATION_PORT", "ANOMALY_REPLICATION_TARGET",
+            "ANOMALY_FLEET_SERVICES",
+        ):
+            monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv("ANOMALY_OTLP_PORT", "0")
+        monkeypatch.setenv("ANOMALY_OTLP_GRPC_PORT", "-1")
+        monkeypatch.setenv("ANOMALY_METRICS_PORT", "0")
+        monkeypatch.setenv("ANOMALY_QUERY_PORT", "-1")
+        daemon = DetectorDaemon(
+            DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        )
+        try:
+            assert daemon.fleet is None
+            _status, detail = daemon._healthz()
+            assert "fleet" not in detail
+        finally:
+            daemon.shutdown()
