@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench spinebench replbench fleetbench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -37,6 +37,9 @@ overloadbench:  ## overload saturation driver (ONE json line: bounded queue, zer
 
 ingestbench:    ## host-ingest engines + decode-pool worker sweep (same methodology as bench.py's host_ingest_*)
 	$(CPU_ENV) $(PY) scripts/bench_ingest.py --workers 1,2,4
+
+decodebench:    ## raw two-pass scanner microbench: pass-1 scan vs pass-2 extract per thread + one-fat-payload shard scaling
+	$(CPU_ENV) $(PY) scripts/bench_ingest.py --raw
 
 spinebench:     ## end-to-end ingest spine: payload → flagged report, workers × ring-depth sweep (ONE json line)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.spinebench

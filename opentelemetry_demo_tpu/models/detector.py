@@ -360,28 +360,63 @@ def detector_step(
         cms_depth=int(cidx.shape[0]), cms_width=config.cms_width,
         num_services=s_axis, hll_p=config.hll_p,
     )
+    # ---- 3b. count-aware detection heads (fused with 3a) -------------
+    # The EWMA/CUSUM head math lives in fused.head_update (formulas
+    # unchanged — see its docstring for the count-aware z rationale).
+    # On the single-chip path it FOLDS into the one-pass
+    # sketch_batch_update program, consuming the stats accumulator in
+    # VMEM — no delta round trip between sketch fold and head advance;
+    # the mesh path applies the same function to its collective-merged
+    # stats (deltas, not banks, must cross the batch axis).
+    heads = fused.HeadState(
+        lat_mean=state.lat_mean,
+        lat_var=state.lat_var,
+        err_mean=state.err_mean,
+        rate_mean=state.rate_mean,
+        rate_var=state.rate_var,
+        cusum=state.cusum,
+        obs_batches=state.obs_batches,
+    )
+    head_kw = dict(
+        taus_s=tuple(config.taus_s),
+        warmup_batches=config.warmup_batches,
+        z_warmup_batches=config.z_warmup_batches,
+        cusum_k=config.cusum_k,
+        cusum_cap=config.cusum_cap,
+        err_slack=config.err_slack,
+    )
+    # step 0 carries a meaningless dt (the window clock has no previous
+    # tick), and a count divided by it would poison λ forever.
+    step_pos = state.step_idx > 0
     if comm is NO_COMM:
         # Single chip: the one-pass spine update — the batch folds into
-        # EVERY current window bank inside one program instead of
-        # materializing a delta and broadcast-merging it as a second
-        # step (fused.sketch_batch_update; bit-identical by the integer
-        # monoids, pinned by tests/test_fused.py). The mesh path below
-        # cannot take this shortcut: per-shard deltas must cross the
-        # batch-axis collectives before any bank merge.
-        hll_new, cms_new, stats = fused.sketch_batch_update(
-            hll_bank[:, 0],
-            cms_bank[:, 0],
-            svc,
-            log_lat,
-            is_error,
-            trace_hi,
-            trace_lo,
-            cidx,
-            valid,
-            num_services=s_axis,
-            hll_p=config.hll_p,
-            cms_width=config.cms_width,
-            impl=impl,
+        # EVERY current window bank AND the EWMA/CUSUM heads inside one
+        # program instead of materializing a delta and merging it as a
+        # second step (fused.sketch_batch_update; bit-identical by the
+        # integer monoids and the shared head_update, pinned by
+        # tests/test_fused.py). The mesh path below cannot take this
+        # shortcut: per-shard deltas must cross the batch-axis
+        # collectives before any bank merge or head advance.
+        hll_new, cms_new, stats, new_heads, (lat_z, err_z, rate_z) = (
+            fused.sketch_batch_update(
+                hll_bank[:, 0],
+                cms_bank[:, 0],
+                svc,
+                log_lat,
+                is_error,
+                trace_hi,
+                trace_lo,
+                cidx,
+                valid,
+                num_services=s_axis,
+                hll_p=config.hll_p,
+                cms_width=config.cms_width,
+                impl=impl,
+                heads=heads,
+                dt=dt,
+                step_pos=step_pos,
+                **head_kw,
+            )
         )
         hll_bank = hll_bank.at[:, 0].set(hll_new)
         cms_bank = cms_bank.at[:, 0].set(cms_new)
@@ -410,98 +445,15 @@ def detector_step(
         )
         cms_bank = cms_bank.at[:, 0].set(cms_bank[:, 0] + cms_delta[None])
         n_valid = comm.psum_batch_f32(jnp.sum(valid_f))
+        new_heads, (lat_z, err_z, rate_z) = fused.head_update(
+            stats, heads, dt, step_pos, **head_kw
+        )
     span_total = span_total.at[:, 0].add(n_valid)
-
-    # ---- 3b. count-aware detection heads -----------------------------
-    # Per-service batch counts vary wildly (a quiet service sees 1 span
-    # per batch, a hot one hundreds), so "batch mean vs EWMA variance of
-    # batch means" over-triggers on sparse services. Every z-score below
-    # is scaled by what the batch actually supports:
-    #   latency    x̄ of n spans → z = (x̄-μ)/sqrt(σ²/n), σ² = EWMA of
-    #              *per-span* variance (learned from the MXU sumsq)
-    #   error rate binomial      → z = (e - n·p)/sqrt(n·p(1-p) + 1)
-    #   throughput Poisson       → z = (n - λdt)/sqrt(λdt + 1)
-    taus = jnp.asarray(config.taus_s, jnp.float32)  # [T]
-    alphas = 1.0 - jnp.exp(-dt / taus)  # [T]
-    cnt, lat_sum, lat_sumsq, err_sum = stats
-    seen = cnt > 0  # [S]
-    obs2d = seen[:, None]
-    warm = (state.obs_batches < config.warmup_batches)[:, None]  # [S,1]
-    z_warm = (state.obs_batches < config.z_warmup_batches)[:, None]  # [S,1]
-    n = jnp.maximum(cnt, 1.0)[:, None]  # [S,1]
-    # Bias-corrected smoothing: a long-τ EWMA started from zero spends
-    # hundreds of batches under-estimating the variance (α≈dt/τ), which
-    # inflates every early z-score. Until a service has seen ~1/α
-    # batches, use the running-average weight 1/(obs+1) instead — the
-    # Adam-style debias, done with a max instead of a divide.
-    alphas = jnp.maximum(
-        alphas, 1.0 / (state.obs_batches[:, None] + 1.0)
-    )  # [S,T]
-    # Variance gets its own (slow) smoothing: the per-span variance is a
-    # property of the service, not of the detection timescale — letting
-    # the 1s column estimate σ² from its last ~4 batches makes the noise
-    # floor itself noisy and singleton batches blow past any threshold.
-    alpha_var = jnp.maximum(
-        1.0 - jnp.exp(-dt / jnp.float32(max(config.taus_s))),
-        1.0 / (state.obs_batches[:, None] + 1.0),
-    )  # [S,1]
-
-    # Latency: per-span mean μ and per-span variance σ² per timescale.
-    # σ has a floor (in log space ≈ 15% latency noise): it keeps the
-    # z sane while σ² bootstraps and sets a sensible minimum detectable
-    # shift for singleton batches.
-    mu = state.lat_mean
-    sigma2 = state.lat_var
-    floor2 = jnp.float32(0.15 * 0.15)
-    xbar = (lat_sum / jnp.maximum(cnt, 1.0))[:, None]  # [S,1]
-    lat_z = (xbar - mu) / jnp.sqrt(sigma2 / n + floor2)
-    lat_z_cusum = jnp.where(obs2d & ~warm, lat_z, 0.0)
-    lat_z = jnp.where(obs2d & ~z_warm, lat_z, 0.0)
-    lat_mean = jnp.where(obs2d, mu + alphas * (xbar - mu), mu)
-    # E[(x-μ)²] against the *updated* mean — the first observation must
-    # not fold the distance-from-zero of an uninitialised μ into σ².
-    v_obs = (
-        (lat_sumsq / jnp.maximum(cnt, 1.0))[:, None]
-        - 2.0 * lat_mean * xbar
-        + lat_mean * lat_mean
-    )
-    lat_var = jnp.where(
-        obs2d, sigma2 + alpha_var * (jnp.maximum(v_obs, 0.0) - sigma2), sigma2
-    )
-
-    # Error rate: EWMA of p, binomial z on this batch's error count.
-    p = state.err_mean
-    err_cnt = err_sum[:, None]  # [S,1]
-    err_z = (err_cnt - n * p) / jnp.sqrt(n * p * (1.0 - p) + 1.0)
-    err_z = jnp.where(obs2d & ~z_warm, err_z, 0.0)
-    phat = err_cnt / n
-    err_mean = jnp.where(obs2d, p + alphas * (phat - p), p)
-
-    # Throughput: EWMA of spans/sec; z on this batch's count with a
-    # variance that honours both Poisson noise and the empirically
-    # learned burstiness (task arrivals cluster, so pure Poisson
-    # under-estimates quiet-traffic variance).
-    lam = state.rate_mean
-    dt_c = jnp.maximum(dt, 1e-3)
-    expected = lam * dt_c
-    emp_var = state.rate_var * dt_c * dt_c  # (spans/s)² → count²
-    # step 0 carries a meaningless dt (the window clock has no previous
-    # tick), and a count divided by it would poison λ forever.
-    rate_obs = (seen | (state.obs_batches > 0))[:, None] & (state.step_idx > 0)
-    rate_z = (cnt[:, None] - expected) / jnp.sqrt(
-        jnp.maximum(expected, emp_var) + 1.0
-    )
-    rate_z_cusum = jnp.where(rate_obs & ~warm, rate_z, 0.0)
-    rate_z = jnp.where(rate_obs & ~z_warm, rate_z, 0.0)
-    rate_x = (cnt / jnp.maximum(dt, 1e-3))[:, None]
-    rate_mean = jnp.where(rate_obs, lam + alphas * (rate_x - lam), lam)
-    rate_var = jnp.where(
-        rate_obs,
-        state.rate_var + alpha_var * ((rate_x - lam) ** 2 - state.rate_var),
-        state.rate_var,
-    )
-
-    obs_batches = state.obs_batches + seen.astype(jnp.float32)
+    cnt = stats[0]
+    lat_mean, lat_var = new_heads.lat_mean, new_heads.lat_var
+    err_mean = new_heads.err_mean
+    rate_mean, rate_var = new_heads.rate_mean, new_heads.rate_var
+    obs_batches = new_heads.obs_batches
 
     # ---- 3c. heavy hitters: attr share of each current window --------
     # CANDIDATE SAMPLING: the per-span CMS lookup is random-access
@@ -577,33 +529,10 @@ def detector_step(
     hh_ratio = (per_svc_max / jnp.maximum(span_total[:, 0], 1.0)[:, None]).T
 
     # ---- CUSUM layer: sustained small shifts --------------------------
-    # Scores use the slowest-τ column as the stable reference. Errors
-    # score the batch's error count against the slack-forgiven baseline,
-    # standardized by the binomial σ — when the learned rate is ~0 the
-    # denominator is 1 and each error is strong evidence (a trickle of
-    # failures under a flagd percentage flag integrates to an alarm
-    # within a few batches), while a service with a real baseline error
-    # rate gets its routine singles absorbed as the noise they are.
-    # No traffic = no evidence either way: sparse services HOLD their
-    # accumulators between observed batches (a decay per empty pump
-    # would erase the evidence of a 1-request-per-few-seconds service
-    # faster than it accrues).
-    k = jnp.float32(config.cusum_k)
-    active = seen & ~warm[:, 0]
-    s_lat = jnp.where(active, lat_z_cusum[:, -1] - k, 0.0)
-    p_ref = err_mean[:, -1]
-    err_sigma = jnp.sqrt(n[:, 0] * p_ref * (1.0 - p_ref) + 1.0)
-    s_err = jnp.where(
-        active,
-        (err_cnt[:, 0] - n[:, 0] * (p_ref + config.err_slack)) / err_sigma
-        - k,
-        0.0,
-    )
-    s_rate = jnp.where(
-        rate_obs[:, 0] & ~warm[:, 0], -rate_z_cusum[:, -1] - k, 0.0
-    )
-    scores = jnp.stack([s_lat, s_err, s_rate], axis=1)  # [S,3]
-    cusum = jnp.clip(state.cusum + scores, 0.0, config.cusum_cap)
+    # Advanced inside fused.head_update alongside the EWMA heads (the
+    # scores standardize against the slowest-τ baseline; sparse
+    # services HOLD their accumulators — see head_update's docstring).
+    cusum = new_heads.cusum
 
     # ---- flags -------------------------------------------------------
     thr = config.z_threshold

@@ -3,7 +3,41 @@
 // The host side of the ≥200k spans/sec target (SURVEY.md §7 hard part
 // (a)): protobuf decode and attribute hashing must not be a per-record
 // Python loop. This library decodes the two ingest seams directly into
-// columnar arrays the tensorizer turns into device batches:
+// columnar arrays the tensorizer turns into device batches.
+//
+// **Two-pass structural decode** (the r15 decode-wall rework,
+// simdjson-style): pass 1 (`scan_request`) is a boundary sweep that
+// validates the structural levels — top-level fields, ResourceSpans
+// including the resource's KeyValues, ScopeSpans, span headers — and
+// records one (ptr, len, svc) entry per span WITHOUT parsing span
+// interiors (their bytes are skipped by length). Pass 2
+// (`extract_span`) consumes that structural index and extracts the
+// columns, one independent span at a time, with no re-parsing of the
+// framing. The split buys three things:
+//
+//   - exact capacity up front: pass 1 knows the span/resource/name
+//     totals before a single column row is written, so -2/-3 are
+//     decided once instead of mid-parse;
+//   - **intra-call sharding**: `otd_decode_otlp_many` splits the
+//     combined span index across `n_threads` worker threads at span-
+//     record boundaries (including MID-payload — one oversized OTLP
+//     export no longer serializes on one core), each thread writing a
+//     disjoint row range of the shared output columns;
+//   - attributable phases: the call reports scan vs extract wall time
+//     (`scan_s` / `extract_s`), which runtime/ingest_pool.py feeds to
+//     the anomaly_phase_seconds{phase=scan|extract} histograms.
+//
+// Verdict parity with the single-pass decoder is by construction: the
+// two passes together check exactly the constraint set the old
+// interleaved walk checked (pass 1 the framing, pass 2 the span
+// interiors), and a payload is malformed iff either pass says so —
+// order of discovery never changes a per-payload verdict. A pass-2
+// failure marks its payload bad; a single-threaded epilogue compacts
+// the bad payload's rows/services back out (append-only writes make
+// the compaction a handful of memmoves), so batchmates keep their
+// rows and `payload_rows` keeps the old -1-per-bad-payload contract.
+//
+// The decoded seams:
 //
 //   - OTLP ExportTraceServiceRequest (the collector-export seam; field
 //     numbers per opentelemetry-proto trace/v1, mirrored from
@@ -39,9 +73,13 @@
 // Build: g++ -O3 -shared -fPIC (no dependencies). Loaded via ctypes by
 // opentelemetry_demo_tpu/runtime/native.py.
 
+#include <atomic>
+#include <chrono>
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -88,7 +126,7 @@ struct Crc32cTable {
 };
 const Crc32cTable kCrc32c;
 
-uint32_t crc32c_update(uint32_t seed, const uint8_t* p, size_t n) {
+uint32_t crc32c_sw(uint32_t seed, const uint8_t* p, size_t n) {
   uint32_t c = ~seed;
   while (n >= 8) {
     uint32_t lo, hi;
@@ -104,6 +142,49 @@ uint32_t crc32c_update(uint32_t seed, const uint8_t* p, size_t n) {
   }
   while (n--) c = kCrc32c.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
   return ~c;
+}
+
+// CRC-32C in hardware where the ISA offers it: the Castagnoli
+// polynomial IS x86 SSE4.2's crc32 instruction (and AArch64's CRC32C
+// extension), so the hardware path is bit-identical to the sliced
+// table walk by definition of the instruction — the ingest-hop verify,
+// the parked-scratch recycle re-check and every frame trailer run at
+// instruction speed (~3 bytes/cycle) instead of table speed. Runtime-
+// detected once; the portable slicing-by-8 path stays the fallback
+// (and the only path on other ISAs).
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw(uint32_t seed,
+                                                     const uint8_t* p,
+                                                     size_t n) {
+  uint32_t c = ~seed;
+#if defined(__x86_64__)
+  uint64_t c64 = c;
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    c64 = __builtin_ia32_crc32di(c64, v);
+    p += 8;
+    n -= 8;
+  }
+  c = uint32_t(c64);
+#endif
+  while (n--) c = __builtin_ia32_crc32qi(c, *p++);
+  return ~c;
+}
+bool crc32c_hw_available() {
+  return __builtin_cpu_supports("sse4.2");
+}
+#else
+uint32_t crc32c_hw(uint32_t seed, const uint8_t* p, size_t n) {
+  return crc32c_sw(seed, p, n);
+}
+bool crc32c_hw_available() { return false; }
+#endif
+
+const bool kCrc32cHw = crc32c_hw_available();
+
+uint32_t crc32c_update(uint32_t seed, const uint8_t* p, size_t n) {
+  return kCrc32cHw ? crc32c_hw(seed, p, n) : crc32c_sw(seed, p, n);
 }
 
 // ------------------------------------------------------------ wire scan
@@ -259,6 +340,13 @@ bool str_eq(const Str& s, const char* lit) {
   return s.set && s.n == n && std::memcmp(s.p, lit, n) == 0;
 }
 
+// Length-precomputed variant for the monitored-key compares in the
+// span hot loop (strlen per attribute per key was measurable at the
+// flush scale the pool runs).
+inline bool str_eq_n(const Str& s, const char* lit, size_t n) {
+  return s.set && s.n == n && std::memcmp(s.p, lit, n) == 0;
+}
+
 // AnyValue{string_value=1}: first occurrence of a LEN field 1 is the
 // string; any other type/field is ignored (otlp._anyvalue_str returns
 // None for non-string values, raising nothing).
@@ -323,28 +411,33 @@ constexpr int kMaxAttrKeys = 16;
 
 namespace {
 
-// Decode one ExportTraceServiceRequest, APPENDING to the output
-// columns: records from `n_rec` up, resource-spans entries from
-// `*n_svc_io` / name bytes from `*svc_pos_io`. Returns the new total
-// record count, or a negative error code. Shared by the single-request
-// entry point and the batched `otd_decode_otlp_many` (which amortizes
-// one Python→C round trip over a whole coalesced flush).
-int decode_request(const uint8_t* buf, size_t len,               //
-                   const char* const* attr_keys, int n_keys,     //
-                   int cap,                                      //
-                   float* duration_us, uint64_t* trace_key,      //
-                   uint8_t* is_error, uint32_t* attr_crc,        //
-                   uint8_t* attr_present, int32_t* svc_idx,      //
-                   int32_t* event_count, uint8_t* has_exception, //
-                   char* svc_buf, size_t svc_buf_cap,            //
-                   int32_t* svc_len, int rs_cap,                 //
-                   int* n_svc_io, size_t* svc_pos_io, int n_rec) {
+// ---------------------------------------------------------- pass 1: scan
+// One structural-index entry per span record (the pass-1 product).
+struct SpanRef {
+  const uint8_t* p;  // span submessage bytes
+  uint32_t len;
+  int32_t svc;      // batch-wide resource-spans entry index
+  int32_t payload;  // payload index within the batch (verdict mapping)
+};
+
+// Structural sweep of one ExportTraceServiceRequest: validates the
+// framing levels (top-level fields, ResourceSpans incl. the resource's
+// KeyValues, ScopeSpans, span headers), APPENDS service names to the
+// shared name buffer, and emits one boundary record per span WITHOUT
+// descending into span interiors — pass 2's job. The sweep is branch-
+// light on purpose: span bodies (the bulk of the bytes) are skipped by
+// their LEN header, so scan throughput is set by varint-walk speed,
+// not field semantics. Returns the new total span count or a negative
+// error code (-1 malformed framing, -2 span capacity, -3 name/entry
+// capacity).
+template <typename EmitSpan>
+int scan_request(const uint8_t* buf, size_t len, int payload_idx,  //
+                 char* svc_buf, size_t svc_buf_cap,                //
+                 int32_t* svc_len, int rs_cap,                     //
+                 int* n_svc_io, size_t* svc_pos_io,                //
+                 int n_spans, int span_cap, EmitSpan&& emit) {
   int n_svc = *n_svc_io;
   size_t svc_pos = *svc_pos_io;
-  // Hoisted out of the span loop: default-initializing all
-  // kMaxAttrKeys Str slots per span cost more memory traffic than
-  // scanning the span itself; only the first n_keys slots are live.
-  Str attr_val[kMaxAttrKeys];
   Slice top{buf, len};
   Field rs_f;
   bool descend;
@@ -354,14 +447,14 @@ int decode_request(const uint8_t* buf, size_t len,               //
     if (!sub_list(rs_f, descend)) return -1;
 
     // ResourceSpans{resource=1 (first), scope_spans=2 (repeated)}.
+    // Sweep A: the resource can appear after scope_spans on the wire;
+    // the Python decoder's two-phase scan is order-independent, so
+    // resolve the service name before emitting this block's spans.
     Str svc_name;
     bool have_name = false;
     bool resource_claimed = false;
     Slice rs{rs_f.val, rs_f.len};
     Field f;
-    // Pass 1: the resource can appear after scope_spans on the wire;
-    // Python's two-phase scan (scan_fields then descend) is order-
-    // independent, so find the service name before emitting records.
     while (!rs.done()) {
       if (!next_field(rs, f)) return -1;
       if (f.no == 1) {
@@ -390,7 +483,7 @@ int decode_request(const uint8_t* buf, size_t len,               //
     svc_pos += svc_name.n;
     svc_len[n_svc++] = have_name ? int32_t(svc_name.n) : -1;
 
-    // Pass 2: emit one record per span.
+    // Sweep B: record span-record boundaries (no interior parse).
     rs = Slice{rs_f.val, rs_f.len};
     while (!rs.done()) {
       if (!next_field(rs, f)) return -1;
@@ -402,134 +495,169 @@ int decode_request(const uint8_t* buf, size_t len,               //
         if (!next_field(ss, sf)) return -1;
         if (sf.no != 2) continue;  // Span (submessage-list)
         if (!sub_list(sf, descend)) return -1;
-        if (n_rec >= cap) return -2;
-
-        Str tid;
-        uint64_t tid_num = 0;
-        bool tid_is_num = false;
-        uint64_t start = 0, end = 0;
-        bool start_claimed = false, end_claimed = false;
-        bool err = false;
-        bool status_claimed = false;
-        int32_t n_events = 0;
-        bool exc = false;
-        for (int k = 0; k < n_keys; ++k) attr_val[k] = Str{};
-
-        Slice sp{sf.val, sf.len};
-        Field pf;
-        while (!sp.done()) {
-          if (!next_field(sp, pf)) return -1;
-          switch (pf.no) {
-            case 1:  // trace_id: first; bytes OR numeric both accepted
-                     // (SpanRecord.trace_id is bytes | int)
-              if (!tid.set && !tid_is_num) {
-                if (pf.wt == kLen) {
-                  tid.p = pf.val;
-                  tid.n = pf.len;
-                  tid.set = true;
-                } else if (numeric(pf)) {
-                  tid_num = pf.num;
-                  tid_is_num = true;
-                }
-              }
-              break;
-            case 7:  // start_time_unix_nano (numeric-first)
-              if (!numeric_first(pf, start_claimed, start)) return -1;
-              break;
-            case 8:  // end_time_unix_nano (numeric-first)
-              if (!numeric_first(pf, end_claimed, end)) return -1;
-              break;
-            case 9: {  // attributes: repeated KeyValue (submessage-list)
-              if (!sub_list(pf, descend)) return -1;
-              Str key, val;
-              if (!keyvalue(pf.val, pf.len, key, val)) return -1;
-              if (val.set)
-                for (int k = 0; k < n_keys; ++k)
-                  if (str_eq(key, attr_keys[k])) attr_val[k] = val;
-              break;
-            }
-            case 11: {  // events: repeated Event{time_unix_nano=1,
-                        // name=2, attributes=3} (submessage-list).
-              if (!sub_list(pf, descend)) return -1;
-              Slice ev{pf.val, pf.len};
-              Field ef;
-              Str ev_name;
-              bool name_claimed = false;
-              bool t_claimed = false;
-              uint64_t t_ns = 0;
-              while (!ev.done()) {
-                if (!next_field(ev, ef)) return -1;
-                if (ef.no == 1) {  // time (numeric-first, empty-LEN ok)
-                  if (!numeric_first(ef, t_claimed, t_ns)) return -1;
-                } else if (ef.no == 2 && !name_claimed) {
-                  // Python: wire.first(ev, 2) then isinstance(bytes) —
-                  // a numeric first occurrence claims the slot with an
-                  // EMPTY name, never an error.
-                  name_claimed = true;
-                  if (ef.wt == kLen) {
-                    ev_name.p = ef.val;
-                    ev_name.n = ef.len;
-                    ev_name.set = true;
-                  }
-                } else if (ef.no == 3) {  // attributes (submessage-list)
-                  if (!sub_list(ef, descend)) return -1;
-                  Str key, val;
-                  if (!keyvalue(ef.val, ef.len, key, val)) return -1;
-                }
-              }
-              ++n_events;
-              // tensorize.EXCEPTION_EVENT_NAMES, exact literals: the
-              // semconv name, checkout's "error", ad's "Error".
-              if (str_eq(ev_name, "exception") || str_eq(ev_name, "error") ||
-                  str_eq(ev_name, "Error"))
-                exc = true;
-              break;
-            }
-            case 15: {  // Status{code=3} (submessage-first)
-              if (!sub_first(pf, status_claimed, descend)) return -1;
-              if (!descend) break;
-              Slice st{pf.val, pf.len};
-              Field stf;
-              bool code_claimed = false;
-              uint64_t code = 0;
-              while (!st.done()) {
-                if (!next_field(st, stf)) return -1;
-                if (stf.no == 3 &&
-                    !numeric_first(stf, code_claimed, code))
-                  return -1;
-              }
-              err = (code == 2);  // STATUS_CODE_ERROR
-              break;
-            }
-            default:
-              break;  // unknown: skipped, not descended
-          }
-        }
-
-        duration_us[n_rec] =
-            end > start ? float(double(end - start) / 1000.0) : 0.0f;
-        trace_key[n_rec] = tid_is_num ? tid_num : key8(tid.p, tid.n);
-        is_error[n_rec] = err ? 1 : 0;
-        uint32_t crc = 0;
-        uint8_t present = 0;
-        for (int k = 0; k < n_keys; ++k)
-          if (attr_val[k].set) {  // priority order: first hit wins
-            crc = crc32(attr_val[k].p, attr_val[k].n);
-            present = 1;
-            break;
-          }
-        attr_crc[n_rec] = crc;
-        attr_present[n_rec] = present;
-        svc_idx[n_rec] = n_svc - 1;
-        event_count[n_rec] = n_events;
-        has_exception[n_rec] = exc ? 1 : 0;
-        ++n_rec;
+        if (n_spans >= span_cap) return -2;
+        emit(sf.val, sf.len, n_svc - 1, payload_idx, n_spans);
+        ++n_spans;
       }
     }
   }
   *n_svc_io = n_svc;
   *svc_pos_io = svc_pos;
-  return n_rec;
+  return n_spans;
+}
+
+// ------------------------------------------------------- pass 2: extract
+// Extract ONE pass-1 span record into output row `r`. Field slot
+// semantics are identical to the retired single-pass walk (the file
+// header's four categories); rows are independent, which is what makes
+// the extraction shardable across threads. Returns false on a
+// malformed span interior (the caller maps it to the owning payload's
+// -1 verdict).
+bool extract_span(const uint8_t* p, size_t n, int32_t svc, int r,  //
+                  const char* const* attr_keys,                    //
+                  const size_t* key_lens, int n_keys,              //
+                  Str* attr_val,                                   //
+                  float* duration_us, uint64_t* trace_key,         //
+                  uint8_t* is_error, uint32_t* attr_crc,           //
+                  uint8_t* attr_present, int32_t* svc_idx,         //
+                  int32_t* event_count, uint8_t* has_exception) {
+  Str tid;
+  uint64_t tid_num = 0;
+  bool tid_is_num = false;
+  uint64_t start = 0, end = 0;
+  bool start_claimed = false, end_claimed = false;
+  bool err = false;
+  bool status_claimed = false;
+  int32_t n_events = 0;
+  bool exc = false;
+  // attr_val is the CALLER's per-thread slot array (hoisted out of
+  // the span loop: value-initializing all kMaxAttrKeys Str slots per
+  // span costs more memory traffic than scanning the span itself);
+  // only the first n_keys slots are live and reset here.
+  for (int k = 0; k < n_keys; ++k) attr_val[k] = Str{};
+  bool descend;
+
+  Slice sp{p, n};
+  Field pf;
+  while (!sp.done()) {
+    if (!next_field(sp, pf)) return false;
+    switch (pf.no) {
+      case 1:  // trace_id: first; bytes OR numeric both accepted
+               // (SpanRecord.trace_id is bytes | int)
+        if (!tid.set && !tid_is_num) {
+          if (pf.wt == kLen) {
+            tid.p = pf.val;
+            tid.n = pf.len;
+            tid.set = true;
+          } else if (numeric(pf)) {
+            tid_num = pf.num;
+            tid_is_num = true;
+          }
+        }
+        break;
+      case 7:  // start_time_unix_nano (numeric-first)
+        if (!numeric_first(pf, start_claimed, start)) return false;
+        break;
+      case 8:  // end_time_unix_nano (numeric-first)
+        if (!numeric_first(pf, end_claimed, end)) return false;
+        break;
+      case 9: {  // attributes: repeated KeyValue (submessage-list)
+        if (!sub_list(pf, descend)) return false;
+        Str key, val;
+        if (!keyvalue(pf.val, pf.len, key, val)) return false;
+        if (val.set)
+          for (int k = 0; k < n_keys; ++k)
+            if (str_eq_n(key, attr_keys[k], key_lens[k])) attr_val[k] = val;
+        break;
+      }
+      case 11: {  // events: repeated Event{time_unix_nano=1,
+                  // name=2, attributes=3} (submessage-list).
+        if (!sub_list(pf, descend)) return false;
+        Slice ev{pf.val, pf.len};
+        Field ef;
+        Str ev_name;
+        bool name_claimed = false;
+        bool t_claimed = false;
+        uint64_t t_ns = 0;
+        while (!ev.done()) {
+          if (!next_field(ev, ef)) return false;
+          if (ef.no == 1) {  // time (numeric-first, empty-LEN ok)
+            if (!numeric_first(ef, t_claimed, t_ns)) return false;
+          } else if (ef.no == 2 && !name_claimed) {
+            // Python: wire.first(ev, 2) then isinstance(bytes) —
+            // a numeric first occurrence claims the slot with an
+            // EMPTY name, never an error.
+            name_claimed = true;
+            if (ef.wt == kLen) {
+              ev_name.p = ef.val;
+              ev_name.n = ef.len;
+              ev_name.set = true;
+            }
+          } else if (ef.no == 3) {  // attributes (submessage-list)
+            if (!sub_list(ef, descend)) return false;
+            Str key, val;
+            if (!keyvalue(ef.val, ef.len, key, val)) return false;
+          }
+        }
+        ++n_events;
+        // tensorize.EXCEPTION_EVENT_NAMES, exact literals: the
+        // semconv name, checkout's "error", ad's "Error".
+        if (str_eq(ev_name, "exception") || str_eq(ev_name, "error") ||
+            str_eq(ev_name, "Error"))
+          exc = true;
+        break;
+      }
+      case 15: {  // Status{code=3} (submessage-first)
+        if (!sub_first(pf, status_claimed, descend)) return false;
+        if (!descend) break;
+        Slice st{pf.val, pf.len};
+        Field stf;
+        bool code_claimed = false;
+        uint64_t code = 0;
+        while (!st.done()) {
+          if (!next_field(st, stf)) return false;
+          if (stf.no == 3 && !numeric_first(stf, code_claimed, code))
+            return false;
+        }
+        err = (code == 2);  // STATUS_CODE_ERROR
+        break;
+      }
+      default:
+        break;  // unknown: skipped, not descended
+    }
+  }
+
+  duration_us[r] = end > start ? float(double(end - start) / 1000.0) : 0.0f;
+  trace_key[r] = tid_is_num ? tid_num : key8(tid.p, tid.n);
+  is_error[r] = err ? 1 : 0;
+  uint32_t crc = 0;
+  uint8_t present = 0;
+  for (int k = 0; k < n_keys; ++k)
+    if (attr_val[k].set) {  // priority order: first hit wins
+      crc = crc32(attr_val[k].p, attr_val[k].n);
+      present = 1;
+      break;
+    }
+  attr_crc[r] = crc;
+  attr_present[r] = present;
+  svc_idx[r] = svc;
+  event_count[r] = n_events;
+  has_exception[r] = exc ? 1 : 0;
+  return true;
+}
+
+void key_lengths(const char* const* attr_keys, int n_keys, size_t* out) {
+  for (int k = 0; k < n_keys; ++k) out[k] = std::strlen(attr_keys[k]);
+}
+
+// Minimum spans per extraction shard: below this the std::thread
+// spawn/join overhead exceeds the parse work a shard would cover.
+constexpr int kMinShardSpans = 512;
+
+double seconds_since(std::chrono::steady_clock::time_point t0) {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now() - t0)
+      .count();
 }
 
 }  // namespace
@@ -567,27 +695,113 @@ int otd_decode_otlp(const uint8_t* buf, size_t len,              //
   if (n_keys > kMaxAttrKeys) return -4;
   int n_svc = 0;
   size_t svc_pos = 0;
-  int n_rec = decode_request(
-      buf, len, attr_keys, n_keys, cap, duration_us, trace_key, is_error,
-      attr_crc, attr_present, svc_idx, event_count, has_exception, svc_buf,
-      svc_buf_cap, svc_len, rs_cap, &n_svc, &svc_pos, 0);
+  std::vector<SpanRef> spans;
+  spans.reserve(len / 64 + 16);
+  int n_rec = scan_request(
+      buf, len, 0, svc_buf, svc_buf_cap, svc_len, rs_cap, &n_svc, &svc_pos,
+      0, cap,
+      [&](const uint8_t* p, size_t n, int svc, int payload, int row) {
+        (void)payload;
+        (void)row;
+        spans.push_back(SpanRef{p, uint32_t(n), int32_t(svc), 0});
+      });
   if (n_rec < 0) return n_rec;
+  size_t key_lens[kMaxAttrKeys];
+  key_lengths(attr_keys, n_keys, key_lens);
+  Str attr_val[kMaxAttrKeys];
+  for (int r = 0; r < n_rec; ++r) {
+    const SpanRef& s = spans[r];
+    if (!extract_span(s.p, s.len, s.svc, r, attr_keys, key_lens, n_keys,
+                      attr_val, duration_us, trace_key, is_error, attr_crc,
+                      attr_present, svc_idx, event_count, has_exception))
+      return -1;
+  }
   *n_services = n_svc;
   return n_rec;
 }
 
-// Batched decode: `n_payloads` independent ExportTraceServiceRequests
-// into ONE set of output columns (rows append across payloads in
-// argument order; `svc_idx` indexes the shared, batch-wide
-// resource-spans list). One ctypes round trip — during which ctypes
-// has dropped the GIL — amortizes over the whole coalesced flush,
-// which is the ingest pool's (runtime/ingest_pool.py) per-flush cost
-// model. Per-payload verdicts land in `payload_rows`: the row count
-// this payload contributed, or -1 when IT was malformed — a poison
-// request rolls back its partial rows and never fails its batchmates
-// (each receiver still answers 400 for exactly the bad request, the
-// serial path's verdict). Capacity exhaustion (-2/-3) aborts the whole
-// call: the caller regrows its pooled buffers and retries everything.
+// Pass 1 alone: structural scan of one ExportTraceServiceRequest into
+// a caller-owned span index (`span_off`/`span_len` relative to `buf`,
+// `span_svc` into the resource-spans list) — the raw-scanner surface
+// `make decodebench` isolates, and the boundary oracle the fuzz suite
+// truncates against. Returns the span count or -1/-2/-3.
+int otd_scan_otlp(const uint8_t* buf, size_t len,                //
+                  int32_t* span_off, int32_t* span_len,          //
+                  int32_t* span_svc, int span_cap,               //
+                  char* svc_buf, size_t svc_buf_cap,             //
+                  int32_t* svc_len, int rs_cap,                  //
+                  int32_t* n_services) {
+  int n_svc = 0;
+  size_t svc_pos = 0;
+  int n = scan_request(
+      buf, len, 0, svc_buf, svc_buf_cap, svc_len, rs_cap, &n_svc, &svc_pos,
+      0, span_cap,
+      [&](const uint8_t* p, size_t sn, int svc, int payload, int row) {
+        (void)payload;
+        span_off[row] = int32_t(p - buf);
+        span_len[row] = int32_t(sn);
+        span_svc[row] = int32_t(svc);
+      });
+  if (n < 0) return n;
+  *n_services = n_svc;
+  return n;
+}
+
+// Pass 2 alone: extract a caller-provided span index (from
+// `otd_scan_otlp`) into columns — the other half of the raw-scanner
+// microbench. Index bounds are re-validated against `len` so a stale
+// or corrupted index can never read outside the payload. Returns
+// `n_spans` or -1.
+int otd_extract_otlp(const uint8_t* buf, size_t len,             //
+                     const int32_t* span_off, const int32_t* span_len,
+                     const int32_t* span_svc, int n_spans,       //
+                     const char* const* attr_keys, int n_keys,   //
+                     float* duration_us, uint64_t* trace_key,    //
+                     uint8_t* is_error, uint32_t* attr_crc,      //
+                     uint8_t* attr_present, int32_t* svc_idx,    //
+                     int32_t* event_count, uint8_t* has_exception) {
+  if (n_keys > kMaxAttrKeys) return -4;
+  size_t key_lens[kMaxAttrKeys];
+  key_lengths(attr_keys, n_keys, key_lens);
+  Str attr_val[kMaxAttrKeys];
+  for (int r = 0; r < n_spans; ++r) {
+    size_t off = size_t(span_off[r]);
+    size_t sn = size_t(span_len[r]);
+    if (span_off[r] < 0 || span_len[r] < 0 || off + sn > len) return -1;
+    if (!extract_span(buf + off, sn, span_svc[r], r, attr_keys, key_lens,
+                      n_keys, attr_val, duration_us, trace_key, is_error,
+                      attr_crc, attr_present, svc_idx, event_count,
+                      has_exception))
+      return -1;
+  }
+  return n_spans;
+}
+
+// Batched two-pass decode: `n_payloads` independent
+// ExportTraceServiceRequests into ONE set of output columns (rows
+// append across payloads in argument order; `svc_idx` indexes the
+// shared, batch-wide resource-spans list). One ctypes round trip —
+// during which ctypes has dropped the GIL — amortizes over the whole
+// coalesced flush, which is the ingest pool's (runtime/ingest_pool.py)
+// per-flush cost model.
+//
+// Pass 1 scans every payload serially (boundary work only), building
+// the combined span index + service table; pass 2 extracts the index
+// into the columns — sharded across up to `n_threads` OS threads at
+// span-record boundaries (including mid-payload) whenever the batch
+// carries at least `shard_min_bytes` of payload and enough spans to
+// amortize a thread spawn. Because pass 1 fixed every row/service slot
+// up front, shard writes are disjoint and need no synchronization.
+//
+// Per-payload verdicts land in `payload_rows`: the row count this
+// payload contributed, or -1 when IT was malformed — a poison request
+// never fails its batchmates (each receiver still answers 400 for
+// exactly the bad request, the serial path's verdict). A pass-1
+// failure contributes nothing (its partial index rolls back); a pass-2
+// failure is compacted out by the single-threaded epilogue. Capacity
+// exhaustion (-2/-3) aborts the whole call: the caller regrows its
+// pooled buffers and retries everything. `scan_s`/`extract_s` (either
+// may be null) report per-pass wall seconds for the phase histograms.
 int otd_decode_otlp_many(const uint8_t* const* bufs, const size_t* lens,
                          int n_payloads,                          //
                          const char* const* attr_keys, int n_keys,  //
@@ -598,33 +812,147 @@ int otd_decode_otlp_many(const uint8_t* const* bufs, const size_t* lens,
                          int32_t* event_count, uint8_t* has_exception,  //
                          char* svc_buf, size_t svc_buf_cap,        //
                          int32_t* svc_len, int rs_cap,             //
-                         int32_t* n_services, int32_t* payload_rows) {
+                         int32_t* n_services, int32_t* payload_rows,
+                         int n_threads, long long shard_min_bytes,
+                         double* scan_s, double* extract_s) {
   if (n_keys > kMaxAttrKeys) return -4;
-  int n_rec = 0;
+  auto t0 = std::chrono::steady_clock::now();
+
+  // ---- pass 1: structural scan, batch-wide index --------------------
+  // The index rides a thread_local vector: each pool worker's calls
+  // reuse one high-watermark allocation instead of paying a
+  // payload-sized malloc/free per flush (the same retention policy as
+  // the Python-side DecodeScratch freelist). clear() keeps capacity.
+  static thread_local std::vector<SpanRef> spans_tls;
+  std::vector<SpanRef>& spans = spans_tls;
+  spans.clear();
+  size_t total_bytes = 0;
+  for (int i = 0; i < n_payloads; ++i) total_bytes += lens[i];
+  if (spans.capacity() < total_bytes / 64 + 16)
+    spans.reserve(total_bytes / 64 + 16);
+  // Per-payload bookkeeping for the epilogue: row/service/name-byte
+  // ranges as committed by pass 1 (rolled-back payloads collapse to
+  // empty ranges).
+  std::vector<int> row0(n_payloads + 1), svc0(n_payloads + 1);
+  std::vector<size_t> pos0(n_payloads + 1);
   int n_svc = 0;
   size_t svc_pos = 0;
+  bool any_bad = false;
+  auto emit = [&](const uint8_t* p, size_t n, int svc, int payload,
+                  int row) {
+    (void)row;
+    spans.push_back(SpanRef{p, uint32_t(n), int32_t(svc), int32_t(payload)});
+  };
   for (int i = 0; i < n_payloads; ++i) {
-    int save_rec = n_rec;
-    int save_svc = n_svc;
-    size_t save_pos = svc_pos;
-    int r = decode_request(
-        bufs[i], lens[i], attr_keys, n_keys, cap, duration_us, trace_key,
-        is_error, attr_crc, attr_present, svc_idx, event_count,
-        has_exception, svc_buf, svc_buf_cap, svc_len, rs_cap, &n_svc,
-        &svc_pos, n_rec);
-    if (r == -2 || r == -3) return r;  // shared-buffer capacity: retry all
+    row0[i] = int(spans.size());
+    svc0[i] = n_svc;
+    pos0[i] = svc_pos;
+    int r = scan_request(bufs[i], lens[i], i, svc_buf, svc_buf_cap,
+                         svc_len, rs_cap, &n_svc, &svc_pos,
+                         int(spans.size()), cap, emit);
+    if (r == -2 || r == -3) return r;  // shared capacity: retry all
     if (r < 0) {
-      // Malformed payload: roll back its partial appends (all writes
-      // are append-only, so restoring the counters IS the rollback).
+      // Malformed framing: roll back this payload's partial appends
+      // (append-only writes — restoring the counters IS the rollback).
       payload_rows[i] = -1;
-      n_rec = save_rec;
-      n_svc = save_svc;
-      svc_pos = save_pos;
+      spans.resize(size_t(row0[i]));
+      n_svc = svc0[i];
+      svc_pos = pos0[i];
+      any_bad = true;
     } else {
-      payload_rows[i] = r - save_rec;
-      n_rec = r;
+      payload_rows[i] = r - row0[i];
     }
   }
+  row0[n_payloads] = int(spans.size());
+  svc0[n_payloads] = n_svc;
+  pos0[n_payloads] = svc_pos;
+  int n_rec = int(spans.size());
+  if (scan_s) *scan_s = seconds_since(t0);
+  auto t1 = std::chrono::steady_clock::now();
+
+  // ---- pass 2: extraction, sharded at span-record boundaries --------
+  size_t key_lens[kMaxAttrKeys];
+  key_lengths(attr_keys, n_keys, key_lens);
+  const size_t n_pl = size_t(n_payloads);
+  std::vector<std::atomic<int>> bad(n_pl);
+  for (auto& b : bad) b.store(0, std::memory_order_relaxed);
+  std::atomic<bool> bad_seen{false};
+  auto extract_range = [&](int lo, int hi) {
+    Str attr_val[kMaxAttrKeys];  // per-thread: shards never share it
+    for (int k = lo; k < hi; ++k) {
+      const SpanRef& s = spans[size_t(k)];
+      if (bad[size_t(s.payload)].load(std::memory_order_relaxed))
+        continue;  // owning payload already condemned: skip the work
+      if (!extract_span(s.p, s.len, s.svc, k, attr_keys, key_lens, n_keys,
+                        attr_val, duration_us, trace_key, is_error,
+                        attr_crc, attr_present, svc_idx, event_count,
+                        has_exception)) {
+        bad[size_t(s.payload)].store(1, std::memory_order_relaxed);
+        bad_seen.store(true, std::memory_order_relaxed);
+      }
+    }
+  };
+  int shards = 1;
+  if (n_threads > 1 && (long long)total_bytes >= shard_min_bytes)
+    shards = n_threads;
+  if (shards > n_rec / kMinShardSpans)
+    shards = n_rec / kMinShardSpans;  // don't spawn for trivial work
+  if (shards <= 1) {
+    extract_range(0, n_rec);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(size_t(shards - 1));
+    int per = (n_rec + shards - 1) / shards;
+    for (int t = 1; t < shards; ++t)
+      pool.emplace_back(extract_range, t * per,
+                        t * per + per < n_rec ? t * per + per : n_rec);
+    extract_range(0, per < n_rec ? per : n_rec);
+    for (auto& th : pool) th.join();
+  }
+
+  // ---- epilogue: compact condemned payloads back out ----------------
+  if (bad_seen.load(std::memory_order_relaxed)) any_bad = true;
+  if (any_bad && n_rec) {
+    int wr = 0;        // write row
+    int wsvc = 0;      // write service entry
+    size_t wpos = 0;   // write name byte
+    for (int i = 0; i < n_payloads; ++i) {
+      int r0 = row0[i], cnt = row0[i + 1] - row0[i];
+      int s0 = svc0[i], scnt = svc0[i + 1] - svc0[i];
+      size_t p0 = pos0[i], pbytes = pos0[i + 1] - pos0[i];
+      if (payload_rows[i] < 0) continue;  // pass-1 bad: empty ranges
+      if (bad[size_t(i)].load(std::memory_order_relaxed)) {
+        payload_rows[i] = -1;  // pass-2 bad: drop rows + services
+        continue;
+      }
+      payload_rows[i] = cnt;
+      int svc_shift = s0 - wsvc;
+      if (wr != r0 || svc_shift) {
+        std::memmove(duration_us + wr, duration_us + r0,
+                     size_t(cnt) * sizeof(float));
+        std::memmove(trace_key + wr, trace_key + r0,
+                     size_t(cnt) * sizeof(uint64_t));
+        std::memmove(is_error + wr, is_error + r0, size_t(cnt));
+        std::memmove(attr_crc + wr, attr_crc + r0,
+                     size_t(cnt) * sizeof(uint32_t));
+        std::memmove(attr_present + wr, attr_present + r0, size_t(cnt));
+        for (int k = 0; k < cnt; ++k)
+          svc_idx[wr + k] = svc_idx[r0 + k] - svc_shift;
+        std::memmove(event_count + wr, event_count + r0,
+                     size_t(cnt) * sizeof(int32_t));
+        std::memmove(has_exception + wr, has_exception + r0, size_t(cnt));
+        std::memmove(svc_len + wsvc, svc_len + s0,
+                     size_t(scnt) * sizeof(int32_t));
+        std::memmove(svc_buf + wpos, svc_buf + p0, pbytes);
+      }
+      wr += cnt;
+      wsvc += scnt;
+      wpos += pbytes;
+    }
+    n_rec = wr;
+    n_svc = wsvc;
+  }
+  if (extract_s) *extract_s = seconds_since(t1);
   *n_services = n_svc;
   return n_rec;
 }

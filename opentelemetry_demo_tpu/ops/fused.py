@@ -64,6 +64,150 @@ class SketchDelta(NamedTuple):
     stats: jnp.ndarray  # float32[4, S] — cnt, Σlog-lat, Σlog-lat², Σerr
 
 
+class HeadState(NamedTuple):
+    """The EWMA/CUSUM detection-head memory one batch advances — the
+    slice of ``models.detector.DetectorState`` the fused one-pass
+    update owns when the head fold is enabled (NO_COMM path)."""
+
+    lat_mean: jnp.ndarray  # float32[S, T]
+    lat_var: jnp.ndarray  # float32[S, T]
+    err_mean: jnp.ndarray  # float32[S, T]
+    rate_mean: jnp.ndarray  # float32[S, T]
+    rate_var: jnp.ndarray  # float32[S, T]
+    cusum: jnp.ndarray  # float32[S, 3] — {lat↑, err↑, rate↓}
+    obs_batches: jnp.ndarray  # float32[S]
+
+
+def head_update(
+    stats: jnp.ndarray,  # float32[4, S] — cnt, Σlog-lat, Σlog-lat², Σerr
+    heads: HeadState,
+    dt: jnp.ndarray,  # float32[] — seconds since previous batch
+    step_pos: jnp.ndarray,  # bool[] — True past step 0 (rate gate)
+    *,
+    taus_s: tuple,
+    warmup_batches: float,
+    z_warmup_batches: float,
+    cusum_k: float,
+    cusum_cap: float,
+    err_slack: float,
+) -> tuple[HeadState, tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """One batch's EWMA/CUSUM head advance: ``(heads', (lat_z, err_z,
+    rate_z))`` — the 3b/CUSUM math of ``models.detector.detector_step``,
+    hoisted HERE so the NO_COMM spine folds it into the one-pass
+    ``sketch_batch_update`` program (the last delta→HBM round trip PR 9
+    left) while the mesh path applies the SAME function to its
+    collective-merged stats. Formulas are verbatim from the detector
+    step — bit-identical by construction; tests/test_fused.py pins the
+    folded path against this two-step form.
+
+    Count-aware scaling (why each z looks the way it does):
+    latency x̄ of n spans → z=(x̄-μ)/sqrt(σ²/n); error binomial;
+    throughput Poisson with empirically learned burstiness — see the
+    detector-step docstring for the full rationale.
+    """
+    # Per-τ smoothing weights, built from traced SCALARS (a
+    # jnp.asarray(taus_s) constant would be a captured const inside
+    # the Pallas kernel); elementwise-identical to 1-exp(-dt/taus).
+    alphas = jnp.stack(
+        [1.0 - jnp.exp(-dt / jnp.float32(t)) for t in taus_s]
+    )  # [T]
+    cnt, lat_sum, lat_sumsq, err_sum = stats
+    seen = cnt > 0  # [S]
+    obs2d = seen[:, None]
+    warm = (heads.obs_batches < warmup_batches)[:, None]  # [S,1]
+    z_warm = (heads.obs_batches < z_warmup_batches)[:, None]  # [S,1]
+    n = jnp.maximum(cnt, 1.0)[:, None]  # [S,1]
+    # Bias-corrected smoothing (Adam-style debias via max, not divide).
+    alphas = jnp.maximum(
+        alphas, 1.0 / (heads.obs_batches[:, None] + 1.0)
+    )  # [S,T]
+    # Variance gets its own (slow) smoothing — the per-span variance is
+    # a property of the service, not of the detection timescale.
+    alpha_var = jnp.maximum(
+        1.0 - jnp.exp(-dt / jnp.float32(max(taus_s))),
+        1.0 / (heads.obs_batches[:, None] + 1.0),
+    )  # [S,1]
+
+    # Latency: per-span mean μ and per-span variance σ² per timescale,
+    # with a σ floor (≈15% latency noise in log space).
+    mu = heads.lat_mean
+    sigma2 = heads.lat_var
+    floor2 = jnp.float32(0.15 * 0.15)
+    xbar = (lat_sum / jnp.maximum(cnt, 1.0))[:, None]  # [S,1]
+    lat_z = (xbar - mu) / jnp.sqrt(sigma2 / n + floor2)
+    lat_z_cusum = jnp.where(obs2d & ~warm, lat_z, 0.0)
+    lat_z = jnp.where(obs2d & ~z_warm, lat_z, 0.0)
+    lat_mean = jnp.where(obs2d, mu + alphas * (xbar - mu), mu)
+    # E[(x-μ)²] against the *updated* mean.
+    v_obs = (
+        (lat_sumsq / jnp.maximum(cnt, 1.0))[:, None]
+        - 2.0 * lat_mean * xbar
+        + lat_mean * lat_mean
+    )
+    lat_var = jnp.where(
+        obs2d, sigma2 + alpha_var * (jnp.maximum(v_obs, 0.0) - sigma2), sigma2
+    )
+
+    # Error rate: EWMA of p, binomial z on this batch's error count.
+    p = heads.err_mean
+    err_cnt = err_sum[:, None]  # [S,1]
+    err_z = (err_cnt - n * p) / jnp.sqrt(n * p * (1.0 - p) + 1.0)
+    err_z = jnp.where(obs2d & ~z_warm, err_z, 0.0)
+    phat = err_cnt / n
+    err_mean = jnp.where(obs2d, p + alphas * (phat - p), p)
+
+    # Throughput: EWMA of spans/sec; Poisson-floored variance with the
+    # learned burstiness. step 0 carries a meaningless dt — gated out.
+    lam = heads.rate_mean
+    dt_c = jnp.maximum(dt, 1e-3)
+    expected = lam * dt_c
+    emp_var = heads.rate_var * dt_c * dt_c  # (spans/s)² → count²
+    rate_obs = (seen | (heads.obs_batches > 0))[:, None] & step_pos
+    rate_z = (cnt[:, None] - expected) / jnp.sqrt(
+        jnp.maximum(expected, emp_var) + 1.0
+    )
+    rate_z_cusum = jnp.where(rate_obs & ~warm, rate_z, 0.0)
+    rate_z = jnp.where(rate_obs & ~z_warm, rate_z, 0.0)
+    rate_x = (cnt / jnp.maximum(dt, 1e-3))[:, None]
+    rate_mean = jnp.where(rate_obs, lam + alphas * (rate_x - lam), lam)
+    rate_var = jnp.where(
+        rate_obs,
+        heads.rate_var + alpha_var * ((rate_x - lam) ** 2 - heads.rate_var),
+        heads.rate_var,
+    )
+
+    obs_batches = heads.obs_batches + seen.astype(jnp.float32)
+
+    # CUSUM layer: sustained small shifts, standardized scores against
+    # the slowest-τ baseline; sparse services HOLD their accumulators.
+    k = jnp.float32(cusum_k)
+    active = seen & ~warm[:, 0]
+    s_lat = jnp.where(active, lat_z_cusum[:, -1] - k, 0.0)
+    p_ref = err_mean[:, -1]
+    err_sigma = jnp.sqrt(n[:, 0] * p_ref * (1.0 - p_ref) + 1.0)
+    s_err = jnp.where(
+        active,
+        (err_cnt[:, 0] - n[:, 0] * (p_ref + err_slack)) / err_sigma - k,
+        0.0,
+    )
+    s_rate = jnp.where(
+        rate_obs[:, 0] & ~warm[:, 0], -rate_z_cusum[:, -1] - k, 0.0
+    )
+    scores = jnp.stack([s_lat, s_err, s_rate], axis=1)  # [S,3]
+    cusum = jnp.clip(heads.cusum + scores, 0.0, cusum_cap)
+
+    new_heads = HeadState(
+        lat_mean=lat_mean,
+        lat_var=lat_var,
+        err_mean=err_mean,
+        rate_mean=rate_mean,
+        rate_var=rate_var,
+        cusum=cusum,
+        obs_batches=obs_batches,
+    )
+    return new_heads, (lat_z, err_z, rate_z)
+
+
 def _cell_chunk(total_cells: int, batch: int, wide: bool = False) -> int:
     """Lane-chunk size: biggest power-of-two tile dividing the cell count.
 
@@ -171,20 +315,11 @@ def _delta_kernel(
 
 
 def _update_kernel(
-    flat_ref,  # int32[TB, 1] — svc*R + bucket (rank 0 ⇒ no-op)
-    rank_ref,  # int32[TB, 1] — HLL rank, 0 for masked lanes
-    cidx_ref,  # int32[TB, D] — CMS row indices
-    weight_ref,  # int32[TB, 1] — CMS increment (0 for masked lanes)
-    svc_ref,  # int32[TB, 1] — local service id, >=S for masked lanes
-    feats_ref,  # float32[4, TB] — premasked [1, loglat, loglat², err]
-    hll_in_ref,  # int32[W·SR/C, C] — current window banks, row-stacked
-    cms_in_ref,  # int32[W·D, Wc] — current window banks, row-stacked
-    hll_ref,  # out int32[W·SR/C, C] — merged banks
-    cms_ref,  # out int32[W·D, Wc] — merged banks
-    stats_ref,  # out float32[4, S]
-    *,
+    *refs,
     wide: bool,
     n_windows: int,
+    fold_heads: bool = False,
+    head_statics: dict | None = None,
 ):
     """One grid step absorbs one batch tile DIRECTLY into every window
     bank — the single-pass form of :func:`_delta_kernel`.
@@ -198,7 +333,32 @@ def _update_kernel(
     Integer max/add monoids make this bit-identical to delta-then-merge.
     Only the single-chip path may use it: on a mesh the DELTA (not the
     merged bank) must cross the batch-axis collectives.
+
+    Positional refs (``fold_heads=False``)::
+
+        flat[TB,1] rank[TB,1] cidx[TB,D] weight[TB,1] svc[TB,1]
+        feats[4,TB] hll_in cms_in → hll_out cms_out stats[4,S]
+
+    With ``fold_heads=True`` the EWMA/CUSUM head state rides along —
+    inputs gain ``lat_mean/lat_var/err_mean/rate_mean/rate_var[S,T]
+    cusum[S,3] obs[1,S] params[1,2]`` (params = dt, step_pos) and
+    outputs gain the advanced heads plus ``lat_z/err_z/rate_z[S,T]``.
+    The head math (:func:`head_update`, shared verbatim with the xla
+    impl and the mesh path) runs ONCE, on the LAST grid step, consuming
+    the fully-accumulated stats straight from VMEM — the stats delta
+    never round-trips to HBM between sketch fold and head advance,
+    which is what makes the NO_COMM path truly one program.
     """
+    if fold_heads:
+        (flat_ref, rank_ref, cidx_ref, weight_ref, svc_ref, feats_ref,
+         hll_in_ref, cms_in_ref, lat_mean_ref, lat_var_ref, err_mean_ref,
+         rate_mean_ref, rate_var_ref, cusum_ref, obs_ref, params_ref,
+         hll_ref, cms_ref, stats_ref, lat_mean_o, lat_var_o, err_mean_o,
+         rate_mean_o, rate_var_o, cusum_o, obs_o, lat_z_o, err_z_o,
+         rate_z_o) = refs
+    else:
+        (flat_ref, rank_ref, cidx_ref, weight_ref, svc_ref, feats_ref,
+         hll_in_ref, cms_in_ref, hll_ref, cms_ref, stats_ref) = refs
     b = flat_ref.shape[0]
     rows_hll, c_hll = hll_ref.shape
     n_hll = rows_hll // n_windows
@@ -261,8 +421,40 @@ def _update_kernel(
     tile_stats = jnp.dot(
         feats_ref[:], onehot, preferred_element_type=jnp.float32
     )
-    prev = jnp.where(first, 0.0, stats_ref[:])
-    stats_ref[:] = prev + tile_stats
+    new_stats = jnp.where(first, 0.0, stats_ref[:]) + tile_stats
+    stats_ref[:] = new_stats
+
+    if fold_heads:
+        # EWMA/CUSUM head advance, ONCE, on the last grid step — the
+        # accumulated stats are consumed from VMEM (new_stats), never
+        # re-read from HBM. Same head_update the xla impl and the mesh
+        # path run, so every impl is bit-identical by shared code.
+        @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
+        def _fold_heads():
+            heads = HeadState(
+                lat_mean=lat_mean_ref[:],
+                lat_var=lat_var_ref[:],
+                err_mean=err_mean_ref[:],
+                rate_mean=rate_mean_ref[:],
+                rate_var=rate_var_ref[:],
+                cusum=cusum_ref[:],
+                obs_batches=obs_ref[0, :],
+            )
+            dt = params_ref[0, 0]
+            step_pos = params_ref[0, 1] > 0.5
+            new_heads, (lat_z, err_z, rate_z) = head_update(
+                new_stats, heads, dt, step_pos, **head_statics
+            )
+            lat_mean_o[:] = new_heads.lat_mean
+            lat_var_o[:] = new_heads.lat_var
+            err_mean_o[:] = new_heads.err_mean
+            rate_mean_o[:] = new_heads.rate_mean
+            rate_var_o[:] = new_heads.rate_var
+            cusum_o[:] = new_heads.cusum
+            obs_o[0, :] = new_heads.obs_batches
+            lat_z_o[:] = lat_z
+            err_z_o[:] = err_z
+            rate_z_o[:] = rate_z
 
 
 def _out_structs(
@@ -490,7 +682,11 @@ def _update_pallas(
     cms_width: int,
     interpret: bool = False,
     batch_tile: int | None = None,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    heads: HeadState | None = None,
+    dt: jnp.ndarray | None = None,
+    step_pos: jnp.ndarray | None = None,
+    head_statics: dict | None = None,
+):
     b = flat.shape[0]
     nb, tb = _batch_tiling(b, batch_tile)
     sr = num_services * hll_regs
@@ -502,13 +698,41 @@ def _update_pallas(
     # mosaic tiler onto an untested layout for no bandwidth gain).
     hll2 = hll_cur.reshape(n_windows * (sr // c_hll), c_hll)
     cms2 = cms_cur.reshape(n_windows * cms_depth, cms_width)
+    fold = heads is not None
+    out_dims: list[tuple[tuple[int, ...], jnp.dtype]] = [
+        (hll2.shape, jnp.int32),
+        (cms2.shape, jnp.int32),
+        ((4, num_services), jnp.float32),
+    ]
+    head_ins: tuple = ()
+    if fold:
+        params = jnp.stack(
+            [
+                jnp.asarray(dt, jnp.float32),
+                jnp.asarray(step_pos, jnp.float32),
+            ]
+        ).reshape(1, 2)
+        head_ins = (
+            heads.lat_mean, heads.lat_var, heads.err_mean,
+            heads.rate_mean, heads.rate_var, heads.cusum,
+            heads.obs_batches.reshape(1, num_services), params,
+        )
+        n_taus = heads.lat_mean.shape[1]
+        out_dims += [
+            ((num_services, n_taus), jnp.float32),  # lat_mean'
+            ((num_services, n_taus), jnp.float32),  # lat_var'
+            ((num_services, n_taus), jnp.float32),  # err_mean'
+            ((num_services, n_taus), jnp.float32),  # rate_mean'
+            ((num_services, n_taus), jnp.float32),  # rate_var'
+            ((num_services, 3), jnp.float32),       # cusum'
+            ((1, num_services), jnp.float32),       # obs_batches'
+            ((num_services, n_taus), jnp.float32),  # lat_z
+            ((num_services, n_taus), jnp.float32),  # err_z
+            ((num_services, n_taus), jnp.float32),  # rate_z
+        ]
     out_shape = _out_structs(
-        [
-            (hll2.shape, jnp.int32),
-            (cms2.shape, jnp.int32),
-            ((4, num_services), jnp.float32),
-        ],
-        (flat, rank, cidx_t, weight, svc, feats, hll2, cms2),
+        out_dims,
+        (flat, rank, cidx_t, weight, svc, feats, hll2, cms2) + head_ins,
     )
     d = cidx_t.shape[1]
 
@@ -518,33 +742,36 @@ def _update_pallas(
     def feats_tile(i):  # [4, B] input: tile the lane (col) axis
         return (0, i)
 
-    def whole(i):  # banks/outputs: same full block every grid step
+    def whole(i):  # banks/heads/outputs: same full block every step
         return (0, 0)
 
-    hll_new, cms_new, stats = pl.pallas_call(
+    def whole_spec(shape):
+        return pl.BlockSpec(shape, whole, memory_space=pltpu.VMEM)
+
+    in_specs = [
+        pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tb, d), col_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((4, tb), feats_tile, memory_space=pltpu.VMEM),
+        whole_spec(hll2.shape),
+        whole_spec(cms2.shape),
+    ] + [whole_spec(tuple(x.shape)) for x in head_ins]
+    out_specs = tuple(whole_spec(shape) for shape, _dtype in out_dims)
+
+    got = pl.pallas_call(
         functools.partial(
-            _update_kernel, wide=wide, n_windows=n_windows
+            _update_kernel, wide=wide, n_windows=n_windows,
+            fold_heads=fold, head_statics=head_statics,
         ),
         grid=(nb,),
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         out_shape=out_shape,
-        in_specs=[
-            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, d), col_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, 1), col_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, tb), feats_tile, memory_space=pltpu.VMEM),
-            pl.BlockSpec(hll2.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec(cms2.shape, whole, memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(hll2.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec(cms2.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, num_services), whole, memory_space=pltpu.VMEM),
-        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
         interpret=interpret,
     )(
         flat.reshape(b, 1),
@@ -555,12 +782,19 @@ def _update_pallas(
         feats,
         hll2,
         cms2,
+        *head_ins,
     )
-    return (
-        hll_new.reshape(n_windows, num_services, hll_regs),
-        cms_new.reshape(n_windows, cms_depth, cms_width),
-        stats,
+    hll_new = got[0].reshape(n_windows, num_services, hll_regs)
+    cms_new = got[1].reshape(n_windows, cms_depth, cms_width)
+    stats = got[2]
+    if not fold:
+        return hll_new, cms_new, stats
+    new_heads = HeadState(
+        lat_mean=got[3], lat_var=got[4], err_mean=got[5],
+        rate_mean=got[6], rate_var=got[7], cusum=got[8],
+        obs_batches=got[9].reshape(num_services),
     )
+    return hll_new, cms_new, stats, new_heads, (got[10], got[11], got[12])
 
 
 def sketch_batch_update(
@@ -579,7 +813,19 @@ def sketch_batch_update(
     cms_width: int = cms.CMS_WIDTH,
     impl: str = "xla",  # "xla" | "pallas" | "interpret"
     batch_tile: int | None = None,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    heads: HeadState | None = None,
+    dt: jnp.ndarray | None = None,
+    step_pos: jnp.ndarray | None = None,
+    # Head constants: REQUIRED whenever ``heads`` is passed (no
+    # defaults here — they live in DetectorConfig, and a stale copy
+    # would silently detune the folded path).
+    taus_s: tuple | None = None,
+    warmup_batches: float | None = None,
+    z_warmup_batches: float | None = None,
+    cusum_k: float | None = None,
+    cusum_cap: float | None = None,
+    err_slack: float | None = None,
+):
     """One-pass batch absorption: ``(hll_banks', cms_banks', stats)``.
 
     The single-chip fast path of the ingest spine: instead of
@@ -593,9 +839,21 @@ def sketch_batch_update(
     every impl bit-identical to the two-step form — pinned by
     tests/test_fused.py.
 
+    **Fused head update** (the r15 close of PR 9's last round trip):
+    pass ``heads`` (+ ``dt``, ``step_pos`` and the head constants) and
+    the EWMA/CUSUM head advance folds into the SAME program — the
+    return grows to ``(hll', cms', stats, heads',
+    (lat_z, err_z, rate_z))``. In the Pallas impl the head math runs on
+    the last grid step against the VMEM-resident stats accumulator, so
+    no stats delta round-trips to HBM between sketch fold and head
+    advance; every impl shares :func:`head_update` verbatim, making the
+    folded form bit-identical to calling it separately (pinned by
+    tests/test_fused.py).
+
     NOT for the mesh path: under ``shard_map`` the per-shard DELTA must
     cross the batch-axis collectives before any bank merge, so
-    ``detector_step`` uses this only when ``comm is NO_COMM``.
+    ``detector_step`` uses this only when ``comm is NO_COMM`` (the mesh
+    path applies :func:`head_update` to the psum-merged stats instead).
     """
     r = 1 << hll_p
     svc = svc.astype(jnp.int32)
@@ -603,6 +861,29 @@ def sketch_batch_update(
     bucket, rank = hll.hll_indices(trace_hi, trace_lo, p=hll_p)
     rank = jnp.where(valid & in_slice, rank, 0)
     flat = jnp.where(in_slice, svc, 0) * r + bucket
+    head_statics = None
+    if heads is not None:
+        required = dict(
+            taus_s=taus_s, warmup_batches=warmup_batches,
+            z_warmup_batches=z_warmup_batches, cusum_k=cusum_k,
+            cusum_cap=cusum_cap, err_slack=err_slack, dt=dt,
+            step_pos=step_pos,
+        )
+        missing = [k for k, v in required.items() if v is None]
+        if missing:
+            raise TypeError(
+                f"sketch_batch_update(heads=...) requires {missing} "
+                "(the head constants come from DetectorConfig — no "
+                "defaults here)"
+            )
+        head_statics = dict(
+            taus_s=tuple(taus_s),
+            warmup_batches=warmup_batches,
+            z_warmup_batches=z_warmup_batches,
+            cusum_k=cusum_k,
+            cusum_cap=cusum_cap,
+            err_slack=err_slack,
+        )
 
     if impl == "xla":
         delta = sketch_batch_delta(
@@ -610,11 +891,17 @@ def sketch_batch_update(
             num_services=num_services, hll_p=hll_p, cms_width=cms_width,
             impl="xla",
         )
-        return (
+        merged = (
             jnp.maximum(hll_cur, delta.hll[None]),
             cms_cur + delta.cms[None],
             delta.stats,
         )
+        if heads is None:
+            return merged
+        new_heads, zs = head_update(
+            delta.stats, heads, dt, step_pos, **head_statics
+        )
+        return merged + (new_heads, zs)
 
     valid_f = valid.astype(jnp.float32)
     log_lat = log_lat.astype(jnp.float32) * valid_f
@@ -642,6 +929,10 @@ def sketch_batch_update(
         cms_width=cms_width,
         interpret=(impl == "interpret"),
         batch_tile=batch_tile,
+        heads=heads,
+        dt=dt,
+        step_pos=step_pos,
+        head_statics=head_statics,
     )
 
 

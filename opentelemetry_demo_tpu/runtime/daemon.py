@@ -58,7 +58,10 @@ pass):
   workers, default 2; 0 disables the pool — serial in-thread decode),
   ``ANOMALY_INGEST_COALESCE`` (max requests per batched decode+flush,
   default 64), ``ANOMALY_INGEST_MAX_PENDING`` (bounded request queue
-  ahead of the pool, default 512; full = retryable 429)
+  ahead of the pool, default 512; full = retryable 429),
+  ``ANOMALY_INGEST_NATIVE_THREADS`` / ``ANOMALY_INGEST_SHARD_MIN_BYTES``
+  (two-pass scanner pass-2 sharding: extraction threads per batched
+  decode call and the payload-byte floor that arms them)
 - Device-put spine knobs (one registry: ``utils.config.SPINE_KNOBS``;
   engine: ``runtime.spine`` — the staging ring between batch assembly
   and the donated device step): ``ANOMALY_SPINE_RING`` (pre-allocated
@@ -929,6 +932,8 @@ class DetectorDaemon:
                 max_pending=ing["ANOMALY_INGEST_MAX_PENDING"],
                 phase_observe=self._observe_phase,
                 selftrace=self.selftrace,
+                native_threads=ing["ANOMALY_INGEST_NATIVE_THREADS"],
+                shard_min_bytes=ing["ANOMALY_INGEST_SHARD_MIN_BYTES"],
             )
             self._supervisor.register(
                 "ingest-pool", base_backoff_s=0.1, max_backoff_s=5.0,

@@ -58,10 +58,12 @@ reader is current.
 CRC32C (Castagnoli) is the checksum: hardware-friendly, and the
 polynomial with the best burst-detection record for storage framing
 (the same choice as Kafka record batches, ext4 metadata and iSCSI).
-The native kernel (``native/ingest.cc otd_crc32c``, slicing-by-8,
-GIL-released like every other native call) computes it at memory
-bandwidth; environments without a compiler fall back to the table
-implementation below — same bits, less throughput.
+The native kernel (``native/ingest.cc otd_crc32c``, the SSE4.2
+``crc32`` instruction when the CPU offers it — same polynomial, so
+bit-identical by definition — slicing-by-8 otherwise, GIL-released
+like every other native call) computes it at memory bandwidth;
+environments without a compiler fall back to the table implementation
+below — same bits, less throughput.
 
 Corruption handling contract for every consumer: verify BEFORE
 merging; a failed check **quarantines** the frame (``quarantine()``

@@ -56,9 +56,27 @@ loaded one sees deep batches automatically (the queue fills while
 workers are busy — the same self-clocking the reference collector's
 batch processor exhibits under load).
 
+The r15 decode-wall rework sharpened the engine on three axes:
+
+- **Two-pass native scanner** (ingest.cc): decode is now a structural
+  boundary scan (pass 1 → span index) plus an index-driven column
+  extraction (pass 2), reported separately to the
+  ``anomaly_phase_seconds{phase=scan|extract}`` histograms.
+- **Intra-call sharding**: a flush carrying ≥
+  ``ANOMALY_INGEST_SHARD_MIN_BYTES`` of payload splits its pass-2
+  extraction across up to ``ANOMALY_INGEST_NATIVE_THREADS`` native OS
+  threads at span-record boundaries — mid-payload included, so ONE
+  oversized OTLP export spreads over cores instead of serializing on
+  whichever worker drained it.
+- **Per-worker arena interning** (tensorize.InternArena): each worker
+  resolves the flush's service names against worker-local memory; only
+  a never-seen name pays one batched reconciliation against the shared
+  read-mostly table. Intern ids stay bit-identical to the serial path.
+
 Knob registry: ``utils.config.INGEST_KNOBS`` (workers / coalesce /
-max-pending), threaded through the daemon env, the compose overlay and
-the k8s generator; scripts/sanitycheck.py pins the correspondence.
+max-pending / native-threads / shard-min-bytes), threaded through the
+daemon env, the compose overlay and the k8s generator;
+scripts/sanitycheck.py pins the correspondence.
 """
 
 from __future__ import annotations
@@ -74,11 +92,20 @@ from . import frame, native
 from .otlp import MONITORED_ATTR_KEYS, decode_export_request
 from .selftrace import (
     PHASE_DECODE,
+    PHASE_EXTRACT,
+    PHASE_SCAN,
     PHASE_SUBMIT,
     PHASE_TENSORIZE,
     PHASE_VERIFY,
 )
-from .tensorize import SpanColumns, SpanRecord, SpanTensorizer
+from .tensorize import InternArena, SpanColumns, SpanRecord, SpanTensorizer
+
+# Phases whose durations PARTITION a flush's wall time. PHASE_SCAN /
+# PHASE_EXTRACT are sub-phases INSIDE the decode envelope (the native
+# two-pass split) — share computations over TOP_PHASES stay a true
+# breakdown while the sub-phases ride the same histograms for
+# attribution.
+TOP_PHASES = (PHASE_DECODE, PHASE_VERIFY, PHASE_TENSORIZE, PHASE_SUBMIT)
 
 
 class IngestPoolSaturated(RuntimeError):
@@ -344,6 +371,8 @@ class IngestPool:
         attr_keys: Sequence[str] = MONITORED_ATTR_KEYS,
         phase_observe=None,
         selftrace=None,
+        native_threads: int = 2,
+        shard_min_bytes: int = native.SHARD_MIN_BYTES_DEFAULT,
     ):
         if workers <= 0:
             raise ValueError("IngestPool needs workers >= 1 (0 = no pool)")
@@ -351,6 +380,14 @@ class IngestPool:
         self.tensorizer = tensorizer
         self.workers = int(workers)
         self.coalesce_max = max(int(coalesce_max), 1)
+        # Intra-call sharding (the two-pass scanner's pass 2): a flush
+        # carrying >= shard_min_bytes of payload splits its extraction
+        # across up to native_threads OS threads at span-record
+        # boundaries — one oversized export no longer serializes on
+        # one core even when only one pool worker holds it.
+        # native_threads <= 1 keeps extraction serial per call.
+        self.native_threads = int(native_threads)
+        self.shard_min_bytes = int(shard_min_bytes)
         self.attr_keys = tuple(attr_keys)
         # Self-telemetry (runtime.selftrace): ``phase_observe(phase,
         # seconds)`` feeds the promoted anomaly_phase_seconds
@@ -378,8 +415,8 @@ class IngestPool:
         # submit) — the attribution the spine's win is measured by
         # (ingestbench phase breakdown).
         self.phase_s = {
-            PHASE_DECODE: 0.0, PHASE_VERIFY: 0.0,
-            PHASE_TENSORIZE: 0.0, PHASE_SUBMIT: 0.0,
+            PHASE_DECODE: 0.0, PHASE_SCAN: 0.0, PHASE_EXTRACT: 0.0,
+            PHASE_VERIFY: 0.0, PHASE_TENSORIZE: 0.0, PHASE_SUBMIT: 0.0,
         }
         self._scratch_corrupt_seen = 0
         self.busy_s = 0.0  # summed across workers
@@ -452,6 +489,11 @@ class IngestPool:
     # -- worker side ---------------------------------------------------
 
     def _run(self) -> None:
+        # Per-worker intern arena: the flush's service names resolve
+        # against worker-local memory; only a genuinely new name pays
+        # ONE batched reconciliation with the shared tensorizer table.
+        # Ids are bit-identical to the serial service_id path.
+        arena = InternArena(self.tensorizer)
         while True:
             batch = self._q.get_batch(self.coalesce_max)
             jobs = [b for b in batch if b is not _STOP]
@@ -463,7 +505,7 @@ class IngestPool:
             if jobs:
                 t0 = time.perf_counter()
                 try:
-                    self._process(jobs)
+                    self._process(jobs, arena)
                 except Exception as e:  # noqa: BLE001 — worker survives
                     # Unexpected (non-decode) failure: resolve every
                     # ticket with a SERVER-fault wrapper so no receiver
@@ -489,7 +531,7 @@ class IngestPool:
             if n_stop:
                 return
 
-    def _process(self, batch: list) -> None:
+    def _process(self, batch: list, arena: InternArena | None = None) -> None:
         payload_jobs = [(d, t) for kind, d, t in batch if kind == "payload"]
         record_jobs = [(d, t) for kind, d, t in batch if kind == "records"]
         parts: list[SpanColumns] = []
@@ -501,7 +543,7 @@ class IngestPool:
         seg: dict[str, float] = {}
         if payload_jobs:
             if native.available():
-                parts += self._decode_native(payload_jobs, errors, seg)
+                parts += self._decode_native(payload_jobs, errors, seg, arena)
             else:
                 parts += self._decode_python(payload_jobs, errors, seg)
         if record_jobs:
@@ -538,7 +580,7 @@ class IngestPool:
             if ticket is not None:
                 ticket._resolve(None)
 
-    def _decode_native(self, payload_jobs, errors, seg) -> list[SpanColumns]:
+    def _decode_native(self, payload_jobs, errors, seg, arena=None) -> list[SpanColumns]:
         payloads = [p for p, _t in payload_jobs]
         total = sum(len(p) for p in payloads)
         t0 = time.perf_counter()
@@ -546,17 +588,28 @@ class IngestPool:
             *native.scratch_dims(total, len(payloads))
         )
         parked = False
+        native_phases: dict[str, float] = {}
         try:
             cols, payload_rows = native.decode_otlp_many(
-                payloads, self.attr_keys, scratch
+                payloads, self.attr_keys, scratch,
+                threads=self.native_threads,
+                shard_min_bytes=self.shard_min_bytes,
+                phases=native_phases,
             )
             for i, rows in enumerate(payload_rows):
                 if rows < 0:
                     errors[i] = ValueError("malformed OTLP payload")
             # Phase sample BEFORE the empty-flush return: an all-
             # malformed flood burns real decode time and the
-            # attribution must show it.
+            # attribution must show it. scan/extract are the native
+            # call's own two-pass split — sub-phases of the decode
+            # envelope, never added into a share denominator
+            # (TOP_PHASES).
             self._phase(PHASE_DECODE, time.perf_counter() - t0, seg)
+            self._phase(PHASE_SCAN, native_phases.get("scan", 0.0), seg)
+            self._phase(
+                PHASE_EXTRACT, native_phases.get("extract", 0.0), seg
+            )
             if not cols.duration_us.shape[0]:
                 return []
             # Zero-copy hand-off (the ingest spine): the pipeline
@@ -577,7 +630,9 @@ class IngestPool:
             crcs = frame.span_column_crcs(cols)
             self._phase(PHASE_VERIFY, time.perf_counter() - t0, seg)
             t0 = time.perf_counter()
-            out = self.tensorizer.columns_from_columnar(cols, copy=False)
+            out = self.tensorizer.columns_from_columnar(
+                cols, copy=False, arena=arena
+            )
             self._phase(PHASE_TENSORIZE, time.perf_counter() - t0, seg)
             if cols.duration_us.base is scratch.duration:
                 self._scratch.park(scratch, cols, crcs)

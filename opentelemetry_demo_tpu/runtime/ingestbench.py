@@ -185,13 +185,109 @@ def measure_pooled_detail(workers: int = 2, n_requests: int = 64,
     finally:
         pool.close()
     phase = stats["phase_s"]
-    total = sum(phase.values()) or 1.0
+    from .ingest_pool import TOP_PHASES
+
+    # Share over the TOP-LEVEL phases only: scan/extract are
+    # sub-phases INSIDE the decode envelope (the native two-pass
+    # split) — summing them into the denominator would double-count.
+    total = sum(phase.get(k, 0.0) for k in TOP_PHASES) or 1.0
+    decode_s = phase.get("decode", 0.0) or 1.0
     return {
         "spans_per_sec": n_spans / best,
-        "phase_share": {k: round(v / total, 4) for k, v in phase.items()},
+        "phase_share": {
+            k: round(phase.get(k, 0.0) / total, 4) for k in TOP_PHASES
+        },
+        # How the decode envelope itself splits between the boundary
+        # scan and the column extraction (fractions of decode time;
+        # the remainder is the ctypes/scratch glue around the call).
+        "decode_split": {
+            "scan": round(phase.get("scan", 0.0) / decode_s, 4),
+            "extract": round(phase.get("extract", 0.0) / decode_s, 4),
+        },
         "tickets_parked": stats["tickets_parked"],
         "tickets_recycled": stats["tickets_recycled"],
     }
+
+
+def measure_raw(n_requests: int = 64, spans_per_request: int = 128,
+                repeat: int = 5,
+                payloads: list[bytes] | None = None) -> dict | None:
+    """Raw two-pass scanner microbench (`make decodebench`): pass-1
+    scan vs pass-2 extract vs whole-call throughput PER THREAD, with
+    no pool, no tensorize, no CRC manifest — the number a future
+    decode regression is attributable against without running the full
+    engine. None when native is unavailable.
+    """
+    if not native.available():
+        return None
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    n_spans = n_requests * spans_per_request
+    # The per-pass times come from INSIDE the one batched call
+    # (ingest.cc stamps scan_s/extract_s around its own passes), so
+    # neither number carries ctypes call overhead or buffer churn —
+    # it is the native pass itself, per thread.
+    total = sum(map(len, payloads))
+    scratch = native.alloc_scratch(
+        *native.scratch_dims(total, len(payloads))
+    )
+    phases: dict[str, float] = {}
+    native.decode_otlp_many(
+        payloads, MONITORED_ATTR_KEYS, scratch, phases=phases
+    )  # warmup
+    decode_t = float("inf")
+    scan_t = float("inf")
+    extract_t = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        native.decode_otlp_many(
+            payloads, MONITORED_ATTR_KEYS, scratch, phases=phases
+        )
+        decode_t = min(decode_t, time.perf_counter() - t0)
+        scan_t = min(scan_t, phases.get("scan") or decode_t)
+        extract_t = min(extract_t, phases.get("extract") or decode_t)
+    return {
+        "scan_spans_per_sec": n_spans / scan_t,
+        "extract_spans_per_sec": n_spans / extract_t,
+        "decode_spans_per_sec": n_spans / decode_t,
+        "scan_bytes_per_sec": total / scan_t,
+        "payload_bytes": total,
+    }
+
+
+def measure_fat_payload_scaling(
+    spans: int = 65536, threads_list=(1, 2), repeat: int = 3
+) -> dict | None:
+    """ONE oversized OTLP export decoded with N native extraction
+    threads (the pass-2 sharding leg `make ingestbench` gates): a
+    single fat payload must not serialize on one core. Returns
+    {"1": spans/s, "2": spans/s, ..., "scaling": rate_N/rate_1} or
+    None when native is unavailable.
+    """
+    if not native.available():
+        return None
+    payload = make_payloads(1, spans, seed=3)[0]
+    scratch = native.alloc_scratch(
+        *native.scratch_dims(len(payload), 1)
+    )
+    out: dict = {}
+    for t in threads_list:
+        best = float("inf")
+        native.decode_otlp_many(
+            [payload], MONITORED_ATTR_KEYS, scratch, threads=t,
+            shard_min_bytes=0,
+        )
+        for _ in range(repeat):
+            t0 = time.perf_counter()
+            native.decode_otlp_many(
+                [payload], MONITORED_ATTR_KEYS, scratch, threads=t,
+                shard_min_bytes=0,
+            )
+            best = min(best, time.perf_counter() - t0)
+        out[str(t)] = spans / best
+    rates = [out[str(t)] for t in threads_list]
+    out["scaling"] = round(rates[-1] / rates[0], 3) if rates[0] else None
+    return out
 
 
 def measure_scaling(workers_list=(1, 2, 3, 4), n_requests: int = 64,

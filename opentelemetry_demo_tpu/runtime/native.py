@@ -29,6 +29,14 @@ the GIL-free window safe. Pinned by
 tests/test_ingest_pool.py::test_native_decode_releases_gil — a Python
 counter thread must keep making progress while a big decode call is
 in flight.
+
+The r15 two-pass scanner extends the same contract INSIDE the window:
+``otd_decode_otlp_many`` may spawn ``threads`` native OS threads to
+shard its pass-2 extraction. Those threads live entirely within the
+GIL-free foreign call (spawned and joined before ctypes re-acquires),
+touch only the raw C buffers, and never see a Python object — so the
+safety argument is unchanged and the sharding is invisible to the
+interpreter beyond the call returning sooner.
 """
 
 from __future__ import annotations
@@ -91,6 +99,10 @@ def _build(name: str) -> str | None:
         "-fPIC",
         "-Wall",
         "-Wextra",
+        # ingest.cc's sharded decode_many spawns std::thread workers;
+        # -pthread is required for that on Linux and harmless for the
+        # single-threaded translation units sharing this build rule.
+        "-pthread",
         "-shared",
         "-o",
         out,
@@ -158,6 +170,32 @@ def _configure_ingest(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
         ctypes.POINTER(ctypes.c_int32),             # n_services
         ctypes.c_void_p,                            # payload_rows
+        ctypes.c_int, ctypes.c_longlong,            # n_threads, shard_min
+        ctypes.POINTER(ctypes.c_double),            # scan_s
+        ctypes.POINTER(ctypes.c_double),            # extract_s
+    ]
+    # Two-pass split, exposed raw for the decodebench microbench and
+    # the boundary-adversarial fuzz suite: pass 1 (structural scan →
+    # span index) and pass 2 (index → columns).
+    lib.otd_scan_otlp.restype = ctypes.c_int
+    lib.otd_scan_otlp.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,           # buf, len
+        ctypes.c_void_p, ctypes.c_void_p,           # span_off, span_len
+        ctypes.c_void_p, ctypes.c_int,              # span_svc, span_cap
+        ctypes.c_char_p, ctypes.c_size_t,           # svc_buf, cap
+        ctypes.c_void_p, ctypes.c_int,              # svc_len, rs_cap
+        ctypes.POINTER(ctypes.c_int32),             # n_services
+    ]
+    lib.otd_extract_otlp.restype = ctypes.c_int
+    lib.otd_extract_otlp.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,           # buf, len
+        ctypes.c_void_p, ctypes.c_void_p,           # span_off, span_len
+        ctypes.c_void_p, ctypes.c_int,              # span_svc, n_spans
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,  # keys
+        ctypes.c_void_p, ctypes.c_void_p,           # duration, trace
+        ctypes.c_void_p, ctypes.c_void_p,           # err, crc
+        ctypes.c_void_p, ctypes.c_void_p,           # present, svc_idx
+        ctypes.c_void_p, ctypes.c_void_p,           # event_count, has_exc
     ]
     lib.otd_decode_orders.restype = ctypes.c_int
     lib.otd_decode_orders.argtypes = [
@@ -470,10 +508,20 @@ def scratch_dims(
     )
 
 
+# Default byte floor below which decode_otlp_many never shards a batch
+# across native threads: under ~256 KiB the extraction wall is small
+# enough that a std::thread spawn/join costs more than it hides.
+# Overridden per call (the pool passes ANOMALY_INGEST_SHARD_MIN_BYTES).
+SHARD_MIN_BYTES_DEFAULT = 262144
+
+
 def decode_otlp_many(
     payloads: Sequence[bytes],
     attr_keys: Sequence[str],
     scratch: DecodeScratch | None = None,
+    threads: int = 0,
+    shard_min_bytes: int = SHARD_MIN_BYTES_DEFAULT,
+    phases: dict | None = None,
 ) -> tuple[ColumnarSpans, np.ndarray]:
     """Batched columnar decode: many requests, ONE ctypes round trip.
 
@@ -483,6 +531,16 @@ def decode_otlp_many(
     i's row count or ``-1`` when that payload was malformed — the
     per-request verdict the receivers turn into a 400 for exactly the
     bad request while its batchmates proceed.
+
+    Two-pass under the hood (ingest.cc): a structural scan builds the
+    batch-wide span index, then extraction fills the columns — sharded
+    across up to ``threads`` native OS threads at span-record
+    boundaries (mid-payload included, so ONE oversized export spreads
+    over cores) whenever the batch carries ≥ ``shard_min_bytes``.
+    ``threads<=1`` keeps the serial extraction. ``phases`` (optional
+    dict) receives the per-pass wall seconds as ``{"scan": s,
+    "extract": s}`` — the ingest pool feeds them to the
+    anomaly_phase_seconds histograms.
 
     With ``scratch`` provided the returned arrays are VIEWS into it
     (zero-copy — the ingest pool's hot path; copy before releasing the
@@ -502,6 +560,8 @@ def decode_otlp_many(
     total = int(lens.sum()) if n_payloads else 0
     payload_rows = np.empty(max(n_payloads, 1), np.int32)
     keys = _keys_array(attr_keys)
+    scan_s = ctypes.c_double(0.0)
+    extract_s = ctypes.c_double(0.0)
     retried = False
     while True:
         need = scratch_dims(total, n_payloads, retried)
@@ -519,6 +579,8 @@ def decode_otlp_many(
             s.svc_buf, s.svc_cap,
             s.svc_len.ctypes.data, s.rs_cap,
             ctypes.byref(n_services), payload_rows.ctypes.data,
+            int(threads), int(shard_min_bytes),
+            ctypes.byref(scan_s), ctypes.byref(extract_s),
         )
         if n in (-2, -3) and not retried:
             # Pathological tiny-span payloads overflowed the heuristic
@@ -529,6 +591,9 @@ def decode_otlp_many(
             continue
         if n < 0:
             raise ValueError(f"otlp batch decode failed (code {n})")
+        if phases is not None:
+            phases["scan"] = scan_s.value
+            phases["extract"] = extract_s.value
         # Copy ONLY the used name-byte prefix, once: `svc_buf.raw` would
         # copy the whole (payload-sized) buffer per access — measured at
         # ~90% of a big flush's wall time before this went string_at.
@@ -555,6 +620,103 @@ def decode_otlp_many(
                 *(a[:n].copy() for a in cols[:8]), services
             )
         return cols, payload_rows[:n_payloads]
+
+
+class SpanIndex(NamedTuple):
+    """Pass-1 structural index over ONE payload (`scan_otlp`): span
+    record boundaries plus the resource-spans service table — exactly
+    what pass 2 (`extract_otlp`) consumes. Offsets are relative to the
+    scanned payload's first byte."""
+
+    span_off: np.ndarray  # int32[N] — span submessage offset
+    span_len: np.ndarray  # int32[N] — span submessage length
+    span_svc: np.ndarray  # int32[N] — resource-spans entry per span
+    services: list[str | None]
+
+
+def scan_otlp(payload: bytes) -> SpanIndex:
+    """Pass 1 alone: structural scan → span index (no column work).
+
+    The raw-scanner surface `make decodebench` prices and the fuzz
+    suite's boundary oracle (truncation exactly at a pass-1 boundary).
+    Raises ``ValueError`` on malformed framing — span-interior damage
+    is invisible to the scan by design (pass 2's verdict).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {load_error()}")
+    cap = len(payload) // 2 + 64  # hard ceiling: a span costs ≥2 bytes
+    rs_cap = len(payload) // 2 + 2
+    svc_cap = len(payload) + 1
+    span_off = np.empty(cap, np.int32)
+    span_len = np.empty(cap, np.int32)
+    span_svc = np.empty(cap, np.int32)
+    svc_buf = ctypes.create_string_buffer(svc_cap)
+    svc_len = np.empty(rs_cap, np.int32)
+    n_services = ctypes.c_int32(0)
+    n = lib.otd_scan_otlp(
+        payload, len(payload),
+        span_off.ctypes.data, span_len.ctypes.data, span_svc.ctypes.data,
+        cap, svc_buf, svc_cap, svc_len.ctypes.data, rs_cap,
+        ctypes.byref(n_services),
+    )
+    if n < 0:
+        raise ValueError(f"malformed OTLP payload (code {n})")
+    services: list[str | None] = []
+    pos = 0
+    blob = ctypes.string_at(
+        svc_buf, sum(int(ln) for ln in svc_len[: n_services.value] if ln > 0)
+    )
+    for ln in svc_len[: n_services.value]:
+        if ln < 0:
+            services.append(None)
+        else:
+            services.append(blob[pos : pos + ln].decode("utf-8", "replace"))
+            pos += ln
+    return SpanIndex(
+        span_off[:n].copy(), span_len[:n].copy(), span_svc[:n].copy(),
+        services,
+    )
+
+
+def extract_otlp(
+    payload: bytes, index: SpanIndex, attr_keys: Sequence[str]
+) -> ColumnarSpans:
+    """Pass 2 alone: a `scan_otlp` index → columns (no re-scan).
+
+    Completes the decode the way `decode_otlp` would have — the
+    decodebench pairing that isolates extract throughput from scan
+    throughput. Raises ``ValueError`` on a malformed span interior.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {load_error()}")
+    n = index.span_off.shape[0]
+    duration = np.empty(n, np.float32)
+    trace = np.empty(n, np.uint64)
+    err = np.empty(n, np.uint8)
+    crc = np.empty(n, np.uint32)
+    present = np.empty(n, np.uint8)
+    svc_idx = np.empty(n, np.int32)
+    event_count = np.empty(n, np.int32)
+    has_exc = np.empty(n, np.uint8)
+    keys = _keys_array(attr_keys)
+    rc = lib.otd_extract_otlp(
+        payload, len(payload),
+        index.span_off.ctypes.data, index.span_len.ctypes.data,
+        index.span_svc.ctypes.data, n,
+        keys, len(attr_keys),
+        duration.ctypes.data, trace.ctypes.data,
+        err.ctypes.data, crc.ctypes.data,
+        present.ctypes.data, svc_idx.ctypes.data,
+        event_count.ctypes.data, has_exc.ctypes.data,
+    )
+    if rc < 0:
+        raise ValueError(f"malformed OTLP payload (code {rc})")
+    return ColumnarSpans(
+        duration, trace, err, crc, present, svc_idx, event_count, has_exc,
+        list(index.services),
+    )
 
 
 def decode_orders(payloads: Sequence[bytes]) -> ColumnarOrders:

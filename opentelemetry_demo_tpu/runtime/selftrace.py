@@ -65,6 +65,8 @@ SELF_SERVICE = "anomaly-detector"
 # dashboards and the Jaeger searches are written against.
 SPAN_BATCH = "detector.batch"
 SPAN_DECODE = "detector.decode"
+SPAN_DECODE_SCAN = "detector.decode_scan"
+SPAN_DECODE_EXTRACT = "detector.decode_extract"
 SPAN_VERIFY = "detector.crc_verify"
 SPAN_TENSORIZE = "detector.tensorize"
 SPAN_SUBMIT = "detector.submit"
@@ -76,6 +78,13 @@ SPAN_FLAG = "detector.flag"
 
 # -- phase-label table (anomaly_phase_seconds{phase=} vocabulary) ------
 PHASE_DECODE = "decode"
+# Sub-phases of the native decode (the two-pass scanner, ingest.cc):
+# pass-1 structural scan vs pass-2 column extraction. They overlap
+# PHASE_DECODE (which stays the whole-call envelope), so phase SHARE
+# computations must not sum them into the denominator — see
+# ingest_pool.TOP_PHASES.
+PHASE_SCAN = "scan"
+PHASE_EXTRACT = "extract"
 PHASE_VERIFY = "verify"
 PHASE_TENSORIZE = "tensorize"
 PHASE_SUBMIT = "submit"
@@ -90,6 +99,8 @@ PHASE_FLAG = "flag"
 # phase label; the trace renders them as spans).
 SPAN_FOR_PHASE = {
     PHASE_DECODE: SPAN_DECODE,
+    PHASE_SCAN: SPAN_DECODE_SCAN,
+    PHASE_EXTRACT: SPAN_DECODE_EXTRACT,
     PHASE_VERIFY: SPAN_VERIFY,
     PHASE_TENSORIZE: SPAN_TENSORIZE,
     PHASE_SUBMIT: SPAN_SUBMIT,

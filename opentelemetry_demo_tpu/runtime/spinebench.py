@@ -206,7 +206,12 @@ def measure_e2e(
         pool.close()
         pipe.close()
     st = pool.stats()
-    phase = st["phase_s"]
+    from .ingest_pool import TOP_PHASES
+
+    # TOP-level phases only: scan/extract are sub-phases INSIDE the
+    # decode envelope (the two-pass scanner's split) — summing them
+    # into the denominator would double-count decode time.
+    phase = {k: st["phase_s"].get(k, 0.0) for k in TOP_PHASES}
     phase_total = sum(phase.values()) or 1.0
     spine = pipe.spine_stats()
     # Matched-basis kernel reference: device-only rate at THIS

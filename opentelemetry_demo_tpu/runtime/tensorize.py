@@ -152,6 +152,39 @@ class TensorBatch(NamedTuple):
         return int(self.valid.sum())
 
 
+class InternArena:
+    """Per-worker intern arena over a shared :class:`SpanTensorizer`.
+
+    Each decode worker owns one. Lookups resolve against the arena's
+    private dict — no shared snapshot read, no lock, no cache-line
+    traffic with sibling workers — and only a flush whose batch carries
+    a name this worker has NEVER seen reconciles against the global
+    table, via ONE batched ``intern_many`` call (at most one lock
+    acquisition per flush). Ids are global and immutable once assigned,
+    which is what makes caching them worker-locally safe; bit-identity
+    with the serial ``service_id`` path is pinned by
+    tests/test_ingest_pool.py.
+    """
+
+    __slots__ = ("_tz", "_local")
+
+    def __init__(self, tensorizer: "SpanTensorizer"):
+        self._tz = tensorizer
+        self._local: dict[str, int] = {}
+
+    def lookup(self, names: list[str]) -> list[int]:
+        """Resolve ``names`` (first-appearance document order) to ids."""
+        local = self._local
+        try:
+            return [local[n] for n in names]  # pure-local hot path
+        except KeyError:
+            pass
+        ids = self._tz.intern_many(names)
+        for n, sid in zip(names, ids):
+            local[n] = sid
+        return ids
+
+
 @dataclass
 class SpanTensorizer:
     """Stateful interner + vectorised hasher; one per ingest stream.
@@ -189,18 +222,51 @@ class SpanTensorizer:
         sid = self._svc_snapshot.get(name)  # lock-free: hit is immutable
         if sid is None:
             with self._intern_lock:
-                sid = self._svc_ids.get(name)
-                if sid is None:
-                    if len(self._svc_ids) < self.num_services - 1:
-                        sid = len(self._svc_ids)
-                    else:
-                        sid = self.num_services - 1  # overflow bucket
-                    self._svc_ids[name] = sid
-                    # Publish a NEW snapshot object — readers holding
-                    # the old one still see consistent (if stale)
-                    # hits and fall through to the lock on miss.
-                    self._svc_snapshot = dict(self._svc_ids)
+                sid = self._assign_locked(name)
         return sid
+
+    def _assign_locked(self, name: str, publish: bool = True) -> int:
+        """Assign (or find) ``name``'s id; caller holds the intern
+        lock. The ONE assignment rule both the per-name path and the
+        batched path share — dense first-appearance ranks with the last
+        id reserved as the overflow bucket. ``publish=False`` defers
+        the snapshot publication to the caller (the batched path
+        publishes ONCE per batch instead of once per new name)."""
+        sid = self._svc_ids.get(name)
+        if sid is None:
+            if len(self._svc_ids) < self.num_services - 1:
+                sid = len(self._svc_ids)
+            else:
+                sid = self.num_services - 1  # overflow bucket
+            self._svc_ids[name] = sid
+            if publish:
+                # Publish a NEW snapshot object — readers holding the
+                # old one still see consistent (if stale) hits and
+                # fall through to the lock on miss.
+                self._svc_snapshot = dict(self._svc_ids)
+        return sid
+
+    def intern_many(self, names: list[str]) -> list[int]:
+        """Batched intern: every name resolved with AT MOST one lock
+        acquisition for the whole batch (the flush-granular
+        reconciliation the per-worker arenas ride).
+
+        Misses are assigned in first-appearance order of ``names``, so
+        a caller passing names in document order produces ids
+        bit-identical to a serial ``service_id`` loop — the intern-id
+        bit-exactness contract (tests/test_ingest_pool.py).
+        """
+        snap = self._svc_snapshot  # immutable: consistent for the batch
+        if all(n in snap for n in names):
+            return [snap[n] for n in names]
+        with self._intern_lock:
+            for n in names:
+                if n not in self._svc_ids:
+                    self._assign_locked(n, publish=False)
+            # ONE snapshot publication for the whole batch — k new
+            # names cost one O(N) copy, not k of them.
+            self._svc_snapshot = snap = dict(self._svc_ids)
+        return [snap[n] for n in names]
 
     def tensorize(self, records: Iterable[SpanRecord]) -> list[TensorBatch]:
         """Pack records into one or more fixed-width batches."""
@@ -256,7 +322,9 @@ class SpanTensorizer:
         )
         return SpanColumns(svc, lat, err, tid, crc)
 
-    def columns_from_columnar(self, cols, copy: bool = False) -> SpanColumns:
+    def columns_from_columnar(
+        self, cols, copy: bool = False, arena: "InternArena | None" = None
+    ) -> SpanColumns:
         """Adopt a native-decoder batch (runtime.native.ColumnarSpans).
 
         Interns the handful of per-request service names (``None`` —
@@ -270,6 +338,11 @@ class SpanTensorizer:
         in document order, so ``np.unique``'s sorted order IS
         first-appearance order.
 
+        ``arena`` (a per-worker :class:`InternArena`) resolves the
+        names against worker-LOCAL memory first, touching the shared
+        snapshot/lock at most once per flush — the decode workers'
+        contention-free path. Ids are bit-identical either way.
+
         ``copy=True`` forces every output lane to own fresh memory —
         required when ``cols`` is views into a reusable decode scratch
         (the ingest pool's buffer freelist), whose next decode would
@@ -281,9 +354,19 @@ class SpanTensorizer:
         # (svc_idx is monotone in document order).
         seen = np.zeros(max(len(cols.services), 1), bool)
         seen[cols.svc_idx] = True
-        for i in np.nonzero(seen)[0]:
-            name = cols.services[i]
-            ids[i] = self.service_id("unknown" if name is None else name)
+        live = np.nonzero(seen)[0]
+        if arena is not None:
+            names = [
+                "unknown" if cols.services[i] is None else cols.services[i]
+                for i in live
+            ]
+            ids[live] = arena.lookup(names)
+        else:
+            for i in live:
+                name = cols.services[i]
+                ids[i] = self.service_id(
+                    "unknown" if name is None else name
+                )
         return SpanColumns(
             svc=ids[cols.svc_idx],
             lat_us=cols.duration_us.astype(np.float32, copy=copy),

@@ -128,6 +128,19 @@ INGEST_KNOBS: dict[str, tuple[str, object, str]] = {
         "answers retryable 429/RESOURCE_EXHAUSTED (no unbounded buffer "
         "ever forms before the pipeline's row-budgeted admission)",
     ),
+    "ANOMALY_INGEST_NATIVE_THREADS": (
+        "int", 2,
+        "native extraction threads PER batched decode call (the "
+        "two-pass scanner's pass-2 sharding: one oversized flush "
+        "splits across cores at span-record boundaries); <=1 keeps "
+        "extraction serial per call",
+    ),
+    "ANOMALY_INGEST_SHARD_MIN_BYTES": (
+        "int", 262144,
+        "payload-byte floor below which a batched decode never shards "
+        "across native threads (thread spawn/join would cost more "
+        "than the extraction it hides)",
+    ),
 }
 
 

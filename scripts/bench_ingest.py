@@ -28,14 +28,49 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 from opentelemetry_demo_tpu.runtime import ingestbench, native  # noqa: E402
 
 
+def _print_fat_scaling():
+    fat = ingestbench.measure_fat_payload_scaling()
+    if fat:
+        legs = "  ".join(
+            f"{t}thr={fat[t]/1e6:.2f}M/s"
+            for t in sorted(k for k in fat if k != "scaling")
+        )
+        print(f"one fat payload:      {legs}  scaling={fat['scaling']}x")
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--workers", default="1,2,4",
         help="comma-separated decode-pool worker counts to sweep",
     )
+    parser.add_argument(
+        "--raw", action="store_true",
+        help="raw two-pass scanner microbench only: pass-1 scan vs "
+             "pass-2 extract vs whole-call throughput per thread "
+             "(`make decodebench`) — attributes a decode regression "
+             "without running the full pool",
+    )
     args = parser.parse_args()
     workers = [int(w) for w in args.workers.split(",") if w.strip()]
+
+    if args.raw:
+        raw = ingestbench.measure_raw()
+        if raw is None:
+            print(f"native unavailable: {native.load_error()}")
+            return
+        print(
+            f"pass-1 scan:          {raw['scan_spans_per_sec']/1e6:8.2f} M spans/s"
+            f"  ({raw['scan_bytes_per_sec']/1e6:7.1f} MB/s)"
+        )
+        print(
+            f"pass-2 extract:       {raw['extract_spans_per_sec']/1e6:8.2f} M spans/s"
+        )
+        print(
+            f"decode_many (1 thr):  {raw['decode_spans_per_sec']/1e6:8.2f} M spans/s"
+        )
+        _print_fat_scaling()
+        return
 
     payloads = ingestbench.make_payloads()  # built once, shared by all
     py = ingestbench.measure_python(payloads=payloads)
@@ -55,10 +90,17 @@ def main():
             f"{name}={share.get(name, 0.0):.0%}"
             for name in ("decode", "verify", "tensorize", "submit")
         )
+        split = got.get("decode_split") or {}
+        split_s = (
+            f"  decode: scan={split.get('scan', 0.0):.0%}"
+            f" extract={split.get('extract', 0.0):.0%}"
+            if split else ""
+        )
         print(
             f"pool workers={w}:        {rate/1e3:10.1f} k spans/s"
-            f"  ({rate/nat:4.2f}x serial)  [{phases}]"
+            f"  ({rate/nat:4.2f}x serial)  [{phases}]{split_s}"
         )
+    _print_fat_scaling()
 
 
 if __name__ == "__main__":

@@ -673,6 +673,82 @@ def main() -> int:
         ):
             check(marker in fttext, f"fleet suite pins {marker}")
 
+    # 12) two-pass native scanner (native/ingest.cc + runtime/native.py,
+    #     the r15 decode-wall rework): the raw C entry points are
+    #     called ONLY from runtime/native.py (monopoly pin, same
+    #     pattern as frame.py's byte-primitive fence — a second caller
+    #     would fork the ctypes contract and the GIL-release story),
+    #     native and the Python fallback share ONE verdict taxonomy
+    #     (malformed → ValueError/-1 → the receivers' 400; no new bare
+    #     error path), and the decodebench/fuzz surfaces exist.
+    native_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "native.py"
+    )
+    check(os.path.exists(native_py), "runtime/native.py exists")
+    otd_entry_callers: set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            fpath = os.path.join(dirpath, fname)
+            text = open(fpath, errors="replace").read()
+            # The C ABI surface: any otd_decode/otd_scan/otd_extract
+            # reference outside native.py is a second ctypes caller.
+            if any(
+                marker in text
+                for marker in (
+                    "otd_decode_otlp", "otd_decode_otlp_many",
+                    "otd_scan_otlp", "otd_extract_otlp",
+                    "otd_decode_orders",
+                )
+            ):
+                otd_entry_callers.add(
+                    os.path.relpath(fpath, pkg_root).replace(os.sep, "/")
+                )
+    check(
+        otd_entry_callers == {"runtime/native.py"},
+        "native decode entry points are called only from native.py "
+        f"(callers {sorted(otd_entry_callers)})",
+    )
+    ntext = open(native_py).read()
+    for marker in (
+        "def scan_otlp", "def extract_otlp", "def decode_otlp_many",
+        "SHARD_MIN_BYTES_DEFAULT", "malformed OTLP payload",
+    ):
+        check(marker in ntext, f"runtime/native.py declares {marker!r}")
+    ingest_cc = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "native", "ingest.cc"
+    )
+    cctext = open(ingest_cc).read()
+    for marker in ("scan_request", "extract_span", "otd_scan_otlp",
+                   "otd_extract_otlp", "payload_rows"):
+        check(marker in cctext, f"native/ingest.cc declares {marker}")
+    # One verdict taxonomy: the pool maps BOTH engines' per-payload
+    # verdicts into the same errors dict the receivers answer 400
+    # from; native.py raises ValueError for whole-batch failures
+    # exactly like otlp.decode_export_request's WireError(ValueError).
+    ptext = open(pool_py).read()
+    check(
+        'ValueError("malformed OTLP payload")' in ptext,
+        "ingest pool maps native per-payload verdicts to the "
+        "fallback's ValueError taxonomy",
+    )
+    check(
+        "decodebench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has a decodebench target",
+    )
+    ni_tests = os.path.join(ROOT, "tests", "test_native_ingest.py")
+    if os.path.exists(ni_tests):
+        nitext = open(ni_tests).read()
+        for marker in (
+            "test_native_and_python_verdicts_agree_on_every_seed",
+            "test_shard_split_varints_bit_exact",
+            "test_truncation_at_every_pass1_boundary",
+            "test_max_nesting_submessages",
+        ):
+            check(marker in nitext, f"scanner fuzz suite pins {marker}")
+
     # no imports from the read-only reference tree
     bad = []
     for dirpath, dirnames, filenames in os.walk(ROOT):

@@ -228,6 +228,172 @@ class TestSketchBatchUpdate:
         np.testing.assert_allclose(np.asarray(got_stats), 0.0)
 
 
+class TestFusedHeadUpdate:
+    """The r15 head fold: sketch_batch_update with ``heads`` must be
+    BIT-exact vs the two-step form (banks via sketch_batch_update, then
+    fused.head_update on the returned stats) in every impl — the last
+    delta round trip PR 9 left, now inside the one program."""
+
+    HEAD_KW = dict(
+        taus_s=(1.0, 10.0, 60.0), warmup_batches=20.0,
+        z_warmup_batches=60.0, cusum_k=0.5, cusum_cap=50.0,
+        err_slack=0.01,
+    )
+
+    def _heads(self, rng, s, t=3):
+        return fused.HeadState(
+            lat_mean=jnp.asarray(rng.gamma(2.0, 1.0, (s, t)), jnp.float32),
+            lat_var=jnp.asarray(rng.gamma(1.0, 0.2, (s, t)), jnp.float32),
+            err_mean=jnp.asarray(rng.random((s, t)) * 0.2, jnp.float32),
+            rate_mean=jnp.asarray(rng.gamma(3.0, 10.0, (s, t)), jnp.float32),
+            rate_var=jnp.asarray(rng.gamma(1.0, 5.0, (s, t)), jnp.float32),
+            cusum=jnp.asarray(rng.random((s, 3)) * 3.0, jnp.float32),
+            obs_batches=jnp.asarray(
+                rng.integers(0, 100, s), jnp.float32
+            ),
+        )
+
+    @pytest.mark.parametrize("impl", ["xla", "interpret"])
+    @pytest.mark.parametrize("step_pos", [True, False])
+    def test_folded_heads_bit_exact_vs_two_step(self, rng, impl, step_pos):
+        # Both paths run under jax.jit — the regime detector_step
+        # always runs in. (Eager op-by-op dispatch makes different
+        # FMA-contraction choices than a traced computation, so an
+        # unjitted comparison can differ by 1 ulp without either side
+        # being wrong; under jit the expression graphs are identical
+        # and so are the bits.)
+        import jax
+
+        b, s, p, d, w = 256, 32, 8, 4, 1024
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        hll_cur = jnp.asarray(
+            rng.integers(0, 20, size=(3, s, 1 << p)), jnp.int32
+        )
+        cms_cur = jnp.asarray(
+            rng.integers(0, 1000, size=(3, d, w)), jnp.int32
+        )
+        heads = self._heads(rng, s)
+        dt = jnp.float32(0.05)
+        sp = jnp.asarray(step_pos)
+
+        @jax.jit
+        def two_step(heads):
+            h, c, stats = fused.sketch_batch_update(
+                hll_cur, cms_cur, *batch.values(), impl=impl, **kw
+            )
+            nh, zs = fused.head_update(
+                stats, heads, dt, sp, **self.HEAD_KW
+            )
+            return h, c, stats, nh, zs
+
+        @jax.jit
+        def folded(heads):
+            return fused.sketch_batch_update(
+                hll_cur, cms_cur, *batch.values(), impl=impl,
+                heads=heads, dt=dt, step_pos=sp, **self.HEAD_KW, **kw
+            )
+
+        ref_hll, ref_cms, ref_stats, ref_heads, ref_zs = two_step(heads)
+        got_hll, got_cms, got_stats, got_heads, got_zs = folded(heads)
+        np.testing.assert_array_equal(np.asarray(ref_hll), np.asarray(got_hll))
+        np.testing.assert_array_equal(np.asarray(ref_cms), np.asarray(got_cms))
+        np.testing.assert_array_equal(
+            np.asarray(ref_stats), np.asarray(got_stats)
+        )
+        for name, x, y in zip(ref_heads._fields, ref_heads, got_heads):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=name
+            )
+        for name, x, y in zip(("lat_z", "err_z", "rate_z"), ref_zs, got_zs):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=name
+            )
+
+    @pytest.mark.parametrize("batch_tile", [64, 128])
+    def test_folded_heads_multi_tile_grid(self, rng, batch_tile):
+        """Multi-step grids run the head fold ONCE, on the last step,
+        against the fully-accumulated stats: the folded form must be
+        bit-exact vs two-step AT THE SAME TILING (tile count changes
+        the f32 stats accumulation ORDER — a 1-ulp effect the existing
+        delta-kernel tests already bound with allclose — so the pin
+        here is folded-vs-two-step, not tiled-vs-untiled)."""
+        import jax
+
+        b, s, p, d, w = 512, 16, 8, 4, 1024
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w, svc_lo=-3, svc_hi=s + 3)
+        hll_cur = jnp.asarray(
+            rng.integers(0, 20, size=(3, s, 1 << p)), jnp.int32
+        )
+        cms_cur = jnp.asarray(
+            rng.integers(0, 1000, size=(3, d, w)), jnp.int32
+        )
+        heads = self._heads(rng, s)
+        dt = jnp.float32(0.05)
+        sp = jnp.asarray(True)
+
+        @jax.jit
+        def two_step(heads):
+            h, c, stats = fused.sketch_batch_update(
+                hll_cur, cms_cur, *batch.values(), impl="interpret",
+                batch_tile=batch_tile, **kw
+            )
+            nh, zs = fused.head_update(
+                stats, heads, dt, sp, **self.HEAD_KW
+            )
+            return h, c, stats, nh, zs
+
+        @jax.jit
+        def folded(heads):
+            return fused.sketch_batch_update(
+                hll_cur, cms_cur, *batch.values(), impl="interpret",
+                batch_tile=batch_tile, heads=heads, dt=dt, step_pos=sp,
+                **self.HEAD_KW, **kw
+            )
+
+        ref = two_step(heads)
+        got = folded(heads)
+        np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+        np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(got[1]))
+        np.testing.assert_array_equal(np.asarray(ref[2]), np.asarray(got[2]))
+        for name, a, b_ in zip(ref[3]._fields, ref[3], got[3]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b_), err_msg=name
+            )
+        for a, b_ in zip(ref[4], got[4]):  # z triples
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    def test_no_stats_roundtrip_in_folded_jaxpr(self, rng):
+        """Structural pin for 'no delta round-trips to HBM on the
+        NO_COMM path': the folded pallas program contains exactly ONE
+        pallas_call, and the head outputs come out of IT — there is no
+        second kernel or post-kernel stats consumer producing them."""
+        import jax
+
+        b, s, p, d, w = 256, 32, 8, 4, 1024
+        kw = dict(num_services=s, hll_p=p, cms_width=w)
+        batch = _batch(rng, b, s, d, w)
+        hll_cur = jnp.zeros((3, s, 1 << p), jnp.int32)
+        cms_cur = jnp.zeros((3, d, w), jnp.int32)
+        heads = self._heads(rng, s)
+
+        def folded(*args):
+            return fused.sketch_batch_update(
+                hll_cur, cms_cur, *args, impl="interpret", heads=heads,
+                dt=jnp.float32(0.05), step_pos=jnp.asarray(True),
+                **self.HEAD_KW, **kw
+            )
+
+        jaxpr = jax.make_jaxpr(folded)(*batch.values())
+        calls = [
+            eqn for eqn in jaxpr.jaxpr.eqns if "pallas" in eqn.primitive.name
+        ]
+        assert len(calls) == 1, [e.primitive.name for e in jaxpr.jaxpr.eqns]
+        # The single kernel emits banks + stats + 7 head arrays + 3 zs.
+        assert len(calls[0].outvars) == 13
+
+
 class TestDetectorWithFusedKernel:
     def test_detector_step_identical_across_impls(self, rng):
         """The full flagship step must not care which impl ran."""

@@ -424,6 +424,61 @@ class TestGilAndInterner:
         assert tz._svc_snapshot == tz._svc_ids
 
 
+class TestInternArena:
+    def test_intern_many_matches_serial_assignment(self):
+        # Batched interning must assign ids bit-identically to a serial
+        # service_id loop over the same first-appearance order,
+        # including the overflow bucket.
+        from opentelemetry_demo_tpu.runtime.tensorize import InternArena
+
+        names = [f"svc-{i}" for i in range(20)] + ["svc-3", "svc-0"]
+        tz_serial = SpanTensorizer(num_services=8)
+        ref = [tz_serial.service_id(n) for n in names]
+        tz_batch = SpanTensorizer(num_services=8)
+        got = tz_batch.intern_many(names)
+        assert got == ref
+        assert tz_serial._svc_ids == tz_batch._svc_ids
+        # Arena path: same ids, and a second lookup is pure-local
+        # (no new snapshot publication).
+        tz_arena = SpanTensorizer(num_services=8)
+        arena = InternArena(tz_arena)
+        assert arena.lookup(names) == ref
+        snap_before = tz_arena._svc_snapshot
+        assert arena.lookup(names) == ref
+        assert tz_arena._svc_snapshot is snap_before  # untouched
+
+    def test_arena_partial_overlap_batches(self):
+        # A flush carrying a mix of known and new names reconciles in
+        # one batch and stays consistent with a sibling arena.
+        from opentelemetry_demo_tpu.runtime.tensorize import InternArena
+
+        tz = SpanTensorizer(num_services=16)
+        a, b = InternArena(tz), InternArena(tz)
+        ids_a = a.lookup(["x", "y"])
+        ids_b = b.lookup(["y", "z", "x"])
+        assert ids_b[0] == ids_a[1]
+        assert ids_b[2] == ids_a[0]
+        assert tz.service_id("z") == ids_b[1]
+
+    @needs_native
+    def test_pool_stats_carry_scan_extract_subphases(self):
+        # The two-pass scanner's per-pass times reach the pool's phase
+        # ledger (they feed anomaly_phase_seconds{phase=scan|extract});
+        # the sub-phases sit INSIDE the decode envelope.
+        tz = SpanTensorizer(num_services=32)
+        pool = IngestPool(lambda c: None, tz, workers=1)
+        try:
+            for p in _payloads(n_requests=8):
+                pool.submit(p)
+            assert pool.drain()
+            phase = pool.stats()["phase_s"]
+            assert phase["scan"] > 0.0
+            assert phase["extract"] > 0.0
+            assert phase["scan"] + phase["extract"] <= phase["decode"] * 1.01
+        finally:
+            pool.close()
+
+
 class TestVectorizedRecordPath:
     def _reference_loop(self, tz, records):
         """The pre-vectorization per-row loop, kept as the oracle."""
