@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -47,8 +47,11 @@ spinebench:     ## end-to-end ingest spine: payload → flagged report, workers 
 replbench:      ## hot-standby failover drill (ONE json line: replication lag p99, failover TTD, exact convergence)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replbench
 
-fleetbench:     ## sharded-fleet reshard drill (ONE json line: SIGKILL a shard under live Kafka+OTLP load, reshard TTD, witness-pinned bit-exact answers, blackholed-shard partial answers, noisy-tenant isolation)
+fleetbench:     ## sharded-fleet reshard drill (ONE json line: SIGKILL a shard under live Kafka+OTLP load, reshard TTD, witness-pinned bit-exact answers, blackholed-shard partial answers, noisy-tenant isolation; folds in the autoscalebench leg)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replbench --fleet
+
+autoscalebench: ## elastic-fleet live drill alone (ONE json line: ramp to saturation, autoscaler proposes scale-out, SIGKILL a shard mid-resize, automatic adoption TTA, bit-exact witness pin, no oscillation)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replbench --autoscale
 
 replaybench:    ## history time-travel drill (ONE json line: record an incident, replay the segment log at N× wall clock, pin bit-identical verdicts, range-query p99)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replaybench

@@ -326,6 +326,27 @@ def main():
         except Exception:  # noqa: BLE001 — artifact field is optional
             fleet_drill = {}
 
+    # ---- elastic-fleet autoscale drill (the elastic tentpole) --------
+    # Two REAL daemon shards wired as an adoptive pair, autoscaler on
+    # the heir: ramp OTLP load until admission saturates and a
+    # scale-out is proposed, SIGKILL the victim mid-resize, and watch
+    # the heir adopt its keyspace with zero operator action.
+    # autoscale_tta_s is SIGKILL → adoption applied; autoscale_ok
+    # gates the whole contract (real-saturation proposal, automatic
+    # adoption, bit-exact witness pin, no oscillation over the quiet
+    # window). Slow (two daemon boots) — gate off with
+    # BENCH_AUTOSCALE=0. {} on failure — additive artifact fields.
+    autoscale_drill = {}
+    if os.environ.get("BENCH_AUTOSCALE", "1") != "0":
+        from opentelemetry_demo_tpu.runtime.replbench import (
+            measure_adoption,
+        )
+
+        try:
+            autoscale_drill = measure_adoption()
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            autoscale_drill = {}
+
     # ---- live query plane (the read-path tentpole) -------------------
     # Real HTTP query service hammered beside live ingest in one
     # process: query_p99_ms is the dashboard-refresh cost over live
@@ -609,6 +630,13 @@ def main():
                 ),
                 "fleet_noisy_tenant_isolated": fleet_drill.get(
                     "noisy_tenant_isolated"
+                ),
+                "autoscale_tta_s": autoscale_drill.get(
+                    "autoscale_tta_s"
+                ),
+                "autoscale_ok": autoscale_drill.get("autoscale_ok"),
+                "autoscale_adoption_bitexact": autoscale_drill.get(
+                    "adoption_bitexact"
                 ),
                 "sketch_impl_matrix": matrix,
                 "lag_note": (

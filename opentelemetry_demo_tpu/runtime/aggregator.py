@@ -49,8 +49,8 @@ from ..telemetry import metrics as tele_metrics
 from .fleet import HashRing, tenant_of
 
 # The endpoints the aggregator understands (a strict subset of the
-# shard query plane's vocabulary — flight/Grafana targets stay
-# per-shard surfaces: a flight ring is process-local evidence).
+# shard query plane's vocabulary — the flight target stays a
+# per-shard surface: a flight ring is process-local evidence).
 AGG_ENDPOINTS = frozenset({
     "/", "/query/services", "/query/topk", "/query/cardinality",
     "/query/zscore", "/query/anomalies",
@@ -60,6 +60,16 @@ SERVICE_KEYED = frozenset({
     "/query/topk", "/query/cardinality", "/query/zscore",
 })
 
+# Grafana simple-JSON datasource surface (the same contract the shard
+# query plane serves per-shard): dashboards point at the FLEET —
+# service-keyed targets route to the keyspace owner, table targets
+# merge across shards.
+GRAFANA_ENDPOINTS = frozenset({"/search", "/query", "/annotations"})
+
+# Query bodies are small Grafana target lists, never megabytes (the
+# shard plane's 413 discipline, mirrored).
+MAX_BODY_BYTES = 1 << 20
+
 LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
@@ -68,17 +78,19 @@ LATENCY_BUCKETS = (
 class ShardAnswer(NamedTuple):
     shard: str
     status: int | None     # None = transport failure/timeout
-    doc: dict | None
+    doc: dict | list | None  # Grafana endpoints answer bare lists
     error: str | None
     elapsed_s: float
 
 
 def _fetch(
-    shard: str, base: str, path: str, params: dict, timeout_s: float
+    shard: str, base: str, path: str, params: dict, timeout_s: float,
+    body: dict | None = None,
 ) -> ShardAnswer:
-    """One shard GET with a hard per-shard deadline. Every failure
-    shape (refused, blackholed, RST mid-body, torn JSON) collapses to
-    an annotated miss — the fan-out's promise is that no shard fault
+    """One shard GET (or POST when ``body`` rides along — the Grafana
+    fan-out) with a hard per-shard deadline. Every failure shape
+    (refused, blackholed, RST mid-body, torn JSON) collapses to an
+    annotated miss — the fan-out's promise is that no shard fault
     becomes an aggregator fault."""
     import http.client
 
@@ -92,10 +104,17 @@ def _fetch(
             query = urlencode(
                 {k: v for k, v in params.items() if v is not None}
             )
-            conn.request("GET", path + ("?" + query if query else ""))
+            target = path + ("?" + query if query else "")
+            if body is not None:
+                conn.request(
+                    "POST", target, body=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+            else:
+                conn.request("GET", target)
             resp = conn.getresponse()
-            body = resp.read()
-            doc = json.loads(body.decode()) if body else {}
+            raw = resp.read()
+            doc = json.loads(raw.decode()) if raw else {}
             return ShardAnswer(
                 shard, resp.status, doc, None,
                 time.perf_counter() - t0,
@@ -128,12 +147,25 @@ class FleetAggregator:
         ring: HashRing | None = None,
         tenant_map: dict[str, str] | None = None,
         live_fn=None,
+        health_addrs: dict[str, str] | None = None,
     ):
         self.shards = dict(shards)
         self.timeout_s = float(timeout_s)
         self.ring = ring
         self.tenant_map = dict(tenant_map or {})
         self._live_fn = live_fn
+        # Ring-staleness repair (``health_addrs``: shard-id → /healthz
+        # address, the heartbeat list): a standalone aggregator pins a
+        # boot-time ring, so after an adoption/resize it would route
+        # service-keyed reads to a shard that no longer owns the key —
+        # forever. When the owner misses, placement refreshes from the
+        # shard /healthz fleet blocks (which publish members + the
+        # adopted map — enough to rebuild the IDENTICAL ring) and the
+        # read retries once against the new owner. The embedded
+        # aggregator shares the live membership ring and passes None.
+        self._health_addrs = dict(health_addrs or {})
+        self._ring_refresh_t = 0.0
+        self._ring_refreshes = 0
 
     def close(self) -> None:
         pass  # fan-out threads are per-request daemons; nothing held
@@ -152,6 +184,7 @@ class FleetAggregator:
     def _scatter(
         self, path: str, params: dict,
         skip: frozenset[str] = frozenset(),
+        body: dict | None = None,
     ) -> list[ShardAnswer]:
         """Fan out with a HARD wall-clock deadline.
 
@@ -169,7 +202,7 @@ class FleetAggregator:
 
         def run(shard: str, base: str) -> None:
             results[shard] = _fetch(
-                shard, base, path, params, self.timeout_s
+                shard, base, path, params, self.timeout_s, body=body
             )
 
         threads = [
@@ -198,7 +231,8 @@ class FleetAggregator:
         return answers
 
     def _fetch_bounded(
-        self, shard: str, base: str, path: str, params: dict
+        self, shard: str, base: str, path: str, params: dict,
+        body: dict | None = None,
     ) -> ShardAnswer:
         """One shard fetch under the same hard deadline as _scatter —
         the owner-routed path must not be the one place a trickling
@@ -207,7 +241,7 @@ class FleetAggregator:
 
         def run() -> None:
             box[shard] = _fetch(
-                shard, base, path, params, self.timeout_s
+                shard, base, path, params, self.timeout_s, body=body
             )
 
         th = threading.Thread(
@@ -218,6 +252,73 @@ class FleetAggregator:
         return box.get(shard) or ShardAnswer(
             shard, None, None, "deadline exceeded", self.timeout_s
         )
+
+    # -- ring refresh ----------------------------------------------------
+
+    def _refresh_ring(self) -> bool:
+        """Rebuild placement from the shard /healthz fleet blocks;
+        True when the ring actually CHANGED (the retry-once trigger).
+
+        The fleet block publishes members + the adopted map, so the
+        rebuilt ring is bit-identical to the shards' own (the
+        zero-coordination property adoption relies on). Throttled: an
+        owner-miss storm must not turn into a healthz-poll storm."""
+        if self.ring is None or not self._health_addrs:
+            return False
+        now = time.monotonic()
+        if now - self._ring_refresh_t < 0.5:
+            return False
+        self._ring_refresh_t = now
+        current = self.ring.version()
+        results: dict[str, ShardAnswer] = {}
+
+        def run(shard: str, base: str) -> None:
+            results[shard] = _fetch(
+                shard, base, "/healthz", {}, self.timeout_s
+            )
+
+        threads = [
+            threading.Thread(
+                target=run, args=(s, a), name=f"agg-healthz-{s}",
+                daemon=True,
+            )
+            for s, a in self._health_addrs.items()
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 2.0 * self.timeout_s + 0.25
+        for th in threads:
+            th.join(max(deadline - time.monotonic(), 0.0))
+        # Prefer the HIGHEST reshard count on a mismatching version:
+        # mid-resize the laggard shards still publish the old ring,
+        # and adopting a stale view would "refresh" into yesterday.
+        best: dict | None = None
+        for a in results.values():
+            if a.status != 200 or not isinstance(a.doc, dict):
+                continue
+            fb = a.doc.get("fleet")
+            if not isinstance(fb, dict) or not fb.get("members"):
+                continue
+            if fb.get("ring_version") == current:
+                continue
+            if (
+                best is None
+                or fb.get("reshards_total", 0)
+                > best.get("reshards_total", 0)
+            ):
+                best = fb
+        if best is None:
+            return False
+        self.ring = HashRing(
+            [str(m) for m in best["members"]],
+            vnodes=int(best.get("owned_vnodes") or self.ring.vnodes),
+            adopted={
+                str(v): str(h)
+                for v, h in (best.get("adopted") or {}).items()
+            },
+        )
+        self._ring_refreshes += 1
+        return True
 
     # -- merge ----------------------------------------------------------
 
@@ -246,17 +347,25 @@ class FleetAggregator:
             meta["ring_version"] = self.ring.version()
         return meta
 
-    def dispatch(self, path: str, params: dict) -> tuple[int, dict]:
+    def dispatch(
+        self, path: str, params: dict, body: dict | None = None,
+    ) -> tuple[int, dict | list]:
         """Route + merge one fleet query; (status, document). Never
-        raises; a partial fleet answers 200 with ``partial: true``."""
+        raises; a partial fleet answers 200 with ``partial: true``.
+        Grafana endpoints take the POST ``body`` and answer the bare
+        lists the simple-JSON contract wants."""
         try:
             if path == "/":
                 return 200, {
                     "status": "ok",
                     "tier": "aggregator",
-                    "endpoints": sorted(AGG_ENDPOINTS - {"/"}),
+                    "endpoints": sorted(
+                        (AGG_ENDPOINTS | GRAFANA_ENDPOINTS) - {"/"}
+                    ),
                     "shards": sorted(self.shards),
                 }
+            if path in GRAFANA_ENDPOINTS:
+                return self._grafana(path, body or {})
             if path not in AGG_ENDPOINTS:
                 return 404, {"error": f"no such endpoint {path!r}"}
             if path in SERVICE_KEYED:
@@ -302,6 +411,7 @@ class FleetAggregator:
         if not service:
             return 400, {"error": "service parameter required"}
         owner = None
+        tenant = None
         if self.ring is not None:
             tenant = params.get("tenant") or tenant_of(
                 service, self.tenant_map
@@ -310,7 +420,9 @@ class FleetAggregator:
                 owner = self.ring.owner_of(service, tenant)
             except RuntimeError:
                 owner = None
-        owner_answer = None
+        refreshed = False
+        tried: set[str] = set()
+        misses: list[ShardAnswer] = []
         if owner is not None and owner in self.shards:
             # Owner-routed: one shard holds this keyspace cell (after
             # a reshard, that is the survivor that adopted the
@@ -319,6 +431,7 @@ class FleetAggregator:
             owner_answer = self._fetch_bounded(
                 owner, self.shards[owner], path, params
             )
+            tried.add(owner)
             if owner_answer.status == 200:
                 meta = self._fleet_meta([owner_answer])
                 meta["shards_total"] = len(self.shards)
@@ -328,19 +441,49 @@ class FleetAggregator:
                     "data": (owner_answer.doc or {}).get("data"),
                     "meta": meta,
                 }
-        # Fallback fan-out: the owner already spent its deadline —
-        # carry its answer over instead of paying the dead shard's
-        # timeout a second time.
-        answers = self._scatter(
-            path, params,
-            skip=frozenset([owner]) if owner_answer is not None
-            else frozenset(),
-        )
-        if owner_answer is not None:
-            answers.append(owner_answer)
+            misses.append(owner_answer)
+            # The boot-time-ring staleness repair: an owner miss right
+            # after an adoption/resize usually means OUR placement is
+            # old, not that the key is gone. Refresh the ring from the
+            # shard /healthz fleet blocks and retry ONCE against the
+            # new owner — then (and only then) pay the full fan-out.
+            if self._refresh_ring():
+                refreshed = True
+                try:
+                    new_owner = self.ring.owner_of(service, tenant)
+                except RuntimeError:
+                    new_owner = None
+                if (
+                    new_owner is not None
+                    and new_owner != owner
+                    and new_owner in self.shards
+                ):
+                    retry = self._fetch_bounded(
+                        new_owner, self.shards[new_owner], path, params
+                    )
+                    tried.add(new_owner)
+                    if retry.status == 200:
+                        meta = self._fleet_meta([retry])
+                        meta["shards_total"] = len(self.shards)
+                        meta["partial"] = False
+                        meta["owner"] = new_owner
+                        meta["ring_refreshed"] = True
+                        return 200, {
+                            "data": (retry.doc or {}).get("data"),
+                            "meta": meta,
+                        }
+                    misses.append(retry)
+                    owner = new_owner
+        # Fallback fan-out: the tried owners already spent their
+        # deadlines — carry their answers over instead of paying a
+        # dead shard's timeout a second time.
+        answers = self._scatter(path, params, skip=frozenset(tried))
+        answers += misses
         meta = self._fleet_meta(answers)
         if owner is not None:
             meta["owner"] = owner
+        if refreshed:
+            meta["ring_refreshed"] = True
         ok = [a for a in answers if a.status == 200]
         if ok:
             # Deterministic pick: lowest shard id that answered (two
@@ -361,6 +504,112 @@ class FleetAggregator:
         # browns out its slice, it never crashes the read surface).
         return 200, {"data": None, "meta": meta}
 
+    # -- Grafana simple-JSON (fleet-global datasource) -------------------
+
+    def _grafana(self, path: str, body: dict) -> tuple[int, dict | list]:
+        """Fleet-global Grafana surface: dashboards point at the
+        FLEET, not a shard (the per-shard plane keeps serving its own
+        copy — this tier merges). ``flight`` targets are deliberately
+        absent: a flight ring is process-local evidence, and a merged
+        one would interleave unrelated incident timelines."""
+        if path == "/search":
+            answers = self._scatter("/search", {}, body=body)
+            targets: set = set()
+            for a in answers:
+                if a.status == 200 and isinstance(a.doc, list):
+                    targets.update(
+                        t for t in a.doc
+                        if isinstance(t, str) and t != "flight"
+                    )
+            if not targets and not any(
+                a.status == 200 for a in answers
+            ):
+                return 503, {"error": "no shard answered"}
+            return 200, sorted(targets)
+        if path == "/annotations":
+            answers = self._scatter("/annotations", {}, body=body)
+            merged: list = []
+            answered = False
+            for a in answers:
+                if a.status == 200 and isinstance(a.doc, list):
+                    answered = True
+                    merged.extend(
+                        e for e in a.doc if isinstance(e, dict)
+                    )
+            if not answered:
+                return 503, {"error": "no shard answered"}
+            merged.sort(key=lambda e: -(e.get("time") or 0.0))
+            return 200, merged
+        # /query: each target routes INDEPENDENTLY (a multi-target
+        # body fanned out whole would 400 on every shard that never
+        # interned one of the services), service-keyed targets to the
+        # ring owner, table targets merged across the fleet.
+        out: list = []
+        for tgt in body.get("targets") or []:
+            if not isinstance(tgt, dict):
+                continue
+            target = (tgt.get("target") or "").strip()
+            single = {
+                k: v for k, v in body.items() if k != "targets"
+            }
+            single["targets"] = [tgt]
+            out.append(self._grafana_target(target, single))
+        return 200, [f for f in out if f is not None]
+
+    def _grafana_target(self, target: str, single: dict):
+        """One target's merged frame (None = nobody answered — the
+        frame is dropped, Grafana's convention for an empty result)."""
+        kind, _, svc = target.partition(":")
+        if svc and self.ring is not None:
+            # Service-keyed series: the keyspace owner answers (post-
+            # adoption, the heir). Owner miss → one refresh + retry,
+            # then lowest-shard fan-out — the /query/* routing rules.
+            tenant = tenant_of(svc, self.tenant_map)
+            for attempt in range(2):
+                try:
+                    owner = self.ring.owner_of(svc, tenant)
+                except RuntimeError:
+                    break
+                if owner not in self.shards:
+                    break
+                a = self._fetch_bounded(
+                    owner, self.shards[owner], "/query", {}, body=single
+                )
+                if (
+                    a.status == 200 and isinstance(a.doc, list)
+                    and a.doc
+                ):
+                    return a.doc[0]
+                if attempt == 0 and not self._refresh_ring():
+                    break
+        answers = self._scatter("/query", {}, body=single)
+        frames = [
+            a.doc[0] for a in sorted(answers)
+            if a.status == 200 and isinstance(a.doc, list) and a.doc
+            and isinstance(a.doc[0], dict)
+        ]
+        if not frames:
+            return None
+        if kind == "anomalies":
+            # Table target: rows merge across shards (each shard flags
+            # its own keyspace), newest first, columns from the first.
+            rows: list = []
+            for f in frames:
+                rows.extend(f.get("rows") or [])
+            rows.sort(key=lambda r: -(r[0] if r else 0.0))
+            return {
+                "type": "table",
+                "columns": frames[0].get("columns") or [],
+                "rows": rows,
+            }
+        # Timeseries (or a service-keyed target with no ring): first
+        # shard with data wins — transiently-duplicated cells right
+        # after an adoption pick deterministically, like /query/*.
+        for f in frames:
+            if f.get("datapoints") or f.get("rows"):
+                return f
+        return frames[0]
+
 
 def _int_param(params: dict, key: str, default: int) -> int:
     try:
@@ -373,9 +622,10 @@ def _int_param(params: dict, key: str, default: int) -> int:
 
 
 class AggregatorService:
-    """HTTP server for the aggregator tier (GET-only; the shard query
-    planes keep the Grafana/POST surfaces — dashboards point at a
-    shard or at this tier interchangeably for the /query/* family)."""
+    """HTTP server for the aggregator tier: the /query/* family plus
+    the Grafana simple-JSON datasource (POST /search /query
+    /annotations) — dashboards point at the FLEET; the per-shard
+    query planes keep serving their own copies."""
 
     def __init__(
         self,
@@ -394,35 +644,91 @@ class AggregatorService:
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True
 
-            def do_GET(self):  # noqa: N802 (http.server API)
-                url = urlparse(self.path)
-                params = {
-                    k: v[0] for k, v in parse_qs(url.query).items()
-                }
+            def _answer(self, path, params, body=None):
                 t0 = time.perf_counter()
                 status, doc = service.aggregator.dispatch(
-                    url.path, params
+                    path, params, body
                 )
                 try:
-                    body = json.dumps(doc).encode()
+                    payload = json.dumps(doc).encode()
                 except (TypeError, ValueError):
                     status = 500
-                    body = b'{"error": "internal aggregator error"}'
+                    payload = b'{"error": "internal aggregator error"}'
                 try:
                     self.send_response(status)
                     self.send_header(
                         "Content-Type", "application/json"
                     )
-                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
                     self.send_header(
                         "Access-Control-Allow-Origin", "*"
                     )
                     self.end_headers()
-                    self.wfile.write(body)
+                    self.wfile.write(payload)
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away mid-answer
                 service._observe(
-                    url.path, status, time.perf_counter() - t0
+                    path, status, time.perf_counter() - t0
+                )
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                url = urlparse(self.path)
+                params = {
+                    k: v[0] for k, v in parse_qs(url.query).items()
+                }
+                self._answer(url.path, params)
+
+            def do_POST(self):  # noqa: N802 — the Grafana surface
+                # (the shard query plane's body discipline, mirrored:
+                # unknowable framing closes, oversized refuses UNREAD)
+                url = urlparse(self.path)
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n < 0:
+                        raise ValueError("negative Content-Length")
+                except ValueError:
+                    self.close_connection = True
+                    self._answer_error(400, "malformed Content-Length")
+                    return
+                if n > MAX_BODY_BYTES:
+                    self.close_connection = True
+                    self._answer_error(413, "body too large")
+                    return
+                try:
+                    raw = self.rfile.read(n) if n else b""
+                    doc = json.loads(raw.decode()) if raw else {}
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, UnicodeDecodeError):
+                    self._answer_error(400, "malformed JSON body")
+                    return
+                self._answer(url.path, {}, doc)
+
+            def do_OPTIONS(self):  # noqa: N802 — Grafana CORS preflight
+                self.send_response(204)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header(
+                    "Access-Control-Allow-Headers", "Content-Type"
+                )
+                self.send_header(
+                    "Access-Control-Allow-Methods", "GET, POST, OPTIONS"
+                )
+                self.end_headers()
+
+            def _answer_error(self, status: int, msg: str) -> None:
+                body = json.dumps({"error": msg}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Access-Control-Allow-Origin", "*")
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+                service._observe(
+                    urlparse(self.path).path, status, 0.0
                 )
 
             def log_message(self, *args):
@@ -436,7 +742,11 @@ class AggregatorService:
     def _observe(self, endpoint: str, status: int, seconds: float) -> None:
         if self.registry is None:
             return
-        label = endpoint if endpoint in AGG_ENDPOINTS else "other"
+        label = (
+            endpoint
+            if endpoint in AGG_ENDPOINTS or endpoint in GRAFANA_ENDPOINTS
+            else "other"
+        )
         self.registry.counter_add(
             tele_metrics.ANOMALY_QUERY_REQUESTS, 1.0,
             endpoint=f"agg:{label}", code=str(status),
@@ -494,6 +804,12 @@ def main() -> None:
     addrs = parse_peer_list(
         str(fl["ANOMALY_FLEET_QUERY_PEERS"]), shards, self_index=-1
     )
+    # Heartbeat (/healthz) addresses feed the ring-staleness repair:
+    # placement refreshes from the shard fleet blocks when the
+    # boot-time ring routes a read to a shard that no longer owns it.
+    health_addrs = parse_peer_list(
+        str(fl["ANOMALY_FLEET_PEERS"]), shards, self_index=-1
+    )
     ring = HashRing(
         [f"shard-{i}" for i in range(shards)],
         vnodes=int(fl["ANOMALY_FLEET_VNODES"]),
@@ -503,6 +819,7 @@ def main() -> None:
         timeout_s=float(fl["ANOMALY_AGGREGATOR_TIMEOUT_S"]),
         ring=ring,
         tenant_map=fleet_tenant_map(fl["ANOMALY_FLEET_TENANTS"]),
+        health_addrs=health_addrs,
     )
     service = AggregatorService(aggregator, port=port)
     service.start()

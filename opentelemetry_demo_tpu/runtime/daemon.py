@@ -190,6 +190,7 @@ from ..models.detector import AnomalyDetector, DetectorConfig
 from ..telemetry import metrics as tele_metrics
 from ..utils.config import (
     ConfigError,
+    autoscale_config,
     daemon_config,
     fleet_config,
     fleet_tenant_map,
@@ -205,7 +206,7 @@ from ..utils.config import (
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import checkpoint, fleet, history, remediation, replication, selftrace
+from . import autoscale, checkpoint, fleet, history, remediation, replication, selftrace
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -791,6 +792,7 @@ class DetectorDaemon:
         self._fleet_index = int(fl["ANOMALY_FLEET_SHARD_INDEX"])
         self._fleet_peers_raw = str(fl["ANOMALY_FLEET_PEERS"])
         self._fleet_query_peers_raw = str(fl["ANOMALY_FLEET_QUERY_PEERS"])
+        self._fleet_repl_peers_raw = str(fl["ANOMALY_FLEET_REPL_PEERS"])
         self._fleet_vnodes = int(fl["ANOMALY_FLEET_VNODES"])
         self._fleet_services = [
             s.strip()
@@ -818,6 +820,16 @@ class DetectorDaemon:
         )
         self.fleet = None
         self.aggregator_service = None
+        # Adoption surface (filled in by the fleet block below when
+        # ANOMALY_FLEET_REPL_PEERS wires the successor mirrors).
+        self._fleet_repl_addrs: dict[str, str] = {}
+        self._adoption_mirror = None
+        self._adoption_target: str | None = None
+        self._adoption_fence = None
+        self._adoptions_total = 0
+        self._adoptions_refused: dict[str, int] = {}
+        self._adoption_seen = {"total": 0}
+        self._last_adoption_tta: float | None = None
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -1118,6 +1130,40 @@ class DetectorDaemon:
                 "mitigation", op="enabled",
                 actuators=[a.name for a in rem_actuators],
             )
+        # Saturation-driven autoscaler (knob registry:
+        # utils.config.AUTOSCALE_KNOBS; engine: runtime.autoscale):
+        # strictly opt-in like remediation — default is observe-only —
+        # proposing shard split on sustained brownout and join on
+        # sustained idle behind the reused token-bucket + two-edge
+        # hysteresis, with every decision fence-checked
+        # (path="autoscale", the sixth fenced write path). The daemon
+        # cannot spawn a shard itself: a landed proposal is
+        # evidence-dumped and exported (anomaly_autoscale_target_shards
+        # + /healthz), and the deployment layer makes the resize one
+        # FLEET_KNOBS change end-to-end.
+        try:
+            ak = autoscale_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.autoscaler = autoscale.AutoscaleController(
+            enabled=bool(int(ak["ANOMALY_AUTOSCALE_ENABLE"])),
+            act_batches=int(ak["ANOMALY_AUTOSCALE_ACT_BATCHES"]),
+            clear_batches=int(ak["ANOMALY_AUTOSCALE_CLEAR_BATCHES"]),
+            budget=int(ak["ANOMALY_AUTOSCALE_BUDGET"]),
+            refill_s=float(ak["ANOMALY_AUTOSCALE_REFILL_S"]),
+            high_water=float(ak["ANOMALY_AUTOSCALE_HIGH_WATER"]),
+            low_water=float(ak["ANOMALY_AUTOSCALE_LOW_WATER"]),
+            min_shards=int(ak["ANOMALY_AUTOSCALE_MIN_SHARDS"]),
+            max_shards=int(ak["ANOMALY_AUTOSCALE_MAX_SHARDS"]),
+            shards_fn=self._fleet_shard_count,
+            role_fn=lambda: self.role,
+            fence=self._fence,
+            flight=self.flight,
+        )
+        self._autoscale_seen: dict[str, int] = {}
+        self._autoscale_shed_seen = 0
+        if self.autoscaler.enabled:
+            self.flight.record("autoscale", op="enabled")
         # Sharded fleet membership (knob registry:
         # utils.config.FLEET_KNOBS; engine: runtime.fleet): a
         # supervised heartbeat loop over the peer shards' /healthz
@@ -1133,6 +1179,21 @@ class DetectorDaemon:
                 self._fleet_peers_raw, self._fleet_shards,
                 self._fleet_index,
             )
+            # Adoption mirrors (ANOMALY_FLEET_REPL_PEERS, index-aligned
+            # like the heartbeat list): each shard subscribes a standby
+            # to its RING-SUCCESSOR's replication stream, so when
+            # membership declares that pair dead through the
+            # double-check + budget guardrails, this daemon already
+            # holds the victim's frame and adopts its keyspace with
+            # zero operator action. Empty list = PR 14 behavior (the
+            # operator merge drill).
+            self._fleet_repl_addrs = (
+                fleet.parse_peer_list(
+                    self._fleet_repl_peers_raw, self._fleet_shards,
+                    self_index=-1, prefix="shard-",
+                )
+                if self._fleet_repl_peers_raw else {}
+            )
             self.fleet = fleet.FleetMember(
                 f"shard-{self._fleet_index}",
                 peer_addrs,
@@ -1143,6 +1204,7 @@ class DetectorDaemon:
                 reshard_budget=self._fleet_reshard_budget,
                 reshard_refill_s=self._fleet_reshard_refill_s,
                 on_reshard=self._on_reshard,
+                adoptive=bool(self._fleet_repl_addrs),
             )
             self._supervisor.register(
                 "fleet", base_backoff_s=0.5, max_backoff_s=15.0,
@@ -1151,6 +1213,14 @@ class DetectorDaemon:
                     self.fleet is None or self.fleet.alive()
                 ),
             )
+            if self._fleet_repl_addrs:
+                # The mirror observes the SUCCESSOR's epoch domain —
+                # never this shard's own fence (each shard is its own
+                # primary; a peer's higher epoch must not fence us).
+                self._adoption_fence = EpochFence()
+                self._retarget_adoption_mirror(
+                    list(self.fleet.membership.ring.members())
+                )
             if self._aggregator_port_req >= 0:
                 from .aggregator import (
                     AggregatorService,
@@ -1410,8 +1480,19 @@ class DetectorDaemon:
             # Fleet block (health_probe --shard reads this): ring
             # version, member set, peer liveness, reshard counters —
             # how an operator tells "one shard browned out" from "the
-            # fleet is splitting".
+            # fleet is splitting". The adoption sub-block rides along:
+            # what this heir merged, what it refused, at what TTA.
             detail["fleet"] = self.fleet.snapshot()
+            detail["fleet"]["adoptions"] = {
+                "total": self._adoptions_total,
+                "refused": dict(self._adoptions_refused),
+                "last_tta_s": self._last_adoption_tta,
+                "mirror_target": self._adoption_target,
+            }
+        # Autoscale surface: the deployment layer reads the proposed
+        # target from here (and the scrape) — a resize is one
+        # FLEET_KNOBS change, this block says which one.
+        detail["autoscale"] = self.autoscaler.stats()
         return ("ok" if state == UP else state), detail
 
     # -- self-telemetry -------------------------------------------------
@@ -2014,6 +2095,13 @@ class DetectorDaemon:
                 time.monotonic() if t_now is None else t_now
             )
             self._export_remediation_stats()
+            # Autoscale housekeeping too: the budget refills, and every
+            # would-be proposal is refused by fence.check — the fenced
+            # counter IS the sixth path's audit trail.
+            self.autoscaler.tick(
+                time.monotonic() if t_now is None else t_now
+            )
+            self._export_autoscale_stats()
             if self.query_engine is not None and self._query_started:
                 self._export_query_stats()
             self._supervisor.tick()
@@ -2067,6 +2155,9 @@ class DetectorDaemon:
                 pending_rows=self.pipeline.pending_rows(),
                 lag_p99_ms=self.pipeline.stats.lag_p99_ms(),
             )
+            # One autoscale observation window per self-report (the
+            # same 1 s cadence ACT_BATCHES counts in).
+            self._autoscale_observe(now_mono)
         # Overload gauges/counters every step (not the 1 s cadence):
         # saturation flips sub-second and the chaos tests scrape between
         # steps — a few dict writes, nothing device-side.
@@ -2125,6 +2216,8 @@ class DetectorDaemon:
         # mitigation whose deadline passed).
         self.remediation.tick(time.monotonic() if t_now is None else t_now)
         self._export_remediation_stats()
+        self.autoscaler.tick(time.monotonic() if t_now is None else t_now)
+        self._export_autoscale_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
         if self.repl_primary is not None:
@@ -2228,12 +2321,187 @@ class DetectorDaemon:
     def _on_reshard(self, event: dict) -> None:
         """Membership applied a ring change (leave/join): evidence in
         the flight recorder — the postmortem question after any
-        reshard is 'who moved, when, at what ring version'."""
+        reshard is 'who moved, when, at what ring version' — and, in
+        adoptive mode, the automatic-adoption trigger: when the leave
+        named THIS shard the heir, the victim's keyspace merges from
+        the successor mirror with zero operator action. Runs on the
+        fleet heartbeat thread, AFTER the membership lock released
+        (the two-phase tick contract), so the merge can take the
+        dispatch lock without ordering against membership state."""
         self.flight.record(
             "reshard", op=event.get("op"), shard=event.get("shard"),
             ring_version=event.get("ring_version"),
             members=event.get("members"),
+            heir=event.get("heir"),
         )
+        if (
+            event.get("op") == "leave"
+            and event.get("heir") == f"shard-{self._fleet_index}"
+        ):
+            self._adopt_shard(event)
+        # Membership moved, so this shard's ring-successor may have
+        # too: re-point the mirror (a retargeted standby drops the old
+        # peer's arrays and bootstraps from the new primary's
+        # SNAPSHOT). After the adoption above — the merge needs the
+        # mirror's pre-retarget state.
+        self._retarget_adoption_mirror(event.get("members") or [])
+
+    def _refuse_adoption(self, reason: str, victim: str) -> None:
+        self._adoptions_refused[reason] = (
+            self._adoptions_refused.get(reason, 0) + 1
+        )
+        self.flight.record(
+            "adoption-refused", reason=reason, victim=victim,
+        )
+        self.flight.dump(
+            "adoption-refused", refusal=reason, victim=victim,
+        )
+
+    def _adopt_shard(self, event: dict) -> None:
+        """Automatic in-daemon frame adoption: merge the dead
+        ring-successor's mirrored frame into live state under the
+        dispatch lock (the PR 14 operator drill, with the operator
+        replaced by the heir computation). Refusals are counted by
+        reason and evidence-dumped — an adoption that CANNOT be done
+        safely (drifted intern tables, no mirrored state) leaves the
+        keyspace orphaned-but-audited, exactly like the manual path."""
+        victim = str(event.get("shard"))
+        mirror = self._adoption_mirror
+        if mirror is None:
+            self._refuse_adoption("no_mirror", victim)
+            return
+        if self.role != ROLE_PRIMARY or self._fence.stale():
+            # A fenced/standby heir must not write state it does not
+            # own; the keyspace stays with whoever outranked us.
+            self._refuse_adoption("role", victim)
+            return
+        src_arrays, src_meta = mirror.snapshot()
+        if not src_arrays:
+            self._refuse_adoption("no_state", victim)
+            return
+        # The victim's keyspace slice under the PRE-leave ring: the
+        # post-event members + adopted map minus this very adoption
+        # reconstruct it exactly (every member computes the same ring
+        # from the same inputs — the zero-coordination property).
+        members = [str(m) for m in (event.get("members") or [])]
+        pre_adopted = {
+            v: h
+            for v, h in self.fleet.membership.ring.adopted().items()
+            if v != victim
+        }
+        pre_ring = fleet.HashRing(
+            members + [victim], vnodes=self._fleet_vnodes,
+            adopted=pre_adopted,
+        )
+        src_names = [str(s) for s in src_meta.get("service_names") or []]
+        owned = {
+            svc for svc in src_names
+            if pre_ring.owner_of(
+                svc, fleet.tenant_of(svc, self._tenant_map)
+            ) == victim
+        }
+        try:
+            import jax
+
+            from ..models.detector import DetectorState
+
+            with self.pipeline._dispatch_lock:
+                import numpy as np
+
+                dst = {
+                    k: np.asarray(v)
+                    for k, v in self.detector.state._asdict().items()
+                }
+                head = dst.get("lat_mean")
+                num_rows = int(head.shape[0]) if head is not None else 0
+                mask = fleet.service_row_mask(
+                    src_names,
+                    self.pipeline.tensorizer.service_names,
+                    num_rows,
+                    owned=owned,
+                )
+                merged = fleet.merge_shard_arrays(dst, src_arrays, mask)
+                self.detector.state = DetectorState(
+                    **{k: jax.device_put(v) for k, v in merged.items()}
+                )
+        except fleet.ShardMergeError as e:
+            self._refuse_adoption("merge", victim)
+            logging.getLogger(__name__).error(
+                "adoption of %s refused: %s", victim, e
+            )
+            return
+        except Exception:  # noqa: BLE001 — a failed adoption is an
+            # audited orphan (like a refused manual merge), never a
+            # dead heartbeat thread.
+            self._refuse_adoption("error", victim)
+            logging.getLogger(__name__).exception(
+                "adoption of %s failed", victim
+            )
+            return
+        # The victim's names are already interned (the pre-intern
+        # contract the drift check just verified) — but late services
+        # the victim interned past our table still need ids for the
+        # query plane to answer by name.
+        for name in src_names:
+            self.pipeline.tensorizer.service_id(name)
+        tta = max(time.monotonic() - float(event.get("t") or 0.0), 0.0)
+        self._adoptions_total += 1
+        self._last_adoption_tta = tta
+        self.flight.record(
+            "adoption", victim=victim, tta_s=round(tta, 4),
+            services=sorted(owned),
+            ring_version=event.get("ring_version"),
+        )
+        self.flight.dump(
+            "adoption", victim=victim, tta_s=round(tta, 4),
+            services=sorted(owned),
+        )
+
+    def _retarget_adoption_mirror(self, members: list) -> None:
+        """Keep the standby mirror pointed at this shard's CURRENT
+        ring-successor (pure function of the member list — every
+        member re-derives the same pairing with no coordination)."""
+        if not self._fleet_repl_addrs:
+            return
+        self_id = f"shard-{self._fleet_index}"
+        succ = fleet.ring_successor(
+            [str(m) for m in members], self_id
+        )
+        addr = self._fleet_repl_addrs.get(succ) if succ else None
+        if addr == self._adoption_target:
+            return
+        self._adoption_target = addr
+        if addr is None:
+            # Alone on the ring (or the successor has no stream):
+            # nothing to mirror — stop, keep the object for rejoin.
+            if self._adoption_mirror is not None:
+                try:
+                    self._adoption_mirror.stop()
+                except Exception:  # noqa: BLE001 — a half-dead client
+                    pass
+            return
+        if self._adoption_mirror is None:
+            self._adoption_mirror = replication.ReplicationStandby(
+                addr,
+                fence=self._adoption_fence or EpochFence(),
+                standby_id=f"{self_id}-adopt",
+                silence_reconnect_s=max(
+                    self._fleet_heartbeat_s * 2.0, 2.0
+                ),
+            )
+            self._adoption_mirror.start()
+        else:
+            self._adoption_mirror.retarget(addr)
+        self.flight.record(
+            "adoption-mirror", successor=succ, target=addr,
+        )
+
+    def _fleet_shard_count(self) -> int:
+        """The autoscaler's proposal base: live members on the ring
+        (single-shard daemons scale from 1)."""
+        if self.fleet is None:
+            return 1
+        return self.fleet.membership.live_count()
 
     def _restart_fleet(self) -> None:
         if self.fleet is None:
@@ -2298,6 +2566,89 @@ class DetectorDaemon:
                 shard=f"shard-{self._fleet_index}",
             )
             seen["spans"] = spans
+        # Adoption trail (delta-based like every fleet counter; the
+        # refused map is tiny — a handful of reason keys).
+        delta = self._adoptions_total - self._adoption_seen["total"]
+        if delta > 0:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_FLEET_ADOPTIONS, float(delta)
+            )
+            self._adoption_seen["total"] = self._adoptions_total
+        for reason, count in list(self._adoptions_refused.items()):
+            key = f"refused_{reason}"
+            d = count - self._adoption_seen.get(key, 0)
+            if d > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_FLEET_ADOPTIONS_REFUSED,
+                    float(d), reason=reason,
+                )
+                self._adoption_seen[key] = count
+        if self._last_adoption_tta is not None:
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_FLEET_ADOPTION_TTA,
+                float(self._last_adoption_tta),
+            )
+
+    def _export_autoscale_stats(self) -> None:
+        """anomaly_autoscale_* from the controller's counters (delta-
+        based) + the live score/target gauges."""
+        st = self.autoscaler.stats()
+        seen = self._autoscale_seen
+        for action in ("split", "join"):
+            key = f"proposals_{action}"
+            delta = st[key] - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_AUTOSCALE_PROPOSALS,
+                    float(delta), action=action,
+                )
+                seen[key] = st[key]
+        for reason in (
+            "disabled", "role", "fenced", "bounds", "budget", "apply",
+        ):
+            key = f"refused_{reason}"
+            delta = st[key] - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_AUTOSCALE_REFUSED,
+                    float(delta), reason=reason,
+                )
+                seen[key] = st[key]
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_AUTOSCALE_SCORE, float(st["score"])
+        )
+        if st["target_shards"] is not None:
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_AUTOSCALE_TARGET,
+                float(st["target_shards"]),
+            )
+
+    def _autoscale_observe(self, t_now: float) -> None:
+        """One saturation window for the autoscaler (1 s cadence, on
+        the primary step): watermark / shed / brownout / saturation
+        signals, each normalized to [0, 1]."""
+        pending = float(self.pipeline.pending_rows())
+        high = float(getattr(self.pipeline, "_high_rows", 0) or 0)
+        shed = self.pipeline.stats.shed_rows
+        shed_total = (
+            int(shed.get("ok", 0)) + int(shed.get("error", 0))
+            + int(self.pipeline.stats.brownout_rows)
+        )
+        shed_active = shed_total > self._autoscale_shed_seen
+        self._autoscale_shed_seen = shed_total
+        max_level = max(
+            float(getattr(self.pipeline, "brownout_max_level", 0) or 0),
+            1.0,
+        )
+        signals = {
+            "watermark": min(pending / high, 1.0) if high > 0 else 0.0,
+            "shed": 1.0 if shed_active else 0.0,
+            "brownout": min(
+                float(self.pipeline.brownout_level) / max_level, 1.0
+            ),
+            "saturated": 1.0 if self.pipeline.saturated else 0.0,
+        }
+        self.autoscaler.observe(t_now, signals)
 
     # -- replication: standby step / promotion / fencing ----------------
 
@@ -2758,6 +3109,8 @@ class DetectorDaemon:
     def shutdown(self) -> None:
         if self.fleet is not None:
             self.fleet.stop()
+        if self._adoption_mirror is not None:
+            self._adoption_mirror.stop()
         if self.aggregator_service is not None:
             self.aggregator_service.stop()
         if self.repl_standby is not None:
@@ -2778,6 +3131,7 @@ class DetectorDaemon:
         # new reports can arrive, and a queued actuation against a dead
         # flagd must not pin shutdown past its bounded retries.
         self.remediation.close()
+        self.autoscaler.close()
         if self.ingest_pool is not None:
             # Receivers are stopped, so no new jobs: flush the decode
             # queue into the pipeline, then stop the workers — BEFORE

@@ -125,9 +125,15 @@ class HashRing:
     the identical ring from the identical member set.
     """
 
-    def __init__(self, members: Iterable[str], vnodes: int = 128):
+    def __init__(
+        self,
+        members: Iterable[str],
+        vnodes: int = 128,
+        adopted: dict[str, str] | None = None,
+    ):
         self.vnodes = max(int(vnodes), 1)
         self._members: set[str] = set()
+        self._adopted: dict[str, str] = dict(adopted or {})
         self._points: list[int] = []
         self._owners: list[str] = []
         self._lock = threading.Lock()
@@ -135,10 +141,28 @@ class HashRing:
             self._members.add(str(m))
         self._rebuild()
 
+    def _heir_of(self, victim: str) -> str | None:
+        """Resolve an adoption chain to a LIVE heir (a heir that died
+        and was itself adopted hands the whole arc onward)."""
+        seen = set()
+        cur = victim
+        while cur in self._adopted and cur not in seen:
+            seen.add(cur)
+            cur = self._adopted[cur]
+        return cur if cur in self._members else None
+
     def _rebuild(self) -> None:
         pairs = sorted(
-            (key_hash64(f"{member}#{v}"), member)
-            for member in self._members
+            (key_hash64(f"{member}#{v}"), owner)
+            for member, owner in (
+                [(m, m) for m in self._members]
+                + [
+                    (v, self._heir_of(v))
+                    for v in self._adopted
+                    if v not in self._members
+                ]
+            )
+            if owner is not None
             for v in range(self.vnodes)
         )
         self._points = [p for p, _ in pairs]
@@ -148,19 +172,35 @@ class HashRing:
         with self._lock:
             return tuple(sorted(self._members))
 
+    def adopted(self) -> dict[str, str]:
+        """victim → heir arc transfers currently in force (the block
+        /healthz publishes so a refreshing aggregator can rebuild the
+        IDENTICAL ring, adoption arcs included)."""
+        with self._lock:
+            return dict(self._adopted)
+
     def version(self) -> int:
         """Stable ring-content digest: equal member sets (and vnode
-        counts) hash equal in every process — the value /healthz and
-        the aggregator compare to detect a ring split."""
+        counts, and adoption arcs) hash equal in every process — the
+        value /healthz and the aggregator compare to detect a ring
+        split. The adoption suffix only appears when arcs are in
+        force, so pre-adoption rings keep their historical digests."""
         with self._lock:
-            return key_hash64(
-                ",".join(sorted(self._members)) + f"|{self.vnodes}"
-            )
+            text = ",".join(sorted(self._members)) + f"|{self.vnodes}"
+            if self._adopted:
+                text += "|" + ",".join(
+                    f"{v}>{h}" for v, h in sorted(self._adopted.items())
+                )
+            return key_hash64(text)
 
     def add(self, member: str) -> bool:
         with self._lock:
+            changed = member in self._adopted
+            self._adopted.pop(member, None)  # rejoin reclaims the arc
             if member in self._members:
-                return False
+                if changed:
+                    self._rebuild()
+                return changed
             self._members.add(member)
             self._rebuild()
             return True
@@ -170,6 +210,23 @@ class HashRing:
             if member not in self._members:
                 return False
             self._members.discard(member)
+            self._rebuild()
+            return True
+
+    def adopt(self, victim: str, heir: str) -> bool:
+        """Transfer ``victim``'s ENTIRE arc to ``heir`` and drop it
+        from membership: unlike :meth:`remove` (which redistributes
+        the victim's vnode arcs across all survivors by hash), every
+        key the victim owned now belongs to the one shard that holds
+        its replicated frame — the ownership shape that makes
+        automatic frame adoption answer bit-exact reads."""
+        with self._lock:
+            if victim not in self._members or heir == victim:
+                return False
+            if heir not in self._members:
+                return False
+            self._members.discard(victim)
+            self._adopted[victim] = heir
             self._rebuild()
             return True
 
@@ -196,6 +253,32 @@ class HashRing:
         for k in keys:
             out[self.owner(k)] += 1
         return out
+
+
+def ring_successor(members: Iterable[str], self_id: str) -> str | None:
+    """The member after ``self_id`` in sorted member order (wrapping)
+    — the peer whose replication stream this shard mirrors so it can
+    adopt the keyspace if that peer dies. Deterministic from the
+    member list alone: every shard computes the same pairing with no
+    coordination. ``None`` when alone (nothing to mirror)."""
+    ordered = sorted({str(m) for m in members})
+    if self_id not in ordered or len(ordered) < 2:
+        return None
+    i = ordered.index(self_id)
+    return ordered[(i + 1) % len(ordered)]
+
+
+def ring_heir(members: Iterable[str], victim: str) -> str | None:
+    """The survivor that adopts ``victim``'s arc: the member whose
+    :func:`ring_successor` is (was) the victim — its predecessor in
+    sorted order over the full member set. Every member computes the
+    identical heir from the identical list, so the adoption lands on
+    exactly one shard. ``None`` when no survivor exists."""
+    full = sorted({str(m) for m in members} | {victim})
+    if len(full) < 2:
+        return None
+    i = full.index(victim)
+    return full[i - 1]
 
 
 # -- reshard state merge ------------------------------------------------
@@ -332,9 +415,16 @@ class FleetMembership:
         reshard_refill_s: float = 60.0,
         health_check: Callable[[str], bool] | None = None,
         on_reshard: Callable[[dict], None] | None = None,
+        adoptive: bool = False,
     ):
         self.self_id = str(self_id)
         peer_ids = [str(p) for p in peers if str(p) != self.self_id]
+        # Adoptive mode: a declared-dead peer's arc TRANSFERS whole to
+        # its deterministic heir (ring.adopt) instead of rehashing
+        # across all survivors — the heir is the shard mirroring the
+        # victim's replication stream, so ownership lands exactly
+        # where the replicated frame already lives.
+        self.adoptive = bool(adoptive)
         self.ring = HashRing([self.self_id, *peer_ids], vnodes=vnodes)
         self.dead_after_s = float(dead_after_s)
         self.rejoin_after_s = float(rejoin_after_s)
@@ -490,20 +580,29 @@ class FleetMembership:
             return None
         self._refused_pending.discard(peer)
         st = self._peers[peer]
+        heir = None
         if op == "leave":
-            self.ring.remove(peer)
+            if self.adoptive:
+                heir = ring_heir(self.ring.members(), peer)
+            if heir is not None:
+                self.ring.adopt(peer, heir)
+            else:
+                self.ring.remove(peer)
             st.in_ring = False
         else:
             self.ring.add(peer)
             st.in_ring = True
         self.reshards_total += 1
-        return {
+        ev = {
             "op": op,
             "shard": peer,
             "t": now,
             "ring_version": self.ring.version(),
             "members": list(self.ring.members()),
         }
+        if heir is not None:
+            ev["heir"] = heir
+        return ev
 
     # -- surfaces -------------------------------------------------------
 
@@ -535,6 +634,7 @@ class FleetMembership:
             "shard": self.self_id,
             "ring_version": self.ring.version(),
             "members": list(members),
+            "adopted": self.ring.adopted(),
             "shards_live": self.live_count(),
             "shards_total": 1 + len(peers),
             "owned_vnodes": self.ring.vnodes,
@@ -594,6 +694,7 @@ class FleetMember:
         reshard_refill_s: float = 60.0,
         on_reshard: Callable[[dict], None] | None = None,
         probe: Callable[[str], bool] | None = None,
+        adoptive: bool = False,
     ):
         self._addrs = dict(peer_addrs)
         self._probe = probe or (
@@ -617,6 +718,7 @@ class FleetMember:
             reshard_refill_s=reshard_refill_s,
             health_check=lambda shard: self._safe_double_check(shard),
             on_reshard=on_reshard,
+            adoptive=adoptive,
         )
         self.heartbeat_s = float(heartbeat_s)
         self._stop = threading.Event()

@@ -857,6 +857,31 @@ class ReplicationStandby:
         self._stop = True
         self._thread.join(timeout=2.0)
 
+    def retarget(self, target: str) -> None:
+        """Re-point the mirror at a NEW primary (the ring-successor
+        changed after an adoption/resize): end the session, drop the
+        mirrored state — it belongs to the OLD peer's keyspace, and a
+        merge against it would double-count — and dial the new
+        address. ``applied_seq`` resets to -1, so the new primary
+        leads with a full SNAPSHOT after HELLO and the mirror is
+        correct again one frame after the dial lands."""
+        self._stop = True
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2.0)
+        host, _, port = target.rpartition(":")
+        with self._lock:
+            self.addr = (host or "127.0.0.1", int(port))
+            self.arrays = {}
+            self.meta = {}
+            self.applied_seq = -1
+        self._have_state.clear()
+        self.last_frame_t = time.monotonic()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="replication-standby", daemon=True
+        )
+        self._thread.start()
+
     def wait_for_state(self, timeout: float = 10.0) -> bool:
         """Block until the first snapshot landed (tests/bootstrap)."""
         return self._have_state.wait(timeout)

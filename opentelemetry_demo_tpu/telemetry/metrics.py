@@ -332,6 +332,22 @@ ANOMALY_FLEET_FROZEN = "anomaly_fleet_ring_frozen"
 ANOMALY_RESHARDS = "anomaly_reshards_total"
 ANOMALY_RESHARDS_REFUSED = "anomaly_reshards_refused_total"
 ANOMALY_FLEET_SHARD_SPANS = "anomaly_fleet_shard_ingest_spans_total"  # {shard=}
+# Elastic fleet (runtime.autoscale + in-daemon frame adoption): the
+# saturation-driven split/join proposer's decision trail — proposals,
+# gated refusals by reason (budget / fenced / bounds / role /
+# disabled), the live saturation score and last proposed size — plus
+# the adoption side: automatic keyspace merges performed by a
+# ring-heir when membership declared its pair dead (zero operator
+# action), refusals (intern-table drift, no mirror state), and the
+# measured time-to-adopt (heartbeat death declaration → merged frame
+# serving), the elastic fleet's headline beside TTD and TTM.
+ANOMALY_AUTOSCALE_PROPOSALS = "anomaly_autoscale_proposals_total"  # {action=}
+ANOMALY_AUTOSCALE_REFUSED = "anomaly_autoscale_refused_total"  # {reason=}
+ANOMALY_AUTOSCALE_TARGET = "anomaly_autoscale_target_shards"
+ANOMALY_AUTOSCALE_SCORE = "anomaly_autoscale_saturation_score"
+ANOMALY_FLEET_ADOPTIONS = "anomaly_fleet_adoptions_total"
+ANOMALY_FLEET_ADOPTIONS_REFUSED = "anomaly_fleet_adoptions_refused_total"  # {reason=}
+ANOMALY_FLEET_ADOPTION_TTA = "anomaly_fleet_adoption_seconds"
 
 
 def export_metrics_report(

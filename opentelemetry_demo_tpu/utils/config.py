@@ -725,6 +725,89 @@ FLEET_KNOBS: dict[str, tuple[str, object, str]] = {
         "answer degrades to a labeled PARTIAL result "
         "(shards_answered/shards_total) — never a crashed query",
     ),
+    "ANOMALY_FLEET_REPL_PEERS": (
+        "str", "",
+        "comma list of per-shard REPLICATION-stream addresses "
+        "host:repl_port, index-aligned like ANOMALY_FLEET_PEERS: each "
+        "shard subscribes a standby mirror to its ring-successor's "
+        "stream so a declared-dead pair's keyspace is ADOPTED by the "
+        "survivor automatically (merge under the dispatch lock, new "
+        "ring version, flight-recorded) with zero operator action; "
+        "empty = no adoption mirrors (the PR 14 operator-merge "
+        "behavior)",
+    ),
+}
+
+
+# Fleet autoscaler knobs (runtime.autoscale: the supervised,
+# STRICTLY OPT-IN controller that proposes shard split on sustained
+# brownout and join on sustained idle, behind the same token-bucket +
+# two-edge-hysteresis guardrails as remediation and reshard — a
+# flapping load shape exhausts the budget and FREEZES the ring instead
+# of oscillating it; every decision is epoch-fenced, the sixth fenced
+# path, and evidence-dumped). Same ONE-registry discipline as every
+# other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+AUTOSCALE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_AUTOSCALE_ENABLE": (
+        "int", 0,
+        "1 = the autoscaler PROPOSES ring resizes (split/join) on a "
+        "PRIMARY; 0 (the default — elastic scaling is strictly "
+        "opt-in) = observe-only: the controller tracks saturation "
+        "streaks and exports metrics but never proposes",
+    ),
+    "ANOMALY_AUTOSCALE_ACT_BATCHES": (
+        "int", 5,
+        "hysteresis, acting half: consecutive observation windows at "
+        "or above the high watermark before a SPLIT is proposed (one "
+        "noisy window must never resize a production ring)",
+    ),
+    "ANOMALY_AUTOSCALE_CLEAR_BATCHES": (
+        "int", 30,
+        "hysteresis, clearing half: consecutive windows at or below "
+        "the low watermark before a JOIN is proposed — deliberately "
+        "much longer than the acting half (scaling in is cheap to "
+        "defer, expensive to regret)",
+    ),
+    "ANOMALY_AUTOSCALE_BUDGET": (
+        "int", 2,
+        "token-bucket capacity on resize proposals: a flapping load "
+        "shape exhausts the bucket and the ring FREEZES in its last "
+        "shape (proposals refused + counted) instead of oscillating",
+    ),
+    "ANOMALY_AUTOSCALE_REFILL_S": (
+        "float", 300.0,
+        "seconds per proposal-budget token refill (observed "
+        "timebase): the sustained resize rate ceiling, 1 proposal "
+        "per this many seconds",
+    ),
+    "ANOMALY_AUTOSCALE_HIGH_WATER": (
+        "float", 0.75,
+        "two-edge hysteresis, upper edge: saturation score (max of "
+        "admission watermark fraction, shed activity, brownout "
+        "level) at or above which a window counts toward the split "
+        "streak",
+    ),
+    "ANOMALY_AUTOSCALE_LOW_WATER": (
+        "float", 0.15,
+        "two-edge hysteresis, lower edge: saturation score at or "
+        "below which a window counts toward the join streak; scores "
+        "between the edges reset BOTH streaks (the dead band that "
+        "makes a flapping shape freeze instead of oscillate)",
+    ),
+    "ANOMALY_AUTOSCALE_MIN_SHARDS": (
+        "int", 2,
+        "floor on the proposed fleet size: join proposals below it "
+        "are refused (counted) — the fleet never scales itself back "
+        "to a single point of failure",
+    ),
+    "ANOMALY_AUTOSCALE_MAX_SHARDS": (
+        "int", 8,
+        "ceiling on the proposed fleet size: split proposals above "
+        "it are refused (counted) — a runaway load shape cannot "
+        "demand unbounded hardware",
+    ),
 }
 
 
@@ -738,7 +821,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
-    "FLEET_KNOBS",
+    "FLEET_KNOBS", "AUTOSCALE_KNOBS",
 )
 
 
@@ -825,6 +908,14 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "measure_reshard: kill a shard beside an unkilled witness "
         "fleet, reshard TTD, witness-pinned bit-exact answers, "
         "blackholed-shard partial answers, noisy-tenant isolation)",
+    ),
+    "BENCH_AUTOSCALE": (
+        "int", 1,
+        "0 skips the elastic-fleet drill (runtime.replbench "
+        "measure_adoption: ramp load to brownout, watch the "
+        "autoscaler propose scale-out, SIGKILL a shard mid-resize, "
+        "pin the automatic adoption bit-exact against an unkilled "
+        "witness; lifts autoscale_tta_s and autoscale_ok)",
     ),
 }
 
@@ -1280,6 +1371,52 @@ def fleet_config() -> dict[str, int | float | str]:
     # the fleet tier reuse) — a map nobody can apply must refuse to
     # boot.
     fleet_tenant_map(out["ANOMALY_FLEET_TENANTS"])
+    return out
+
+
+def autoscale_config() -> dict[str, int | float | str]:
+    """Resolve every AUTOSCALE_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the guardrail
+    shapes — a controller with zero hysteresis, a zero budget,
+    inverted watermark edges or an inverted shard range could resize
+    a production ring on one noisy window, and must refuse to boot
+    instead."""
+    out = _resolve(AUTOSCALE_KNOBS)
+    if int(out["ANOMALY_AUTOSCALE_ACT_BATCHES"]) < 1:
+        raise ConfigError(
+            "ANOMALY_AUTOSCALE_ACT_BATCHES="
+            f"{out['ANOMALY_AUTOSCALE_ACT_BATCHES']} must be >= 1"
+        )
+    if int(out["ANOMALY_AUTOSCALE_CLEAR_BATCHES"]) < 1:
+        raise ConfigError(
+            "ANOMALY_AUTOSCALE_CLEAR_BATCHES="
+            f"{out['ANOMALY_AUTOSCALE_CLEAR_BATCHES']} must be >= 1"
+        )
+    if int(out["ANOMALY_AUTOSCALE_BUDGET"]) < 1:
+        raise ConfigError(
+            f"ANOMALY_AUTOSCALE_BUDGET="
+            f"{out['ANOMALY_AUTOSCALE_BUDGET']} must be >= 1"
+        )
+    if float(out["ANOMALY_AUTOSCALE_REFILL_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_AUTOSCALE_REFILL_S="
+            f"{out['ANOMALY_AUTOSCALE_REFILL_S']} must be > 0"
+        )
+    high = float(out["ANOMALY_AUTOSCALE_HIGH_WATER"])
+    low = float(out["ANOMALY_AUTOSCALE_LOW_WATER"])
+    if not 0.0 <= low < high <= 1.0:
+        raise ConfigError(
+            f"ANOMALY_AUTOSCALE_LOW_WATER={low} / HIGH_WATER={high}: "
+            "the two-edge hysteresis needs 0 <= low < high <= 1 (the "
+            "dead band between the edges is what prevents oscillation)"
+        )
+    lo_n = int(out["ANOMALY_AUTOSCALE_MIN_SHARDS"])
+    hi_n = int(out["ANOMALY_AUTOSCALE_MAX_SHARDS"])
+    if not 1 <= lo_n <= hi_n:
+        raise ConfigError(
+            f"ANOMALY_AUTOSCALE_MIN_SHARDS={lo_n} / MAX_SHARDS={hi_n}: "
+            "need 1 <= min <= max"
+        )
     return out
 
 

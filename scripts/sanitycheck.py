@@ -168,7 +168,7 @@ def main() -> int:
                         "REPLICATION_KNOBS", "FRAME_KNOBS",
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
                         "HISTORY_KNOBS", "REMEDIATION_KNOBS",
-                        "FLEET_KNOBS",
+                        "FLEET_KNOBS", "AUTOSCALE_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -177,7 +177,7 @@ def main() -> int:
         "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
-        "REMEDIATION_KNOBS", "FLEET_KNOBS",
+        "REMEDIATION_KNOBS", "FLEET_KNOBS", "AUTOSCALE_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -748,6 +748,74 @@ def main() -> int:
             "test_max_nesting_submessages",
         ):
             check(marker in nitext, f"scanner fuzz suite pins {marker}")
+
+    # 13) elastic fleet (runtime/autoscale.py + the adoption tier in
+    #     fleet.py/daemon.py): the autoscaler defaults OFF (the same
+    #     hard opt-in as remediation — a ring that resizes itself is a
+    #     product decision, not a knob drift), every decision passes
+    #     the SIXTH fenced epoch path (path="autoscale"), dead-peer
+    #     keyspace adoption is automatic in-daemon (ring_heir + adopt
+    #     + merge under the dispatch lock), the k8s generator emits
+    #     the collector-side fleet routing from the REAL ring, and the
+    #     chaos suite pins the proofs.
+    autoscale_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "autoscale.py"
+    )
+    check(os.path.exists(autoscale_py), "runtime/autoscale.py exists")
+    if os.path.exists(autoscale_py):
+        astext = open(autoscale_py).read()
+        for marker in (
+            "class AutoscaleController", "TokenBucket",
+            'path="autoscale"', "observe_only", "budget_exhausted",
+            "refused_apply",
+        ):
+            check(marker in astext, f"runtime/autoscale.py declares {marker!r}")
+    as_knobs = registries.get("AUTOSCALE_KNOBS") or {}
+    as_enable = as_knobs.get("ANOMALY_AUTOSCALE_ENABLE")
+    check(
+        as_enable is not None and as_enable[1] == 0,
+        "autoscaling defaults OFF (ANOMALY_AUTOSCALE_ENABLE=0)",
+    )
+    if os.path.exists(fleet_py):
+        fleet_text = open(fleet_py).read()
+        for marker in ("def adopt", "def ring_heir", "adoptive"):
+            check(
+                marker in fleet_text,
+                f"runtime/fleet.py grows the adoption tier ({marker})",
+            )
+    for marker in (
+        "_adopt_shard", "_retarget_adoption_mirror",
+        "AutoscaleController",
+    ):
+        check(
+            marker in daemon_text,
+            f"daemon wires automatic adoption + autoscaler ({marker})",
+        )
+    check(
+        "fleet_routing_configmap" in k8s_text,
+        "k8s generator emits the ring-derived fleet routing configmap",
+    )
+    check(
+        "def measure_adoption" in open(os.path.join(
+            ROOT, "opentelemetry_demo_tpu", "runtime", "replbench.py"
+        )).read(),
+        "replbench.py grows the autoscale + SIGKILL-adoption drill",
+    )
+    check(
+        "autoscalebench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has an autoscalebench target",
+    )
+    if os.path.exists(fleet_tests):
+        fttext = open(fleet_tests).read()
+        for marker in (
+            "test_dead_peer_frame_adopted_automatically",
+            "test_stalled_but_serving_shard_never_auto_adopted",
+            "test_budget_exhausted_freezes_adoption",
+            "test_observe_only_default_never_proposes",
+            "test_fenced_decision_refused",
+            "test_autoscale_sigkill_adoption_live",
+        ):
+            check(marker in fttext, f"elastic-fleet suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -190,14 +190,16 @@ class TestManifests:
     def test_yaml_round_trip(self, tmp_path):
         paths = k8s.write_manifests(str(tmp_path))
         # 3 aggregates (full, minimal, sidecar) + one file per
-        # component + the component-only fleet aggregator bundle.
-        assert len(paths) == 3 + len(k8s.component_bundles()) + 1
+        # component + the component-only fleet bundles (aggregator +
+        # the N-shard fleet with its routing configmap).
+        assert len(paths) == 3 + len(k8s.component_bundles()) + 2
         for p in paths:
             docs = list(yaml.safe_load_all(open(p)))
             assert all("apiVersion" in d and "kind" in d for d in docs)
         names = {p.split("/")[-1] for p in paths}
         assert {"kafka.yaml", "shop-gateway.yaml", "anomaly-detector.yaml",
-                "load-generator.yaml", "anomaly-aggregator.yaml"} <= names
+                "load-generator.yaml", "anomaly-aggregator.yaml",
+                "anomaly-fleet.yaml"} <= names
         # The fleet tier is component-only: a default aggregator
         # (SHARDS=0) in the standalone stack would just crash-loop.
         standalone = {
@@ -205,6 +207,8 @@ class TestManifests:
             for d in k8s.standalone_stack() if d["kind"] == "Deployment"
         }
         assert "anomaly-aggregator" not in standalone
+        assert not any(n.startswith("anomaly-detector-shard-")
+                       for n in standalone)
 
     def test_flagd_configmap_carries_real_flags(self):
         cm = k8s._flagd_configmap()

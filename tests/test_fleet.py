@@ -26,6 +26,29 @@ The acceptance bars this suite proves (ISSUE 14):
   adopt its replicated frame by monoid merge, and every post-reshard
   answer for the victim's keys is BIT-EXACT against an unkilled
   witness fleet.
+
+And the elastic-fleet bars (ISSUE 16):
+
+- **Adoption ring** (``TestRingAdoption``): ``adopt`` transfers the
+  victim's WHOLE arc to the one heir (never rehashes), chains resolve
+  to a live member, rejoin reclaims the arc bit-identically, and the
+  successor/heir pairing is its own inverse.
+- **Adoptive membership** (``TestAdoptiveMembership``): a
+  declared-dead peer's leave event names the deterministic heir; a
+  stalled-but-serving peer is NEVER auto-adopted; an exhausted budget
+  freezes adoption; a rejoined victim reclaims its keyspace.
+- **Autoscaler** (``TestAutoscaleController``): strictly opt-in,
+  two-edge hysteresis with a dead band that freezes, token-bucket
+  budget, role + epoch-fence gating (the sixth fenced path), bounds,
+  and refund on unapplied proposals.
+- **Daemon adoption** (``TestDaemonAdoption``): a real daemon whose
+  peer dies adopts the mirrored frame automatically — zero operator
+  action — and REFUSES (counted, state untouched) on intern-table
+  drift or a missing mirror.
+- **Elastic aggregator** (``TestAggregatorElastic``): a boot-time
+  ring gone stale across a mid-query resize self-repairs (refresh +
+  retry-once), and the fleet-global Grafana simple-JSON surface
+  merges /search, /query and /annotations across shards.
 """
 
 from __future__ import annotations
@@ -46,6 +69,7 @@ from opentelemetry_demo_tpu.runtime.aggregator import (
     AggregatorService,
     FleetAggregator,
 )
+from opentelemetry_demo_tpu.runtime.autoscale import AutoscaleController
 from opentelemetry_demo_tpu.runtime.faultwire import FaultWire
 from opentelemetry_demo_tpu.runtime.fleet import (
     FleetMember,
@@ -55,10 +79,13 @@ from opentelemetry_demo_tpu.runtime.fleet import (
     key_hash64,
     merge_shard_arrays,
     parse_peer_list,
+    ring_heir,
+    ring_successor,
     service_row_mask,
     shard_key,
     tenant_of,
 )
+from opentelemetry_demo_tpu.runtime.replication import StaleEpochError
 from opentelemetry_demo_tpu.runtime.query import QueryEngine, QueryService
 from opentelemetry_demo_tpu.utils.config import (
     ConfigError,
@@ -934,3 +961,705 @@ class TestDaemonFleet:
             assert "fleet" not in detail
         finally:
             daemon.shutdown()
+
+
+# --- elastic fleet: adoption ring units (ISSUE 16) ---------------------
+
+
+class TestRingAdoption:
+    def test_adopt_transfers_whole_arc_to_heir(self):
+        """`adopt` moves EVERY key the victim owned to the one heir
+        (the shard already mirroring its replication stream) — unlike
+        `remove`, which rehashes the victim's vnode arcs across all
+        survivors and would scatter keyspace away from the only
+        replica that holds the frame."""
+        keys = _keys(3000)
+        ring = HashRing(
+            [f"shard-{i}" for i in range(4)], vnodes=128
+        )
+        before = ring.assignments(keys)
+        victim = "shard-1"
+        heir = ring_heir(ring.members(), victim)
+        assert ring.adopt(victim, heir)
+        after = ring.assignments(keys)
+        for k in keys:
+            if before[k] == victim:
+                assert after[k] == heir
+            else:
+                assert after[k] == before[k]
+        assert ring.adopted() == {victim: heir}
+        assert victim not in ring.members()
+
+    def test_version_tracks_arcs_and_rejoin_reclaims(self):
+        """The ring digest covers adoption arcs (a refreshing
+        aggregator must rebuild the IDENTICAL post-adoption ring from
+        the /healthz fleet block), and a rejoin reclaims the arc,
+        restoring the pre-adoption digest exactly."""
+        r1 = HashRing(["a", "b", "c"], vnodes=64)
+        v0 = r1.version()
+        heir = ring_heir(r1.members(), "b")
+        r1.adopt("b", heir)
+        assert r1.version() != v0
+        rebuilt = HashRing(["a", "c"], vnodes=64, adopted={"b": heir})
+        assert rebuilt.version() == r1.version()
+        keys = _keys(500)
+        assert rebuilt.assignments(keys) == r1.assignments(keys)
+        r1.add("b")
+        assert r1.version() == v0
+        assert r1.adopted() == {}
+
+    def test_adoption_chain_resolves_to_live_heir(self):
+        """A dead heir hands its whole arc (its own keys AND its
+        adopted victim's) onward: key resolution follows the chain to
+        a LIVE member, so cascading failures still leave every key
+        with exactly one owner."""
+        ring = HashRing([f"shard-{i}" for i in range(4)], vnodes=128)
+        keys = _keys(2000)
+        h1 = ring_heir(ring.members(), "shard-1")  # shard-0
+        ring.adopt("shard-1", h1)
+        h0 = ring_heir(ring.members(), h1)
+        ring.adopt(h1, h0)
+        owners = set(ring.assignments(keys).values())
+        assert owners <= set(ring.members())
+        assert h1 not in owners and "shard-1" not in owners
+
+    def test_successor_and_heir_are_inverse(self):
+        """The mirroring pairing is exactly the adoption pairing:
+        the heir of a victim is the member whose ring-successor WAS
+        the victim — so the adopted keyspace always lands on the
+        shard that holds the replicated frame, computed identically
+        by every member with zero coordination."""
+        members = [f"shard-{i}" for i in range(5)]
+        for victim in members:
+            survivors = [m for m in members if m != victim]
+            heir = ring_heir(survivors, victim)
+            assert ring_successor(members, heir) == victim
+        assert ring_successor(["only"], "only") is None
+        assert ring_heir([], "gone") is None
+
+
+# --- elastic fleet: adoptive membership chaos (ISSUE 16) ---------------
+
+
+class TestAdoptiveMembership:
+    def _member(self, **kw):
+        defaults = dict(
+            dead_after_s=0.02, rejoin_after_s=0.1,
+            reshard_budget=4, reshard_refill_s=3600.0,
+            health_check=lambda s: False, adoptive=True,
+        )
+        defaults.update(kw)
+        return FleetMembership(
+            "shard-0", ["shard-1", "shard-2"], **defaults
+        )
+
+    def test_adoptive_leave_names_the_mirroring_heir(self):
+        """A declared-dead peer's leave event carries the
+        deterministic heir, and the ring transfers the victim's keys
+        to that heir ONLY — the on_reshard hook (the daemon's
+        automatic adoption trigger) needs no other coordination."""
+        m = self._member()
+        keys = _keys(2000)
+        before = m.ring.assignments(keys)
+        t = 50.0
+        m.observe("shard-1", t)
+        m.observe("shard-2", t)
+        t += 0.05  # shard-1 goes silent past the dead edge
+        m.observe("shard-2", t)
+        events = m.tick(t)
+        assert [e["op"] for e in events] == ["leave"]
+        ev = events[0]
+        heir = ring_heir(
+            ["shard-0", "shard-1", "shard-2"], "shard-1"
+        )
+        assert ev["shard"] == "shard-1"
+        assert ev["heir"] == heir
+        assert m.ring.adopted() == {"shard-1": heir}
+        after = m.ring.assignments(keys)
+        for k in keys:
+            if before[k] == "shard-1":
+                assert after[k] == heir
+            else:
+                assert after[k] == before[k]
+
+    def test_stalled_but_serving_shard_never_auto_adopted(self):
+        """The flake guard holds in adoptive mode too: heartbeats
+        stall past the dead edge but the peer's health surface still
+        answers — NO adoption fires, the keyspace stays put. A
+        compile-stalled shard must never have its frame merged away
+        while it is still serving (a split-brain write)."""
+        serving = {"shard-1": True, "shard-2": True}
+        m = self._member(health_check=lambda s: serving[s])
+        t = 20.0
+        m.observe("shard-1", t)
+        for _ in range(10):
+            t += 0.05
+            m.observe("shard-2", t)
+            assert m.tick(t) == []
+        assert m.ring.adopted() == {}
+        assert "shard-1" in m.ring.members()
+        # Its health surface going dark too IS death: adoption fires.
+        serving["shard-1"] = False
+        t += 0.05
+        m.observe("shard-2", t)
+        events = m.tick(t)
+        assert [e.get("heir") for e in events] == [
+            ring_heir(["shard-0", "shard-1", "shard-2"], "shard-1")
+        ]
+        assert "shard-1" in m.ring.adopted()
+
+    def test_budget_exhausted_freezes_adoption(self):
+        """One token left: the first death adopts, the second is
+        REFUSED — the ring freezes in its last shape (refusal
+        counted, adopted map unchanged) instead of moving keyspace
+        it has no budget to move back."""
+        m = self._member(reshard_budget=1)
+        t = 30.0
+        m.observe("shard-1", t)
+        m.observe("shard-2", t)
+        t += 0.05
+        m.observe("shard-2", t)
+        events = m.tick(t)  # shard-1 dies: the one token spent
+        assert len(events) == 1 and events[0]["heir"]
+        assert m.frozen
+        arcs = dict(m.ring.adopted())
+        version = m.ring.version()
+        t += 0.05  # shard-2 dies too: refused, frozen shape held
+        events = m.tick(t)
+        assert events == []
+        assert m.reshards_refused >= 1
+        assert m.ring.adopted() == arcs
+        assert m.ring.version() == version
+        assert "shard-2" in m.ring.members()
+
+    def test_rejoined_victim_reclaims_its_keyspace(self):
+        """Sustained comeback beats reclaim the adopted arc: the
+        rejoin event restores the victim's ownership bit-identically
+        (same digest, same placements) — adoption is a lease, not a
+        tombstone."""
+        m = self._member()
+        keys = _keys(1000)
+        t = 40.0
+        m.observe("shard-1", t)
+        m.observe("shard-2", t)
+        v0 = m.ring.version()
+        before = m.ring.assignments(keys)
+        t += 0.05
+        m.observe("shard-2", t)
+        assert [e["op"] for e in m.tick(t)] == ["leave"]
+        events = []
+        for _ in range(60):
+            t += 0.01
+            m.observe("shard-1", t)
+            m.observe("shard-2", t)
+            events = m.tick(t)
+            if events:
+                break
+        assert [e["op"] for e in events] == ["join"]
+        assert m.ring.adopted() == {}
+        assert m.ring.version() == v0
+        assert m.ring.assignments(keys) == before
+
+
+# --- saturation-driven autoscaler units (ISSUE 16) ---------------------
+
+
+class _FlightStub:
+    def __init__(self):
+        self.records: list[tuple] = []
+        self.dumps: list[tuple] = []
+
+    def record(self, kind, **fields):
+        self.records.append((kind, fields))
+
+    def dump(self, reason, **context):
+        self.dumps.append((reason, context))
+
+
+class _StaleFence:
+    def check(self, path):
+        raise StaleEpochError("outranked")
+
+
+class TestAutoscaleController:
+    def _mk(self, **kw):
+        defaults = dict(
+            enabled=True, act_batches=3, clear_batches=4,
+            budget=2, refill_s=3600.0, high_water=0.75,
+            low_water=0.15, min_shards=2, max_shards=8,
+            shards_fn=lambda: 2,
+        )
+        defaults.update(kw)
+        return AutoscaleController(**defaults)
+
+    def test_observe_only_default_never_proposes(self):
+        """enabled=False (the registry default) is observe-only:
+        streaks and score tracked, the would-be decision refused and
+        flight-noted ONCE per episode, the propose hook never
+        called."""
+        calls: list = []
+        flight = _FlightStub()
+        ctl = self._mk(
+            enabled=False, propose=calls.append, flight=flight
+        )
+        for i in range(9):
+            ctl.observe(float(i), {"queue": 1.0})
+        assert calls == []
+        st = ctl.stats()
+        assert st["enabled"] is False
+        assert st["proposals_split"] == 0
+        assert st["refused_disabled"] >= 1
+        noted = [
+            r for r in flight.records
+            if r[0] == "autoscale-refused"
+            and r[1]["reason"] == "observe_only"
+        ]
+        assert len(noted) == 1  # once per episode, not per window
+
+    def test_split_on_sustained_brownout(self):
+        """act_batches consecutive windows at/above high_water →
+        exactly one split proposal, target = shards + 1, the evidence
+        ring riding along; the streak resets after the decision."""
+        calls: list = []
+        ctl = self._mk(propose=lambda d: calls.append(d) or True)
+        for i in range(3):
+            ctl.observe(float(i), {"queue": 0.9, "brownout": 0.2})
+        assert len(calls) == 1
+        d = calls[0]
+        assert d["action"] == "split"
+        assert d["shards"] == 2 and d["target"] == 3
+        assert len(d["evidence"]) == 3
+        st = ctl.stats()
+        assert st["proposals_split"] == 1
+        assert st["hot_streak"] == 0
+        assert st["target_shards"] == 3
+
+    def test_join_on_sustained_idle(self):
+        calls: list = []
+        ctl = self._mk(
+            shards_fn=lambda: 3,
+            propose=lambda d: calls.append(d) or True,
+        )
+        for i in range(4):
+            ctl.observe(float(i), {"queue": 0.05})
+        assert [d["action"] for d in calls] == ["join"]
+        assert calls[0]["target"] == 2
+        assert ctl.stats()["proposals_join"] == 1
+
+    def test_dead_band_resets_both_streaks(self):
+        """A score bouncing between the edges resets BOTH streaks —
+        an oscillating load shape freezes the fleet's shape instead
+        of resizing it."""
+        calls: list = []
+        ctl = self._mk(propose=lambda d: calls.append(d) or True)
+        for i in range(2):
+            ctl.observe(float(i), {"queue": 0.9})
+        ctl.observe(2.0, {"queue": 0.5})  # dead band
+        st = ctl.stats()
+        assert st["hot_streak"] == 0 and st["idle_streak"] == 0
+        assert calls == []
+
+    def test_score_is_max_of_signals_clamped(self):
+        ctl = self._mk()
+        assert ctl.observe(0.0, {"a": 0.2, "b": 0.6}) == 0.6
+        assert ctl.observe(1.0, {"a": 3.0}) == 1.0
+        assert ctl.observe(2.0, {}) == 0.0
+
+    def test_bounds_refused_at_fleet_limits(self):
+        """A split at max_shards and a join at min_shards are refused
+        (counted) — the autoscaler can never propose a fleet size the
+        knobs forbid."""
+        calls: list = []
+        ctl = self._mk(
+            shards_fn=lambda: 8,
+            propose=lambda d: calls.append(d) or True,
+        )
+        for i in range(3):
+            ctl.observe(float(i), {"q": 1.0})
+        assert calls == []
+        assert ctl.stats()["refused_bounds"] == 1
+        ctl2 = self._mk(
+            shards_fn=lambda: 2,
+            propose=lambda d: calls.append(d) or True,
+        )
+        for i in range(4):
+            ctl2.observe(float(i), {"q": 0.0})
+        assert calls == []
+        assert ctl2.stats()["refused_bounds"] == 1
+
+    def test_budget_exhausted_freezes_then_refuses(self):
+        """budget proposals land, then the bucket is dry: the next
+        sustained episode is refused_budget and `frozen` reports true
+        — flapping load cannot resize the ring more than budget times
+        per refill window."""
+        calls: list = []
+        ctl = self._mk(
+            budget=1, propose=lambda d: calls.append(d) or True
+        )
+        for i in range(3):
+            ctl.observe(float(i), {"q": 1.0})
+        assert len(calls) == 1
+        assert ctl.frozen
+        for i in range(3, 6):
+            ctl.observe(float(i), {"q": 1.0})
+        assert len(calls) == 1  # held, not thrashed
+        st = ctl.stats()
+        assert st["refused_budget"] >= 1
+        assert st["frozen"] is True
+
+    def test_fenced_decision_refused(self):
+        """The SIXTH fenced path: a resurrected stale primary's
+        resize proposal fails fence.check(path='autoscale') and is
+        refused (counted) — it can never move a fleet it no longer
+        owns."""
+        calls: list = []
+        ctl = self._mk(
+            fence=_StaleFence(),
+            propose=lambda d: calls.append(d) or True,
+        )
+        for i in range(3):
+            ctl.observe(float(i), {"q": 1.0})
+        assert calls == []
+        assert ctl.stats()["refused_fenced"] == 1
+
+    def test_standby_role_refused(self):
+        calls: list = []
+        ctl = self._mk(
+            role_fn=lambda: "standby",
+            propose=lambda d: calls.append(d) or True,
+        )
+        for i in range(3):
+            ctl.observe(float(i), {"q": 1.0})
+        assert calls == []
+        assert ctl.stats()["refused_role"] == 1
+
+    def test_failed_apply_refunds_the_token(self):
+        """A propose hook answering False (the deploy layer could not
+        act) refunds the budget token — an unapplied proposal must
+        not count against the flap budget."""
+        ctl = self._mk(budget=2, propose=lambda d: False)
+        for i in range(3):
+            ctl.observe(float(i), {"q": 1.0})
+        st = ctl.stats()
+        assert st["refused_apply"] == 1
+        assert st["tokens"] == 2.0
+        assert st["frozen"] is False
+
+
+# --- daemon-level automatic adoption (ISSUE 16) ------------------------
+
+
+class TestDaemonAdoption:
+    def test_dead_peer_frame_adopted_automatically(
+        self, monkeypatch, tmp_path
+    ):
+        """The tentpole, in-proc: a fleet daemon (shard-0 of 2) with
+        an adoption mirror on its ring-successor's replication stream.
+        The peer serves /healthz until its state is mirrored, then
+        goes dark → membership declares it dead through the
+        double-check → the daemon merges the mirrored frame under its
+        own dispatch lock with ZERO operator action: adoption counters
+        move, /healthz publishes the arc, the merged sketch state
+        carries the victim's rows. Refusal paths ride along: a
+        drifted intern table and a missing mirror are refused
+        (counted), never mis-merged."""
+        from opentelemetry_demo_tpu.models import DetectorConfig
+        from opentelemetry_demo_tpu.runtime.daemon import DetectorDaemon
+        from opentelemetry_demo_tpu.runtime.replbench import (
+            FLEET_SERVICES,
+            _Shard,
+            _fleet_records,
+        )
+
+        config = DetectorConfig(num_services=8, hll_p=8, cms_width=512)
+        # The victim: a real replication primary streaming a frame
+        # with the SHARED pre-interned service table.
+        victim = _Shard("shard-1", config, batch=128, interval_s=0.05)
+        hz = _HealthzServer()  # the victim's health surface
+        base = {
+            "ANOMALY_OTLP_PORT": "0",
+            "ANOMALY_OTLP_GRPC_PORT": "-1",
+            "ANOMALY_METRICS_PORT": "0",
+            "ANOMALY_BATCH": "128",
+            "ANOMALY_ADAPTIVE_BATCH": "0",
+            "ANOMALY_QUERY_PORT": "-1",
+            "ANOMALY_FLEET_SHARDS": "2",
+            "ANOMALY_FLEET_SHARD_INDEX": "0",
+            "ANOMALY_FLEET_PEERS": f"self:0,127.0.0.1:{hz.port}",
+            "ANOMALY_FLEET_REPL_PEERS": (
+                f"self:0,127.0.0.1:{victim.primary.port}"
+            ),
+            "ANOMALY_FLEET_HEARTBEAT_S": "0.05",
+            "ANOMALY_FLEET_DEAD_AFTER_S": "0.5",
+            "ANOMALY_FLEET_REJOIN_AFTER_S": "60",
+            "ANOMALY_FLEET_SERVICES": ",".join(FLEET_SERVICES),
+        }
+        for k, v in base.items():
+            monkeypatch.setenv(k, v)
+        for k in (
+            "ANOMALY_CHECKPOINT", "KAFKA_ADDR", "ANOMALY_ROLE",
+            "ANOMALY_REPLICATION_PORT", "ANOMALY_REPLICATION_TARGET",
+            "ANOMALY_FLEET_TENANTS", "ANOMALY_AUTOSCALE_ENABLE",
+        ):
+            monkeypatch.delenv(k, raising=False)
+        # Victim-owned keyspace under the 2-shard ring (vnodes=128):
+        # deterministic, but computed rather than assumed.
+        ring = HashRing(["shard-0", "shard-1"], vnodes=128)
+        victim_services = [
+            s for s in FLEET_SERVICES
+            if ring.owner(shard_key(s, "default")) == "shard-1"
+        ]
+        assert victim_services  # frontend + email on this ring
+        rng = np.random.default_rng(11)
+        for svc in victim_services:
+            victim.pipe.submit(_fleet_records(rng, svc, 256))
+        victim.pipe.pump(0.0)
+        victim.pipe.drain()
+        final = victim.arrays()
+        assert float(final["span_total"].sum()) > 0.0
+
+        daemon = DetectorDaemon(config)
+        daemon.start()
+        try:
+            # The autoscaler boots observe-only by default.
+            _status, detail = daemon._healthz()
+            assert detail["autoscale"]["enabled"] is False
+            # Wait for the adoption mirror to carry the victim's
+            # final frame (bootstrap SNAPSHOT + deltas).
+            deadline = time.monotonic() + 20.0
+            mirrored = False
+            while time.monotonic() < deadline and not mirrored:
+                mirror = daemon._adoption_mirror
+                if mirror is not None:
+                    arrs, _m = mirror.snapshot()
+                    mirrored = bool(arrs) and (
+                        arrs["cms_bank"] == final["cms_bank"]
+                    ).all()
+                if not mirrored:
+                    time.sleep(0.05)
+            assert mirrored, "adoption mirror never caught up"
+            span0 = float(
+                np.asarray(daemon.detector.state.span_total).sum()
+            )
+
+            # SIGKILL shape: health surface dies, stream goes dark.
+            hz.stop()
+            victim.stop()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                daemon.step(0.0)
+                if daemon._adoptions_total >= 1:
+                    break
+                time.sleep(0.05)
+            assert daemon._adoptions_total == 1
+            assert daemon._last_adoption_tta is not None
+
+            # The merged state carries the victim's rows (this daemon
+            # ingested NOTHING itself), and /healthz publishes the
+            # arc + adoption block.
+            span1 = float(
+                np.asarray(daemon.detector.state.span_total).sum()
+            )
+            assert span1 > span0
+            _status, detail = daemon._healthz()
+            fb = detail["fleet"]
+            assert fb["adopted"] == {"shard-1": "shard-0"}
+            assert fb["adoptions"]["total"] == 1
+            assert fb["adoptions"]["refused"] == {}
+            assert "shard-1" not in fb["members"]
+
+            # Refusal: a mirror whose intern table DRIFTED from ours
+            # cannot merge — refused loudly (counted, evidence
+            # dumped), detector state untouched.
+            class _DriftedMirror:
+                def snapshot(self):
+                    return _bank_arrays(3), {
+                        "service_names": ["frontend", "zzz-drift"],
+                    }
+
+                def stop(self):
+                    pass
+
+            event = {
+                "op": "leave", "shard": "shard-1",
+                "heir": "shard-0", "t": time.monotonic(),
+                "members": ["shard-0"], "ring_version": 0,
+            }
+            daemon._adoption_mirror = _DriftedMirror()
+            daemon._adopt_shard(event)
+            assert daemon._adoptions_refused.get("merge") == 1
+            assert daemon._adoptions_total == 1  # not double-counted
+            assert float(
+                np.asarray(daemon.detector.state.span_total).sum()
+            ) == span1
+
+            # Refusal: no mirror at all — the keyspace stays
+            # orphaned-but-audited, exactly like the manual path.
+            daemon._adoption_mirror = None
+            daemon._adopt_shard(event)
+            assert daemon._adoptions_refused.get("no_mirror") == 1
+        finally:
+            daemon.shutdown()
+            victim.stop()
+
+
+# --- elastic aggregator: mid-resize repair + Grafana surface -----------
+
+
+class _FleetHealthzServer:
+    """A /healthz endpoint publishing a given fleet block — the
+    surface the aggregator's ring-staleness repair polls."""
+
+    def __init__(self, fleet_block: dict):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                body = json.dumps(
+                    {"status": "serving", "fleet": fleet_block}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestAggregatorElastic:
+    SERVICES = ["frontend", "cart", "payment", "email"]
+
+    def test_stale_boot_ring_self_repairs_mid_resize(self):
+        """The mid-resize regression: a standalone aggregator pinned
+        a boot-time 2-shard ring; shard-1 was killed and its keyspace
+        adopted by shard-0. A service-keyed read routed to the dead
+        owner misses, refreshes placement from the shard /healthz
+        fleet blocks (members + adopted map → the IDENTICAL
+        post-adoption ring) and retries ONCE against the heir — a 200
+        with ``ring_refreshed``, not an eternal brownout."""
+        # The heir holds the whole table post-merge.
+        heir = _ShardPlane(1, self.SERVICES)
+        post_ring = HashRing(
+            ["shard-0"], vnodes=64, adopted={"shard-1": "shard-0"}
+        )
+        hz = _FleetHealthzServer({
+            "members": ["shard-0"],
+            "adopted": {"shard-1": "shard-0"},
+            "ring_version": post_ring.version(),
+            "reshards_total": 1,
+            "owned_vnodes": 64,
+        })
+        boot_ring = HashRing(["shard-0", "shard-1"], vnodes=64)
+        victim_svcs = [
+            s for s in self.SERVICES
+            if boot_ring.owner(shard_key(s, "default")) == "shard-1"
+        ]
+        assert victim_svcs  # frontend + email on this ring
+        agg = FleetAggregator(
+            {"shard-0": heir.addr, "shard-1": "127.0.0.1:1"},
+            timeout_s=0.5, ring=boot_ring,
+            health_addrs={
+                "shard-0": f"127.0.0.1:{hz.port}",
+                "shard-1": "127.0.0.1:1",
+            },
+        )
+        try:
+            status, doc = agg.dispatch(
+                "/query/zscore", {"service": victim_svcs[0]}
+            )
+            assert status == 200
+            assert doc["data"]["service"] == victim_svcs[0]
+            assert doc["meta"]["ring_refreshed"] is True
+            assert doc["meta"]["owner"] == "shard-0"
+            assert doc["meta"]["partial"] is False
+            assert agg._ring_refreshes == 1
+            # The repaired ring persists: the next read routes to the
+            # heir directly, no second refresh, no dead-owner miss.
+            status, doc = agg.dispatch(
+                "/query/cardinality", {"service": victim_svcs[-1]}
+            )
+            assert status == 200
+            assert doc["meta"]["owner"] == "shard-0"
+            assert "ring_refreshed" not in doc["meta"]
+            assert agg._ring_refreshes == 1
+        finally:
+            agg.close()
+            hz.stop()
+            heir.stop()
+
+    def test_grafana_surface_merges_across_shards(self):
+        """The fleet-global Grafana simple-JSON datasource: /search
+        unions shard target lists (flight excluded — process-local
+        evidence), /query routes service-keyed targets and merges
+        table targets, /annotations merges newest-first."""
+        a = _ShardPlane(1, ["frontend", "cart"])
+        b = _ShardPlane(2, ["payment", "email"])
+        agg = FleetAggregator(
+            {"shard-0": a.addr, "shard-1": b.addr}, timeout_s=2.0
+        )
+        try:
+            status, targets = agg.dispatch("/search", {}, body={})
+            assert status == 200
+            assert "anomalies" in targets
+            assert "cardinality:frontend" in targets
+            assert "cardinality:payment" in targets
+            assert "flight" not in targets
+            status, frames = agg.dispatch("/query", {}, body={
+                "targets": [
+                    {"target": "topk:frontend"},
+                    {"target": "anomalies"},
+                ],
+            })
+            assert status == 200
+            assert len(frames) == 2
+            topk, table = frames
+            assert topk["type"] == "table" and topk["rows"]
+            # The anomalies table merges BOTH shards' rows.
+            assert table["type"] == "table"
+            assert len(table["rows"]) == 4
+            times = [r[0] for r in table["rows"]]
+            assert times == sorted(times, reverse=True)
+            status, anns = agg.dispatch("/annotations", {}, body={
+                "annotation": {"name": "anomaly"},
+            })
+            assert status == 200
+            assert len(anns) == 4
+        finally:
+            agg.close()
+            a.stop()
+            b.stop()
+
+
+# --- the live elastic drill (autoscalebench) ---------------------------
+
+
+@pytest.mark.slow
+def test_autoscale_sigkill_adoption_live():
+    """The fleetbench elastic leg end to end (real daemons, real
+    SIGKILL): ramp OTLP load until the heir's admission saturates →
+    the opt-in autoscaler proposes scale-out → SIGKILL the victim
+    mid-resize → automatic adoption within the TTD+heartbeat bound,
+    post-settle /query/* bit-exact vs the in-proc witness merge, and
+    no further ring changes in the quiet window."""
+    from opentelemetry_demo_tpu.runtime.replbench import measure_adoption
+
+    out = measure_adoption()
+    assert out["autoscale_ok"] is True, out.get("adoption_mismatch")
+    assert out["autoscale_proposals_split"] >= 1
+    assert out["adoption_bitexact"] is True
+    assert out["adoption_answers_victim_keys"] is True
+    assert out["adoption_no_oscillation"] is True
+    # TTA bound: detection (dead_after) + one heartbeat + merge slack.
+    assert out["autoscale_tta_s"] <= (
+        out["adoption_dead_after_s"]
+        + out["adoption_heartbeat_s"] + 2.0
+    )
