@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench shadowbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -56,8 +56,11 @@ autoscalebench: ## elastic-fleet live drill alone (ONE json line: ramp to satura
 replaybench:    ## history time-travel drill (ONE json line: record an incident, replay the segment log at N× wall clock, pin bit-identical verdicts, range-query p99)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replaybench
 
-mitigbench:     ## closed-loop auto-mitigation drill (ONE json line: time-to-mitigate per flagd scenario, rollback drill, no-oscillation gate)
+mitigbench:     ## closed-loop auto-mitigation drill (ONE json line: time-to-mitigate per flagd scenario, rollback drill, no-oscillation gate; BENCH_SHADOW=1 folds in the shadow leg)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.mitigbench
+
+shadowbench:    ## counterfactual pre-flight drill alone (ONE json line: shadow-replay bit-identity at ≥10× wall, would-help released vs wrong-flag refused with zero actuator writes, collector keep/drop ratio + exact revert)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.mitigbench --shadow
 
 querybench:     ## live query plane under concurrent ingest (ONE json line: query p99/qps, ingest interference ratio)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.querybench

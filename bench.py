@@ -489,6 +489,16 @@ def main():
         # the rollback drill restoring the exact prior flag state, and
         # ZERO flag writes over the long clean run (no oscillation).
         "mitigation_ok": mitig.get("mitigation_ok"),
+        # Counterfactual pre-flight verdicts (r17; ride the mitigbench
+        # subprocess when BENCH_SHADOW=1): shadow_ok = bit-identical
+        # shadow replay at ≥ the rate target AND would-help released
+        # within 2× the ungated TTM AND the wrong-flag refusal drill
+        # holding (below). preflight_refusal_ok = the refusal drill
+        # alone — a mitigation that would NOT help is refused BEFORE
+        # any actuator write: zero flag-store mutations, budget token
+        # refunded, flight-recorder evidence (ring event + dump file).
+        "shadow_ok": mitig.get("shadow_ok"),
+        "preflight_refusal_ok": mitig.get("preflight_refusal_ok"),
     }
 
     print(
@@ -613,6 +623,14 @@ def main():
                     if mitig else None
                 ),
                 "mitigation_detail": mitig or None,
+                "preflight_verdict_s": mitig.get("preflight_verdict_s"),
+                "preflight_ttm_ratio": mitig.get("preflight_ttm_ratio"),
+                "shadow_identical": mitig.get("shadow_identical"),
+                "shadow_speedup": mitig.get("shadow_speedup"),
+                "collector_keep_ratio": mitig.get("collector_keep_ratio"),
+                "collector_storage_reduction": mitig.get(
+                    "collector_storage_reduction"
+                ),
                 "failover_ttd_s": repl.get("failover_ttd_s"),
                 "replication_lag_p99_ms": repl.get(
                     "replication_lag_p99_ms"
@@ -689,10 +707,13 @@ def measure_quality_subprocess(timeout_s: float = 900.0) -> dict:
     )
 
 
-def measure_mitigation_subprocess(timeout_s: float = 900.0) -> dict:
+def measure_mitigation_subprocess(timeout_s: float = 1500.0) -> dict:
     """Closed-loop mitigation drill (runtime.mitigbench) on CPU: the
     same stepped-report methodology as qualbench, plus the remediation
-    controller acting through a live flag store."""
+    controller acting through a live flag store. With BENCH_SHADOW=1
+    (default) the subprocess folds in the counterfactual pre-flight
+    leg — shadow bit-identity, both verdict directions, the collector
+    keep/drop measurement — hence the wider timeout."""
     return _measure_module_subprocess(
         "opentelemetry_demo_tpu.runtime.mitigbench", timeout_s
     )

@@ -203,10 +203,11 @@ from ..utils.config import (
     remediation_config,
     replication_config,
     selftrace_config,
+    shadow_config,
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import autoscale, checkpoint, fleet, history, remediation, replication, selftrace
+from . import autoscale, checkpoint, fleet, history, remediation, replication, selftrace, shadow
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -709,6 +710,29 @@ class DetectorDaemon:
             "incident — time-to-mitigate beside time-to-detect",
         )
         self.registry.describe(
+            tele_metrics.ANOMALY_PREFLIGHT_VERDICTS,
+            "Counterfactual pre-flight verdicts by direction "
+            "(released = the shadow replay proved the mitigation "
+            "clears the heads; refused = it would not have helped)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_PREFLIGHT_REFUSED,
+            "Pre-flight refusals by reason (still_flagged / deadline "
+            "/ insufficient_records / error) — every one is a "
+            "mitigation that did NOT fire, with flight evidence",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_PREFLIGHT_SECONDS,
+            "Act-decision to shadow-verdict wall interval — what the "
+            "counterfactual gate adds in front of every actuation",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_COLLECTOR_KEEP_RATIO,
+            "Storage fraction the pushed collector tail-sampling "
+            "policy implies (promoted services keep 1.0, quiet ones "
+            "the base head-sampling rate)",
+        )
+        self.registry.describe(
             tele_metrics.ANOMALY_FLEET_SHARDS_LIVE,
             "Shards this member currently believes alive (itself "
             "included) — N means full fleet, less means a keyspace "
@@ -1109,6 +1133,59 @@ class DetectorDaemon:
                 base_policy=dict(self._history_span_rates),
                 exemplar_fn=self._exemplars_for,
             ))
+        # Collector-steering leg (ROADMAP item 4): when a policy file
+        # path or endpoint is configured, the flagged service's traces
+        # tail-sample at 100% (exemplar-seeded) while quiet services
+        # head-sample at the base keep — the storage-reduction ratio
+        # rides the scrape as anomaly_collector_keep_ratio.
+        self._collector_actuator = None
+        col_path = str(rk["ANOMALY_REMEDIATION_COLLECTOR_PATH"])
+        col_url = str(rk["ANOMALY_REMEDIATION_COLLECTOR_URL"])
+        if col_path or col_url:
+            self._collector_actuator = remediation.CollectorActuator(
+                policy_path=col_path,
+                url=col_url,
+                base_keep=float(
+                    rk["ANOMALY_REMEDIATION_COLLECTOR_BASE_KEEP"]
+                ),
+                exemplar_fn=self._exemplars_for,
+                services_fn=(
+                    lambda: self.pipeline.tensorizer.service_names
+                ),
+                timeout_s=rem_timeout_s,
+            )
+            rem_actuators.append(self._collector_actuator)
+        # Counterfactual pre-flight gate (knob registry:
+        # utils.config.SHADOW_KNOBS; engine: runtime.shadow): opt-in,
+        # and a gate that cannot replay is a misconfiguration that
+        # refuses to boot — never a silent rubber stamp.
+        try:
+            sk = shadow_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.shadow_verifier: shadow.ShadowVerifier | None = None
+        if int(sk["ANOMALY_SHADOW_ENABLE"]):
+            if self.history_reader is None or not self._history_spans:
+                raise SystemExit(
+                    "ANOMALY_SHADOW_ENABLE=1 needs the recorded replay "
+                    "corpus: set ANOMALY_HISTORY_DIR and turn on "
+                    "ANOMALY_HISTORY_SPANS span capture"
+                )
+            self.shadow_verifier = shadow.ShadowVerifier(
+                self.history_reader,
+                self.detector.config,
+                batch_size=self.batch_size,
+                window_s=float(sk["ANOMALY_SHADOW_WINDOW_S"]),
+                deadline_s=float(sk["ANOMALY_SHADOW_DEADLINE_S"]),
+                rate_target=float(sk["ANOMALY_SHADOW_RATE"]),
+                min_records=int(sk["ANOMALY_SHADOW_MIN_RECORDS"]),
+                flight=self.flight,
+            )
+            self.flight.record(
+                "preflight", op="enabled",
+                window_s=float(sk["ANOMALY_SHADOW_WINDOW_S"]),
+                deadline_s=float(sk["ANOMALY_SHADOW_DEADLINE_S"]),
+            )
         self.remediation = remediation.RemediationController(
             rem_actuators,
             enabled=bool(int(rk["ANOMALY_REMEDIATION_ENABLE"])),
@@ -1123,6 +1200,10 @@ class DetectorDaemon:
             role_fn=lambda: self.role,
             fence=self._fence,
             flight=self.flight,
+            preflight=(
+                self._preflight_mitigation
+                if self.shadow_verifier is not None else None
+            ),
         )
         self._remediation_seen: dict[str, int] = {}
         if self.remediation.enabled:
@@ -1476,6 +1557,16 @@ class DetectorDaemon:
                 "failed": self.remediation.failed_services(),
             },
         }
+        if self.shadow_verifier is not None:
+            # Counterfactual gate surface (separate block so the
+            # mitigation block's shape stays pinned): verdict counts
+            # by direction + refusal reasons.
+            st = self.remediation.stats()
+            detail["shadow"] = {
+                "runs": self.shadow_verifier.runs,
+                "verdicts": st["preflight_verdicts"],
+                "refused": st["preflight_refused"],
+            }
         if self.fleet is not None:
             # Fleet block (health_probe --shard reads this): ring
             # version, member set, peer liveness, reshard counters —
@@ -1641,6 +1732,20 @@ class DetectorDaemon:
             seeds={svc: len(ex) for svc, ex in (seeds or {}).items()},
         )
 
+    def _preflight_mitigation(self, service: str):
+        """The controller's pre-flight hook (worker thread): replay
+        the recorded window with the service's fault columns
+        suppressed — the counterfactual of the flagd mitigation — and
+        return the shadow verdict. An unmappable service fails closed
+        (the verifier could prove nothing about it)."""
+        names = self.pipeline.tensorizer.service_names
+        if service not in names:
+            return shadow.refused(shadow.REASON_ERROR)
+        idx = names.index(service)
+        return self.shadow_verifier.verify(
+            idx, shadow.suppress_transform(idx)
+        )
+
     def _export_remediation_stats(self) -> None:
         """anomaly_mitigation_* (delta-based like every family) plus
         the TTM histogram observations drained from the controller."""
@@ -1671,6 +1776,37 @@ class DetectorDaemon:
             self.registry.histogram_observe(
                 tele_metrics.ANOMALY_TIME_TO_MITIGATE, ttm,
                 remediation.TTM_BUCKETS,
+            )
+        # Pre-flight family (delta-based like the rest; series appear
+        # only once a verdict exists, so a gate-less daemon's scrape
+        # is unchanged).
+        for verdict, count in st["preflight_verdicts"].items():
+            key = f"pf_{verdict}"
+            delta = count - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_PREFLIGHT_VERDICTS,
+                    float(delta), verdict=verdict,
+                )
+            seen[key] = count
+        for reason, count in st["preflight_refused"].items():
+            key = f"pfr_{reason}"
+            delta = count - seen.get(key, 0)
+            if delta > 0:
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_PREFLIGHT_REFUSED,
+                    float(delta), reason=reason,
+                )
+            seen[key] = count
+        for verdict_s in self.remediation.take_preflight_samples():
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_PREFLIGHT_SECONDS, verdict_s,
+                shadow.PREFLIGHT_BUCKETS,
+            )
+        if self._collector_actuator is not None:
+            self.registry.gauge_set(
+                tele_metrics.ANOMALY_COLLECTOR_KEEP_RATIO,
+                float(self._collector_actuator.keep_ratio()),
             )
 
     # -- report export --------------------------------------------------

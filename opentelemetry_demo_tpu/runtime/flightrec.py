@@ -18,6 +18,10 @@ strings, the ring is schema-light on purpose):
 - shed/brownout ladder moves and saturation edges
 - fence hits and frame quarantines (per hop)
 - supervised-component crash-loop (DEGRADED) edges
+- mitigation-loop moves (``mitigation`` acts/verifies/rollbacks) and
+  counterfactual pre-flight verdicts (``preflight`` runs,
+  ``preflight_refused`` evidence — each refusal also dumps
+  ``flight-preflight-refused-*.json``, the proof an act did NOT fire)
 - 1 Hz phase-timing snapshots (pool phase shares, spine overlap,
   lag p99) — the trend context around any transition
 

@@ -998,21 +998,43 @@ class HistoryReader:
                     events.append(dict(ev))
         return events, names
 
+    def span_records(
+        self,
+        from_ts: float | None = None,
+        to_ts: float | None = None,
+    ) -> list[HistoryRecord]:
+        """The explicit "recent window" read API over the span-capture
+        log: KIND_SPANS record headers whose [t_start, t_end] overlaps
+        [from_ts, to_ts], in log order — a header-only time filter (no
+        frame decode), so the shadow pre-flight and other windowed
+        consumers stop re-scanning whole segments. Decode each record
+        with :meth:`read_span_record`."""
+        return self.store.records(
+            kind=KIND_SPANS, t_from=from_ts, t_to=to_ts
+        )
+
+    def read_span_record(self, rec: HistoryRecord):
+        """Decode ONE span-capture record: (arrays, t_batch), or
+        (None, None) when corrupt — counted + quarantined by the store
+        per the existing hop contract, skipped by the caller."""
+        try:
+            fr = self.store.read_frame(rec)
+        except frame.FrameCorrupt:
+            return None, None
+        t_batch = fr.meta.get("t_batch")
+        # 0.0 is a legitimate virtual timebase — only ABSENT falls
+        # back to the record's wall stamp.
+        return fr.arrays, float(
+            rec.t_start if t_batch is None else t_batch
+        )
+
     def span_batches(
         self, t_from: float | None = None, t_to: float | None = None
     ):
         """The replay corpus: (arrays, t_batch) per recorded span
         batch in log order; corrupt records are skipped (counted)."""
-        for rec in self.store.records(
-            kind=KIND_SPANS, t_from=t_from, t_to=t_to
-        ):
-            try:
-                fr = self.store.read_frame(rec)
-            except frame.FrameCorrupt:
+        for rec in self.span_records(t_from, t_to):
+            arrays, t_batch = self.read_span_record(rec)
+            if arrays is None:
                 continue
-            t_batch = fr.meta.get("t_batch")
-            # 0.0 is a legitimate virtual timebase — only ABSENT falls
-            # back to the record's wall stamp.
-            yield fr.arrays, float(
-                rec.t_start if t_batch is None else t_batch
-            )
+            yield arrays, t_batch

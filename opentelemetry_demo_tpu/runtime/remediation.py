@@ -23,6 +23,21 @@ one interface:
   PR 6 rings) while quiet services keep the configured head-sampling
   policy (``ANOMALY_HISTORY_SPANS``'s per-service map), publishing the
   merged policy through one callback.
+- :class:`CollectorActuator` — steers a REAL collector: pushes a
+  tail-sampling policy document (keep 100% of the flagged service,
+  exemplar-seeded; head-sample quiet services at a base rate) to a
+  policy file (atomic write + reloader sidecar) or an HTTP endpoint,
+  with refcounted holds and exact-state revert; its keep ratio is the
+  measured storage-reduction number.
+
+When a counterfactual pre-flight verifier (``runtime.shadow``) is
+wired via the ``preflight=`` hook, an act that passed every guardrail
+below is NOT released immediately: the episode parks in
+``STATE_PREFLIGHT`` while the worker replays the last minutes of
+recorded history with the proposed mitigation applied — released to
+ACTIVE only if the shadow's heads clear; refused otherwise (budget
+token refunded, flag streak reset, ``preflight_refused`` flight
+evidence + dump).
 
 A control loop that can touch production flags must be unable to make
 an outage worse. The guardrails, built like the PR 2 brownout ladder:
@@ -65,6 +80,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.request
@@ -83,9 +99,14 @@ log = logging.getLogger(__name__)
 # Episode states (per service). FAILED is the DEGRADED-analogue: the
 # mitigation did not recover the system within the deadline; it was
 # rolled back (when enabled) and the service is sticky-failed until a
-# full clean streak passes.
+# full clean streak passes. PREFLIGHT sits between PENDING and ACTIVE
+# when a counterfactual verifier (runtime.shadow) is wired: the budget
+# token is already taken, the actuator writes are NOT yet enqueued,
+# and the shadow replay's verdict decides release (→ ACTIVE) or
+# refusal (→ back to PENDING, token refunded, flight evidence).
 STATE_IDLE = "idle"
 STATE_PENDING = "pending"
+STATE_PREFLIGHT = "preflight"
 STATE_ACTIVE = "active"
 STATE_FAILED = "mitigation_failed"
 
@@ -352,6 +373,240 @@ class SamplingActuator:
         self._push()
 
 
+_PRIOR_ABSENT = object()  # CollectorActuator: "no policy file existed"
+
+
+class CollectorActuator:
+    """Tail-sampling steering for a REAL collector — ROADMAP item 4's
+    second leg, PAPER.md's sampling seam driven by the detector.
+
+    When a service flags, this actuator renders a tail-sampling policy
+    document that keeps 100% of the flagged service's traces
+    (``string_attribute(service.name)`` ∧ ``always_sample``, seeded
+    with its flag-time exemplar trace ids) while every quiet service
+    head-samples at ``base_keep`` (probabilistic) — the
+    ``deploy/otelcol-config-anomaly.yml`` tail-sampling block's shape,
+    so an `otelcol` config reloader can merge it verbatim. Two
+    transports, same policy: ``policy_path`` writes the rendered JSON
+    through the flag plane's ONE atomic write primitive
+    (``atomic_write_doc`` — this module is already inside the
+    sanitycheck-pinned writer set; a file watcher/reloader sidecar
+    picks it up), or ``url`` POSTs it with a bounded timeout (a torn
+    or dead endpoint raises → the worker's capped jittered retry).
+
+    Guardrails match :class:`FlagdActuator`: per-service refcounted
+    holds (two episodes on one service join, not rewrite), exact-state
+    revert (the pre-actuation file content — or its ABSENCE — is
+    recorded at first hold and restored when the LAST hold releases),
+    and every write runs behind the controller's epoch fence + token
+    budget. ``keep_ratio()`` reports the policy-implied storage
+    fraction (promoted·1.0 + quiet·base_keep over all services) — the
+    ``anomaly_collector_keep_ratio`` gauge; mitigbench measures the
+    row-level ratio on real replayed traffic beside it.
+    """
+
+    name = "collector"
+
+    def __init__(
+        self,
+        policy_path: str = "",
+        url: str = "",
+        base_keep: float = 0.1,
+        exemplar_fn: Callable[[str], list] | None = None,
+        services_fn: Callable[[], list] | None = None,
+        timeout_s: float = 1.0,
+    ):
+        if not policy_path and not url:
+            raise ValueError(
+                "CollectorActuator needs a policy_path or a url"
+            )
+        self.policy_path = policy_path
+        self.url = url.rstrip("/") if url else ""
+        self.base_keep = min(max(float(base_keep), 0.0), 1.0)
+        self._exemplar_fn = exemplar_fn
+        self._services_fn = services_fn
+        self.timeout_s = float(timeout_s)
+        self.writes = 0
+        self._holds_lock = threading.Lock()
+        self._holds: dict[str, dict] = {}  # svc → {count, exemplars}
+        self._prior = _PRIOR_ABSENT  # captured at FIRST hold only
+
+    # -- policy rendering ----------------------------------------------
+
+    def render_policy(self) -> dict:
+        """The merged policy doc for the CURRENT hold set (JSON — a
+        strict YAML subset, so collector config tooling reads it
+        as-is): one and(service-match, always_sample) tail policy per
+        promoted service, one probabilistic baseline for everyone
+        else, plus the exemplar seeds under a vendor block."""
+        with self._holds_lock:
+            promoted = {
+                svc: list(h["exemplars"]) for svc, h in self._holds.items()
+            }
+        policies = [
+            {
+                "name": f"anomaly-keep-{svc}",
+                "type": "and",
+                "and": {"and_sub_policy": [
+                    {
+                        "name": f"svc-{svc}",
+                        "type": "string_attribute",
+                        "string_attribute": {
+                            "key": "service.name", "values": [svc],
+                        },
+                    },
+                    {"name": "always", "type": "always_sample"},
+                ]},
+            }
+            for svc in sorted(promoted)
+        ]
+        policies.append({
+            "name": "anomaly-baseline-head",
+            "type": "probabilistic",
+            "probabilistic": {
+                "sampling_percentage": round(self.base_keep * 100.0, 4),
+            },
+        })
+        return {
+            "processors": {
+                "tail_sampling/anomaly": {
+                    "decision_wait": "2s",
+                    "policies": policies,
+                },
+            },
+            "anomaly": {
+                "promoted": sorted(promoted),
+                "base_keep": self.base_keep,
+                "exemplar_seeds": promoted,
+            },
+        }
+
+    def _push(self, doc: dict) -> None:
+        self.writes += 1
+        if self.url:
+            body = json.dumps(doc).encode()
+            req = urllib.request.Request(
+                f"{self.url}/api/sampling-policy", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                return
+        atomic_write_doc(self.policy_path, doc)
+
+    def _capture_prior_locked(self) -> None:
+        """Record the pre-actuation policy file EXACTLY (or its
+        absence) at the first hold — the revert target. An existing
+        file this actuator cannot parse is refused (raise → retry →
+        counted): never steer a collector whose config can't be
+        restored byte-for-byte-equivalent."""
+        if self._holds or not self.policy_path:
+            return
+        try:
+            with open(self.policy_path, "r") as f:
+                self._prior = json.load(f)
+        except FileNotFoundError:
+            self._prior = _PRIOR_ABSENT
+        except (OSError, ValueError) as e:
+            raise ActuationError(
+                f"collector policy at {self.policy_path} is not "
+                f"restorable: {e}"
+            )
+
+    # -- actuation -----------------------------------------------------
+
+    def apply(self, service: str):
+        exemplars = []
+        if self._exemplar_fn is not None:
+            try:
+                exemplars = list(self._exemplar_fn(service) or [])
+            except Exception:  # noqa: BLE001 — best-effort garnish,
+                # same contract as SamplingActuator.
+                exemplars = []
+        with self._holds_lock:
+            self._capture_prior_locked()
+            hold = self._holds.get(service)
+            if hold is not None:
+                hold["count"] += 1
+                return service  # joined: policy already keeps 100%
+            self._holds[service] = {"count": 1, "exemplars": exemplars}
+        try:
+            self._push(self.render_policy())
+        except BaseException:
+            # The push never landed: release the hold this call minted
+            # so the worker's retry re-takes it cleanly.
+            with self._holds_lock:
+                hold = self._holds.get(service)
+                if hold is not None:
+                    hold["count"] -= 1
+                    if hold["count"] <= 0:
+                        del self._holds[service]
+            raise
+        return service
+
+    def revert(self, service: str, token) -> None:
+        if not token:
+            return
+        with self._holds_lock:
+            hold = self._holds.get(service)
+            if hold is None:
+                return
+            hold["count"] -= 1
+            if hold["count"] > 0:
+                return  # another episode still holds this service
+            del self._holds[service]
+            last = not self._holds
+            prior = self._prior
+        try:
+            if not last:
+                # Other services still promoted: re-render without
+                # this one.
+                self._push(self.render_policy())
+            elif self.url:
+                self._push({"reset": True})
+            elif prior is _PRIOR_ABSENT:
+                # Exact-state revert: the file did not exist before the
+                # first hold, so the LAST release removes it.
+                self.writes += 1
+                try:
+                    os.remove(self.policy_path)
+                except FileNotFoundError:
+                    pass
+            else:
+                self.writes += 1
+                atomic_write_doc(self.policy_path, prior)
+        except BaseException:
+            # The restore never landed: re-take the hold so the
+            # worker's retry releases it again (idempotent retry).
+            with self._holds_lock:
+                re = self._holds.setdefault(
+                    service, {"count": 0, "exemplars": []}
+                )
+                re["count"] += 1
+            raise
+        if last:
+            with self._holds_lock:
+                if not self._holds:
+                    self._prior = _PRIOR_ABSENT
+
+    def keep_ratio(self) -> float:
+        """Policy-implied storage fraction over the known service set
+        (1.0 per promoted, ``base_keep`` per quiet) — what the current
+        policy would keep of a uniform stream; the exported gauge."""
+        services = list(self._services_fn() or []) if (
+            self._services_fn is not None
+        ) else []
+        with self._holds_lock:
+            promoted = set(self._holds)
+        universe = set(services) | promoted
+        if not universe:
+            return self.base_keep
+        kept = sum(
+            1.0 if svc in promoted else self.base_keep for svc in universe
+        )
+        return kept / len(universe)
+
+
 class TokenBucket:
     """Actuation budget: ``capacity`` burst, one token per
     ``refill_s`` observed-timebase seconds sustained."""
@@ -405,6 +660,7 @@ class RemediationController:
         retry_attempts: int = 4,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 1.0,
+        preflight: Callable[[str], object] | None = None,
     ):
         self.actuators = list(actuators)
         self.enabled = bool(enabled)
@@ -415,6 +671,12 @@ class RemediationController:
         self._role_fn = role_fn
         self._fence = fence
         self._flight = flight
+        # Counterfactual pre-flight gate (runtime.shadow): called with
+        # the service name ON THE WORKER THREAD (it replays minutes of
+        # recorded frames — never the hot path), returning an object
+        # with ``would_help``/``reason`` (PreflightVerdict) or a bare
+        # bool. None = no gate: act immediately (the PR 13 behavior).
+        self._preflight = preflight
         self.bucket = TokenBucket(budget, budget_refill_s)
         self._retry_attempts = max(int(retry_attempts), 1)
         self._backoff_base_s = float(backoff_base_s)
@@ -445,6 +707,13 @@ class RemediationController:
         self.actuator_errors = 0
         self.queue_dropped = 0
         self._ttm_samples: list[tuple[float, float]] = []  # (ttm, act→recover)
+        # Pre-flight bookkeeping (daemon-exported as deltas):
+        # verdicts by direction, refusals by reason, act→verdict
+        # wall intervals.
+        self.preflight_verdicts: dict[str, int] = {}
+        self.preflight_refused: dict[str, int] = {}
+        self._preflight_samples: list[float] = []
+        self._t_now = 0.0  # last observed timebase (release stamps t_act)
 
     # -- hot path ------------------------------------------------------
 
@@ -461,6 +730,7 @@ class RemediationController:
         """
         flagged_set = set(flagged)
         with self._lock:
+            self._t_now = t_now
             self.bucket.advance(t_now)
             universe = set(self._episodes) | flagged_set
             if services is not None:
@@ -499,7 +769,17 @@ class RemediationController:
                             self._verify_locked(svc, ep, t_now)
                     elif ep["clean_streak"] >= self.clear_batches:
                         # PENDING that never acted, or sticky FAILED:
-                        # a full clean streak closes the episode.
+                        # a full clean streak closes the episode. A
+                        # PREFLIGHT episode closing this way (the
+                        # incident cleared on its own while the shadow
+                        # replay ran) refunds the token the act
+                        # decision took — the in-flight verdict finds
+                        # the episode gone and is discarded.
+                        if ep["state"] == STATE_PREFLIGHT:
+                            self.bucket.tokens = min(
+                                self.bucket.tokens + 1.0,
+                                float(self.bucket.capacity),
+                            )
                         del self._episodes[svc]
             expired = self._deadline_scan_locked(t_now)
         self._dump_expired(expired)
@@ -509,6 +789,7 @@ class RemediationController:
         """Deadline/budget housekeeping when no reports arrive (pump
         cadence; observed timebase, same clock as observe)."""
         with self._lock:
+            self._t_now = t_now
             self.bucket.advance(t_now)
             expired = self._deadline_scan_locked(t_now)
         self._dump_expired(expired)
@@ -569,11 +850,32 @@ class RemediationController:
                     "depth": len(self._jobs),
                 })
             return
+        ep["noted"].discard("budget")
+        if self._preflight is not None:
+            # Counterfactual gate: hold the token, park the episode in
+            # PREFLIGHT, and let the worker replay recorded history
+            # with the proposed mitigation applied before ANY actuator
+            # write is even enqueued. The wall stamp starts the
+            # act→verdict interval (``anomaly_preflight_seconds``).
+            ep["state"] = STATE_PREFLIGHT
+            ep["t_act"] = None
+            ep["w_preflight"] = time.monotonic()
+            self._enqueue_locked(("preflight", None, svc))
+            self._record({
+                "op": "preflight", "service": svc, "t": t_now,
+                "streak": ep["flag_streak"],
+            })
+            return
+        self._act_locked(svc, ep, t_now)
+
+    def _act_locked(self, svc: str, ep: dict, t_now: float) -> None:
+        """Release the act: enqueue every actuator's apply (directly
+        from hysteresis when no pre-flight gate is wired; from the
+        worker's released verdict otherwise)."""
         ep["state"] = STATE_ACTIVE
         ep["t_act"] = t_now
         ep["applied"] = 0       # actuator applies that LANDED
         ep["apply_failed"] = 0  # applies that exhausted their retries
-        ep["noted"].discard("budget")
         for act in self.actuators:
             # actions_total counts on worker SUCCESS (not here): an
             # apply that fails every retry must not mint a phantom
@@ -700,6 +1002,13 @@ class RemediationController:
                     # must not touch production flags, not even to
                     # revert — the new primary owns the loop now.
                     self._fence.check(path="remediation")
+                if op == "preflight":
+                    # Past the fence check: a fenced daemon never even
+                    # replays (the StaleEpochError branch below refunds
+                    # and parks the episode). The verdict path handles
+                    # its own failures fail-closed — no retry loop.
+                    self._finish_preflight(svc)
+                    return
                 if op == "apply":
                     token = act.apply(svc)
                     with self._lock:
@@ -725,8 +1034,25 @@ class RemediationController:
             except StaleEpochError:
                 with self._lock:
                     self.refused_fenced += 1
+                    if op == "preflight":
+                        # The fenced daemon's act decision is void:
+                        # refund the token and park the episode back
+                        # in PENDING (the successor primary owns the
+                        # loop — it will run its OWN pre-flight).
+                        ep = self._episodes.get(svc)
+                        if (
+                            ep is not None
+                            and ep.get("state") == STATE_PREFLIGHT
+                        ):
+                            self.bucket.tokens = min(
+                                self.bucket.tokens + 1.0,
+                                float(self.bucket.capacity),
+                            )
+                            ep["state"] = STATE_PENDING
+                            ep["t_act"] = None
                 self._record({
-                    "op": "fenced", "service": svc, "actuator": act.name,
+                    "op": "fenced", "service": svc,
+                    "actuator": act.name if act is not None else "preflight",
                 })
                 return
             except Exception:  # noqa: BLE001 — actuator transport
@@ -775,6 +1101,93 @@ class RemediationController:
                 if self._stop_event.wait(self._retry_delay(attempt)):
                     return  # closing: abandon the backoff sleep
 
+    def _finish_preflight(self, svc: str) -> None:
+        """Worker-side verdict: run the counterfactual replay (outside
+        the controller lock — it decodes minutes of frames), then
+        release the act or refuse it. Fail closed: a verifier that
+        raised has proven nothing, so the act is refused."""
+        with self._lock:
+            ep = self._episodes.get(svc)
+            if ep is None or ep.get("state") != STATE_PREFLIGHT:
+                return  # episode closed while queued: token already refunded
+            w0 = ep.get("w_preflight") or time.monotonic()
+        try:
+            verdict = self._preflight(svc)
+        except Exception as e:  # noqa: BLE001 — any verifier fault
+            # refuses the act; the evidence names the exception.
+            verdict = None
+            error = f"{type(e).__name__}: {e}"
+        else:
+            error = None
+        verdict_s = time.monotonic() - w0
+        would_help = bool(getattr(verdict, "would_help", verdict))
+        reason = str(
+            getattr(verdict, "reason", "cleared" if would_help else "refused")
+        )
+        if error is not None:
+            reason = "error"
+        detail = {
+            k: getattr(verdict, k)
+            for k in (
+                "batches", "records", "corrupt", "virtual_s", "wall_s",
+                "speedup", "flagged_tail", "clear_tail",
+            )
+            if hasattr(verdict, k)
+        }
+        refused_dump = False
+        with self._lock:
+            ep = self._episodes.get(svc)
+            stale = ep is None or ep.get("state") != STATE_PREFLIGHT
+            if not stale:
+                self._preflight_samples.append(verdict_s)
+                if would_help:
+                    self.preflight_verdicts["released"] = (
+                        self.preflight_verdicts.get("released", 0) + 1
+                    )
+                    self._act_locked(svc, ep, self._t_now)
+                else:
+                    # Refusal: the mitigation would NOT have helped.
+                    # Refund the token, reset the streak (a fresh
+                    # act_batches run of flagged reports is needed
+                    # before the next attempt), stay PENDING.
+                    self.preflight_verdicts["refused"] = (
+                        self.preflight_verdicts.get("refused", 0) + 1
+                    )
+                    self.preflight_refused[reason] = (
+                        self.preflight_refused.get(reason, 0) + 1
+                    )
+                    self.bucket.tokens = min(
+                        self.bucket.tokens + 1.0,
+                        float(self.bucket.capacity),
+                    )
+                    ep["state"] = STATE_PENDING
+                    ep["t_act"] = None
+                    ep["flag_streak"] = 0
+                    refused_dump = True
+        if stale:
+            return
+        if would_help:
+            self._record({
+                "op": "preflight_released", "service": svc,
+                "verdict_s": round(verdict_s, 4), **detail,
+            })
+            return
+        # Evidence OUTSIDE the lock (dump writes a file).
+        if self._flight is not None:
+            self._flight.record(
+                "preflight_refused", service=svc, reason=reason,
+                verdict_s=round(verdict_s, 4),
+                **({"error": error} if error else {}), **detail,
+            )
+        if refused_dump and self._flight is not None:
+            # NB: ``reason`` is dump()'s positional parameter (the
+            # file-name stem) — the verdict's reason rides as
+            # ``refusal_reason`` context.
+            self._flight.dump(
+                "preflight-refused", service=svc, refusal_reason=reason,
+                verdict_s=round(verdict_s, 4), **detail,
+            )
+
     # -- surface -------------------------------------------------------
 
     def drain(self, timeout_s: float = 5.0) -> bool:
@@ -822,6 +1235,13 @@ class RemediationController:
             samples, self._ttm_samples = self._ttm_samples, []
             return samples
 
+    def take_preflight_samples(self) -> list[float]:
+        """Drain act→verdict wall intervals accumulated since the last
+        call (``anomaly_preflight_seconds`` observations)."""
+        with self._lock:
+            samples, self._preflight_samples = self._preflight_samples, []
+            return samples
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -836,6 +1256,8 @@ class RemediationController:
                 "actuator_errors": self.actuator_errors,
                 "queue_dropped": self.queue_dropped,
                 "queue_depth": len(self._jobs),
+                "preflight_verdicts": dict(self.preflight_verdicts),
+                "preflight_refused": dict(self.preflight_refused),
                 "tokens": round(self.bucket.tokens, 3),
                 "active": sum(
                     1 for ep in self._episodes.values()

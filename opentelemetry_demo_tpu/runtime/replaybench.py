@@ -39,9 +39,8 @@ import time
 
 import numpy as np
 
-from ..models.detector import AnomalyDetector, DetectorConfig
+from ..models.detector import DetectorConfig
 from . import history
-from .pipeline import DetectorPipeline
 from .tensorize import SpanColumns
 
 # CI-friendly geometry: the protocol (record → replay equivalence), not
@@ -80,15 +79,14 @@ def _make_cols(rng, step: int, faulted: bool) -> SpanColumns:
 
 
 def _make_pipeline(collect: dict) -> tuple[AnomalyDetector, DetectorPipeline]:
-    det = AnomalyDetector(_replay_config())
+    # Delegates to the ONE shared builder (runtime.shadow) so the
+    # counterfactual pre-flight verifier and this harness can never
+    # drift: same pipeline construction, same verdict keying —
+    # bit-identity between shadow and replaybench holds by
+    # construction, and the mitigbench shadow leg pins it.
+    from .shadow import build_shadow_pipeline
 
-    def on_report(t_batch, report, flagged):
-        collect[round(float(t_batch), 6)] = tuple(
-            bool(f) for f in np.asarray(report.flags)
-        )
-
-    pipe = DetectorPipeline(det, on_report=on_report, batch_size=B)
-    return det, pipe
+    return build_shadow_pipeline(_replay_config(), B, collect)
 
 
 def record_incident(

@@ -294,6 +294,25 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("quantile",
                             "anomaly_time_to_mitigate_seconds_bucket",
                             q=0.99), "s"),
+                # Counterfactual pre-flight (runtime.shadow): verdicts
+                # by direction (released vs refused), refusals by
+                # reason (a deadline/insufficient burst = the gate is
+                # starved, not the mitigations wrong), the shadow
+                # replay's wall cost, and the collector-steering
+                # storage fraction (1 - ratio = reduction bought).
+                Panel("Pre-flight verdicts",
+                      Query("rate", "anomaly_preflight_verdicts_total",
+                            by=("verdict",)), "verdicts/s"),
+                Panel("Pre-flight refusals by reason",
+                      Query("rate", "anomaly_preflight_refused_total",
+                            by=("reason",)), "refusals/s"),
+                Panel("Pre-flight verdict p99",
+                      Query("quantile",
+                            "anomaly_preflight_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Collector keep ratio (steered sampling)",
+                      Query("instant", "anomaly_collector_keep_ratio"),
+                      "fraction"),
                 # Sharded fleet (runtime.fleet + runtime.aggregator):
                 # live member count vs N, the ring digest every shard
                 # should agree on (disagreement = split), applied vs

@@ -320,6 +320,15 @@ ANOMALY_MITIGATION_VERIFIED = "anomaly_mitigation_verified_total"
 ANOMALY_MITIGATION_FAILED = "anomaly_mitigation_failed_total"
 ANOMALY_MITIGATION_ACTIVE = "anomaly_mitigation_active"
 ANOMALY_TIME_TO_MITIGATE = "anomaly_time_to_mitigate_seconds"  # histogram
+# Counterfactual pre-flight (runtime.shadow gating the controller's
+# acts on a shadow replay of recorded history) + the collector-steering
+# actuator: every verdict by direction, every refusal by reason (the
+# fail-closed audit trail), the act→verdict wall interval, and the
+# storage fraction the currently pushed tail-sampling policy implies.
+ANOMALY_PREFLIGHT_VERDICTS = "anomaly_preflight_verdicts_total"  # {verdict=}
+ANOMALY_PREFLIGHT_REFUSED = "anomaly_preflight_refused_total"  # {reason=}
+ANOMALY_PREFLIGHT_SECONDS = "anomaly_preflight_seconds"  # histogram
+ANOMALY_COLLECTOR_KEEP_RATIO = "anomaly_collector_keep_ratio"
 # Sharded detector fleet (runtime.fleet membership + guardrailed
 # reshard; runtime.aggregator scatter-gather reads): who is on the
 # ring, how often the keyspace moved, how often a move was REFUSED by

@@ -610,6 +610,29 @@ REMEDIATION_KNOBS: dict[str, tuple[str, object, str]] = {
         "services keep the configured ANOMALY_HISTORY_SPANS policy; "
         "0 = flagd actuator only",
     ),
+    "ANOMALY_REMEDIATION_COLLECTOR_PATH": (
+        "str", "",
+        "collector-steering leg, file transport: path the "
+        "CollectorActuator atomically writes its rendered "
+        "tail-sampling policy document to (an otelcol config "
+        "reloader/sidecar watches it — see "
+        "deploy/otelcol-config-anomaly.yml); empty AND no URL = the "
+        "collector actuator is off (the default)",
+    ),
+    "ANOMALY_REMEDIATION_COLLECTOR_URL": (
+        "str", "",
+        "collector-steering leg, HTTP transport: base URL whose POST "
+        "/api/sampling-policy receives the rendered tail-sampling "
+        "policy (bounded timeout + the worker's capped jittered "
+        "retry); wins over the file path when both are set",
+    ),
+    "ANOMALY_REMEDIATION_COLLECTOR_BASE_KEEP": (
+        "float", 0.1,
+        "head-sampling keep fraction [0,1] for QUIET services in the "
+        "pushed collector policy (flagged services always keep 1.0); "
+        "the policy-implied storage fraction is exported as "
+        "anomaly_collector_keep_ratio",
+    ),
 }
 
 
@@ -811,6 +834,51 @@ AUTOSCALE_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Counterfactual pre-flight knobs (runtime.shadow: before the
+# remediation controller releases an actuator write, replay the last
+# WINDOW_S of recorded span frames through a fresh shadow pipeline
+# with the proposed mitigation applied, and refuse acts whose shadow
+# heads do not clear). Same ONE-registry discipline as every other
+# family — daemon, compose overlay, k8s generator and sanitycheck.py
+# all consume this dict. Values must stay literals (sanitycheck reads
+# via ast.literal_eval, without importing jax).
+SHADOW_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_SHADOW_ENABLE": (
+        "int", 0,
+        "1 = every remediation act is pre-flighted on a shadow replay "
+        "of recorded history before any actuator write (requires "
+        "ANOMALY_HISTORY_DIR + ANOMALY_HISTORY_SPANS span capture); "
+        "0 (the default — the gate is strictly opt-in like every "
+        "controller tier) = PR 13 behavior, act on hysteresis alone",
+    ),
+    "ANOMALY_SHADOW_WINDOW_S": (
+        "float", 120.0,
+        "how far back the counterfactual replay reaches: the recorded "
+        "span window (seconds, recorded timebase) re-fed through the "
+        "shadow pipeline with the mitigation transform applied",
+    ),
+    "ANOMALY_SHADOW_RATE": (
+        "float", 10.0,
+        "minimum recorded-seconds-per-wall-second the shadow replay "
+        "must sustain (the replaybench >=10x discipline) — gated by "
+        "the mitigbench shadow leg, measured on every verdict",
+    ),
+    "ANOMALY_SHADOW_DEADLINE_S": (
+        "float", 5.0,
+        "verification deadline (wall seconds): a shadow replay still "
+        "running past it REFUSES the act (fail closed, "
+        "reason=deadline) — a slow verifier must delay mitigation, "
+        "never release an unproven one",
+    ),
+    "ANOMALY_SHADOW_MIN_RECORDS": (
+        "int", 20,
+        "minimum recorded span batches inside the window for a "
+        "verdict; fewer = the counterfactual is unprovable and the "
+        "act is refused (fail closed, reason=insufficient_records)",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -821,7 +889,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "DAEMON_KNOBS", "OVERLOAD_KNOBS", "INGEST_KNOBS",
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
-    "FLEET_KNOBS", "AUTOSCALE_KNOBS",
+    "FLEET_KNOBS", "AUTOSCALE_KNOBS", "SHADOW_KNOBS",
 )
 
 
@@ -916,6 +984,15 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "autoscaler propose scale-out, SIGKILL a shard mid-resize, "
         "pin the automatic adoption bit-exact against an unkilled "
         "witness; lifts autoscale_tta_s and autoscale_ok)",
+    ),
+    "BENCH_SHADOW": (
+        "int", 1,
+        "0 skips the counterfactual pre-flight leg of the mitigation "
+        "bench (runtime.mitigbench --shadow: released + refused "
+        "verdict drills through a preflighted controller, "
+        "shadow-vs-replaybench bit-identity at >= ANOMALY_SHADOW_RATE "
+        "x wall, collector keep-ratio measurement; lifts "
+        "preflight_refusal_ok and preflight_verdict_s)",
     ),
 }
 
@@ -1233,6 +1310,12 @@ def remediation_config() -> dict[str, int | float | str]:
             "ANOMALY_REMEDIATION_TIMEOUT_S="
             f"{out['ANOMALY_REMEDIATION_TIMEOUT_S']} must be > 0"
         )
+    keep = float(out["ANOMALY_REMEDIATION_COLLECTOR_BASE_KEEP"])
+    if not 0.0 <= keep <= 1.0:
+        raise ConfigError(
+            f"ANOMALY_REMEDIATION_COLLECTOR_BASE_KEEP={keep} must be "
+            "a keep fraction in [0, 1]"
+        )
     return out
 
 
@@ -1416,6 +1499,36 @@ def autoscale_config() -> dict[str, int | float | str]:
         raise ConfigError(
             f"ANOMALY_AUTOSCALE_MIN_SHARDS={lo_n} / MAX_SHARDS={hi_n}: "
             "need 1 <= min <= max"
+        )
+    return out
+
+
+def shadow_config() -> dict[str, int | float | str]:
+    """Resolve every SHADOW_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the fail-closed
+    shapes — a zero window, rate, deadline or record floor would turn
+    the counterfactual gate into a rubber stamp (or a wedge), and must
+    refuse to boot instead."""
+    out = _resolve(SHADOW_KNOBS)
+    if float(out["ANOMALY_SHADOW_WINDOW_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_SHADOW_WINDOW_S="
+            f"{out['ANOMALY_SHADOW_WINDOW_S']} must be > 0"
+        )
+    if float(out["ANOMALY_SHADOW_RATE"]) <= 0:
+        raise ConfigError(
+            f"ANOMALY_SHADOW_RATE={out['ANOMALY_SHADOW_RATE']} "
+            "must be > 0"
+        )
+    if float(out["ANOMALY_SHADOW_DEADLINE_S"]) <= 0:
+        raise ConfigError(
+            "ANOMALY_SHADOW_DEADLINE_S="
+            f"{out['ANOMALY_SHADOW_DEADLINE_S']} must be > 0"
+        )
+    if int(out["ANOMALY_SHADOW_MIN_RECORDS"]) < 1:
+        raise ConfigError(
+            "ANOMALY_SHADOW_MIN_RECORDS="
+            f"{out['ANOMALY_SHADOW_MIN_RECORDS']} must be >= 1"
         )
     return out
 

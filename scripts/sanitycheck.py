@@ -169,6 +169,7 @@ def main() -> int:
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
                         "HISTORY_KNOBS", "REMEDIATION_KNOBS",
                         "FLEET_KNOBS", "AUTOSCALE_KNOBS",
+                        "SHADOW_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -178,6 +179,7 @@ def main() -> int:
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
         "REMEDIATION_KNOBS", "FLEET_KNOBS", "AUTOSCALE_KNOBS",
+        "SHADOW_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -816,6 +818,87 @@ def main() -> int:
             "test_autoscale_sigkill_adoption_live",
         ):
             check(marker in fttext, f"elastic-fleet suite pins {marker}")
+
+    # 14) counterfactual control (runtime/shadow.py + the preflight
+    #     interlude in remediation.py): the pre-flight verifier
+    #     defaults OFF (same hard opt-in as remediation/autoscale — a
+    #     gate that can refuse mitigations is a product decision), the
+    #     shadow replay is built by the SAME pipeline builder
+    #     replaybench uses (bit-identity by construction), it touches
+    #     live state through the disk-backed HistoryReader ONLY (the
+    #     query.py isolation contract), and the suite + bench legs pin
+    #     both verdict directions.
+    shadow_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "shadow.py"
+    )
+    check(os.path.exists(shadow_py), "runtime/shadow.py exists")
+    if os.path.exists(shadow_py):
+        shtext = open(shadow_py).read()
+        for marker in (
+            "class ShadowVerifier", "def build_shadow_pipeline",
+            "def suppress_transform", "PreflightVerdict",
+            "REASON_DEADLINE", "REASON_INSUFFICIENT",
+        ):
+            check(marker in shtext, f"runtime/shadow.py declares {marker!r}")
+        check(
+            "detector.state" not in shtext
+            and "_dispatch_lock" not in shtext,
+            "shadow.py replays from the disk-backed reader only "
+            "(no detector.state / _dispatch_lock reference)",
+        )
+    sh_knobs = registries.get("SHADOW_KNOBS") or {}
+    sh_enable = sh_knobs.get("ANOMALY_SHADOW_ENABLE")
+    check(
+        sh_enable is not None and sh_enable[1] == 0,
+        "pre-flight verification defaults OFF (ANOMALY_SHADOW_ENABLE=0)",
+    )
+    rem_text = open(os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "remediation.py"
+    )).read()
+    for marker in (
+        "STATE_PREFLIGHT", "_finish_preflight", "class CollectorActuator",
+    ):
+        check(
+            marker in rem_text,
+            f"remediation.py grows the preflight interlude ({marker})",
+        )
+    check(
+        "build_shadow_pipeline" in open(os.path.join(
+            ROOT, "opentelemetry_demo_tpu", "runtime", "replaybench.py"
+        )).read(),
+        "replaybench builds its replay pipeline through the ONE "
+        "shared builder (shadow.build_shadow_pipeline)",
+    )
+    check(
+        "def measure_shadow" in open(os.path.join(
+            ROOT, "opentelemetry_demo_tpu", "runtime", "mitigbench.py"
+        )).read(),
+        "mitigbench.py grows the shadow pre-flight leg",
+    )
+    check(
+        "shadowbench:" in open(os.path.join(ROOT, "Makefile")).read(),
+        "Makefile has a shadowbench target",
+    )
+    check(
+        "shadow:" in open(os.path.join(ROOT, "pyproject.toml")).read(),
+        "pyproject registers the shadow marker",
+    )
+    shadow_tests = os.path.join(ROOT, "tests", "test_shadow.py")
+    check(os.path.exists(shadow_tests), "tests/test_shadow.py exists")
+    if os.path.exists(shadow_tests):
+        sttext = open(shadow_tests).read()
+        for marker in (
+            "test_bit_identity_with_replaybench",
+            "test_would_help_mitigation_released",
+            "test_wrong_mitigation_refused",
+            "test_deadline_miss_refuses",
+            "test_refused_verdict_refunds_and_stays_pending",
+            "test_fenced_daemon_never_preflights",
+            "test_isolation_pin_no_live_state",
+            "test_exact_revert_prior_restored",
+            "test_refcounted_shared_holds",
+        ):
+            check(marker in sttext, f"shadow suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

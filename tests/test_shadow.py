@@ -1,0 +1,468 @@
+"""Counterfactual pre-flight proofs (runtime.shadow + the PR 17
+controller integration): shadow-vs-replaybench bit-identity, both
+verdict directions, fail-closed refusals (deadline / thin corpus /
+verifier crash), budget refund on refusal, fenced-daemon-never-
+preflights, the query.py-style live-state isolation pin, and the
+CollectorActuator guardrail set (push / exact revert / refcounted
+holds / timeout → retryable)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.runtime import history, replaybench, shadow
+from opentelemetry_demo_tpu.runtime.flightrec import FlightRecorder
+from opentelemetry_demo_tpu.runtime.remediation import (
+    STATE_ACTIVE,
+    STATE_PENDING,
+    CollectorActuator,
+    RemediationController,
+)
+from opentelemetry_demo_tpu.runtime.replication import EpochFence
+
+pytestmark = pytest.mark.shadow
+
+FAULT = replaybench.FAULT_SVC
+
+
+@pytest.fixture(scope="module")
+def incident_dir(tmp_path_factory):
+    """One short recorded incident shared by every replay test (the
+    pipeline compile is paid once; replays share the XLA cache)."""
+    directory = str(tmp_path_factory.mktemp("shadow-incident"))
+    recorded = replaybench.record_incident(
+        directory, warm_steps=24, fault_steps=24
+    )
+    return directory, recorded
+
+
+def _verifier(directory, **kw):
+    store = history.HistoryStore(directory)
+    reader = history.HistoryReader(store, rungs=(1.0, 60.0))
+    kw.setdefault("batch_size", replaybench.B)
+    kw.setdefault("window_s", 1e6)
+    kw.setdefault("deadline_s", 300.0)
+    kw.setdefault("min_records", 1)
+    return shadow.ShadowVerifier(
+        reader, replaybench._replay_config(), **kw
+    ), reader
+
+
+def _released_verdict():
+    return shadow.PreflightVerdict(
+        would_help=True, reason=shadow.REASON_CLEARED, batches=8,
+        records=8, corrupt=0, virtual_s=2.0, wall_s=0.01,
+        speedup=200.0, flagged_tail=0, clear_tail=4, verdicts={},
+    )
+
+
+class SpyActuator:
+    name = "spy"
+
+    def __init__(self):
+        self.applies = []
+        self.reverts = []
+
+    def apply(self, svc):
+        self.applies.append(svc)
+        return svc
+
+    def revert(self, svc, token):
+        self.reverts.append(svc)
+
+
+def _controller(actuators, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("act_batches", 2)
+    kw.setdefault("clear_batches", 4)
+    kw.setdefault("budget", 3)
+    kw.setdefault("budget_refill_s", 1e9)
+    kw.setdefault("deadline_s", 30.0)
+    return RemediationController(actuators, **kw)
+
+
+def _observe_n(ctrl, n, flagged, t0=0.0, dt=0.25):
+    t = t0
+    for _ in range(n):
+        ctrl.observe(t, flagged, services=["svc5"])
+        t += dt
+    return t
+
+
+class TestShadowReplay:
+    def test_bit_identity_with_replaybench(self, incident_dir):
+        """The tentpole pin: a transform-less shadow pass over the
+        recorded window yields EXACTLY the recording run's (and
+        replaybench's) per-batch flag verdicts — one shared pipeline
+        builder, provably un-drifted."""
+        directory, recorded = incident_dir
+        replayed, _v, _w, _b = replaybench.replay(directory)
+        verifier, _ = _verifier(directory)
+        now = verifier.reader.span_records()[-1].t_end + 1.0
+        v = verifier.verify(FAULT, None, now=now)
+        assert v.verdicts == recorded == replayed
+        assert v.batches == 48
+        # The un-mitigated incident must NOT clear: still_flagged.
+        assert not v.would_help
+        assert v.reason == shadow.REASON_STILL_FLAGGED
+        assert v.flagged_tail > 0
+
+    def test_would_help_mitigation_released(self, incident_dir):
+        """Suppressing the faulted service's columns (the flagd
+        counterfactual) clears the shadow heads → releasable."""
+        directory, _ = incident_dir
+        verifier, _ = _verifier(directory)
+        now = verifier.reader.span_records()[-1].t_end + 1.0
+        v = verifier.verify(
+            FAULT, shadow.suppress_transform(FAULT), now=now
+        )
+        assert v.would_help
+        assert v.reason == shadow.REASON_CLEARED
+        assert v.flagged_tail == 0 and v.clear_tail > 0
+
+    def test_wrong_mitigation_refused(self, incident_dir):
+        """A mitigation mapped to the WRONG service leaves the flagged
+        service flagged in the shadow tail → refused."""
+        directory, _ = incident_dir
+        verifier, _ = _verifier(directory)
+        now = verifier.reader.span_records()[-1].t_end + 1.0
+        wrong = (FAULT + 1) % replaybench.S
+        v = verifier.verify(
+            FAULT, shadow.suppress_transform(wrong), now=now
+        )
+        assert not v.would_help
+        assert v.reason == shadow.REASON_STILL_FLAGGED
+
+    def test_deadline_miss_refuses(self, incident_dir):
+        """A verifier that cannot finish inside the wall deadline
+        refuses the act (fail closed), reason-coded."""
+        directory, _ = incident_dir
+        verifier, _ = _verifier(directory, deadline_s=0.0)
+        now = verifier.reader.span_records()[-1].t_end + 1.0
+        v = verifier.verify(FAULT, None, now=now)
+        assert not v.would_help
+        assert v.reason == shadow.REASON_DEADLINE
+
+    def test_thin_corpus_refuses(self, incident_dir):
+        """Fewer recorded batches than the floor = the counterfactual
+        is unprovable: refused, not rubber-stamped."""
+        directory, _ = incident_dir
+        verifier, _ = _verifier(directory, min_records=10_000)
+        v = verifier.verify(FAULT, None, now=1e12)
+        assert not v.would_help
+        assert v.reason == shadow.REASON_INSUFFICIENT
+
+    def test_verifier_crash_refuses(self, incident_dir):
+        """ANY replay fault refuses the act — a crashed verifier has
+        proven nothing about the mitigation."""
+        directory, _ = incident_dir
+        verifier, _ = _verifier(directory)
+        now = verifier.reader.span_records()[-1].t_end + 1.0
+
+        def bomb(_cols):
+            raise RuntimeError("transform exploded")
+
+        v = verifier.verify(FAULT, bomb, now=now)
+        assert not v.would_help
+        assert v.reason == shadow.REASON_ERROR
+
+    def test_span_records_window_and_corrupt_skip(self, incident_dir):
+        """The new HistoryReader window API: header-only time filter
+        over KIND_SPANS records; a corrupted record decodes to
+        (None, None) and counts on the store's corruption counter."""
+        directory, _ = incident_dir
+        store = history.HistoryStore(directory)
+        reader = history.HistoryReader(store, rungs=(1.0, 60.0))
+        recs = reader.span_records()
+        assert len(recs) == 48
+        t0 = recs[0].t_start
+        sub = reader.span_records(t0, t0 + 2.0)
+        assert 0 < len(sub) < len(recs)
+        assert all(
+            r.t_end >= t0 and r.t_start <= t0 + 2.0 for r in sub
+        )
+        rec = recs[5]
+        with open(rec.path, "r+b") as f:
+            f.seek(rec.offset + rec.length // 2)
+            byte = f.read(1)
+            f.seek(rec.offset + rec.length // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        store2 = history.HistoryStore(directory)
+        reader2 = history.HistoryReader(store2, rungs=(1.0, 60.0))
+        before = store2.frames_corrupt
+        arrays, t = reader2.read_span_record(
+            reader2.span_records()[5]
+        )
+        assert arrays is None and t is None
+        assert store2.frames_corrupt == before + 1
+
+    def test_isolation_pin_no_live_state(self):
+        """The query.py isolation contract, pinned: the shadow module
+        never names live detector state or the dispatch lock — it
+        consumes only the disk-backed reader + a static config."""
+        src = open(shadow.__file__.rstrip("c")).read()
+        assert "detector.state" not in src
+        assert "_dispatch_lock" not in src
+
+
+class TestPreflightController:
+    def test_released_verdict_acts(self):
+        """would_help=True → episode goes ACTIVE, actuators apply,
+        the act→verdict interval lands in the histogram feed and the
+        preflight events land in the flight ring."""
+        spy = SpyActuator()
+        flight = FlightRecorder()
+        calls = []
+
+        def preflight(svc):
+            calls.append(svc)
+            return _released_verdict()
+
+        ctrl = _controller([spy], preflight=preflight, flight=flight)
+        try:
+            _observe_n(ctrl, 2, ["svc5"])
+            assert ctrl.drain()
+            assert calls == ["svc5"]
+            assert spy.applies == ["svc5"]
+            assert ctrl.state_of("svc5") == STATE_ACTIVE
+            samples = ctrl.take_preflight_samples()
+            assert len(samples) == 1 and samples[0] >= 0.0
+            st = ctrl.stats()
+            assert st["preflight_verdicts"] == {"released": 1}
+            kinds = [ev["kind"] for ev in flight.snapshot()]
+            assert "mitigation" in kinds  # op=preflight + released
+        finally:
+            ctrl.close()
+
+    def test_refused_verdict_refunds_and_stays_pending(self, tmp_path):
+        """would_help=False → zero actuator writes, budget token
+        refunded, episode back to PENDING with the streak reset, and
+        the flight evidence (ring event + dump file) on disk."""
+        spy = SpyActuator()
+        flight = FlightRecorder(dump_dir=str(tmp_path))
+        ctrl = _controller(
+            [spy],
+            preflight=lambda svc: shadow.refused(
+                shadow.REASON_STILL_FLAGGED
+            ),
+            flight=flight,
+        )
+        try:
+            _observe_n(ctrl, 2, ["svc5"])
+            assert ctrl.drain()
+            assert spy.applies == []
+            assert ctrl.state_of("svc5") == STATE_PENDING
+            assert abs(ctrl.bucket.tokens - 3.0) < 1e-6  # refunded
+            st = ctrl.stats()
+            assert st["preflight_verdicts"] == {"refused": 1}
+            assert st["preflight_refused"] == {
+                shadow.REASON_STILL_FLAGGED: 1
+            }
+            assert flight.events_total.get("preflight_refused") == 1
+            dumps = list(tmp_path.glob("flight-preflight-refused-*"))
+            assert len(dumps) == 1
+            evidence = json.loads(dumps[0].read_text())
+            assert evidence["service"] == "svc5"
+            assert evidence["refusal_reason"] == shadow.REASON_STILL_FLAGGED
+            # act→verdict interval measured on refusals too.
+            assert len(ctrl.take_preflight_samples()) == 1
+        finally:
+            ctrl.close()
+
+    def test_preflight_crash_fails_closed(self):
+        """A preflight hook that raises refuses the act (reason=error)
+        instead of releasing an unproven mitigation."""
+        spy = SpyActuator()
+
+        def bomb(svc):
+            raise RuntimeError("verifier died")
+
+        ctrl = _controller([spy], preflight=bomb)
+        try:
+            _observe_n(ctrl, 2, ["svc5"])
+            assert ctrl.drain()
+            assert spy.applies == []
+            assert ctrl.state_of("svc5") == STATE_PENDING
+            assert ctrl.stats()["preflight_refused"] == {"error": 1}
+        finally:
+            ctrl.close()
+
+    def test_fenced_daemon_never_preflights(self):
+        """A superseded daemon's preflight job is fence-refused before
+        the verifier even runs: the callable is never invoked, the
+        token refunds, the episode parks in PENDING."""
+        spy = SpyActuator()
+        fence = EpochFence(0)
+        fence.observe(5)  # stale: a successor owns the store
+        calls = []
+
+        def preflight(svc):
+            calls.append(svc)
+            return _released_verdict()
+
+        ctrl = _controller([spy], preflight=preflight, fence=fence)
+        try:
+            _observe_n(ctrl, 2, ["svc5"])
+            assert ctrl.drain()
+            assert calls == []
+            assert spy.applies == []
+            assert ctrl.state_of("svc5") == STATE_PENDING
+            assert abs(ctrl.bucket.tokens - 3.0) < 1e-6
+            assert ctrl.refused_fenced == 1
+        finally:
+            ctrl.close()
+
+    def test_episode_clears_during_preflight_refunds(self):
+        """The incident heals on its own while the verdict is queued:
+        the clean streak closes the episode AND refunds the held
+        token; the late verdict is discarded."""
+        spy = SpyActuator()
+        import threading
+
+        gate = threading.Event()
+
+        def preflight(svc):
+            gate.wait(5.0)  # hold the verdict until the streak closes
+            return _released_verdict()
+
+        ctrl = _controller([spy], preflight=preflight)
+        try:
+            t = _observe_n(ctrl, 2, ["svc5"])
+            _observe_n(ctrl, 4, [], t0=t)  # clean streak closes it
+            gate.set()
+            assert ctrl.drain()
+            assert spy.applies == []
+            assert abs(ctrl.bucket.tokens - 3.0) < 1e-6
+            assert ctrl.stats()["states"] == {}
+        finally:
+            gate.set()
+            ctrl.close()
+
+    def test_no_preflight_hook_acts_directly(self):
+        """preflight=None is exactly the PR 13 controller: hysteresis
+        releases the act with no PREFLIGHT interlude."""
+        spy = SpyActuator()
+        ctrl = _controller([spy])
+        try:
+            _observe_n(ctrl, 2, ["svc5"])
+            assert ctrl.drain()
+            assert spy.applies == ["svc5"]
+            assert ctrl.stats()["preflight_verdicts"] == {}
+        finally:
+            ctrl.close()
+
+
+class TestCollectorActuator:
+    def _names(self):
+        return [f"svc{i}" for i in range(8)]
+
+    def test_policy_push_shape(self, tmp_path):
+        """apply() renders the tail-sampling document: keep-100% for
+        the promoted service (exemplar-seeded), probabilistic baseline
+        for the quiet rest."""
+        path = str(tmp_path / "policy.json")
+        col = CollectorActuator(
+            policy_path=path, base_keep=0.2,
+            exemplar_fn=lambda svc: ["aa01", "aa02"],
+            services_fn=self._names,
+        )
+        token = col.apply("svc5")
+        assert token == "svc5"
+        doc = json.load(open(path))
+        policies = doc["processors"]["tail_sampling/anomaly"]["policies"]
+        names = [p["name"] for p in policies]
+        assert "anomaly-keep-svc5" in names
+        assert "anomaly-baseline-head" in names
+        keep = policies[names.index("anomaly-keep-svc5")]
+        sub = keep["and"]["and_sub_policy"]
+        assert sub[0]["string_attribute"] == {
+            "key": "service.name", "values": ["svc5"],
+        }
+        base = policies[names.index("anomaly-baseline-head")]
+        assert base["probabilistic"]["sampling_percentage"] == 20.0
+        assert doc["anomaly"]["exemplar_seeds"]["svc5"] == [
+            "aa01", "aa02",
+        ]
+        expected = (1.0 + 7 * 0.2) / 8
+        assert abs(col.keep_ratio() - expected) < 1e-9
+
+    def test_exact_revert_file_absent(self, tmp_path):
+        """No policy file existed before the first hold: the last
+        release REMOVES it — exact-state revert, not an empty doc."""
+        path = str(tmp_path / "policy.json")
+        col = CollectorActuator(policy_path=path)
+        token = col.apply("svc1")
+        assert os.path.exists(path)
+        col.revert("svc1", token)
+        assert not os.path.exists(path)
+
+    def test_exact_revert_prior_restored(self, tmp_path):
+        """A pre-existing policy file restores to its exact prior
+        content when the last hold releases."""
+        path = tmp_path / "policy.json"
+        prior = {"processors": {"operator": "owned"}, "v": 7}
+        path.write_text(json.dumps(prior))
+        col = CollectorActuator(policy_path=str(path))
+        token = col.apply("svc1")
+        assert json.load(open(path)) != prior
+        col.revert("svc1", token)
+        assert json.load(open(path)) == prior
+
+    def test_refcounted_shared_holds(self, tmp_path):
+        """Two episodes on one service join the hold; the policy keeps
+        the service promoted until the LAST release. Independent
+        services re-render on partial release."""
+        path = str(tmp_path / "policy.json")
+        col = CollectorActuator(policy_path=path)
+        t1 = col.apply("svc1")
+        t2 = col.apply("svc1")  # joined, not rewritten
+        t3 = col.apply("svc2")
+        col.revert("svc1", t1)
+        doc = json.load(open(path))
+        assert doc["anomaly"]["promoted"] == ["svc1", "svc2"]
+        col.revert("svc1", t2)
+        doc = json.load(open(path))
+        assert doc["anomaly"]["promoted"] == ["svc2"]
+        col.revert("svc2", t3)
+        assert not os.path.exists(path)
+
+    def test_unrestorable_prior_refuses(self, tmp_path):
+        """An existing file the actuator cannot parse refuses the
+        apply (raise → worker retry): never steer a collector whose
+        config can't be restored."""
+        path = tmp_path / "policy.json"
+        path.write_text("{torn garbage")
+        col = CollectorActuator(policy_path=str(path))
+        with pytest.raises(Exception):
+            col.apply("svc1")
+        assert col._holds == {}  # clean retry state
+        assert path.read_text() == "{torn garbage"
+
+    def test_dead_endpoint_raises_retryable(self):
+        """URL transport against a dead endpoint raises (bounded
+        timeout) — the worker's capped jittered retry handles it; the
+        minted hold is released so the retry re-takes it cleanly."""
+        col = CollectorActuator(
+            url="http://127.0.0.1:9", timeout_s=0.2,
+        )
+        with pytest.raises(Exception):
+            col.apply("svc1")
+        assert col._holds == {}
+
+    def test_transform_only_touches_target(self):
+        """suppress_transform edits ONLY the target service's rows —
+        a transform that edited healthy services could fake a clear."""
+        rng = np.random.default_rng(0)
+        cols = replaybench._make_cols(rng, 0, True)
+        out = shadow.suppress_transform(FAULT)(cols)
+        svc = np.asarray(cols.svc)
+        other = svc != FAULT
+        assert (np.asarray(out.lat_us)[other]
+                == np.asarray(cols.lat_us)[other]).all()
+        assert (np.asarray(out.is_error)[other]
+                == np.asarray(cols.is_error)[other]).all()
+        hit = ~other
+        assert (np.asarray(out.is_error)[hit] == 0.0).all()
+        assert (np.asarray(out.trace_key) == np.asarray(cols.trace_key)).all()
