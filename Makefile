@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench shadowbench querybench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench shadowbench querybench explainbench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -64,6 +64,9 @@ shadowbench:    ## counterfactual pre-flight drill alone (ONE json line: shadow-
 
 querybench:     ## live query plane under concurrent ingest (ONE json line: query p99/qps, ingest interference ratio)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.querybench
+
+explainbench:   ## verdict-provenance canary (ONE json line: provenance-on/off ABAB overhead ratio gated ≤1.03, /query/explain p99 under the live-ingest hammer)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.spinebench --explain
 
 gen-k8s:        ## regenerate deploy/k8s manifests
 	$(PY) -m opentelemetry_demo_tpu.utils.k8s --out deploy/k8s

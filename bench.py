@@ -271,6 +271,21 @@ def main():
         except Exception:  # noqa: BLE001 — artifact field is optional
             selftrace_ab = {}
 
+    # ---- verdict provenance overhead (the ISSUE 18 canary) -----------
+    # Provenance-on vs provenance-off spinebench A/B: the per-report
+    # trajectory ring (the only provenance work on the hot path —
+    # bundle assembly fires only on flags) must cost ≤3% of spine
+    # throughput, same discipline as the selftrace gate above.
+    # {} on failure — additive fields.
+    explain_ab = {}
+    if os.environ.get("BENCH_EXPLAIN", "1") != "0":
+        from opentelemetry_demo_tpu.runtime import spinebench
+
+        try:
+            explain_ab = spinebench.measure_explain_overhead() or {}
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            explain_ab = {}
+
     # ---- history replay (the time-travel tentpole) -------------------
     # Record a synthetic incident into the on-disk segment log, then
     # re-feed the recorded frames through a FRESH real pipeline under
@@ -481,6 +496,12 @@ def main():
             bool(selftrace_ab["ratio"] <= 1.03)
             if selftrace_ab.get("ratio") is not None else None
         ),
+        # Provenance verdict: the evidence plane's per-report ring must
+        # cost ≤3% of spine throughput (bundle assembly is flag-rare).
+        "explain_overhead_ok": (
+            bool(explain_ab["ratio"] <= 1.03)
+            if explain_ab.get("ratio") is not None else None
+        ),
         # Time-travel verdict: replaying a recorded segment log through
         # the real pipeline must run ≥10× wall clock with verdicts
         # bit-identical to the recording run.
@@ -598,10 +619,15 @@ def main():
                 "selftrace_traces_exported": selftrace_ab.get(
                     "traces_exported"
                 ),
+                "explain_overhead_ratio": explain_ab.get("ratio"),
+                "explain_spans_per_sec_on": explain_ab.get(
+                    "spans_per_sec_on"
+                ),
                 "query_p99_ms": queryq.get("query_p99_ms"),
                 "query_p50_ms": queryq.get("query_p50_ms"),
                 "query_qps": queryq.get("query_qps"),
                 "query_ingest_ratio": queryq.get("ingest_ratio"),
+                "explain_p99_ms": queryq.get("explain_p99_ms"),
                 "replay_speedup": replay.get("replay_speedup"),
                 "replay_verdicts_identical": replay.get(
                     "replay_verdicts_identical"

@@ -199,6 +199,7 @@ from ..utils.config import (
     history_spans_policy,
     ingest_config,
     overload_config,
+    provenance_config,
     query_config,
     remediation_config,
     replication_config,
@@ -207,7 +208,7 @@ from ..utils.config import (
     spine_config,
 )
 from ..utils.flags import FlagEvaluator, FlagFileStore, OfrepClient
-from . import autoscale, checkpoint, fleet, history, remediation, replication, selftrace, shadow
+from . import autoscale, checkpoint, fleet, history, provenance, remediation, replication, selftrace, shadow
 from . import frame as frame_fmt
 from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
@@ -221,6 +222,26 @@ from .replication import (
     EpochFence,
 )
 from .supervision import Supervisor
+
+
+def _package_version() -> str:
+    """Package version for the build_info gauge ("unknown" rather than
+    a crash if the package is run from a mangled checkout)."""
+    try:
+        from .. import __version__
+
+        return str(__version__)
+    except Exception:  # noqa: BLE001 — a label must never fail boot
+        return "unknown"
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return str(jax.__version__)
+    except Exception:  # noqa: BLE001 — a label must never fail boot
+        return "unknown"
 
 
 class DetectorDaemon:
@@ -331,6 +352,10 @@ class DetectorDaemon:
                 submit=submit,
                 sample=float(st["ANOMALY_SELFTRACE_SAMPLE"]),
             )
+        # Provenance log-record export reuses the selftrace collector
+        # endpoint: evidence bundles ride the same OTLP pipeline as
+        # every other self-observation signal (no second endpoint knob).
+        self._otlp_export_endpoint = str(st["ANOMALY_SELFTRACE_ENDPOINT"])
         self.flight.record(
             "boot", role=self.role,
             selftrace=bool(int(st["ANOMALY_SELFTRACE_ENABLE"])),
@@ -449,6 +474,21 @@ class DetectorDaemon:
         )
 
         self.registry = tele_metrics.MetricRegistry()
+        # Build identity: version labels are static for the process
+        # lifetime, so the gauge is set exactly once here; the matching
+        # start_ts rides /healthz (restart forensics pair with bundle
+        # timestamps through these two surfaces).
+        self._start_ts = time.time()
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_BUILD_INFO, 1.0,
+            version=_package_version(),
+            frame_version=str(frame_fmt.FRAME_VERSION),
+            jax=_jax_version(),
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_BUILD_INFO,
+            "Constant 1 labelled with package/frame/jax versions",
+        )
         self.registry.describe(
             tele_metrics.ANOMALY_FLAG_TOTAL,
             "Anomaly flags raised, by service",
@@ -854,6 +894,44 @@ class DetectorDaemon:
         self._adoptions_refused: dict[str, int] = {}
         self._adoption_seen = {"total": 0}
         self._last_adoption_tta: float | None = None
+
+        # Verdict provenance plane (knob registry:
+        # utils.config.PROVENANCE_KNOBS; engine: runtime.provenance).
+        # The engine rings head trajectories off the already-harvested
+        # reports and assembles one bounded evidence bundle per flagged
+        # service at capture time — the pipeline owns the flag-time
+        # hook, so the engine must exist before the pipeline does.
+        try:
+            pv = provenance_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.provenance = None
+        self._explain_poster = None
+        self._provenance_ring = int(pv["ANOMALY_PROVENANCE_RING"])
+        self._explanations_seen = 0
+        if int(pv["ANOMALY_PROVENANCE_ENABLE"]):
+            self.provenance = provenance.ProvenanceEngine(
+                self.detector.config,
+                topk=int(pv["ANOMALY_PROVENANCE_TOPK"]),
+                trajectory_windows=int(
+                    pv["ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS"]
+                ),
+                epoch_fn=lambda: self._fence.epoch,
+            )
+            if self._otlp_export_endpoint:
+                # Bundles double as OTLP log records on the same
+                # collector pipeline the selftrace spans ride.
+                from .otlp_export import OtlpHttpLogsExporter
+
+                self._explain_poster = OtlpHttpLogsExporter(
+                    self._otlp_export_endpoint
+                )
+            self.flight.record(
+                "provenance", op="enabled",
+                ring=self._provenance_ring,
+                topk=int(pv["ANOMALY_PROVENANCE_TOPK"]),
+                export=bool(self._explain_poster is not None),
+            )
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -897,6 +975,10 @@ class DetectorDaemon:
                 if self._tenant_quota_rows_s > 0 else None
             ),
             tenant_quota_rows_s=self._tenant_quota_rows_s,
+            # Verdict provenance (PROVENANCE_KNOBS; runtime.provenance):
+            # evidence bundles assembled at flag time on the harvester.
+            provenance=self.provenance,
+            explain_ring=self._provenance_ring,
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -1180,6 +1262,7 @@ class DetectorDaemon:
                 rate_target=float(sk["ANOMALY_SHADOW_RATE"]),
                 min_records=int(sk["ANOMALY_SHADOW_MIN_RECORDS"]),
                 flight=self.flight,
+                bundle_fn=self._bundle_for,
             )
             self.flight.record(
                 "preflight", op="enabled",
@@ -1204,6 +1287,7 @@ class DetectorDaemon:
                 self._preflight_mitigation
                 if self.shadow_verifier is not None else None
             ),
+            bundle_fn=self._bundle_for,
         )
         self._remediation_seen: dict[str, int] = {}
         if self.remediation.enabled:
@@ -1548,6 +1632,9 @@ class DetectorDaemon:
             # — and what health_probe --role prints.
             "role": self.role,
             "epoch": self._fence.epoch,
+            # Process birth time: lets an operator (and the build_info
+            # gauge's dashboards) correlate restarts with verdicts.
+            "start_ts": self._start_ts,
             # Auto-mitigation surface: what is mitigated right now and
             # whether any mitigation FAILED (the DEGRADED-style state
             # an operator triages before trusting the loop again).
@@ -1719,6 +1806,24 @@ class DetectorDaemon:
         block = self.pipeline.query_meta()
         events = (block.get("exemplars") or {}).get(str(idx), [])
         return [e.get("trace_id") for e in events if e.get("trace_id")]
+
+    def _bundle_for(self, service: str | int) -> str | None:
+        """Newest evidence-bundle id for one service — the remediation
+        (by name) and pre-flight (by index) citation hook: every
+        episode/refusal names the verdict it answers (worker thread;
+        query lock only, same discipline as ``_exemplars_for``)."""
+        if self.provenance is None:
+            return None
+        if isinstance(service, int):
+            names = self.pipeline.tensorizer.service_names
+            if not 0 <= service < len(names):
+                return None
+            service = names[service]
+        block = self.pipeline.query_meta()
+        for b in reversed(block.get("explains") or []):
+            if b.get("service") == service:
+                return b.get("id")
+        return None
 
     def _publish_sampling_policy(self, policy, seeds) -> None:
         """The sampling actuator's one push target: the history
@@ -2128,6 +2233,49 @@ class DetectorDaemon:
             )
             self._exemplars_seen = captured
 
+    def _export_provenance_stats(self) -> None:
+        """Provenance housekeeping each step: the built-counter delta
+        (same seen-baseline discipline as exemplars — a restore must
+        not replay old increments), build-latency observations, and
+        the export drain — each drained bundle lands in the history
+        tier (ranged /query/explain after restart) and, when the
+        collector endpoint is configured, ships as one OTLP log
+        record on the shared background poster."""
+        if self.provenance is None:
+            return
+        from .query import LATENCY_BUCKETS
+
+        built = self.pipeline.explanations_built
+        delta = built - self._explanations_seen
+        if delta > 0:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_EXPLANATIONS_BUILT, float(delta)
+            )
+            self._explanations_seen = built
+        for seconds in self.provenance.take_build_samples():
+            self.registry.histogram_observe(
+                tele_metrics.ANOMALY_EXPLAIN_LATENCY, seconds,
+                LATENCY_BUCKETS,
+            )
+        bundles = self.pipeline.take_explain_exports()
+        if bundles:
+            if self.history_writer is not None:
+                for b in bundles:
+                    self.history_writer.capture_explain(b)
+            if self._explain_poster is not None:
+                docs = [provenance.log_doc(b) for b in bundles]
+                self._explain_poster(time.time(), docs)
+            if (self.history_writer is not None
+                    or self._explain_poster is not None):
+                self.registry.counter_add(
+                    tele_metrics.ANOMALY_EXPLANATIONS_EXPORTED,
+                    float(len(bundles)),
+                )
+        if self._explain_poster is not None:
+            self._explain_poster.publish_stats(
+                self.registry, signal="explain"
+            )
+
     def _register_replication_component(self) -> None:
         """One supervised 'replication' component for either role: the
         standby watchdog thread and the primary listener both restart
@@ -2240,6 +2388,7 @@ class DetectorDaemon:
             self._export_autoscale_stats()
             if self.query_engine is not None and self._query_started:
                 self._export_query_stats()
+            self._export_provenance_stats()
             self._supervisor.tick()
             return
         # Self-telemetry on a 1 s cadence (the collector's own otelcol_*
@@ -2356,6 +2505,7 @@ class DetectorDaemon:
         self._export_autoscale_stats()
         if self.query_engine is not None and self._query_started:
             self._export_query_stats()
+        self._export_provenance_stats()
         if self.repl_primary is not None:
             self._export_replication_stats()
         if self._orders is not None:
@@ -3274,6 +3424,13 @@ class DetectorDaemon:
             # the pipeline drains, so nothing in flight is lost.
             self.ingest_pool.close()
         self.pipeline.close()  # drain + stop the harvester thread if any
+        # Final provenance drain AFTER the pipeline drain (bundles the
+        # last batches flagged) and BEFORE the writer closes, so they
+        # make the sealed log.
+        try:
+            self._export_provenance_stats()
+        except Exception:  # noqa: BLE001 — shutdown must not hang on it
+            pass
         if self.history_writer is not None:
             # After the pipeline drain (the last captured batches are
             # in the queue) and before the final checkpoint: one last
@@ -3289,6 +3446,9 @@ class DetectorDaemon:
             # sender — bounded: shutdown never hangs on a dead sink.
             self._selftrace_poster.flush(timeout_s=1.0)
             self._selftrace_poster.close()
+        if self._explain_poster is not None:
+            self._explain_poster.flush(timeout_s=1.0)
+            self._explain_poster.close()
         self.exporter.stop()
 
 

@@ -75,6 +75,7 @@ log = logging.getLogger(__name__)
 RECORD_MAGIC = b"OTDH"
 KIND_BANK = 0    # one retention-ladder rung record (sketch banks + heads)
 KIND_SPANS = 1   # one dispatched span batch (the replay corpus)
+KIND_EXPLAIN = 2  # one evidence bundle (runtime.provenance, meta-only frame)
 
 # Record header: magic, kind, rung, reserved, epoch, t_start, t_end,
 # frame length — then a CRC32C over those 36 bytes. The header is the
@@ -195,7 +196,11 @@ class HistoryStore:
 
     @staticmethod
     def _basename(kind: int, rung: int, seq: int) -> str:
-        prefix = "b" if kind == KIND_BANK else "s"
+        prefix = (
+            "b" if kind == KIND_BANK
+            else "e" if kind == KIND_EXPLAIN
+            else "s"
+        )
         return f"{prefix}{rung}-{seq:010d}"
 
     def _recover(self) -> None:
@@ -514,6 +519,17 @@ class HistoryWriter:
         self.spans_dropped = 0
         self.spans_recorded = 0
         self.spans_sampled_out = 0
+        # Evidence bundles (runtime.provenance) awaiting persistence:
+        # same bounded drop-oldest handoff as the span queue — the
+        # harvester enqueues a JSON-able dict, the compaction thread
+        # encodes it as a META-ONLY frame (no columns) so ranged
+        # explain reads stay header-only. Flags are rare; the span
+        # queue's cap is plenty.
+        self._explain_queue: deque = deque(
+            maxlen=max(int(span_queue_max), 1)
+        )
+        self.explains_recorded = 0
+        self.explains_dropped = 0
         # Ladder state: per coarse rung, an (accumulator, t_start,
         # child count) triple; rung 0 feeds from the window clock.
         self._acc: list[dict | None] = [None] * len(self.rungs)
@@ -654,6 +670,30 @@ class HistoryWriter:
             self.store.append(KIND_SPANS, 0, now, now, blob)
             self.spans_recorded += 1
 
+    # -- evidence-bundle capture (runtime.provenance) --------------------
+
+    def capture_explain(self, bundle: dict) -> None:
+        """Remember one evidence bundle (harvester thread; bounded,
+        never blocks). The bundle is already a plain JSON-able dict —
+        no copy-out needed, it is never mutated after build."""
+        with self._span_lock:
+            if len(self._explain_queue) == self._explain_queue.maxlen:
+                self.explains_dropped += 1
+            self._explain_queue.append(bundle)
+
+    def _drain_explains(self, now: float) -> None:
+        while True:
+            with self._span_lock:
+                if not self._explain_queue:
+                    return
+                bundle = self._explain_queue.popleft()
+            # Meta-only frame: the bundle IS the header JSON, so the
+            # ranged explain read (read_meta) never decodes columns.
+            blob = frame.encode({}, meta=dict(bundle))
+            t = float(bundle.get("t") or now)
+            self.store.append(KIND_EXPLAIN, 0, t, t, blob)
+            self.explains_recorded += 1
+
     # -- compaction ------------------------------------------------------
 
     def tick(self, now: float | None = None) -> None:
@@ -666,6 +706,7 @@ class HistoryWriter:
             return  # a stale writer stays quiet until restart/redeploy
         try:
             self._drain_spans(now)
+            self._drain_explains(now)
             self._tick_banks(now)
         except StaleEpochError as e:
             # Fourth fencing path: the epoch moved past us — stop
@@ -792,6 +833,8 @@ class HistoryWriter:
             "windows_missed": self.windows_missed,
             "spans_recorded": self.spans_recorded,
             "spans_dropped": self.spans_dropped,
+            "explains_recorded": self.explains_recorded,
+            "explains_dropped": self.explains_dropped,
             "fenced": self.fenced,
         }
 
@@ -997,6 +1040,25 @@ class HistoryReader:
                 if t_from <= t <= t_to:
                     events.append(dict(ev))
         return events, names
+
+    def explain_events(self, t_from: float, t_to: float) -> list[dict]:
+        """Evidence bundles over the range, oldest first — meta-only
+        reads over the KIND_EXPLAIN log (the bundle IS the frame's
+        header JSON; no columns exist to decode). The ranged
+        /query/explain backend, and the restart-survival half of the
+        provenance contract: a bundle recorded before a daemon restart
+        answers from disk here."""
+        bundles: list[dict] = []
+        for rec in self.store.records(
+            kind=KIND_EXPLAIN, t_from=t_from, t_to=t_to
+        ):
+            meta = self.store.read_meta(rec)
+            if not meta:
+                continue
+            t = float(meta.get("t") or rec.t_start)
+            if t_from <= t <= t_to:
+                bundles.append(meta)
+        return bundles
 
     def span_records(
         self,

@@ -48,6 +48,13 @@ import numpy as np
 from ..models.detector import AnomalyDetector, DetectorReport, report_unpack
 from ..ops.hashing import splitmix64_np
 from ..utils.flags import FlagEvaluator
+from .provenance import (
+    REASON_CARDINALITY,
+    REASON_CUSUM,
+    REASON_ERROR_RATE,
+    REASON_LATENCY,
+    REASON_THROUGHPUT,
+)
 from .selftrace import (
     PHASE_DISPATCH,
     PHASE_FLAG,
@@ -173,6 +180,8 @@ class DetectorPipeline:
         history_capture: Callable[[object, float], None] | None = None,
         tenant_of: Callable[[str], str] | None = None,
         tenant_quota_rows_s: float = 0.0,
+        provenance=None,
+        explain_ring: int = 64,
     ):
         self.detector = detector
         # Time-travel span capture (runtime.history.HistoryWriter
@@ -365,6 +374,20 @@ class DetectorPipeline:
         self._hh_cands: dict[int, deque] = {}
         self._anomaly_ring: deque = deque(maxlen=64)
         self.exemplars_captured = 0
+        # Verdict provenance (runtime.provenance; knob registry:
+        # utils.config.PROVENANCE_KNOBS): the evidence engine builds
+        # one bundle per flagged service at flag time; the bundle RING
+        # lives here beside the anomaly ring so it rides query_meta
+        # replication and the read replica's /query/explain answers
+        # bit-identically. The built counter follows the
+        # exemplars_captured delta discipline (never restored).
+        self._provenance = provenance
+        self._explain_ring: deque = deque(maxlen=max(int(explain_ring), 1))
+        self.explanations_built = 0
+        # Bundles awaiting OTLP log export, drained by the daemon's
+        # export tick (bounded, drop-oldest — freshness over
+        # completeness, the exporter queue's own discipline).
+        self._explain_export: deque = deque(maxlen=max(int(explain_ring), 1))
 
     # -- ingestion -----------------------------------------------------
 
@@ -1157,8 +1180,38 @@ class DetectorPipeline:
                     )
                 ring.extend(tail)
 
+    def _provenance_snapshot(self) -> dict | None:
+        """Flag-time device→host fetch of the baseline/sketch state the
+        evidence bundles cite (EWMA means/vars, CUSUM accumulators, the
+        live CMS/HLL banks). Harvester thread, under ``_dispatch_lock``
+        — the donation-race contract: ``detector.state`` may be donated
+        away mid-read otherwise. Flags are rare and the fetch is the
+        same order of work as one replication snapshot; a failed fetch
+        costs the bundle its state block, never the report path."""
+        try:
+            with self._dispatch_lock:
+                state = self.detector.state
+                return jax.device_get({
+                    "lat_mean": state.lat_mean,
+                    "lat_var": state.lat_var,
+                    "err_mean": state.err_mean,
+                    "rate_mean": state.rate_mean,
+                    "rate_var": state.rate_var,
+                    "card_mean": state.card_mean,
+                    "card_var": state.card_var,
+                    "cusum": state.cusum,
+                    "cms_bank": state.cms_bank,
+                    "hll_bank": state.hll_bank,
+                    "span_total": state.span_total,
+                    "step_idx": state.step_idx,
+                })
+        except Exception:  # noqa: BLE001 — evidence is advisory; the
+            # report (and the anomaly event) must land regardless.
+            return None
+
     def _capture_exemplars(
-        self, t_batch, cols, report, flags_np, threshold
+        self, t_batch, cols, report, flags_np, threshold,
+        prov_state: dict | None = None, trace_id: str | None = None,
     ) -> list[str]:
         """At flag time: link each flagged service to concrete trace
         ids from the batch that flagged it (harvester thread).
@@ -1187,17 +1240,21 @@ class DetectorPipeline:
         with self._query_lock:
             for i in np.nonzero(flags_np)[0]:
                 i = int(i)
+                # Signal names come from the runtime.provenance
+                # REASON_* table (the provenance-vocabulary staticcheck
+                # pass fences this set — bundles, anomaly events and
+                # dashboards all speak it).
                 signals = [
                     name
                     for name, z in (
-                        ("latency", report.lat_z[i]),
-                        ("error_rate", report.err_z[i]),
-                        ("throughput", report.rate_z[i]),
-                        ("cardinality", report.card_z[i]),
+                        (REASON_LATENCY, report.lat_z[i]),
+                        (REASON_ERROR_RATE, report.err_z[i]),
+                        (REASON_THROUGHPUT, report.rate_z[i]),
+                        (REASON_CARDINALITY, report.card_z[i]),
                     )
                     if np.abs(z).max() > threshold
                 ] + (
-                    ["cusum"]
+                    [REASON_CUSUM]
                     if (report.cusum[i] > cusum_thr).any()
                     else []
                 )
@@ -1219,12 +1276,48 @@ class DetectorPipeline:
                         )
                 self.exemplars_captured += len(traces)
                 captured.extend(traces)
+                bundle_ref = None
+                if self._provenance is not None:
+                    # Evidence bundle per flagged service: candidates
+                    # come from the same ring the top-k query reads
+                    # (already under _query_lock here); seq is the
+                    # detector step from the dispatch-lock snapshot so
+                    # the id is a pure function of replicated
+                    # coordinates.
+                    seq = (
+                        int(prov_state["step_idx"])
+                        if prov_state is not None
+                        and "step_idx" in prov_state
+                        else self.stats.flag_events
+                    )
+                    names = self.tensorizer.service_names
+                    cands = list(dict.fromkeys(
+                        reversed(self._hh_cands.get(i) or ())
+                    ))[: self._hh_cand_max]
+                    bundle = self._provenance.build(
+                        t_batch=float(t_batch),
+                        seq=seq,
+                        service=i,
+                        label=(
+                            names[i] if i < len(names) else f"svc-{i}"
+                        ),
+                        signals=signals,
+                        exemplars=traces,
+                        state=prov_state,
+                        hh_candidates=cands,
+                        trace_id=trace_id,
+                    )
+                    self._explain_ring.append(bundle)
+                    self._explain_export.append(bundle)
+                    self.explanations_built += 1
+                    bundle_ref = bundle["id"]
                 self._anomaly_ring.append({
                     "t": now,
                     "t_batch": float(t_batch),
                     "service": i,
                     "signals": signals,
                     "exemplars": traces,
+                    "bundle": bundle_ref,
                 })
         return captured
 
@@ -1250,6 +1343,12 @@ class DetectorPipeline:
                     for svc, ring in self._hh_cands.items()
                 },
                 "exemplars_captured": self.exemplars_captured,
+                # Evidence bundles are built once (on the primary, at
+                # flag time) and ride here verbatim — the replica's
+                # /query/explain answers from the SAME dicts, which is
+                # what makes the parity pin bit-identical.
+                "explains": [dict(b) for b in self._explain_ring],
+                "explanations_built": self.explanations_built,
             }
 
     def restore_query_meta(self, block: dict) -> None:
@@ -1292,6 +1391,23 @@ class DetectorPipeline:
                     # query_meta lists most-recent-FIRST; the rings
                     # keep arrival order (most recent at the right).
                     ring.extend(int(c) for c in reversed(crcs))
+            # Bundle ring: restored (the mirror is the only copy), but
+            # explanations_built is NOT — it backs this process's
+            # Prometheus counter delta, same rule as
+            # exemplars_captured above.
+            for b in (block.get("explains") or [])[
+                -self._explain_ring.maxlen:
+            ]:
+                self._explain_ring.append(dict(b))
+
+    def take_explain_exports(self) -> list[dict]:
+        """Drain bundles awaiting OTLP log export (daemon export
+        tick). Bounded drop-oldest upstream, so a stalled exporter
+        never grows this queue."""
+        with self._query_lock:
+            out = list(self._explain_export)
+            self._explain_export.clear()
+        return out
 
     # -- report processing --------------------------------------------
 
@@ -1304,6 +1420,11 @@ class DetectorPipeline:
         report = report_unpack(jax.device_get(dev_report), self.detector.config)
         fetch_dt = time.perf_counter() - t_fetch
         flags_np = report.flags
+        if self._provenance is not None:
+            # Ring the head trajectories on EVERY harvested report —
+            # already host numpy, so the K-window evidence history
+            # costs an append, never a device round trip.
+            self._provenance.observe_report(float(t_batch), report)
         lag_ms = (time.monotonic() - t_dispatch) * 1e3
         self.stats.lag_ms.append(lag_ms)
         if self.phase_observe is not None:
@@ -1345,8 +1466,17 @@ class DetectorPipeline:
                 names[i] if i < len(names) else f"svc-{i}"
                 for i in np.nonzero(flags_np)[0]
             ]
+            prov_state = (
+                self._provenance_snapshot()
+                if self._provenance is not None
+                else None
+            )
             links = self._capture_exemplars(
-                t_batch, cols, report, flags_np, threshold
+                t_batch, cols, report, flags_np, threshold,
+                prov_state=prov_state,
+                trace_id=(
+                    trace.trace_id.hex() if trace is not None else None
+                ),
             )
             flag_dt = time.perf_counter() - t_flag
             if self.phase_observe is not None:

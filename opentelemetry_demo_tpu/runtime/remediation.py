@@ -661,6 +661,7 @@ class RemediationController:
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 1.0,
         preflight: Callable[[str], object] | None = None,
+        bundle_fn: Callable[[str], str | None] | None = None,
     ):
         self.actuators = list(actuators)
         self.enabled = bool(enabled)
@@ -677,6 +678,11 @@ class RemediationController:
         # with ``would_help``/``reason`` (PreflightVerdict) or a bare
         # bool. None = no gate: act immediately (the PR 13 behavior).
         self._preflight = preflight
+        # Provenance citation hook (runtime.provenance via the daemon):
+        # newest evidence-bundle id for a service, stamped into the
+        # act/pre-flight flight records so every mitigation names the
+        # verdict it answers. None = records carry no citation.
+        self._bundle_fn = bundle_fn
         self.bucket = TokenBucket(budget, budget_refill_s)
         self._retry_attempts = max(int(retry_attempts), 1)
         self._backoff_base_s = float(backoff_base_s)
@@ -851,6 +857,10 @@ class RemediationController:
                 })
             return
         ep["noted"].discard("budget")
+        # Evidence citation: stamp the episode with the newest bundle
+        # id for this service ONCE, at escalation — the id every
+        # downstream record (act, preflight park/refusal) carries.
+        ep["bundle"] = self._cite(svc)
         if self._preflight is not None:
             # Counterfactual gate: hold the token, park the episode in
             # PREFLIGHT, and let the worker replay recorded history
@@ -864,9 +874,21 @@ class RemediationController:
             self._record({
                 "op": "preflight", "service": svc, "t": t_now,
                 "streak": ep["flag_streak"],
+                "bundle": ep.get("bundle"),
             })
             return
         self._act_locked(svc, ep, t_now)
+
+    def _cite(self, svc: str) -> str | None:
+        """Newest evidence-bundle id for ``svc`` via the daemon hook
+        (pipeline query lock only — cheap dict copy, no I/O; a hook
+        failure costs the citation, never the episode)."""
+        if self._bundle_fn is None:
+            return None
+        try:
+            return self._bundle_fn(svc)
+        except Exception:  # noqa: BLE001 — citation is best-effort
+            return None
 
     def _act_locked(self, svc: str, ep: dict, t_now: float) -> None:
         """Release the act: enqueue every actuator's apply (directly
@@ -886,6 +908,7 @@ class RemediationController:
             "streak": ep["flag_streak"],
             "actuators": [a.name for a in self.actuators],
             "tokens_left": self.bucket.tokens,
+            "bundle": ep.get("bundle"),
         })
 
     def _verify_locked(self, svc: str, ep: dict, t_now: float) -> None:
@@ -1135,9 +1158,12 @@ class RemediationController:
             if hasattr(verdict, k)
         }
         refused_dump = False
+        bundle = None
         with self._lock:
             ep = self._episodes.get(svc)
             stale = ep is None or ep.get("state") != STATE_PREFLIGHT
+            if ep is not None:
+                bundle = ep.get("bundle")
             if not stale:
                 self._preflight_samples.append(verdict_s)
                 if would_help:
@@ -1176,7 +1202,7 @@ class RemediationController:
         if self._flight is not None:
             self._flight.record(
                 "preflight_refused", service=svc, reason=reason,
-                verdict_s=round(verdict_s, 4),
+                verdict_s=round(verdict_s, 4), bundle=bundle,
                 **({"error": error} if error else {}), **detail,
             )
         if refused_dump and self._flight is not None:
@@ -1185,7 +1211,7 @@ class RemediationController:
             # ``refusal_reason`` context.
             self._flight.dump(
                 "preflight-refused", service=svc, refusal_reason=reason,
-                verdict_s=round(verdict_s, 4), **detail,
+                verdict_s=round(verdict_s, 4), bundle=bundle, **detail,
             )
 
     # -- surface -------------------------------------------------------

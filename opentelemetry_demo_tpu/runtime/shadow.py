@@ -177,6 +177,7 @@ class ShadowVerifier:
         min_records: int = 20,
         clear_tail: int = 4,
         flight=None,
+        bundle_fn: Callable[[int], str | None] | None = None,
         now_fn: Callable[[], float] = time.time,
     ):
         self.reader = reader
@@ -188,6 +189,13 @@ class ShadowVerifier:
         self.min_records = max(int(min_records), 1)
         self.clear_tail = max(int(clear_tail), 1)
         self._flight = flight
+        # Provenance citation hook (service index → newest evidence-
+        # bundle id via the daemon): every refusal/verdict record names
+        # the verdict it judged. Single-call discipline: verify() runs
+        # on the controller's one worker thread, so the per-call stamp
+        # below needs no lock.
+        self._bundle_fn = bundle_fn
+        self._bundle: str | None = None
         self._now_fn = now_fn
         self._warmed = False
         # Verifier-side tallies (the daemon exports the controller's;
@@ -199,7 +207,7 @@ class ShadowVerifier:
 
     def _record(self, **detail) -> None:
         if self._flight is not None:
-            self._flight.record("preflight", **detail)
+            self._flight.record("preflight", bundle=self._bundle, **detail)
 
     def _cols_of(self, arrays: dict) -> SpanColumns:
         return SpanColumns(**{
@@ -234,6 +242,7 @@ class ShadowVerifier:
         flagged service's heads clear for the final ``clear_tail``
         replayed batches within the wall deadline."""
         self.runs += 1
+        self._bundle = self._cite(int(service_idx))
         try:
             verdict = self._verify(int(service_idx), transform, now)
         except Exception as e:  # noqa: BLE001 — ANY replay fault
@@ -247,6 +256,14 @@ class ShadowVerifier:
         if not verdict.would_help:
             self.refusals += 1
         return verdict
+
+    def _cite(self, service_idx: int) -> str | None:
+        if self._bundle_fn is None:
+            return None
+        try:
+            return self._bundle_fn(service_idx)
+        except Exception:  # noqa: BLE001 — citation is best-effort
+            return None
 
     def _verify(
         self,

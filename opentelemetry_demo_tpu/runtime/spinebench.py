@@ -89,6 +89,7 @@ def measure_e2e(
     kernel_ref: bool = True,
     selftrace: bool = False,
     selftrace_sample: float = 0.05,
+    provenance: bool = False,
 ) -> dict | None:
     """One configuration's e2e rate, or None without the native decoder.
 
@@ -153,6 +154,17 @@ def measure_e2e(
             else:
                 registry.histogram_observe(metric, seconds_, PHASE_BUCKETS)
 
+    # Provenance A/B leg (bench.py's explain_overhead_ratio): the real
+    # engine wired the way the daemon wires it, so the steady-state
+    # cost under measurement is the per-report trajectory ring — the
+    # only provenance work that runs on every batch (bundle assembly
+    # only fires on flags, which synthetic steady load rarely raises;
+    # same sampled-measurement philosophy as the selftrace arm).
+    prov = None
+    if provenance:
+        from .provenance import ProvenanceEngine
+
+        prov = ProvenanceEngine(config)
     pipe = DetectorPipeline(
         det,
         on_report=lambda t, r, flagged: reports.__setitem__(
@@ -163,6 +175,7 @@ def measure_e2e(
         spine_overlap=overlap,
         phase_observe=phase_observe,
         selftrace=tracer,
+        provenance=prov,
     )
     pool = IngestPool(
         pipe.submit_columns,
@@ -246,6 +259,9 @@ def measure_e2e(
         "selftrace_traces": (
             tracer.traces_exported if tracer is not None else None
         ),
+        "explanations_built": (
+            pipe.explanations_built if prov is not None else None
+        ),
     }
 
 
@@ -285,6 +301,43 @@ def measure_selftrace_overhead(
     }
 
 
+def measure_explain_overhead(
+    seconds: float = 2.0, rounds: int = 2, **kw
+) -> dict | None:
+    """Provenance-on vs provenance-off spinebench A/B.
+
+    Same ABAB discipline as ``measure_selftrace_overhead``: interleaved
+    OFF/ON rounds over one payload set so CPU drift hits both arms,
+    the real ``ProvenanceEngine`` on the ON arm. ``ratio`` =
+    off_rate / on_rate; bench.py gates it at ≤ 1.03 — the evidence
+    plane must ride the harvester for free. None without the native
+    decoder."""
+    payloads = kw.pop("payloads", None) or make_payloads(
+        kw.get("n_requests", 32), kw.get("spans_per_request", 256)
+    )
+    rates = {True: [], False: []}
+    built = 0
+    for _ in range(max(int(rounds), 1)):
+        for on in (False, True):
+            got = measure_e2e(
+                seconds=seconds, payloads=payloads, kernel_ref=False,
+                provenance=on, **kw,
+            )
+            if got is None:
+                return None
+            rates[on].append(got["spans_per_sec"])
+            if on:
+                built += got.get("explanations_built") or 0
+    rate_off = sum(rates[False]) / len(rates[False])
+    rate_on = sum(rates[True]) / len(rates[True])
+    return {
+        "ratio": round(rate_off / max(rate_on, 1e-9), 4),
+        "spans_per_sec_on": round(rate_on, 1),
+        "spans_per_sec_off": round(rate_off, 1),
+        "explanations_built": built,
+    }
+
+
 def measure_sweep(
     workers_list=(1, 2), rings=(0, 2, 4), seconds: float = 2.0,
     **kw,
@@ -310,12 +363,38 @@ def measure_sweep(
 
 def main() -> None:
     import json
+    import sys
 
     from ..utils.config import BENCH_KNOBS, env_float
 
     seconds = env_float(
         "BENCH_SPINE_SECONDS", BENCH_KNOBS["BENCH_SPINE_SECONDS"][1]
     )
+    if "--explain" in sys.argv[1:]:
+        # `make explainbench`: the provenance canary alone — the A/B
+        # overhead ratio (gated ≤1.03 in bench.py) plus the explain
+        # endpoint's own p99 from the querybench hammer.
+        from .querybench import measure_query
+
+        explain_ab = measure_explain_overhead(
+            seconds=max(seconds / 3, 1.0)
+        )
+        queryq = measure_query()
+        print(
+            json.dumps(
+                {
+                    "metric": "explain_overhead",
+                    "explain_overhead_ratio": (
+                        explain_ab.get("ratio") if explain_ab else None
+                    ),
+                    "explain_overhead": explain_ab or None,
+                    "explain_p99_ms": queryq.get("explain_p99_ms"),
+                    "explain_queries": queryq.get("explain_queries"),
+                    "query_p99_ms": queryq.get("query_p99_ms"),
+                }
+            )
+        )
+        return
     headline = measure_e2e(seconds=seconds)
     sweep = measure_sweep(seconds=max(seconds / 3, 1.0))
     selftrace_ab = measure_selftrace_overhead(

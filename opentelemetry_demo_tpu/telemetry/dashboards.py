@@ -211,6 +211,25 @@ def provisioned_dashboards() -> list[Dashboard]:
                 Panel("Anomaly exemplars captured",
                       Query("rate", "anomaly_exemplars_captured_total"),
                       "traces/s"),
+                # Verdict provenance plane (runtime.provenance): how
+                # many flags got an evidence bundle, what assembling
+                # one costs on the harvester, how many shipped to the
+                # history tier / OTLP logs, and which build is
+                # running (restart forensics beside bundle times).
+                Panel("Anomaly explanations built",
+                      Query("rate", "anomaly_explanations_built_total"),
+                      "bundles/s"),
+                Panel("Anomaly explanations exported",
+                      Query("rate", "anomaly_explanations_exported_total"),
+                      "bundles/s"),
+                Panel("Explain build latency p99",
+                      Query("quantile",
+                            "anomaly_explain_latency_seconds_bucket",
+                            q=0.99), "s"),
+                Panel("Build info",
+                      Query("instant", "anomaly_build_info",
+                            by=("version", "frame_version", "jax")),
+                      "info"),
                 # Detector self-telemetry (runtime.selftrace +
                 # runtime.flightrec): where a batch's wall time goes
                 # per lifecycle phase, whether the device put hid

@@ -358,6 +358,18 @@ ANOMALY_FLEET_ADOPTIONS = "anomaly_fleet_adoptions_total"
 ANOMALY_FLEET_ADOPTIONS_REFUSED = "anomaly_fleet_adoptions_refused_total"  # {reason=}
 ANOMALY_FLEET_ADOPTION_TTA = "anomaly_fleet_adoption_seconds"
 
+# Verdict provenance plane (runtime.provenance): evidence bundles
+# built at flag time (per flagged service), bundles exported as OTLP
+# log records through the background poster, and what a flag-time
+# build costs on the harvester thread — plus the fleet build-identity
+# gauge (one 1-valued series per process, labeled with the package
+# version, the wire frame version and the jax build) a rolling resize
+# checks for mixed-build shards.
+ANOMALY_EXPLANATIONS_BUILT = "anomaly_explanations_built_total"
+ANOMALY_EXPLANATIONS_EXPORTED = "anomaly_explanations_exported_total"
+ANOMALY_EXPLAIN_LATENCY = "anomaly_explain_latency_seconds"  # histogram
+ANOMALY_BUILD_INFO = "anomaly_build_info"  # {version=, frame_version=, jax=}
+
 
 def export_metrics_report(
     registry: MetricRegistry,

@@ -879,6 +879,48 @@ SHADOW_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Verdict provenance knobs (runtime.provenance: the per-verdict
+# evidence engine — at flag time a bounded JSON-able bundle is built
+# per flagged service: firing head, head trajectories over the last K
+# windows, CMS top-k contributors, HLL cardinality delta, exemplar +
+# selftrace ids — served live by /query/explain, replicated in the
+# query_meta block, persisted through the history retention ladder and
+# exported as OTLP log records). Same ONE-registry discipline as every
+# other family — daemon, compose overlay, k8s generator and
+# sanitycheck.py all consume this dict. Values must stay literals
+# (sanitycheck reads via ast.literal_eval, without importing jax).
+PROVENANCE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_PROVENANCE_ENABLE": (
+        "int", 1,
+        "1 = build an evidence bundle per flagged service at flag "
+        "time (harvester thread, beside exemplar capture) and serve "
+        "it on /query/explain; 0 = provenance off (flags and "
+        "exemplars still capture — bundles are explanation, not "
+        "detection)",
+    ),
+    "ANOMALY_PROVENANCE_RING": (
+        "int", 64,
+        "bounded bundle ring size (newest wins): the live "
+        "/query/explain depth, and — because the ring rides the "
+        "replicated query_meta block — the replica's too",
+    ),
+    "ANOMALY_PROVENANCE_TOPK": (
+        "int", 5,
+        "heavy-hitter contributors per bundle: the top-k candidate "
+        "attribute CRCs folded through the CMS under the dispatch "
+        "lock at flag time (the /query/topk fold, snapshotted into "
+        "evidence)",
+    ),
+    "ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS": (
+        "int", 16,
+        "per-service head-trajectory depth (reports): how many "
+        "recent harvested windows of z/CUSUM/cardinality each "
+        "bundle replays — ring-buffered host-side from stats "
+        "already fetched, never an extra device round trip",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -890,6 +932,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
     "FLEET_KNOBS", "AUTOSCALE_KNOBS", "SHADOW_KNOBS",
+    "PROVENANCE_KNOBS",
 )
 
 
@@ -954,6 +997,12 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "int", 1,
         "0 skips the self-telemetry overhead A/B (tracer-on vs "
         "tracer-off spinebench, gated <= 1.03)",
+    ),
+    "BENCH_EXPLAIN": (
+        "int", 1,
+        "0 skips the provenance overhead A/B (evidence-engine-on vs "
+        "off spinebench, gated <= 1.03) and the /query/explain "
+        "latency leg",
     ),
     "BENCH_SPINE_SECONDS": (
         "float", 6.0, "e2e spine bench duration per configuration",
@@ -1529,6 +1578,31 @@ def shadow_config() -> dict[str, int | float | str]:
         raise ConfigError(
             "ANOMALY_SHADOW_MIN_RECORDS="
             f"{out['ANOMALY_SHADOW_MIN_RECORDS']} must be >= 1"
+        )
+    return out
+
+
+def provenance_config() -> dict[str, int | float | str]:
+    """Resolve every PROVENANCE_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the bundle shapes —
+    a zero ring or trajectory depth would silently build empty
+    evidence, and must refuse to boot instead."""
+    out = _resolve(PROVENANCE_KNOBS)
+    if int(out["ANOMALY_PROVENANCE_RING"]) < 1:
+        raise ConfigError(
+            "ANOMALY_PROVENANCE_RING="
+            f"{out['ANOMALY_PROVENANCE_RING']} must be >= 1"
+        )
+    if int(out["ANOMALY_PROVENANCE_TOPK"]) < 1:
+        raise ConfigError(
+            "ANOMALY_PROVENANCE_TOPK="
+            f"{out['ANOMALY_PROVENANCE_TOPK']} must be >= 1"
+        )
+    if int(out["ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS"]) < 1:
+        raise ConfigError(
+            "ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS="
+            f"{out['ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS']} "
+            "must be >= 1"
         )
     return out
 

@@ -169,7 +169,7 @@ def main() -> int:
                         "QUERY_KNOBS", "SPINE_KNOBS", "SELFTRACE_KNOBS",
                         "HISTORY_KNOBS", "REMEDIATION_KNOBS",
                         "FLEET_KNOBS", "AUTOSCALE_KNOBS",
-                        "SHADOW_KNOBS",
+                        "SHADOW_KNOBS", "PROVENANCE_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -179,7 +179,7 @@ def main() -> int:
         "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS",
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
         "REMEDIATION_KNOBS", "FLEET_KNOBS", "AUTOSCALE_KNOBS",
-        "SHADOW_KNOBS",
+        "SHADOW_KNOBS", "PROVENANCE_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")

@@ -293,12 +293,14 @@ def _load_passes() -> None:
         frame_monopoly,
         knobs,
         metric_surface,
+        provenance_vocabulary,
         trace_discipline,
     )
 
     for mod in (
         donation, knobs, metric_surface, trace_discipline,
         frame_monopoly, concurrency, exception_status,
+        provenance_vocabulary,
     ):
         PASSES[mod.PASS_ID] = (mod.run, mod.DESCRIPTION)
 

@@ -1,0 +1,195 @@
+"""provenance-vocabulary: one head/reason table, every surface in sync.
+
+The evidence vocabulary (``runtime/provenance.py``: ``HEAD_*`` head
+kinds, ``REASON_*`` signal names) is what an evidence bundle's
+``heads``/``signals`` fields carry, what ``/query/explain`` consumers
+filter on, and what any Grafana panel pinning a ``head=``/``signal=``
+label graphs — the trace-discipline story, replayed for verdicts.
+Drift modes this pass closes:
+
+1. **Unknown literal.** A dict display under ``runtime/`` whose
+   ``head``/``heads`` entry names a head kind no ``HEAD_*`` constant
+   declares (or whose ``signal``/``signals`` entry names a signal no
+   ``REASON_*`` constant declares) mints a vocabulary fork: the
+   bundle self-describes with a word nothing downstream understands,
+   and a replica/history answer can never be joined against it.
+   Literals carrying a DECLARED value pass — the fence is the
+   vocabulary, not the spelling.
+
+2. **Orphan.** A ``HEAD_*``/``REASON_*`` constant nothing references
+   (the ``HEAD_FOR_REASON`` projection counts, like trace-discipline's
+   ``SPAN_FOR_PHASE``) is a dead vocabulary entry.
+
+3. **Dangling dashboard label.** A dashboard Query whose ``matchers``
+   pin ``head=``/``signal=`` to a value the table does not declare
+   graphs nothing, forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Repo, Violation
+
+PASS_ID = "provenance-vocabulary"
+DESCRIPTION = (
+    "evidence head/signal names come from runtime/provenance.py "
+    "constants; no unknown literals, no orphans, dashboard labels "
+    "resolve"
+)
+
+PROVENANCE_REL = ("runtime", "provenance.py")
+DASHBOARDS_REL = ("telemetry", "dashboards.py")
+PREFIXES = ("HEAD_", "REASON_")
+# The no-signal fallback pipeline.py stamps on exemplar entries when a
+# flag carried no per-signal evidence — deliberate, and not a head.
+EXTRA_SIGNALS = {"flag"}
+# Dict keys that claim membership in each half of the vocabulary.
+HEAD_KEYS = {"head", "heads"}
+SIGNAL_KEYS = {"signal", "signals"}
+
+
+def load_constants(repo: Repo) -> dict[str, str]:
+    """HEAD_*/REASON_* name → string value from runtime/provenance.py."""
+    rel = repo.pkg_path(*PROVENANCE_REL)
+    src = repo.source(rel) if rel else None
+    consts: dict[str, str] = {}
+    if src is None or src.tree is None:
+        return consts
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith(PREFIXES):
+                    consts[t.id] = node.value.value
+    return consts
+
+
+def _literal_strings(node: ast.AST):
+    """(value, lineno) for a string constant or a list/tuple/set
+    display of string constants — the only literal shapes a
+    head/signal entry legitimately takes."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node.lineno
+    elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                yield elt.value, elt.lineno
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    if repo.package is None:
+        return out
+    consts = load_constants(repo)
+    if not consts:
+        return out  # no vocabulary declared — nothing to police
+    head_values = {v for k, v in consts.items() if k.startswith("HEAD_")}
+    signal_values = {
+        v for k, v in consts.items() if k.startswith("REASON_")
+    } | EXTRA_SIGNALS
+    provenance_rel = repo.pkg_path(*PROVENANCE_REL)
+    referenced: set[str] = set()
+
+    runtime_prefix = f"{repo.package}/runtime/"
+    for rel in repo.iter_py(repo.package):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            # Constant references anywhere (incl. provenance.py's own
+            # HEAD_FOR_REASON projection) count against the orphan rule.
+            if isinstance(node, ast.Attribute) and node.attr in consts:
+                referenced.add(node.attr)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in consts:
+                referenced.add(node.id)
+            if not isinstance(node, ast.Dict):
+                continue
+            if rel == provenance_rel or not rel.startswith(runtime_prefix):
+                # provenance.py IS the table; outside runtime/ nothing
+                # constructs evidence bundles.
+                continue
+            for key, val in zip(node.keys, node.values):
+                if not (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                ):
+                    continue
+                if key.value in HEAD_KEYS:
+                    allowed, half = head_values, "HEAD_*"
+                elif key.value in SIGNAL_KEYS:
+                    allowed, half = signal_values, "REASON_*"
+                else:
+                    continue
+                for text, lineno in _literal_strings(val):
+                    if text not in allowed:
+                        out.append(Violation(
+                            PASS_ID, rel, lineno,
+                            f"{key.value!r} entry names {text!r} but no "
+                            f"runtime/provenance.py {half} constant "
+                            "declares it — an evidence-vocabulary fork "
+                            "nothing downstream can join against",
+                        ))
+
+    # Orphans: a vocabulary entry nothing references.
+    src = repo.source(provenance_rel) if provenance_rel else None
+    const_line: dict[str, int] = {}
+    if src is not None and src.tree is not None:
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        const_line[t.id] = node.lineno
+    for cname in consts:
+        if cname not in referenced:
+            out.append(Violation(
+                PASS_ID, provenance_rel, const_line.get(cname, 1),
+                f"{cname} ({consts[cname]!r}) is never referenced — a "
+                "dead vocabulary entry (wire it into HEAD_FOR_REASON "
+                "or a construction site, or delete it)",
+            ))
+
+    # Dashboard head/signal labels must resolve against the table.
+    dash_rel = repo.pkg_path(*DASHBOARDS_REL)
+    dash_src = repo.source(dash_rel) if dash_rel else None
+    if dash_src is not None and dash_src.tree is not None:
+        for node in ast.walk(dash_src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Query"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "matchers" or not isinstance(
+                    kw.value, ast.Dict
+                ):
+                    continue
+                for key, val in zip(kw.value.keys, kw.value.values):
+                    if not (
+                        isinstance(key, ast.Constant)
+                        and isinstance(val, ast.Constant)
+                    ):
+                        continue
+                    if key.value == "head" and val.value not in head_values:
+                        bad_half = "HEAD_*"
+                    elif (
+                        key.value == "signal"
+                        and val.value not in signal_values
+                    ):
+                        bad_half = "REASON_*"
+                    else:
+                        continue
+                    out.append(Violation(
+                        PASS_ID, dash_rel, node.lineno,
+                        f"dashboard panel pins {key.value}="
+                        f"{val.value!r} but no runtime/provenance.py "
+                        f"{bad_half} constant declares it — the panel "
+                        "would graph nothing, forever",
+                    ))
+    return out

@@ -680,6 +680,9 @@ def test_replica_answers_bit_identical_at_same_seq():
             "/query/cardinality?service=cart",
             "/query/zscore?service=currency",
             "/query/anomalies?limit=50",
+            # Evidence bundles ride the replicated query_meta block
+            # verbatim — the replica's explanation IS the primary's.
+            "/query/explain?limit=50",
         ):
             ps, pdoc = _get(p_port, path)
             ss, sdoc = _get(s_port, path)
@@ -693,6 +696,10 @@ def test_replica_answers_bit_identical_at_same_seq():
                 json.dumps(pdoc["data"], sort_keys=True)
                 == json.dumps(sdoc["data"], sort_keys=True)
             ), f"replica answer diverged on {path}"
+            if path.startswith("/query/explain"):
+                # The pin must compare real evidence, not two empty
+                # rings agreeing about nothing.
+                assert pdoc["data"]["bundles"], "no bundles built"
         # The replica's staleness reports the replication-lag bound.
         _s, sdoc = _get(s_port, "/query/services")
         assert sdoc["meta"]["staleness_s"] >= 0.0

@@ -285,6 +285,66 @@ TRACE_CLEAN = {
     """,
 }
 
+PROVENANCE_BAD = {
+    **BASE,
+    "pkg/telemetry/__init__.py": "",
+    "pkg/runtime/provenance.py": """
+        HEAD_EWMA_Z = "ewma-z"
+        HEAD_CUSUM = "cusum"
+        REASON_LATENCY = "latency"
+        REASON_ORPHAN = "never_referenced"
+
+        HEAD_FOR_REASON = {REASON_LATENCY: HEAD_EWMA_Z}
+
+        def heads_for(signals):
+            return sorted({HEAD_FOR_REASON.get(s, HEAD_CUSUM)
+                           for s in signals})
+    """,
+    "pkg/runtime/mod.py": """
+        def event():
+            return {
+                "heads": ["ewma-z", "rogue-head"],   # unknown head kind
+                "signals": ["latency", "made_up"],   # unknown signal
+            }
+    """,
+    "pkg/telemetry/dashboards.py": """
+        class Query:
+            def __init__(self, kind, metric="", matchers=None, **kw):
+                pass
+
+        PANELS = [Query("rate", "anomaly_explanations_built_total",
+                        matchers={"head": "unknown-head"})]
+    """,
+}
+PROVENANCE_CLEAN = {
+    **BASE,
+    "pkg/telemetry/__init__.py": "",
+    "pkg/runtime/provenance.py": """
+        HEAD_EWMA_Z = "ewma-z"
+        REASON_LATENCY = "latency"
+
+        HEAD_FOR_REASON = {REASON_LATENCY: HEAD_EWMA_Z}
+    """,
+    "pkg/runtime/mod.py": """
+        from .provenance import HEAD_EWMA_Z, REASON_LATENCY
+
+        def event():
+            return {
+                "heads": [HEAD_EWMA_Z],
+                "signals": ["latency"],   # declared value: spelling ok
+                "head": "ewma-z",
+            }
+    """,
+    "pkg/telemetry/dashboards.py": """
+        class Query:
+            def __init__(self, kind, metric="", matchers=None, **kw):
+                pass
+
+        PANELS = [Query("rate", "anomaly_explanations_built_total",
+                        matchers={"head": "ewma-z"})]
+    """,
+}
+
 FIXTURES = [
     ("donation-race", DONATION_BAD, DONATION_CLEAN, 1),
     ("knob-discipline", KNOBS_BAD, KNOBS_CLEAN, 2),
@@ -293,6 +353,7 @@ FIXTURES = [
     ("trace-discipline", TRACE_BAD, TRACE_CLEAN, 3),
     ("concurrency", CONCURRENCY_BAD, CONCURRENCY_CLEAN, 2),
     ("exception-status", STATUS_BAD, STATUS_CLEAN, 4),
+    ("provenance-vocabulary", PROVENANCE_BAD, PROVENANCE_CLEAN, 4),
 ]
 
 
