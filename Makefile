@@ -6,7 +6,7 @@
 PY      := python
 CPU_ENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench replbench fleetbench autoscalebench replaybench mitigbench shadowbench querybench explainbench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
+.PHONY: start start-minimal start-kafka start-load test tracetest kafka-interop bench overloadbench ingestbench decodebench spinebench frontdoorbench replbench fleetbench autoscalebench replaybench mitigbench shadowbench querybench explainbench gen-k8s gen-proto gen-dashboards build-native staticcheck check clean
 
 start:          ## serve the shop stack (gateway :8080 + detector + 5 users)
 	$(CPU_ENV) $(PY) scripts/serve_shop.py --users 5
@@ -43,6 +43,9 @@ decodebench:    ## raw two-pass scanner microbench: pass-1 scan vs pass-2 extrac
 
 spinebench:     ## end-to-end ingest spine: payload → flagged report, workers × ring-depth sweep (ONE json line)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.spinebench
+
+frontdoorbench: ## native front door vs in-process pool at matched workers + ≥1M-distinct-key cardinality soak (ONE json line)
+	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.frontdoorbench
 
 replbench:      ## hot-standby failover drill (ONE json line: replication lag p99, failover TTD, exact convergence)
 	$(CPU_ENV) $(PY) -m opentelemetry_demo_tpu.runtime.replbench

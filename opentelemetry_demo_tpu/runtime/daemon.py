@@ -195,6 +195,7 @@ from ..utils.config import (
     fleet_config,
     fleet_tenant_map,
     frame_config,
+    frontdoor_config,
     history_config,
     history_spans_policy,
     ingest_config,
@@ -1064,6 +1065,17 @@ class DetectorDaemon:
             "flushes": 0, "flushed_spans": 0, "coalesced_requests": 0,
             "frames_corrupt": 0, "busy_s": 0.0, "wall_t": time.monotonic(),
         }
+        # Native front door (runtime/frontdoor.py): opt-in second
+        # producer into the SAME bounded decode queue — socket→scratch
+        # →scan with zero Python per payload. Resolved (and validated)
+        # at boot even when disabled, so a typo'd knob fails fast;
+        # started only from run()/promotion on a serving primary.
+        # Knob registry: utils.config.FRONTDOOR_KNOBS.
+        try:
+            self._frontdoor_cfg = frontdoor_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self.frontdoor = None
         # Orders flushes whose pool ticket hadn't resolved within the
         # pump's wait: offsets are withheld until the flush confirms,
         # so a checkpoint can never persist offsets for records that
@@ -1559,6 +1571,52 @@ class DetectorDaemon:
         self.receiver = self._make_http_receiver(port)
         self.receiver.start()
 
+    def _start_frontdoor(self) -> None:
+        """Opt-in native OTLP/HTTP front door (FRONTDOOR_KNOBS).
+
+        Started only on a serving primary, only when
+        ANOMALY_FRONTDOOR_ENABLE=1, only with a decode pool to ticket
+        into (ANOMALY_INGEST_WORKERS>0), and only when the native
+        library built — every other combination keeps the Python
+        receiver as the sole door and logs why.
+        """
+        fd = self._frontdoor_cfg
+        if int(fd["ANOMALY_FRONTDOOR_ENABLE"]) != 1:
+            return
+        if self.frontdoor is not None:
+            return
+        log = logging.getLogger(__name__)
+        if self.ingest_pool is None:
+            log.warning(
+                "ANOMALY_FRONTDOOR_ENABLE=1 ignored: the front door "
+                "tickets into the decode pool and "
+                "ANOMALY_INGEST_WORKERS=0 built none"
+            )
+            return
+        from . import native as _native
+
+        if not _native.frontdoor_available():
+            log.warning(
+                "ANOMALY_FRONTDOOR_ENABLE=1 ignored: native front-door "
+                "library unavailable (%s)", _native.frontdoor_load_error()
+            )
+            return
+        from .frontdoor import FrontDoorServer
+
+        self.frontdoor = FrontDoorServer(
+            self.ingest_pool,
+            port=int(fd["ANOMALY_FRONTDOOR_PORT"]),
+            max_body_bytes=self.max_body_bytes,
+            pumps=int(fd["ANOMALY_FRONTDOOR_PUMPS"]),
+            batch_max=int(fd["ANOMALY_FRONTDOOR_BATCH"]),
+            max_conns=int(fd["ANOMALY_FRONTDOOR_MAX_CONNS"]),
+            retry_after=lambda: self.pipeline.admission_retry_after(),
+            on_reject=self._on_ingest_reject("frontdoor"),
+            on_metric_records=self.metrics_feed.submit,
+            on_log_records=self._on_logs,
+        )
+        log.info("native front door serving on :%d", self.frontdoor.port)
+
     def _restart_grpc_receiver(self) -> None:
         if self.role == ROLE_FENCED or self.grpc_receiver is None:
             return
@@ -1996,6 +2054,7 @@ class DetectorDaemon:
         self.receiver.start()
         if self.grpc_receiver is not None:
             self.grpc_receiver.start()
+        self._start_frontdoor()
         self.exporter.start()
         self._start_query_plane()
         self._start_history_writer()
@@ -3134,6 +3193,7 @@ class DetectorDaemon:
                     self.grpc_receiver.start()
                 except ImportError:
                     self.grpc_receiver = None
+            self._start_frontdoor()
             self._register_serving_components()
         except Exception:  # noqa: BLE001 — promotion retries, never parks
             logging.getLogger(__name__).exception(
@@ -3411,6 +3471,11 @@ class DetectorDaemon:
             self.receiver.stop()
         if self.grpc_receiver is not None:
             self.grpc_receiver.stop()
+        if self.frontdoor is not None:
+            # Quiesce + drain in-flight verdicts BEFORE the decode
+            # pool closes: a ticket the pool will never resolve must
+            # get its 503, not a hung socket.
+            self.frontdoor.stop()
         if self._orders is not None:
             self._orders.close()
         # Stop the remediation worker before the pipeline drains: no

@@ -1,0 +1,543 @@
+"""Front-door benchmark + million-key cardinality soak.
+
+Two measurements this repo never had, one module, one JSON line:
+
+- ``measure_frontdoor_vs_pool`` — the tentpole's perf gate: OTLP/HTTP
+  spans/s through the NATIVE front door (socket → native buffer →
+  ticket → decode pool, zero Python per payload) vs the in-process
+  pool baseline (``ingestbench.measure_pooled``) at MATCHED workers
+  and payload geometry. The front door pays real sockets and HTTP
+  framing that the in-process number never does, so meeting the
+  baseline means the native acceptor's framing is genuinely free
+  relative to decode — the claim BENCH_r06 said the Python receiver
+  could not make (pooled ingest flat at ~6.1M spans/s because the
+  front end, not decode, was the wall).
+
+- ``measure_million_key_soak`` — the repo's first scale-of-keys run:
+  a synthetic shop-fleet generator drives ≥1M distinct
+  (tenant × service) keys through ingest → sketch → query, measuring
+  steady-state RSS per million keys, intern-table pressure (the
+  snapshot-republish cost is REAL at this scale and is exactly what
+  this soak exists to observe), sketch-geometry overflow behavior
+  (keys past ``num_services`` fold into the overflow bucket by
+  contract — counted, not hidden), and the fleet's drift refusal
+  (``merge_shard_arrays`` must still refuse a mismatched geometry
+  when the tables are a million keys deep, not just at the ~13
+  services every other test uses).
+
+Callers: ``make frontdoorbench`` (standalone, full-size soak) and
+``bench.py``'s BENCH_FRONTDOOR leg (additive artifact fields).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from . import native, wire
+from .ingest_pool import IngestPool, IngestPoolSaturated
+from .ingestbench import make_payloads, measure_pooled
+from .tensorize import SpanTensorizer
+
+ONE_MILLION = 1_000_000
+
+
+# ---------------------------------------------------------------------------
+# synthetic shop fleet: many DISTINCT services per request
+# ---------------------------------------------------------------------------
+
+def make_fleet_payloads(
+    n_requests: int,
+    services_per_request: int = 4096,
+    tenants: int = 16,
+    start_index: int = 0,
+) -> list[bytes]:
+    """OTLP trace payloads whose every span belongs to a DISTINCT
+    (tenant × service) key — one resource_spans block per service,
+    one span each.
+
+    ``ingestbench.make_payloads`` models today's demo (~10 services,
+    fat resource blocks); this models the paper's north star (millions
+    of users → millions of live keys). The span body is one shared
+    template — what varies per key is the resource's service.name,
+    which is the axis the interner, the sketches and the fleet table
+    all key on.
+    """
+
+    def anyval(s: bytes) -> bytes:
+        return wire.encode_len(1, s)
+
+    def kv(k: bytes, v: bytes) -> bytes:
+        return wire.encode_len(1, k) + wire.encode_len(2, anyval(v))
+
+    start = 1_700_000_000_000_000_000
+    span = (
+        wire.encode_len(1, bytes(range(16)))
+        + wire.encode_len(5, b"oteldemo.rpc/Call")
+        + wire.encode_fixed64(7, start)
+        + wire.encode_fixed64(8, start + 5_000_000)
+        + wire.encode_len(9, kv(b"app.product.id", b"P-7"))
+        + wire.encode_len(9, kv(b"rpc.system", b"grpc"))
+    )
+    # ResourceSpans.field2 = ScopeSpans, ScopeSpans.field2 = Span —
+    # the same double wrap ingestbench.make_payloads emits.
+    scope_spans = wire.encode_len(2, wire.encode_len(2, span))
+    payloads = []
+    key = start_index
+    for _ in range(n_requests):
+        rs_bufs = []
+        for _ in range(services_per_request):
+            tenant = key % tenants
+            name = f"t{tenant:02d}.svc-{key:07d}".encode()
+            resource = wire.encode_len(1, kv(b"service.name", name))
+            rs_bufs.append(
+                wire.encode_len(
+                    1, wire.encode_len(1, resource) + scope_spans
+                )
+            )
+            key += 1
+        payloads.append(b"".join(rs_bufs))
+    return payloads
+
+
+# ---------------------------------------------------------------------------
+# HTTP client for the front door (bench-side: Python is fine HERE —
+# the claim under test is the SERVER's per-payload loop, not the load
+# generator's)
+# ---------------------------------------------------------------------------
+
+def _post_loop(
+    port: int,
+    payloads: list[bytes],
+    stop: threading.Event,
+    counts: dict,
+    lock: threading.Lock,
+    depth: int = 4,
+    path: bytes = b"/v1/traces",
+) -> None:
+    """Keep-alive client: send ``depth`` pipelined POSTs, read ``depth``
+    responses, repeat until ``stop``. Pipelining keeps the connection's
+    ticket slot busy without one thread per in-flight request."""
+    reqs = [
+        b"POST %s HTTP/1.1\r\nHost: bench\r\nContent-Length: %d\r\n\r\n"
+        % (path, len(p)) + p
+        for p in payloads
+    ]
+    s = socket.create_connection(("127.0.0.1", port))
+    s.settimeout(30.0)
+    try:
+        i = 0
+        buf = b""
+        while not stop.is_set():
+            burst = [reqs[(i + k) % len(reqs)] for k in range(depth)]
+            i += depth
+            s.sendall(b"".join(burst))
+            need = depth
+            ok = 0
+            while need > 0:
+                # Responses are header-only (Content-Length: 0), so a
+                # complete response == one blank-line terminator.
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise ConnectionError("front door closed mid-burst")
+                buf += chunk
+                while b"\r\n\r\n" in buf and need > 0:
+                    head, buf = buf.split(b"\r\n\r\n", 1)
+                    if head.split(b" ", 2)[1] == b"200":
+                        ok += 1
+                    need -= 1
+            with lock:
+                counts["ok"] = counts.get("ok", 0) + ok
+                counts["sent"] = counts.get("sent", 0) + depth
+    except Exception:  # noqa: BLE001 — a bench client dying ends its lane
+        pass
+    finally:
+        s.close()
+
+
+def _run_frontdoor_clients(
+    port: int,
+    payloads: list[bytes],
+    seconds: float,
+    clients: int,
+    depth: int,
+) -> dict:
+    stop = threading.Event()
+    counts: dict = {}
+    lock = threading.Lock()
+    threads = [
+        threading.Thread(
+            target=_post_loop,
+            args=(port, payloads, stop, counts, lock, depth),
+            daemon=True,
+        )
+        for _ in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    counts["elapsed"] = time.perf_counter() - t0
+    return counts
+
+
+def measure_frontdoor_vs_pool(
+    workers: int = 2,
+    n_requests: int = 12,
+    spans_per_request: int = 4096,
+    seconds: float = 4.0,
+    clients: int = 16,
+    depth: int = 2,
+    repeat: int = 2,
+    payloads: list[bytes] | None = None,
+) -> dict | None:
+    """Front-door spans/s vs the in-process pool at matched geometry.
+
+    Same payload set, same worker count, same null sink, same
+    tensorizer width — the ONLY difference is the door: in-process
+    ``pool.submit(bytes)`` vs real sockets through native framing
+    into the same pool. Fat payloads (default 4096 spans/request) are
+    deliberate: the gate is about the steady-state span path, and a
+    49-byte request would measure connection scheduling, not ingest.
+    Returns None when the native decoder or front door can't build.
+    """
+    if not native.available() or not native.frontdoor_available():
+        return None
+    from .frontdoor import FrontDoorServer
+
+    if payloads is None:
+        payloads = make_payloads(n_requests, spans_per_request)
+    pool_rate = measure_pooled(
+        workers=workers, repeat=repeat, passes=16, coalesce=64,
+        payloads=payloads,
+        n_requests=n_requests, spans_per_request=spans_per_request,
+    )
+    if pool_rate is None:
+        return None
+
+    tz = SpanTensorizer(num_services=32)
+    sink = lambda cols: None  # noqa: E731 — matched with measure_pooled
+    pool = IngestPool(
+        sink, tz, workers=workers, coalesce_max=64,
+        max_pending=max(clients * depth * 4, 256),
+    )
+    fd = FrontDoorServer(
+        pool,
+        port=0,
+        max_body_bytes=64 << 20,
+        batch_max=64,
+        max_conns=clients + 4,
+    )
+    try:
+        # Warmup off the clock: size scratch, fault in the whole path.
+        warm = _run_frontdoor_clients(
+            fd.port, payloads, min(seconds, 1.0), clients, depth
+        )
+        timed = _run_frontdoor_clients(
+            fd.port, payloads, seconds, clients, depth
+        )
+    finally:
+        fd.stop()
+        pool.close()
+    fd_rate = (
+        timed.get("ok", 0) * spans_per_request / timed["elapsed"]
+        if timed.get("ok") else 0.0
+    )
+    return {
+        "workers": workers,
+        "spans_per_request": spans_per_request,
+        "clients": clients,
+        "pipeline_depth": depth,
+        "pool_spans_per_sec": round(pool_rate, 1),
+        "frontdoor_spans_per_sec": round(fd_rate, 1),
+        "frontdoor_vs_pool": round(fd_rate / pool_rate, 4) if pool_rate else None,
+        "requests_ok": timed.get("ok", 0),
+        "requests_sent": timed.get("sent", 0),
+        "warmup_ok": warm.get("ok", 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# million-key soak
+# ---------------------------------------------------------------------------
+
+def _rss_kb() -> int | None:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    try:
+        import resource
+
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    except Exception:  # noqa: BLE001 — RSS is best-effort off-linux
+        return None
+
+
+def measure_million_key_soak(
+    target_keys: int = 1_048_576,
+    services_per_request: int = 4096,
+    tenants: int = 16,
+    workers: int = 2,
+    num_services: int = 4096,
+    batch: int = 4096,
+    via_frontdoor: bool = True,
+    clients: int = 2,
+) -> dict | None:
+    """Drive ``target_keys`` DISTINCT (tenant × service) keys through
+    ingest → sketch → query and report what scale actually costs.
+
+    Payloads are generated in waves (a resident list of a million-key
+    corpus would bill its own footprint to the thing under test);
+    every wave goes through the REAL path — front door sockets when
+    the native library is up, ``pool.submit`` otherwise — into a real
+    ``DetectorPipeline`` + device sketch step, then the query-side
+    checks run against the drained state:
+
+    - ``distinct_interned`` must equal ``target_keys`` EXACTLY (the
+      intern table is exact, not probabilistic — any gap is
+      corruption, and the soak fails loudly);
+    - a re-intern of a sample must return the same ids (read-back
+      identity after a million publications);
+    - sketch ids past ``num_services`` fold into the overflow bucket
+      by contract — ``overflow_keys`` reports how many, because a soak
+      that silently dropped 99% of its keys would be a lie;
+    - ``merge_shard_arrays`` must still REFUSE a drifted geometry at
+      this table size (``drift_refused``);
+    - ``frames_corrupt`` must be 0 across every pooled flush.
+
+    RSS is sampled before generation and after the final drain;
+    ``rss_per_million_keys_mb`` is the headline the regression bound
+    watches.
+    """
+    if not native.available():
+        return None
+    import numpy as np
+
+    from ..models.detector import AnomalyDetector, DetectorConfig
+    from .frontdoor import FrontDoorServer
+    from .pipeline import DetectorPipeline
+
+    n_requests = -(-target_keys // services_per_request)
+    total_keys = n_requests * services_per_request
+    rss_before = _rss_kb()
+
+    config = DetectorConfig(
+        num_services=num_services, hll_p=8, cms_width=1024
+    )
+    det = AnomalyDetector(config)
+    reports = [0]
+    pipe = DetectorPipeline(
+        det,
+        on_report=lambda t, r, flagged: reports.__setitem__(
+            0, reports[0] + 1
+        ),
+        batch_size=batch,
+    )
+    pool = IngestPool(
+        pipe.submit_columns, pipe.tensorizer, workers=workers,
+        coalesce_max=64, max_pending=512,
+    )
+    use_fd = via_frontdoor and native.frontdoor_available()
+    fd = (
+        FrontDoorServer(pool, port=0, max_body_bytes=64 << 20,
+                        max_conns=clients + 2)
+        if use_fd else None
+    )
+
+    pump_stop = threading.Event()
+
+    def pump_loop() -> None:
+        while not pump_stop.is_set():
+            pipe.pump()
+            time.sleep(0.001)
+
+    pump = threading.Thread(target=pump_loop, name="soak-pump", daemon=True)
+    pump.start()
+
+    def ship(wave: list[bytes]) -> None:
+        if fd is not None:
+            counts: dict = {}
+            lock = threading.Lock()
+            # One pass over the wave per client lane, no repeat loop:
+            # _post_loop cycles forever, so ship waves directly here.
+            per = -(-len(wave) // clients)
+            lanes = [wave[i * per:(i + 1) * per] for i in range(clients)]
+
+            def lane(payloads: list[bytes]) -> None:
+                s = socket.create_connection(("127.0.0.1", fd.port))
+                s.settimeout(60.0)
+                try:
+                    for p in payloads:
+                        s.sendall(
+                            b"POST /v1/traces HTTP/1.1\r\nHost: soak\r\n"
+                            b"Content-Length: %d\r\n\r\n" % len(p) + p
+                        )
+                        buf = b""
+                        while b"\r\n\r\n" not in buf:
+                            chunk = s.recv(65536)
+                            if not chunk:
+                                raise ConnectionError("closed")
+                            buf += chunk
+                        with lock:
+                            if buf.split(b" ", 2)[1] == b"200":
+                                counts["ok"] = counts.get("ok", 0) + 1
+                finally:
+                    s.close()
+
+            threads = [
+                threading.Thread(target=lane, args=(ln,), daemon=True)
+                for ln in lanes if ln
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300.0)
+        else:
+            for p in wave:
+                while True:
+                    try:
+                        pool.submit(p)
+                        break
+                    except IngestPoolSaturated:
+                        pipe.pump()
+                        time.sleep(0.001)
+
+    t0 = time.perf_counter()
+    wave_requests = max(1, (32 << 20) // (services_per_request * 120))
+    shipped = 0
+    try:
+        while shipped < n_requests:
+            n = min(wave_requests, n_requests - shipped)
+            wave = make_fleet_payloads(
+                n, services_per_request, tenants,
+                start_index=shipped * services_per_request,
+            )
+            ship(wave)
+            shipped += n
+            pipe.pump()
+        pool.drain()
+        pipe.pump()
+        pipe.drain()
+    finally:
+        if fd is not None:
+            fd.stop()
+        pump_stop.set()
+        pump.join(timeout=10.0)
+        pool_stats = pool.stats()
+        pool.close()
+    elapsed = time.perf_counter() - t0
+    rss_after = _rss_kb()
+
+    tz = pipe.tensorizer
+    distinct = len(tz.service_names)
+    # Read-back identity: a sample of generated keys must ALREADY be
+    # in the published snapshot (nothing lost across a million
+    # publications) and a batched re-intern of known names must agree
+    # with it without assigning anything new.
+    sample = [
+        f"t{(k % tenants):02d}.svc-{k:07d}"
+        for k in range(0, total_keys, max(total_keys // 1024, 1))
+    ]
+    snap = tz._svc_snapshot  # noqa: SLF001 — the lock-free read surface
+    readback_ok = all(n in snap for n in sample) and (
+        tz.intern_many(sample) == [snap[n] for n in sample]
+    )
+    overflow_keys = max(distinct - (num_services - 1), 0)
+
+    # Fleet drift refusal at scale: a shard whose sketch geometry
+    # drifted by one row must still be REFUSED when the shared table
+    # is a million keys deep.
+    from .fleet import ShardMergeError, merge_shard_arrays
+
+    rows = max(num_services, 1 << 14)
+    a = {"cms_bank": np.ones((rows, 64), np.int32)}
+    b = {"cms_bank": np.ones((rows + 1, 64), np.int32)}
+    try:
+        merge_shard_arrays(a, b)
+        drift_refused = False
+    except ShardMergeError:
+        drift_refused = True
+
+    keys_m = total_keys / ONE_MILLION
+    rss_delta_mb = (
+        (rss_after - rss_before) / 1024.0
+        if rss_after is not None and rss_before is not None else None
+    )
+    return {
+        "target_keys": target_keys,
+        "distinct_keys": total_keys,
+        "distinct_interned": distinct,
+        "intern_exact": bool(distinct == total_keys),
+        "readback_ok": bool(readback_ok),
+        "overflow_keys": int(overflow_keys),
+        "sketch_num_services": num_services,
+        "tenants": tenants,
+        "reports": reports[0],
+        "frames_corrupt": int(pool_stats.get("frames_corrupt", 0)),
+        "decode_errors": int(pool_stats.get("decode_errors", 0)),
+        "drift_refused": bool(drift_refused),
+        "via_frontdoor": bool(use_fd),
+        "elapsed_s": round(elapsed, 2),
+        "keys_per_sec": round(total_keys / elapsed, 1),
+        "rss_before_kb": rss_before,
+        "rss_after_kb": rss_after,
+        "rss_per_million_keys_mb": (
+            round(rss_delta_mb / keys_m, 1)
+            if rss_delta_mb is not None else None
+        ),
+        "soak_ok": bool(
+            distinct == total_keys
+            and readback_ok
+            and drift_refused
+            and pool_stats.get("frames_corrupt", 0) == 0
+        ),
+    }
+
+
+def main() -> None:
+    import json
+    import os
+
+    perf = measure_frontdoor_vs_pool(
+        workers=int(os.environ.get("BENCH_FRONTDOOR_WORKERS", "2")),
+        seconds=float(os.environ.get("BENCH_FRONTDOOR_SECONDS", "4.0")),
+    )
+    soak = measure_million_key_soak(
+        target_keys=int(
+            os.environ.get("BENCH_FRONTDOOR_KEYS", str(1_048_576))
+        ),
+    )
+    eligible = (os.cpu_count() or 1) >= 2
+    print(
+        json.dumps(
+            {
+                "metric": "frontdoor_vs_pool_and_million_key_soak",
+                "frontdoor": perf or {},
+                "soak": soak or {},
+                # Same null-when-ineligible convention as bench.py's
+                # decode_wall_ok: on a 1-core box neither door can
+                # overlap anything, so pass/fail is unmeasurable.
+                "frontdoor_ok": (
+                    bool(
+                        perf["frontdoor_spans_per_sec"]
+                        >= perf["pool_spans_per_sec"]
+                    )
+                    if perf is not None and eligible else None
+                ),
+                "soak_ok": (soak or {}).get("soak_ok"),
+            },
+            sort_keys=True,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

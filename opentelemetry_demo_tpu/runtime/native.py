@@ -257,8 +257,42 @@ def _configure_shipping(lib: ctypes.CDLL) -> None:
     ]
 
 
+def _configure_frontdoor(lib: ctypes.CDLL) -> None:
+    # The native HTTP front door (frontdoor.cc): acceptor + per-conn
+    # threads live entirely on the C side; these entry points are the
+    # pump's batch drain and verdict write-back. otd_fd_next blocks
+    # with the GIL released (ctypes.CDLL — the same contract as the
+    # decode calls), so a waiting pump costs the interpreter nothing.
+    lib.otd_fd_start.restype = ctypes.c_int64
+    lib.otd_fd_start.argtypes = [
+        ctypes.c_int32, ctypes.c_int64,             # port, max_body
+        ctypes.c_int32, ctypes.c_int64,             # max_conns, hdr_timeout
+    ]
+    lib.otd_fd_port.restype = ctypes.c_int32
+    lib.otd_fd_port.argtypes = [ctypes.c_int64]
+    lib.otd_fd_next.restype = ctypes.c_int64
+    lib.otd_fd_next.argtypes = [
+        ctypes.c_int64,                             # handle
+        ctypes.c_void_p, ctypes.c_void_p,           # ids, kinds
+        ctypes.c_void_p, ctypes.c_void_p,           # ptrs, lens
+        ctypes.c_int64, ctypes.c_int64,             # max_n, timeout_ms
+    ]
+    lib.otd_fd_respond.restype = ctypes.c_int32
+    lib.otd_fd_respond.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,             # handle, req id
+        ctypes.c_int32, ctypes.c_int32,             # status, retry_after
+    ]
+    lib.otd_fd_stats.restype = None
+    lib.otd_fd_stats.argtypes = [ctypes.c_int64, ctypes.c_void_p]
+    lib.otd_fd_quiesce.restype = None
+    lib.otd_fd_quiesce.argtypes = [ctypes.c_int64]
+    lib.otd_fd_stop.restype = None
+    lib.otd_fd_stop.argtypes = [ctypes.c_int64]
+
+
 _CONFIGURE = {
     "ingest": _configure_ingest,
+    "frontdoor": _configure_frontdoor,
     "currency": _configure_currency,
     "shipping": _configure_shipping,
 }
@@ -280,6 +314,140 @@ def load_error() -> str | None:
 
 def currency_available() -> bool:
     return _lib_for("currency") is not None
+
+
+def frontdoor_available() -> bool:
+    return _lib_for("frontdoor") is not None
+
+
+def frontdoor_load_error() -> str | None:
+    _lib_for("frontdoor")
+    return _errors.get("frontdoor")
+
+
+# Signal kinds a front-door ticket carries (frontdoor.cc constants):
+# the pump routes traces to the decode pool's pointer path and
+# metrics/logs — scrape-cadence traffic — to the Python decoders.
+FD_KIND_TRACES = 0
+FD_KIND_METRICS = 1
+FD_KIND_LOGS = 2
+
+# otd_fd_stats slot names, in the C enum's order (frontdoor.cc
+# StatIdx) — keep in sync.
+FD_STAT_NAMES = (
+    "accepted", "live_conns", "enqueued", "pending", "bad_length",
+    "oversized", "chunked", "truncated", "disconnect", "overcap",
+    "health", "notfound", "bytes_in", "responded",
+)
+
+
+class FrontDoorBatch(NamedTuple):
+    """Reusable drain buffers for :func:`frontdoor_next` — allocated
+    once per pump so the steady-state drain performs zero numpy
+    allocations."""
+
+    ids: np.ndarray  # int64[max_n] — ticket ids
+    kinds: np.ndarray  # int32[max_n] — FD_KIND_*
+    ptrs: np.ndarray  # uint64[max_n] — native body addresses
+    lens: np.ndarray  # int64[max_n] — body lengths
+
+
+def frontdoor_alloc_batch(max_n: int) -> FrontDoorBatch:
+    return FrontDoorBatch(
+        np.empty(max_n, np.int64), np.empty(max_n, np.int32),
+        np.empty(max_n, np.uint64), np.empty(max_n, np.int64),
+    )
+
+
+def frontdoor_start(
+    port: int, max_body: int, max_conns: int = 64,
+    header_timeout_ms: int = 10000,
+) -> int:
+    """Start a native front door; returns the server handle.
+
+    Raises ``RuntimeError`` when the library is unavailable or the
+    port cannot be bound (the daemon surfaces either as a boot error —
+    an opt-in front door that silently didn't bind would make the
+    operator think the fast path is serving).
+    """
+    lib = _lib_for("frontdoor")
+    if lib is None:
+        raise RuntimeError(
+            f"native frontdoor unavailable: {frontdoor_load_error()}"
+        )
+    h = lib.otd_fd_start(
+        int(port), int(max_body), int(max_conns), int(header_timeout_ms)
+    )
+    if h < 0:
+        raise RuntimeError(f"frontdoor bind failed on port {port}")
+    return int(h)
+
+
+def frontdoor_port(handle: int) -> int:
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    return int(lib.otd_fd_port(int(handle)))
+
+
+def frontdoor_next(
+    handle: int, batch: FrontDoorBatch, timeout_ms: int = 100
+) -> int:
+    """Drain up to ``len(batch.ids)`` complete request tickets into
+    ``batch`` (blocking up to ``timeout_ms`` with the GIL released).
+    Returns the count, 0 on timeout, or -1 once the server is stopping
+    and the queue is empty — the pump's exit signal."""
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    return int(lib.otd_fd_next(
+        int(handle), batch.ids.ctypes.data, batch.kinds.ctypes.data,
+        batch.ptrs.ctypes.data, batch.lens.ctypes.data,
+        batch.ids.shape[0], int(timeout_ms),
+    ))
+
+
+def frontdoor_body(ptr: int, length: int) -> ctypes.Array:
+    """Borrow a ticket's native body buffer as a ctypes view — len()
+    and the decode pointer path both work on it, with ZERO copy. The
+    buffer stays valid until :func:`frontdoor_respond` for its id (the
+    frontdoor.cc ownership rule); callers must respond only after the
+    decode consumed the bytes."""
+    return (ctypes.c_char * int(length)).from_address(int(ptr))
+
+
+def frontdoor_respond(
+    handle: int, req_id: int, status: int, retry_after: int = 0
+) -> None:
+    """Deliver the verdict for a ticket: the native side writes the
+    canned response and recycles the body buffer."""
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    lib.otd_fd_respond(
+        int(handle), int(req_id), int(status), int(retry_after)
+    )
+
+
+def frontdoor_stats(handle: int) -> dict[str, int]:
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    out = np.zeros(len(FD_STAT_NAMES), np.int64)
+    lib.otd_fd_stats(int(handle), out.ctypes.data)
+    return {k: int(v) for k, v in zip(FD_STAT_NAMES, out)}
+
+
+def frontdoor_quiesce(handle: int) -> None:
+    """Graceful-drain phase 1: stop accepting; queued tickets keep
+    flowing to the pump, new requests answer 503."""
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    lib.otd_fd_quiesce(int(handle))
+
+
+def frontdoor_stop(handle: int) -> None:
+    """Full stop: 503 every still-queued ticket, wake the pump
+    (frontdoor_next returns -1), join every native thread."""
+    lib = _lib_for("frontdoor")
+    assert lib is not None
+    lib.otd_fd_stop(int(handle))
 
 
 _INT64_MIN, _INT64_MAX = -(1 << 63), (1 << 63) - 1
@@ -553,7 +721,19 @@ def decode_otlp_many(
     if lib is None:
         raise RuntimeError(f"native ingest unavailable: {load_error()}")
     n_payloads = len(payloads)
-    bufs = (ctypes.c_char_p * max(n_payloads, 1))(*payloads)
+    try:
+        bufs = (ctypes.c_char_p * max(n_payloads, 1))(*payloads)
+    except TypeError:
+        # Buffer-backed payloads (the front door's native body views):
+        # cast the address instead of copying — the borrowed-pointer
+        # contract is identical, the owner (frontdoor.cc) keeps the
+        # buffer alive until its ticket is answered.
+        bufs = (ctypes.c_char_p * max(n_payloads, 1))()
+        for i, p in enumerate(payloads):
+            bufs[i] = (
+                p if isinstance(p, bytes)
+                else ctypes.cast(p, ctypes.c_char_p)
+            )
     lens = np.fromiter(
         map(len, payloads), np.uint64, count=n_payloads
     ) if n_payloads else np.zeros(1, np.uint64)

@@ -921,6 +921,46 @@ PROVENANCE_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Native front-door knobs (runtime/frontdoor.py + native/frontdoor.cc:
+# the zero-Python OTLP/HTTP acceptor that recv's request bodies into
+# native buffers and tickets them straight to the decode pool).
+# Strictly OPT-IN: enable defaults to 0 and the Python receiver stays
+# the default path — the front door is a second, faster door into the
+# SAME bounded admission queue, never a replacement contract. Values
+# must stay literals (sanitycheck reads via ast.literal_eval).
+FRONTDOOR_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_FRONTDOOR_ENABLE": (
+        "int", 0,
+        "1 = start the native OTLP/HTTP front door (socket→scratch→"
+        "scan, zero Python per payload); 0 (default) = Python "
+        "receiver only — opt-in, never implicit",
+    ),
+    "ANOMALY_FRONTDOOR_PORT": (
+        "int", 4316,
+        "front-door listen port (distinct from the Python receiver's "
+        "4318 — both can serve at once during migration; 0 = ephemeral "
+        "for tests)",
+    ),
+    "ANOMALY_FRONTDOOR_PUMPS": (
+        "int", 1,
+        "verdict-pump threads draining native tickets into the decode "
+        "pool (each drains whole batches per GIL-released call; 1 is "
+        "enough below ~10 Gb/s of OTLP)",
+    ),
+    "ANOMALY_FRONTDOOR_BATCH": (
+        "int", 64,
+        "max tickets one pump drain hands the decode pool before "
+        "resolving verdicts (mirrors ANOMALY_INGEST_COALESCE: an idle "
+        "stream still sees single-request latency)",
+    ),
+    "ANOMALY_FRONTDOOR_MAX_CONNS": (
+        "int", 64,
+        "concurrent front-door connections; the acceptor answers 503 "
+        "past the cap instead of queueing unbounded sockets",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -932,7 +972,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
     "FLEET_KNOBS", "AUTOSCALE_KNOBS", "SHADOW_KNOBS",
-    "PROVENANCE_KNOBS",
+    "PROVENANCE_KNOBS", "FRONTDOOR_KNOBS",
 )
 
 
@@ -1042,6 +1082,24 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "shadow-vs-replaybench bit-identity at >= ANOMALY_SHADOW_RATE "
         "x wall, collector keep-ratio measurement; lifts "
         "preflight_refusal_ok and preflight_verdict_s)",
+    ),
+    "BENCH_FRONTDOOR": (
+        "int", 1,
+        "0 skips the native front-door leg (runtime.frontdoorbench: "
+        "front-door spans/s vs the in-process pool at matched "
+        "workers + the >=1M-distinct-key cardinality soak; lifts "
+        "frontdoor_ok and frontdoor_soak_ok)",
+    ),
+    "BENCH_FRONTDOOR_WORKERS": (
+        "int", 2, "front-door bench decode workers per side",
+    ),
+    "BENCH_FRONTDOOR_SECONDS": (
+        "float", 4.0, "front-door vs pool timed-run duration",
+    ),
+    "BENCH_FRONTDOOR_KEYS": (
+        "int", 1048576,
+        "distinct (tenant x service) keys the cardinality soak must "
+        "push through ingest->sketch->query",
     ),
 }
 
@@ -1603,6 +1661,30 @@ def provenance_config() -> dict[str, int | float | str]:
             "ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS="
             f"{out['ANOMALY_PROVENANCE_TRAJECTORY_WINDOWS']} "
             "must be >= 1"
+        )
+    return out
+
+
+def frontdoor_config() -> dict[str, int | float | str]:
+    """Resolve every FRONTDOOR_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the pump/batch
+    shapes — a zero pump count would accept connections whose tickets
+    nobody ever drains, and must refuse to boot instead."""
+    out = _resolve(FRONTDOOR_KNOBS)
+    if int(out["ANOMALY_FRONTDOOR_PUMPS"]) < 1:
+        raise ConfigError(
+            "ANOMALY_FRONTDOOR_PUMPS="
+            f"{out['ANOMALY_FRONTDOOR_PUMPS']} must be >= 1"
+        )
+    if int(out["ANOMALY_FRONTDOOR_BATCH"]) < 1:
+        raise ConfigError(
+            "ANOMALY_FRONTDOOR_BATCH="
+            f"{out['ANOMALY_FRONTDOOR_BATCH']} must be >= 1"
+        )
+    if int(out["ANOMALY_FRONTDOOR_MAX_CONNS"]) < 1:
+        raise ConfigError(
+            "ANOMALY_FRONTDOOR_MAX_CONNS="
+            f"{out['ANOMALY_FRONTDOOR_MAX_CONNS']} must be >= 1"
         )
     return out
 
