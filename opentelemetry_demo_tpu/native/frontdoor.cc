@@ -106,7 +106,11 @@ struct Server;
 
 struct Conn {
   Server* srv = nullptr;
-  int fd = -1;
+  // Atomic because the acceptor's reaper partitions on fd != -1 with
+  // no lock; teardown (exchange → shutdown → close) and otd_fd_stop's
+  // wake-up shutdown additionally serialize under verdict_mu so stop
+  // can never shutdown() an fd number the kernel already recycled.
+  std::atomic<int> fd{-1};
   std::thread thread;
   // Buffered reader state: bytes recv'd but not yet consumed (the
   // pipelining holdover).
@@ -216,7 +220,7 @@ bool write_response(int fd, int status, int retry_after, bool close_conn) {
 int fill_rbuf(Conn* c, Clock::time_point deadline) {
   if (Clock::now() >= deadline) return -1;
   char tmp[kReadChunk];
-  ssize_t r = ::recv(c->fd, tmp, sizeof(tmp), 0);
+  ssize_t r = ::recv(c->fd.load(), tmp, sizeof(tmp), 0);
   if (r > 0) {
     c->rbuf.append(tmp, static_cast<size_t>(r));
     c->srv->stats[kStatBytesIn] += r;
@@ -285,6 +289,9 @@ int64_t parse_length(const std::string& s) {
 // close (error, Connection: close, or drain).
 bool serve_one(Conn* c) {
   Server* s = c->srv;
+  // Only this thread ever changes c->fd, so one load is stable for
+  // the whole request cycle.
+  const int fd = c->fd.load();
   auto deadline =
       Clock::now() + std::chrono::milliseconds(s->header_timeout_ms);
 
@@ -295,7 +302,7 @@ bool serve_one(Conn* c) {
     if (hdr_end != std::string::npos) break;
     if (c->rbuf.size() - c->rpos > kMaxHeaderBytes) {
       s->stats[kStatBadLength]++;
-      write_response(c->fd, 400, 0, true);
+      write_response(fd, 400, 0, true);
       return false;
     }
     int r = fill_rbuf(c, deadline);
@@ -323,7 +330,7 @@ bool serve_one(Conn* c) {
   size_t sp2 = line.find(' ', sp1 == std::string::npos ? 0 : sp1 + 1);
   if (sp1 == std::string::npos || sp2 == std::string::npos) {
     s->stats[kStatBadLength]++;
-    write_response(c->fd, 400, 0, true);
+    write_response(fd, 400, 0, true);
     return false;
   }
   std::string method = line.substr(0, sp1);
@@ -335,19 +342,23 @@ bool serve_one(Conn* c) {
   if (iequals(conn_hdr, "close")) keep_alive = false;
 
   if (method == "GET") {
-    c->rpos = body_start;
+    // Erase the consumed request like the POST path does — advancing
+    // rpos alone would let a keep-alive /healthz prober grow rbuf
+    // without bound.
+    c->rbuf.erase(0, body_start);
+    c->rpos = 0;
     if (path == "/healthz") {
       s->stats[kStatHealth]++;
-      write_response(c->fd, 200, 0, !keep_alive);
+      write_response(fd, 200, 0, !keep_alive);
     } else {
       s->stats[kStatNotFound]++;
-      write_response(c->fd, 404, 0, !keep_alive);
+      write_response(fd, 404, 0, !keep_alive);
     }
     return keep_alive;
   }
   if (method != "POST") {
     s->stats[kStatNotFound]++;
-    write_response(c->fd, 404, 0, true);
+    write_response(fd, 404, 0, true);
     return false;
   }
 
@@ -359,14 +370,14 @@ bool serve_one(Conn* c) {
     // close — the chunked body bytes must not be parsed as a next
     // request.
     s->stats[kStatChunked]++;
-    write_response(c->fd, 400, 0, true);
+    write_response(fd, 400, 0, true);
     return false;
   }
   std::string cl = header_value(head, "Content-Length");
   int64_t length = cl.empty() ? 0 : parse_length(cl);
   if (length < 0) {
     s->stats[kStatBadLength]++;
-    write_response(c->fd, 400, 0, true);
+    write_response(fd, 400, 0, true);
     return false;
   }
   if (length > s->max_body) {
@@ -375,7 +386,7 @@ bool serve_one(Conn* c) {
     // 413 is itself a resource fault) and close so the unread
     // remainder can't be parsed as a next request.
     s->stats[kStatOversized]++;
-    write_response(c->fd, 413, 0, true);
+    write_response(fd, 413, 0, true);
     return false;
   }
 
@@ -397,8 +408,19 @@ bool serve_one(Conn* c) {
   c->rbuf.erase(0, body_start + have);
   c->rpos = 0;
   size_t filled = have;
+  // Total-deadline for the body too (SO_RCVTIMEO alone resets per
+  // trickled byte — the slowloris guard must cover both phases):
+  // header-timeout grace plus a floor transfer rate of ~8 KiB/s, so a
+  // one-byte-per-9s trickler is bounded while a slow legitimate
+  // exporter on a thin link is not cut off.
+  auto body_deadline = Clock::now() + std::chrono::milliseconds(
+                           s->header_timeout_ms + length / 8);
   while (filled < static_cast<size_t>(length)) {
-    ssize_t r = ::recv(c->fd, c->body.data() + filled,
+    if (Clock::now() >= body_deadline) {
+      s->stats[kStatDisconnect]++;
+      return false;
+    }
+    ssize_t r = ::recv(fd, c->body.data() + filled,
                        static_cast<size_t>(length) - filled, 0);
     if (r > 0) {
       filled += static_cast<size_t>(r);
@@ -410,7 +432,7 @@ bool serve_one(Conn* c) {
       // Truncated frame: the client promised more bytes than it sent
       // (died mid-upload). 4xx, not a crash — otlp.py's verdict.
       s->stats[kStatTruncated]++;
-      write_response(c->fd, 400, 0, true);
+      write_response(fd, 400, 0, true);
     } else {
       // Timeout or reset mid-body: nothing to answer.
       s->stats[kStatDisconnect]++;
@@ -418,10 +440,10 @@ bool serve_one(Conn* c) {
     return false;
   }
 
-  if (s->stopping.load() || s->quiesced.load()) {
+  if (s->quiesced.load() || s->stopping.load()) {
     // Draining: no new work enters the pump. 503 is the OTLP
     // retryable status — the exporter resends to the successor.
-    write_response(c->fd, 503, 1, true);
+    write_response(fd, 503, 1, true);
     return false;
   }
 
@@ -435,7 +457,18 @@ bool serve_one(Conn* c) {
     c->done = false;
   }
   {
-    std::lock_guard<std::mutex> lk(s->mu);
+    // The stopping re-check MUST happen under s->mu: otd_fd_stop sets
+    // stopping before taking s->mu for its ready/by_id 503 flush, so a
+    // ticket either lands before the flush (and is flushed) or the
+    // check here observes stopping and refuses — no ticket can be
+    // enqueued after the flush with nobody left to answer it (which
+    // would strand this thread on verdict_cv and hang stop's join).
+    std::unique_lock<std::mutex> lk(s->mu);
+    if (s->stopping.load()) {
+      lk.unlock();
+      write_response(fd, 503, 1, true);
+      return false;
+    }
     s->by_id[id] = c;
     s->ready.push_back(Ticket{id, kind, c->body.data(),
                               static_cast<int64_t>(length)});
@@ -462,7 +495,7 @@ bool serve_one(Conn* c) {
     std::vector<uint8_t>().swap(c->body);
   }
   bool close_now = !keep_alive || s->stopping.load();
-  if (!write_response(c->fd, status, retry_after, close_now)) {
+  if (!write_response(fd, status, retry_after, close_now)) {
     s->stats[kStatDisconnect]++;
     return false;
   }
@@ -472,19 +505,26 @@ bool serve_one(Conn* c) {
 void conn_loop(Conn* c) {
   // Per-recv bound so a dead peer can't pin the thread; the overall
   // header deadline in serve_one handles the trickle case.
+  const int fd = c->fd.load();
   struct timeval tv;
   tv.tv_sec = static_cast<time_t>(c->srv->header_timeout_ms / 1000);
   tv.tv_usec =
       static_cast<suseconds_t>((c->srv->header_timeout_ms % 1000) * 1000);
-  setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   int one = 1;
-  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   while (!c->srv->stopping.load()) {
     if (!serve_one(c)) break;
   }
-  ::shutdown(c->fd, SHUT_RDWR);
-  ::close(c->fd);
-  c->fd = -1;
+  {
+    // Publish -1 and close under verdict_mu: otd_fd_stop's wake-up
+    // shutdown() takes the same mutex, so it can never race this
+    // close and hit a kernel-recycled fd number.
+    std::lock_guard<std::mutex> lk(c->verdict_mu);
+    c->fd.store(-1);
+    ::shutdown(fd, SHUT_RDWR);
+    ::close(fd);
+  }
   c->srv->stats[kStatLiveConns]--;
 }
 
@@ -701,7 +741,22 @@ void otd_fd_stop(int64_t h) {
     conns.swap(s->conns);
   }
   for (Conn* c : conns) {
-    if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+    std::lock_guard<std::mutex> lk(c->verdict_mu);
+    // Under verdict_mu the conn thread's exchange(-1)+close teardown
+    // cannot interleave, so this shutdown() can never hit an fd number
+    // the kernel already recycled for another descriptor.
+    int fd = c->fd.load();
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    // Belt-and-suspenders vs a stranded waiter: resolve, don't just
+    // notify — a bare notify_all leaves the wait predicate (done)
+    // false and the join below would hang forever. The enqueue-time
+    // stopping re-check makes this unreachable in practice, but a
+    // verdict the pump popped-and-dropped still lands here.
+    if (!c->done) {
+      c->status = 503;
+      c->retry_after = 1;
+      c->done = true;
+    }
     c->verdict_cv.notify_all();
   }
   for (Conn* c : conns) {

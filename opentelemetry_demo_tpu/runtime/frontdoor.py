@@ -13,10 +13,16 @@ Python, because it needs pipeline state:
   in place), metrics/logs take the Python decoders at scrape cadence;
 - the verdict taxonomy, bit-compatible with ``runtime/otlp.py``'s
   receiver: pipeline saturation → 429 + integer Retry-After (rounded
-  up), pool saturation → 429 + Retry-After: 1, a wedged flush →
-  503 + Retry-After: 1, a server-side flush failure → 500, and the
-  per-request DECODE verdict carried by the :class:`DecodeTicket` →
-  400 for exactly the bad request while its batchmates proceed.
+  up), pool saturation → 429 + Retry-After: 1, a server-side flush
+  failure → 500, and the per-request DECODE verdict carried by the
+  :class:`DecodeTicket` → 400 for exactly the bad request while its
+  batchmates proceed. A WEDGED flush gets its verdict DEFERRED, not
+  short-circuited to 503: the pool still holds a zero-copy view of
+  the ticket's native buffer, and ``frontdoor_respond`` is what hands
+  the buffer back to the connection thread for recycling — responding
+  early would let the decode scan freed/reused memory. The ticket is
+  parked on a stalled list the pump re-polls each drain, and the
+  eventual REAL verdict (200/400/500) goes out when the flush lands;
   Metrics/logs stay exempt from the saturation gate (they arrive at
   scrape cadence — the same exemption the Python receiver applies);
 - reject bookkeeping: the natively-decided verdicts (bad_length,
@@ -126,11 +132,22 @@ class FrontDoorServer:
     def _pump(self) -> None:
         batch = native.frontdoor_alloc_batch(self._batch_max)
         pending: list[tuple[int, object]] = []
+        # Tickets whose flush outlived _ticket_timeout_s: the pool
+        # STILL holds a zero-copy view of their native buffers, so the
+        # verdict (and with it the buffer hand-back) is deferred until
+        # the flush actually resolves — see _sweep_stalled.
+        stalled: list[tuple[int, object]] = []
         h = self._handle
         while True:
             n = native.frontdoor_next(h, batch, timeout_ms=100)
             if n < 0:
-                return  # server stopping, queue drained
+                # Server stopping, queue drained. Give any still-
+                # stalled flush one last bounded wait so its verdict
+                # (a no-op respond by now — native stop already
+                # answered the conn 503) marks the buffer released
+                # before this thread exits.
+                self._sweep_stalled(stalled, final=True)
+                return
             for i in range(n):
                 rid = int(batch.ids[i])
                 kind = int(batch.kinds[i])
@@ -148,9 +165,16 @@ class FrontDoorServer:
                     ticket.result(timeout=self._ticket_timeout_s)
                     status, ra = 200, 0
                 except TimeoutError:
-                    # Wedged flush: retryable 503, never a 4xx that
-                    # would make an exporter discard the batch.
-                    status, ra = 503, 1
+                    # Wedged flush: the ticket's buffer is STILL queued
+                    # in the pool. Responding now would return the
+                    # buffer to the connection thread for resize/
+                    # recycle while the decode worker can still scan
+                    # it (use-after-free) — frontdoor_body's contract
+                    # is respond only AFTER the decode consumed the
+                    # bytes. Park it; the verdict goes out on a later
+                    # sweep, when the flush has really resolved.
+                    stalled.append((rid, ticket))
+                    continue
                 except IngestWorkerError:
                     # Server-side flush failure: our bug, not the
                     # client's bytes — 5xx, never "malformed".
@@ -160,8 +184,45 @@ class FrontDoorServer:
                     status, ra = 400, 0
                 native.frontdoor_respond(h, rid, status, ra)
             pending.clear()
+            if stalled:
+                self._sweep_stalled(stalled)
             if n > 0:
                 self._sync_native_rejects()
+
+    def _sweep_stalled(
+        self, stalled: list[tuple[int, object]], final: bool = False
+    ) -> None:
+        """Respond to parked wedged-flush tickets whose flush has since
+        landed (non-blocking poll per ticket; ``final`` blocks one
+        ticket-timeout each — the pump's exit path). Unresolved tickets
+        stay parked: their native buffers are still borrowed by the
+        pool, and ``pending`` in the native stats stays >0 for them,
+        which is what makes ``stop()``'s drain wait cover them too."""
+        kept: list[tuple[int, object]] = []
+        for rid, ticket in stalled:
+            if not final and not ticket.done():
+                kept.append((rid, ticket))
+                continue
+            try:
+                ticket.result(
+                    timeout=self._ticket_timeout_s if final else 0.0
+                )
+                status, ra = 200, 0
+            except TimeoutError:
+                if final:
+                    # Truly wedged past the exit grace: nothing safe
+                    # left to do — dropping the respond keeps our side
+                    # of the never-release-a-borrowed-buffer contract.
+                    continue
+                kept.append((rid, ticket))
+                continue
+            except IngestWorkerError:
+                status, ra = 500, 0
+            except Exception:  # noqa: BLE001 — the decode verdict
+                self._reject("malformed")
+                status, ra = 400, 0
+            native.frontdoor_respond(self._handle, rid, status, ra)
+        stalled[:] = kept
 
     def _admit_trace(
         self, rid: int, ptr: int, ln: int, pending: list
@@ -230,6 +291,11 @@ class FrontDoorServer:
         import time
 
         native.frontdoor_quiesce(self._handle)
+        # "pending" counts every ticket whose conn has not received a
+        # verdict — including pump-parked wedged-flush tickets whose
+        # buffers the pool still borrows — so this wait also keeps the
+        # hard stop (which frees conn buffers) away from live views
+        # for as long as the drain budget allows.
         deadline = time.monotonic() + drain_timeout_s
         while time.monotonic() < deadline:
             if native.frontdoor_stats(self._handle)["pending"] == 0:

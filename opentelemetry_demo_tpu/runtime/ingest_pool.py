@@ -148,6 +148,14 @@ class DecodeTicket:
         if ev is not None:
             ev.set()
 
+    def done(self) -> bool:
+        """Non-blocking: has the request's flush landed (success or
+        error)? Safe from any thread — ``_done`` is published last by
+        the resolver. The front-door pump polls this to defer a
+        wedged-flush verdict instead of abandoning a borrowed native
+        buffer (``runtime/frontdoor.py``)."""
+        return self._done
+
     def result(self, timeout: float = 30.0) -> None:
         """Block until the request's flush lands; re-raise its decode
         error (``ValueError`` for malformed wire data) if any."""

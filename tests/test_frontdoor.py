@@ -579,6 +579,62 @@ class TestControlPlane:
         with pytest.raises(OSError):
             socket.create_connection(("127.0.0.1", port), timeout=2.0)
 
+    def test_frontdoor_wedged_flush_defers_verdict(self):
+        """A flush that outlives the pump's ticket timeout must NOT be
+        short-circuited to an early 503: responding is what returns the
+        native body buffer to the connection thread for recycling, and
+        the pool still holds a zero-copy view of it (use-after-free).
+        The pump parks the ticket and the REAL verdict goes out when
+        the flush finally lands."""
+        from opentelemetry_demo_tpu.runtime.frontdoor import FrontDoorServer
+
+        class _WedgedTicket:
+            def __init__(self):
+                self._ev = threading.Event()
+
+            def done(self):
+                return self._ev.is_set()
+
+            def result(self, timeout=None):
+                if not self._ev.wait(timeout):
+                    raise TimeoutError("wedged flush")
+
+        class _WedgedPool:
+            def __init__(self):
+                self.tickets = []
+
+            def submit(self, payload):
+                t = _WedgedTicket()
+                self.tickets.append(t)
+                return t
+
+        pool = _WedgedPool()
+        fd = FrontDoorServer(
+            pool, port=0, max_body_bytes=MAX_BODY, ticket_timeout_s=0.15
+        )
+        try:
+            req = _http(b"POST", b"/v1/traces", b"\x0a\x00")
+            got: dict = {}
+
+            def client():
+                got["resp"] = _raw_request(fd.port, req, timeout=15.0)
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while not pool.tickets and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert pool.tickets, "request never reached the pool"
+            # Well past the ticket timeout: no premature verdict may
+            # have landed while the buffer is still borrowed.
+            time.sleep(0.6)
+            assert "resp" not in got
+            pool.tickets[0]._ev.set()  # the flush finally resolves
+            t.join(timeout=10.0)
+            assert _status(got.get("resp", b"")) == 200
+        finally:
+            fd.stop()
+
     def test_frontdoor_no_python_http_in_payload_path(self):
         """The zero-Python pin, enforced from inside the suite as well
         as sanitycheck: the front door's module may not import any
