@@ -266,6 +266,7 @@ def main():
     # failure — additive artifact fields.
     frontdoor = {}
     frontdoor_soak = {}
+    churn_soak = {}
     if os.environ.get("BENCH_FRONTDOOR", "1") != "0":
         from opentelemetry_demo_tpu.runtime import frontdoorbench
 
@@ -285,6 +286,12 @@ def main():
             ) or {}
         except Exception:  # noqa: BLE001 — artifact field is optional
             frontdoor_soak = {}
+        try:
+            churn_soak = frontdoorbench.measure_churn_soak(
+                waves=int(os.environ.get("BENCH_CHURN_WAVES", "8")),
+            ) or {}
+        except Exception:  # noqa: BLE001 — artifact field is optional
+            churn_soak = {}
 
     # ---- self-telemetry overhead (the ISSUE 10 canary) ---------------
     # Tracer-on vs tracer-off spinebench A/B with the full production
@@ -574,10 +581,23 @@ def main():
             and (os.cpu_count() or 1) >= 2
             else None
         ),
-        # Million-key soak verdict: exact intern count, read-back
+        # Million-key soak verdict: bounded intern count, read-back
         # identity, drift refusal at scale, zero corrupt frames —
         # computed inside the soak itself (frontdoorbench).
         "frontdoor_soak_ok": frontdoor_soak.get("soak_ok"),
+        # Bounded-memory verdict (r20): RSS per million distinct keys
+        # under SOAK_RSS_CEILING_MB_PER_MILLION (the old append-only
+        # table's measured ~935 MB/M leak is the fail line). None when
+        # RSS is unmeasurable or BENCH_FRONTDOOR_KEYS trimmed the run
+        # below the normalization floor.
+        "soak_rss_ok": frontdoor_soak.get("soak_rss_ok"),
+        # Churn-soak verdict (r20 tentpole gate): ≥3× key budget of
+        # distinct keys with churn through a keyspace-enabled pipeline
+        # — evictions recycling ids under generation bumps, live-key
+        # ids bit-stable, evicted keys answering from history labeled
+        # source:"evicted", generation-drifted fleet merge refused,
+        # zero corrupt frames, steady-state RSS slope ≈ 0.
+        "churn_ok": churn_soak.get("churn_ok"),
     }
 
     print(
@@ -687,6 +707,12 @@ def main():
                 "frontdoor_soak_overflow_keys": frontdoor_soak.get(
                     "overflow_keys"
                 ),
+                "churn_soak_evictions": churn_soak.get("evictions"),
+                "churn_soak_generation": churn_soak.get("generation"),
+                "churn_soak_distinct_streamed": churn_soak.get(
+                    "distinct_streamed"
+                ),
+                "churn_soak_rss_slope_mb": churn_soak.get("rss_slope_mb"),
                 "selftrace_overhead_ratio": selftrace_ab.get("ratio"),
                 "selftrace_spans_per_sec_on": selftrace_ab.get(
                     "spans_per_sec_on"

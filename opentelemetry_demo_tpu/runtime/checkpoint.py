@@ -107,6 +107,7 @@ def save(
     service_names: list[str] | None = None,
     metrics_feed=None,
     epoch: int = 0,
+    generation: int = 0,
     *,
     dispatch_lock,
 ) -> None:
@@ -133,7 +134,7 @@ def save(
         path, state_host, detector.config,
         offsets=offsets, service_names=service_names,
         clock_t_prev=clock_t_prev, metrics_feed=metrics_feed,
-        epoch=epoch,
+        epoch=epoch, generation=generation,
     )
 
 
@@ -146,6 +147,7 @@ def save_state(
     clock_t_prev: float | None = None,
     metrics_feed=None,
     epoch: int = 0,
+    generation: int = 0,
 ) -> None:
     """Snapshot any DetectorState — single-chip or MESH-SHARDED.
 
@@ -182,6 +184,12 @@ def save_state(
         "config": list(config._replace(sketch_impl=None)),
         "clock_t_prev": clock_t_prev,
         "epoch": int(epoch),
+        # Keyspace generation (runtime/keyspace.py): restore adopts it
+        # positionally with the name table — EVICTED_SLOT tombstones in
+        # service_names mark recycled-id holes — so a restored process
+        # refuses generation-drifted frames exactly like the one that
+        # wrote the snapshot.
+        "generation": int(generation),
     }
     if metrics_feed is not None:
         # The metrics-leg head warms in minutes, but a restart must not

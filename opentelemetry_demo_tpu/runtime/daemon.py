@@ -199,6 +199,7 @@ from ..utils.config import (
     history_config,
     history_spans_policy,
     ingest_config,
+    keyspace_config,
     overload_config,
     provenance_config,
     query_config,
@@ -215,6 +216,7 @@ from .flightrec import FlightRecorder
 from .metrics_feed import MetricsFeed
 from .otlp import OtlpHttpReceiver
 from .pipeline import DetectorPipeline
+from .tensorize import EVICTED_SLOT
 from .replication import (
     ROLE_FENCED,
     ROLE_PRIMARY,
@@ -321,6 +323,9 @@ class DetectorDaemon:
         )
         self._query_max_staleness_s = float(
             qk["ANOMALY_QUERY_MAX_STALENESS_S"]
+        )
+        self._query_evicted_lookback_s = float(
+            qk["ANOMALY_QUERY_EVICTED_LOOKBACK_S"]
         )
 
         # Detector self-telemetry (knob registry:
@@ -804,6 +809,54 @@ class DetectorDaemon:
             "Spans ingested by this shard, labeled with its shard id "
             "(the per-shard ingest-rate panel)",
         )
+        self.registry.describe(
+            tele_metrics.ANOMALY_PROCESS_RSS,
+            "Resident set size of this process (VmRSS) — the keyspace "
+            "budget watchdog's denominator",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_ROWS,
+            "Live interned service keys (detector state rows in use)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_CAPACITY,
+            "Intern-table key budget (num_services minus the overflow "
+            "slot)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_FILL,
+            "Intern-table fill fraction (rows/capacity) — the "
+            "keyspace ladder's pressure signal",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_LEVEL,
+            "Keyspace degradation-ladder level: 0 normal, 1 evict "
+            "idle, 2 throttle new keys, 3 collapse new keys to "
+            "overflow, 4 shed ingest (429)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_GENERATION,
+            "Keyspace generation epoch — bumped by every eviction "
+            "sweep; frames refuse to merge across a bump",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_EVICTED,
+            "Idle keys evicted into history (their ids recycled)",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_FREE_IDS,
+            "Retired intern ids awaiting reuse",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_THROTTLED,
+            "New keys refused by the per-tenant admission throttle "
+            "(ladder level 2+), by tenant",
+        )
+        self.registry.describe(
+            tele_metrics.ANOMALY_KEYSPACE_OVERFLOW,
+            "New keys collapsed into the overflow bucket under "
+            "keyspace pressure (ladder level 3+), by tenant",
+        )
         self._exemplars_seen = 0
         # Mint the per-hop corrupt series at zero (like the shed-lane
         # counters): "this number never moved" must be a visible 0.
@@ -933,6 +986,15 @@ class DetectorDaemon:
                 topk=int(pv["ANOMALY_PROVENANCE_TOPK"]),
                 export=bool(self._explain_poster is not None),
             )
+        # Key lifecycle plane (knob registry: utils.config.
+        # KEYSPACE_KNOBS; engine: runtime.keyspace): the pipeline owns
+        # the ladder + per-tenant new-key admission; the manager below
+        # owns the watchdog thread and the idle-key evictor.
+        try:
+            ks = keyspace_config()
+        except ConfigError as e:
+            raise SystemExit(str(e)) from e
+        self._keyspace_cfg = ks
         self.pipeline = DetectorPipeline(
             self.detector,
             flags=flags,
@@ -980,6 +1042,15 @@ class DetectorDaemon:
             # evidence bundles assembled at flag time on the harvester.
             provenance=self.provenance,
             explain_ring=self._provenance_ring,
+            # Key lifecycle ladder (KEYSPACE_KNOBS; runtime.keyspace):
+            # evict idle → throttle new keys per tenant → collapse new
+            # keys to overflow → 429 through every ingest door.
+            keyspace_enable=bool(int(ks["ANOMALY_KEYSPACE_ENABLE"])),
+            keyspace_high_watermark=ks["ANOMALY_KEYSPACE_HIGH_WATERMARK"],
+            keyspace_low_watermark=ks["ANOMALY_KEYSPACE_LOW_WATERMARK"],
+            keyspace_hold_s=ks["ANOMALY_KEYSPACE_HOLD_S"],
+            keyspace_newkey_rate=ks["ANOMALY_KEYSPACE_NEWKEY_RATE"],
+            keyspace_retry_after_s=ks["ANOMALY_KEYSPACE_RETRY_AFTER_S"],
         )
         # Watermark gauges are static config — export once so every
         # scrape can judge anomaly_queue_rows against them; and mint the
@@ -1018,8 +1089,18 @@ class DetectorDaemon:
                 target=self._warm_widths_quietly,
                 name="width-ladder-warmup", daemon=True,
             ).start()
-        for name in restored_names:  # re-intern in checkpoint order
-            self.pipeline.tensorizer.service_id(name)
+        # Positional re-adoption, NOT name-by-name re-interning: a
+        # checkpoint written after an eviction sweep carries
+        # EVICTED_SLOT tombstones, and interning each live name in
+        # sequence would compact past them — shifting every later id
+        # off the sketch rows the restored state holds for it. The
+        # keyspace generation rides along so a restored primary keeps
+        # refusing frames from before its last eviction sweep.
+        self.pipeline.tensorizer.adopt_names(restored_names)
+        if meta is not None:
+            self.pipeline.tensorizer.generation = int(
+                meta.get("generation") or 0
+            )
         for name in self._fleet_services:
             # Fleet mode pre-interns ONE shared service table in knob
             # order on every shard: CMS cells fold the service id into
@@ -1199,6 +1280,37 @@ class DetectorDaemon:
                 spans=self._history_spans,
                 replay_rate=self._history_replay_rate,
             )
+        # Key lifecycle watchdog + evictor (runtime.keyspace): built
+        # after the history tier so eviction fold records have a
+        # writer to land in; started only by a SERVING role (start()
+        # below / promote()) — a standby mirrors the primary's state
+        # verbatim and must not run local eviction sweeps that would
+        # diverge its generation.
+        self.keyspace = None
+        self._keyspace_level_seen = 0
+        self._keyspace_evicted_seen = 0
+        self._keyspace_tenant_seen: dict[str, dict[str, float]] = {
+            "throttled": {}, "overflow": {},
+        }
+        if int(ks["ANOMALY_KEYSPACE_ENABLE"]):
+            from .keyspace import KeyspaceManager
+
+            self.keyspace = KeyspaceManager(
+                self.pipeline,
+                idle_s=ks["ANOMALY_KEYSPACE_IDLE_S"],
+                evict_batch=ks["ANOMALY_KEYSPACE_EVICT_BATCH"],
+                rss_budget_mb=ks["ANOMALY_KEYSPACE_RSS_MB"],
+                protected=self._fleet_services,
+                history_writer=self.history_writer,
+                flight=self.flight,
+            )
+            self.flight.record(
+                "keyspace", op="enabled",
+                capacity=self.pipeline.tensorizer.capacity,
+                idle_s=float(ks["ANOMALY_KEYSPACE_IDLE_S"]),
+                evict_batch=int(ks["ANOMALY_KEYSPACE_EVICT_BATCH"]),
+                rss_budget_mb=float(ks["ANOMALY_KEYSPACE_RSS_MB"]),
+            )
         # Closed-loop auto-mitigation (knob registry:
         # utils.config.REMEDIATION_KNOBS; engine: runtime.remediation).
         # Constructed for EVERY role — a standby observes episodes so a
@@ -1243,8 +1355,14 @@ class DetectorDaemon:
                     rk["ANOMALY_REMEDIATION_COLLECTOR_BASE_KEEP"]
                 ),
                 exemplar_fn=self._exemplars_for,
+                # Tombstoned (evicted) slots are not services — a
+                # sampling rule for one would be noise in the policy.
                 services_fn=(
-                    lambda: self.pipeline.tensorizer.service_names
+                    lambda: [
+                        n
+                        for n in self.pipeline.tensorizer.service_names
+                        if n != EVICTED_SLOT
+                    ]
                 ),
                 timeout_s=rem_timeout_s,
             )
@@ -1490,6 +1608,9 @@ class DetectorDaemon:
                 # range read lands one latency observation.
                 history=self.history_reader,
                 read_observe=self._observe_history_read,
+                # Evicted-key continuity: how far back the fallback
+                # searches history for a name the live table dropped.
+                evicted_lookback_s=self._query_evicted_lookback_s,
             )
             self.query_service = QueryService(
                 self.query_engine, registry=self.registry,
@@ -1702,6 +1823,24 @@ class DetectorDaemon:
                 "failed": self.remediation.failed_services(),
             },
         }
+        # Keyspace block (the cardinality-bomb triage surface): how
+        # full the intern table is, which ladder rung is engaged, and
+        # the generation epoch peers must match to merge frames.
+        # Present even with the evictor disabled — fill + RSS are the
+        # early-warning numbers.
+        if self.keyspace is not None:
+            detail["keyspace"] = self.keyspace.stats()
+        else:
+            tz = self.pipeline.tensorizer
+            detail["keyspace"] = {
+                "level": self.pipeline.keyspace_level,
+                "rows": tz.live_keys,
+                "capacity": tz.capacity,
+                "fill": round(tz.live_keys / max(tz.capacity, 1), 4),
+                "free_ids": tz.free_ids,
+                "generation": tz.generation,
+                "evicted_total": tz.evicted_total,
+            }
         if self.shadow_verifier is not None:
             # Counterfactual gate surface (separate block so the
             # mitigation block's shape stays pinned): verdict counts
@@ -2058,6 +2197,7 @@ class DetectorDaemon:
         self.exporter.start()
         self._start_query_plane()
         self._start_history_writer()
+        self._start_keyspace()
         self._register_serving_components()
         if self._repl_port >= 0:
             self._start_replication_primary()
@@ -2115,6 +2255,11 @@ class DetectorDaemon:
             # this map replays any unconfirmed tail, never skips it.
             "offsets": self._offsets_snapshot(),
             "service_names": self.pipeline.tensorizer.service_names,
+            # Keyspace generation: bumped by every eviction sweep.
+            # Standbys refuse DELTAS from a different generation (the
+            # arrays' slot→service mapping changed under them) and
+            # adopt the new one wholesale from the next snapshot.
+            "generation": self.pipeline.tensorizer.generation,
             "clock_t_prev": clock_t_prev,
             "config": list(
                 self.detector.config._replace(sketch_impl=None)
@@ -2209,6 +2354,30 @@ class DetectorDaemon:
         if self.history_writer is None or self.role == ROLE_FENCED:
             return
         self.history_writer.start()  # idempotent while alive
+
+    def _start_keyspace(self) -> None:
+        """Start + supervise the keyspace watchdog (idempotent):
+        serving roles only — an eviction sweep WRITES detector state
+        and bumps the generation, so a standby running its own sweeps
+        would drift from the primary instead of mirroring it."""
+        if self.keyspace is None:
+            return
+        self.keyspace.start()
+        if not self._supervisor.registered("keyspace"):
+            self._supervisor.register(
+                "keyspace", base_backoff_s=0.5, max_backoff_s=15.0,
+                probe=lambda: (
+                    self.role == ROLE_FENCED
+                    or self.keyspace is None
+                    or self.keyspace.alive()
+                ),
+                restart=self._restart_keyspace,
+            )
+
+    def _restart_keyspace(self) -> None:
+        if self.keyspace is None or self.role == ROLE_FENCED:
+            return
+        self.keyspace.start()  # idempotent while alive
 
     def _observe_history_read(self, seconds: float) -> None:
         from .query import LATENCY_BUCKETS
@@ -2484,6 +2653,10 @@ class DetectorDaemon:
             # History-tier gauges on the same 1 s cadence (they walk
             # the segment dir listing — not per-step work).
             self._export_history_stats()
+            # Keyspace/RSS gauges on the 1 s cadence too (the RSS
+            # sample is a /proc open+scan; the ladder moves on hold_s
+            # timescales, never sub-second).
+            self._export_keyspace_stats()
             # Trend context for any later transition dump: a compact
             # 1 Hz snapshot of where batch time goes right now.
             spine_st = self.pipeline.spine_stats()
@@ -2589,6 +2762,83 @@ class DetectorDaemon:
             # Guarded: a full disk is a degraded snapshot cadence, not
             # a dead detector.
             self._supervisor.run_step("checkpoint", self._checkpoint)
+
+    def _export_keyspace_stats(self) -> None:
+        """anomaly_process_rss_bytes (first-class — the soak bench's
+        VmRSS read promoted to a scrape) + the anomaly_keyspace_*
+        family, delta-based per-tenant counters like the shed exports,
+        and one flight-recorder event per ladder EDGE — the evidence
+        an operator replays after surviving a cardinality bomb."""
+        tz = self.pipeline.tensorizer
+        if self.keyspace is not None and self.keyspace.last_rss:
+            rss = self.keyspace.last_rss
+        else:
+            from .keyspace import process_rss_bytes
+
+            rss = process_rss_bytes()
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_PROCESS_RSS, float(rss)
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_ROWS, float(tz.live_keys)
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_CAPACITY, float(tz.capacity)
+        )
+        fill = tz.live_keys / max(tz.capacity, 1)
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_FILL, float(fill)
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_FREE_IDS, float(tz.free_ids)
+        )
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_GENERATION,
+            float(tz.generation),
+        )
+        level = self.pipeline.keyspace_level
+        self.registry.gauge_set(
+            tele_metrics.ANOMALY_KEYSPACE_LEVEL, float(level)
+        )
+        delta = tz.evicted_total - self._keyspace_evicted_seen
+        if delta:
+            self.registry.counter_add(
+                tele_metrics.ANOMALY_KEYSPACE_EVICTED, float(delta)
+            )
+            self._keyspace_evicted_seen = tz.evicted_total
+        if level != self._keyspace_level_seen:
+            # Every ladder edge (both directions) leaves evidence: the
+            # eviction sweeps themselves record their own events.
+            self.flight.record(
+                "keyspace", op="level",
+                prev=self._keyspace_level_seen, level=level,
+                fill=round(float(fill), 4),
+                rss_mb=round(rss / (1024 * 1024), 1),
+                rows=tz.live_keys, free_ids=tz.free_ids,
+                generation=tz.generation,
+            )
+            self._keyspace_level_seen = level
+        for kind, metric, totals in (
+            (
+                "throttled", tele_metrics.ANOMALY_KEYSPACE_THROTTLED,
+                self.pipeline.stats.newkey_throttled_tenant,
+            ),
+            (
+                "overflow", tele_metrics.ANOMALY_KEYSPACE_OVERFLOW,
+                self.pipeline.stats.overflow_keys_tenant,
+            ),
+        ):
+            seen = self._keyspace_tenant_seen[kind]
+            for tenant, total in list(totals.items()):
+                d = total - seen.get(tenant, 0)
+                if d:
+                    self.registry.counter_add(
+                        metric, float(d), tenant=tenant
+                    )
+                    seen[tenant] = total
+                    self.flight.record(
+                        "keyspace", op=kind, tenant=tenant, keys=int(d),
+                    )
 
     def _export_pool_stats(self) -> None:
         """anomaly_ingest_pool_* gauges/counters from the pool's
@@ -2765,7 +3015,17 @@ class DetectorDaemon:
                     num_rows,
                     owned=owned,
                 )
-                merged = fleet.merge_shard_arrays(dst, src_arrays, mask)
+                merged = fleet.merge_shard_arrays(
+                    dst, src_arrays, mask,
+                    # Keyspace generation drift refuses the merge: a
+                    # victim that ran an eviction sweep we never saw
+                    # has recycled ids our positional mask would
+                    # cross-attribute.
+                    dst_generation=self.pipeline.tensorizer.generation,
+                    src_generation=int(
+                        src_meta.get("generation") or 0
+                    ),
+                )
                 self.detector.state = DetectorState(
                     **{k: jax.device_put(v) for k, v in merged.items()}
                 )
@@ -3160,8 +3420,19 @@ class DetectorDaemon:
                         }
                     )
                     self.detector.clock._t_prev = meta.get("clock_t_prev")
-                for name in meta.get("service_names", []):
-                    self.pipeline.tensorizer.service_id(name)
+                # Positional adoption (the checkpoint-restore rule):
+                # the mirrored table may carry EVICTED_SLOT tombstones
+                # from the old primary's sweeps, and name-by-name
+                # interning would compact past them, shifting ids off
+                # the rows we just hydrated. The generation rides
+                # along so this promoted primary refuses pre-sweep
+                # frames exactly like the one it replaced.
+                self.pipeline.tensorizer.adopt_names(
+                    list(meta.get("service_names", []))
+                )
+                self.pipeline.tensorizer.generation = int(
+                    meta.get("generation") or 0
+                )
                 self._offsets = {
                     int(p): int(o)
                     for p, o in (meta.get("offsets") or {}).items()
@@ -3234,6 +3505,14 @@ class DetectorDaemon:
             logging.getLogger(__name__).exception(
                 "promoted, but the history writer failed to start"
             )
+        try:
+            # The promoted daemon owns eviction duty now, same as
+            # compaction: a standby never ran local sweeps.
+            self._start_keyspace()
+        except Exception:  # noqa: BLE001 — the keyspace plane is optional; ingest must live
+            logging.getLogger(__name__).exception(
+                "promoted, but the keyspace watchdog failed to start"
+            )
         if self.ckpt_path:
             # Durable promotion (and the first fencing artifact the old
             # primary can trip over on a shared volume).
@@ -3275,6 +3554,13 @@ class DetectorDaemon:
             # the volume next.
             try:
                 self.history_writer.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if self.keyspace is not None:
+            # A fenced process must not keep mutating its state tree
+            # (evictions bump the generation — noise for forensics).
+            try:
+                self.keyspace.close()
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         # Stop SERVING too: a fenced replica that kept answering OTLP
@@ -3404,6 +3690,9 @@ class DetectorDaemon:
             service_names=self.pipeline.tensorizer.service_names,
             metrics_feed=self.metrics_feed,
             epoch=self._fence.epoch,
+            # The keyspace generation restores WITH the name table:
+            # a restored primary keeps refusing pre-sweep frames.
+            generation=self.pipeline.tensorizer.generation,
             # The copy-out snapshots under the pipeline's dispatch
             # lock: the width-ladder warmup (and any future background
             # dispatcher) must never donate state mid-read.
@@ -3478,6 +3767,10 @@ class DetectorDaemon:
             self.frontdoor.stop()
         if self._orders is not None:
             self._orders.close()
+        if self.keyspace is not None:
+            # Before the pipeline drains: a sweep mid-drain would race
+            # the final flushes for the dispatch lock for no benefit.
+            self.keyspace.close()
         # Stop the remediation worker before the pipeline drains: no
         # new reports can arrive, and a queued actuation against a dead
         # flagd must not pin shutdown past its bounded retries.

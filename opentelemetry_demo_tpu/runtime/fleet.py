@@ -66,6 +66,7 @@ import numpy as np
 # "flap-proof by construction" guardrail is one implementation, not
 # three lookalikes that could drift.
 from .remediation import TokenBucket
+from .tensorize import EVICTED_SLOT
 
 DEFAULT_TENANT = "default"
 
@@ -318,6 +319,8 @@ def service_row_mask(
     for i, name in enumerate(src_names):
         if i >= num_rows:
             break
+        if name == EVICTED_SLOT:
+            continue  # freed slot: no service owns the row anymore
         if allowed is None or name in allowed:
             mask[i] = True
     return mask
@@ -327,6 +330,9 @@ def merge_shard_arrays(
     dst: dict,
     src: dict,
     head_rows: np.ndarray | None = None,
+    *,
+    dst_generation: int | None = None,
+    src_generation: int | None = None,
 ) -> dict:
     """Monoid-merge a victim shard's replicated arrays into a
     survivor's — the reshard adoption step.
@@ -337,7 +343,25 @@ def merge_shard_arrays(
     (bool [S]) copy from the victim — the survivor's rows for a
     keyspace it never observed are virgin. Returns NEW arrays; neither
     input is mutated (the caller swaps under its own dispatch lock).
+
+    ``dst_generation``/``src_generation`` extend the drift-refusal
+    contract to the key lifecycle plane: a keyspace eviction sweep
+    recycles intern ids behind a generation bump, so two frames whose
+    generations disagree may use the SAME id for DIFFERENT services —
+    merging them would mis-attribute sketch rows with no way to tell.
+    When both are provided they must match; ``None`` (a frame minted
+    before the lifecycle plane) skips the check for compatibility.
     """
+    if (
+        dst_generation is not None
+        and src_generation is not None
+        and int(dst_generation) != int(src_generation)
+    ):
+        raise ShardMergeError(
+            f"keyspace generation drift: dst gen {dst_generation} vs "
+            f"src gen {src_generation} — recycled intern ids cannot "
+            "merge across an eviction sweep"
+        )
     out = {k: np.array(v, copy=True) for k, v in dst.items()}
     for name in MERGE_MAX:
         if name in out and name in src:

@@ -541,6 +541,7 @@ class HistoryWriter:
         self.compactions = 0
         self.windows_recorded = 0
         self.windows_missed = 0
+        self.evictions_recorded = 0
         self.fenced = False
         self._ticks = 0
         self._retention_every = max(int(retention_every), 1)
@@ -790,11 +791,46 @@ class HistoryWriter:
             "t_end": t_end,
             "service_names": list(meta.get("service_names") or []),
             "config": list(meta.get("config") or []),
+            # Keyspace generation at capture time: range reads refuse
+            # to merge records across an eviction sweep's id recycling
+            # (the drift-refusal contract, runtime/keyspace.py).
+            "generation": int(meta.get("generation") or 0),
             "query": {
                 "anomalies": events,
                 "hh_candidates": dict(q.get("hh_candidates") or {}),
             },
         }
+
+    # -- eviction folds (runtime/keyspace.py) ----------------------------
+
+    def record_eviction(
+        self, record: dict, rec_meta: dict, now: float | None = None
+    ) -> None:
+        """Append one eviction fold record: the evicted keys' final
+        head rows + in-progress window bank, captured by the keyspace
+        evictor UNDER the dispatch lock before it zeroed them. Rung 0,
+        appended directly (no upward cascade — the ladder accumulators
+        count window children, and this record is not a window). The
+        record carries the PRE-bump generation: its rows are
+        attributed under the OLD id assignment, exactly the records it
+        may merge with."""
+        from .checkpoint import StaleEpochError
+
+        if self.fenced:
+            return
+        now = self.now_fn() if now is None else now
+        t_start = now - self.rungs[0]
+        blob = frame.encode(
+            record,
+            meta=dict(rec_meta, rung=0, t_start=t_start, t_end=now),
+        )
+        try:
+            self.store.append(KIND_BANK, 0, t_start, now, blob)
+        except StaleEpochError as e:
+            self.fenced = True
+            log.error("history writer fenced: %s", e)
+            return
+        self.evictions_recorded += 1
 
     def _emit(
         self, rung_idx: int, t_start: float, t_end: float,
@@ -831,6 +867,7 @@ class HistoryWriter:
             "compactions": self.compactions,
             "windows_recorded": self.windows_recorded,
             "windows_missed": self.windows_missed,
+            "evictions_recorded": self.evictions_recorded,
             "spans_recorded": self.spans_recorded,
             "spans_dropped": self.spans_dropped,
             "explains_recorded": self.explains_recorded,
@@ -884,19 +921,36 @@ class HistoryReader:
         t_from: float,
         t_to: float,
         resolution: float | None = None,
+        generation: int | None = None,
     ) -> tuple[dict, dict] | None:
         """Merged (arrays, meta) over [t_from, t_to], or None when no
         record overlaps. Corrupt records are skipped (counted +
-        quarantined by the store) — the merge is over what survives."""
+        quarantined by the store) — the merge is over what survives.
+
+        Records are merged within ONE keyspace generation only: an
+        eviction sweep recycles intern ids, so two records across a
+        generation bump may attribute the same row to different
+        services — refused, never mis-merged (the ShardMergeError
+        discipline applied to disk). ``generation=None`` merges the
+        NEWEST generation in range (header-only pre-scan) and counts
+        the rest in ``skipped_generation``."""
         rung_idx = self.pick_rung(t_from, t_to, resolution)
         recs = self.store.records(
             kind=KIND_BANK, rung=rung_idx, t_from=t_from, t_to=t_to
         )
+        target_gen = generation
+        if target_gen is None:
+            for rec in reversed(recs):
+                m = self.store.read_meta(rec)
+                if m is not None:
+                    target_gen = int(m.get("generation") or 0)
+                    break
         merged: dict | None = None
         last_meta: dict = {}
         anomalies: list = []
         candidates: dict[str, list] = {}
         skipped = 0
+        skipped_gen = 0
         cover_from: float | None = None
         cover_to: float | None = None
         for rec in recs:
@@ -904,6 +958,9 @@ class HistoryReader:
                 fr = self.store.read_frame(rec)
             except frame.FrameCorrupt:
                 skipped += 1
+                continue
+            if int(fr.meta.get("generation") or 0) != (target_gen or 0):
+                skipped_gen += 1
                 continue
             merged = merge_record_arrays(merged, fr.arrays)
             last_meta = fr.meta
@@ -951,12 +1008,47 @@ class HistoryReader:
                 "exemplars": {},
             },
             "seq": int(last_meta.get("seq") or 0),
+            "generation": int(target_gen or 0),
             "resolution_s": self.rungs[rung_idx],
-            "records": len(recs) - skipped,
+            "records": len(recs) - skipped - skipped_gen,
             "skipped_corrupt": skipped,
+            "skipped_generation": skipped_gen,
             "coverage": [cover_from, cover_to],
         }
         return arrays, meta
+
+    def service_range_state(
+        self,
+        name: str,
+        t_from: float,
+        t_to: float,
+        resolution: float | None = None,
+    ) -> tuple[dict, dict] | None:
+        """Merged state for the NEWEST generation that still knows
+        ``name`` — the evicted-key query fallback: a key retired from
+        the live table answers from the records minted while it owned
+        its id (the eviction fold rode in with the same generation, so
+        its final head rows are the last-value winners). Header-only
+        scans locate the generation; None when no record in range ever
+        interned the name."""
+        for rung_idx in (
+            self.pick_rung(t_from, t_to, resolution), 0
+        ):
+            found = None
+            for rec in reversed(self.store.records(
+                kind=KIND_BANK, rung=rung_idx, t_from=t_from, t_to=t_to
+            )):
+                m = self.store.read_meta(rec)
+                if m and name in (m.get("service_names") or []):
+                    found = int(m.get("generation") or 0)
+                    break
+            if found is not None:
+                return self.range_state(
+                    t_from, t_to,
+                    resolution=self.rungs[rung_idx],
+                    generation=found,
+                )
+        return None
 
     @staticmethod
     def _as_query_arrays(merged: dict) -> dict:

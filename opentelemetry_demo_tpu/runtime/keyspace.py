@@ -1,0 +1,293 @@
+"""Key lifecycle plane: bounded-memory survival of cardinality bombs.
+
+The detector's headline job is flagging OTHER services' cardinality
+anomalies, yet its own intern table was append-only: a UUID-per-
+"service" bug either grew host memory without bound (~935 MB per
+million keys, measured by the PR 19 soak) or — once the static table
+filled — collapsed every future legitimate service into the overflow
+bucket forever. This module closes that hole with a budgeted keyspace:
+
+- **Watchdog** (:meth:`KeyspaceManager.tick`): samples process RSS
+  (``/proc/self/status`` VmRSS — the same read the soak bench uses)
+  and the intern-table fill fraction, and clocks the pipeline's
+  keyspace degradation ladder (``DetectorPipeline.keyspace_update``,
+  two-edge hysteresis like the brownout ladder).
+- **Evictor** (:meth:`KeyspaceManager.evict_idle`): under pressure,
+  folds IDLE keys' sketch/head rows into one history record via the
+  existing monoids (HLL rows max-merge later reads; CMS/span-total are
+  written as the add-identity so nothing double-counts), zeroes the
+  rows, and retires the intern ids into the tensorizer's generation-
+  stamped free list so ids recycle without mis-attribution. Detector
+  state is written ONLY under the pipeline dispatch lock (the
+  donation-race contract; the eviction-lock staticcheck pass pins the
+  ``retire_services`` half).
+- **Generation epoch**: every retirement sweep bumps
+  ``SpanTensorizer.generation``; frames (replication, checkpoint,
+  fleet reshard, history) carry it and refuse to merge across a bump —
+  the ShardMergeError drift-refusal contract extended to recycled ids.
+
+An evicted key is NOT forgotten: its final head state and in-progress
+window rode into history, so ``/query/*`` answers stitch from disk
+with ``source:"evicted"``, and if the key returns it re-interns (a
+fresh slot, a fresh baseline) with its past still answerable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Iterable
+
+import numpy as np
+
+from .fleet import MERGE_HEAD_ROWS
+from .pipeline import KEYSPACE_LEVEL_EVICT
+
+log = logging.getLogger(__name__)
+
+
+def process_rss_bytes() -> int:
+    """Resident set size of THIS process in bytes (0 where
+    /proc/self/status is unavailable — macOS CI, sandboxes): the
+    budget watchdog's denominator and the anomaly_process_rss_bytes
+    gauge. One open+scan, no dependencies."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+class KeyspaceManager:
+    """The supervised keyspace watchdog + idle-key evictor.
+
+    ``tick()`` is the whole behavior (the background thread just calls
+    it on a cadence; tests and the churn soak call it directly with a
+    virtual clock): sample pressure → clock the ladder → evict idle
+    keys while the ladder is engaged. All detector-state writes happen
+    under ``pipeline._dispatch_lock``; the interner retirement happens
+    inside the same critical section, so no flush can intern a new key
+    into a slot whose rows still hold the old key's state.
+
+    ``protected`` names (the fleet's pre-interned shared table) are
+    never evicted — cross-shard frame exchange requires the shared
+    prefix to stay put.
+    """
+
+    def __init__(
+        self,
+        pipeline,
+        *,
+        idle_s: float = 300.0,
+        evict_batch: int = 64,
+        rss_budget_mb: float = 0.0,
+        interval_s: float = 1.0,
+        protected: Iterable[str] = (),
+        history_writer=None,
+        flight=None,
+        now_fn: Callable[[], float] = time.monotonic,
+        wall_fn: Callable[[], float] = time.time,
+        rss_fn: Callable[[], int] = process_rss_bytes,
+    ):
+        self.pipeline = pipeline
+        self.idle_s = float(idle_s)
+        self.evict_batch = max(int(evict_batch), 1)
+        self.rss_budget_mb = float(rss_budget_mb)
+        self.interval_s = float(interval_s)
+        self.protected = set(protected)
+        self.history_writer = history_writer
+        self.flight = flight
+        self.now_fn = now_fn
+        self.wall_fn = wall_fn
+        self.rss_fn = rss_fn
+        # Keys interned before this manager existed (restore, fleet
+        # pre-intern) have no last-seen sample; they idle from HERE,
+        # not from the epoch, so a just-restored quiet key is not
+        # evicted on the first pressured tick.
+        self._t0 = now_fn()
+        self.last_rss = 0
+        self.last_fill = 0.0
+        self.evictions = 0  # keys evicted by THIS manager
+        self.sweeps = 0  # sweeps that evicted at least one key
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the watchdog thread (idempotent while it lives)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="keyspace-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def alive(self) -> bool:
+        return self._thread is None or self._thread.is_alive()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — one bad tick is a
+                # skipped sweep, never a dead watchdog; crash loops
+                # surface through the supervisor probe.
+                log.exception("keyspace watchdog tick failed")
+
+    # -- the watchdog --------------------------------------------------
+
+    def fill_fraction(self) -> float:
+        tz = self.pipeline.tensorizer
+        return tz.live_keys / max(tz.capacity, 1)
+
+    def rss_over_budget(self, rss_bytes: int) -> bool:
+        if self.rss_budget_mb <= 0:
+            return False
+        return rss_bytes > self.rss_budget_mb * 1024 * 1024
+
+    def tick(self, now: float | None = None) -> dict:
+        """One watchdog step: pressure sample → ladder clock → evict
+        while engaged. Returns the sample (the daemon's gauge source
+        and the soak's probe)."""
+        now = self.now_fn() if now is None else now
+        self.last_rss = rss = self.rss_fn()
+        self.last_fill = fill = self.fill_fraction()
+        level = self.pipeline.keyspace_update(
+            fill, self.rss_over_budget(rss), now=now
+        )
+        evicted: list[str] = []
+        if self.pipeline.keyspace_enable and level >= KEYSPACE_LEVEL_EVICT:
+            evicted = self.evict_idle(now)
+        return {
+            "level": level,
+            "fill": fill,
+            "rss_bytes": rss,
+            "evicted": evicted,
+        }
+
+    # -- the evictor ---------------------------------------------------
+
+    def idle_candidates(self, now: float) -> list[tuple[float, str, int]]:
+        """(last_seen, name, id) of eviction-eligible keys, oldest
+        first: idle past the budget, not protected, not the overflow
+        bucket. Reads the immutable snapshot — no intern lock."""
+        tz = self.pipeline.tensorizer
+        last_seen = self.pipeline._last_seen
+        out: list[tuple[float, str, int]] = []
+        for name, sid in tz._svc_snapshot.items():
+            if name in self.protected or sid >= tz.num_services - 1:
+                continue
+            seen = last_seen[sid] if last_seen[sid] > 0.0 else self._t0
+            if now - seen >= self.idle_s:
+                out.append((seen, name, sid))
+        out.sort()
+        return out[: self.evict_batch]
+
+    def evict_idle(self, now: float | None = None) -> list[str]:
+        """One eviction sweep: fold the idle keys' rows into a history
+        record, zero them, retire the ids (generation bump) — all
+        state writes under the dispatch lock. Returns evicted names."""
+        import jax
+
+        from ..models.detector import DetectorState
+
+        now = self.now_fn() if now is None else now
+        candidates = self.idle_candidates(now)
+        if not candidates:
+            return []
+        names = [name for _, name, _ in candidates]
+        sids = np.asarray([sid for _, _, sid in candidates], np.int64)
+        pipeline = self.pipeline
+        tz = pipeline.tensorizer
+        with pipeline._dispatch_lock:
+            arrays = {
+                k: np.asarray(v)
+                for k, v in pipeline.detector.state._asdict().items()
+            }
+            # Fold record FIRST (the rows still hold the keys' state):
+            # the in-progress shortest-window HLL bank rides whole
+            # (max-merge is idempotent — no double count), CMS/span
+            # totals ride as the add-identity (their cells are shared
+            # across services and already recorded by the regular rung
+            # ladder), head arrays ride whole (last-value merge — the
+            # evicted keys' final baselines, every other row identical
+            # to what the next regular record would carry anyway).
+            record = {
+                "hll_bank": np.array(arrays["hll_bank"][0, 0], copy=True),
+                "cms_bank": np.zeros_like(arrays["cms_bank"][0, 0]),
+                "span_total": np.zeros_like(arrays["span_total"][0, 0]),
+            }
+            for head in MERGE_HEAD_ROWS:
+                if head in arrays:
+                    record[head] = np.array(arrays[head], copy=True)
+            rec_meta = {
+                "seq": int(np.asarray(arrays.get("step_idx", 0))),
+                "service_names": tz.service_names,  # PRE-retirement
+                "config": list(
+                    pipeline.detector.config._replace(sketch_impl=None)
+                ),
+                "generation": tz.generation,  # PRE-bump: old ids
+                "evicted": list(names),
+                "query": {},
+            }
+            # Zero the retired rows: a recycled id must start from the
+            # monoid identities, or its first occupant inherits ghosts.
+            out = dict(arrays)
+            hll = np.array(arrays["hll_bank"], copy=True)
+            hll[:, :, sids, :] = 0
+            out["hll_bank"] = hll
+            for head in MERGE_HEAD_ROWS:
+                if head in arrays:
+                    h = np.array(arrays[head], copy=True)
+                    h[sids] = 0
+                    out[head] = h
+            pipeline.detector.state = DetectorState(
+                **{k: jax.device_put(v) for k, v in out.items()}
+            )
+            # Retire INSIDE the lock: after the snapshot republish a
+            # freed id is assignable on the very next flush, and that
+            # flush must find zeroed rows.
+            freed = tz.retire_services(names)
+        evicted = [n for n in names if tz._svc_snapshot.get(n) is None]
+        self.evictions += len(freed)
+        self.sweeps += 1
+        if self.history_writer is not None and freed:
+            self.history_writer.record_eviction(
+                record, rec_meta, now=self.wall_fn()
+            )
+        if self.flight is not None and freed:
+            self.flight.record(
+                "keyspace", op="evict", keys=len(freed),
+                generation=tz.generation, fill=self.fill_fraction(),
+                rss_mb=round(self.last_rss / (1024 * 1024), 1),
+                names=names[:8],
+            )
+        return evicted
+
+    def stats(self) -> dict:
+        tz = self.pipeline.tensorizer
+        return {
+            "level": self.pipeline.keyspace_level,
+            "rows": tz.live_keys,
+            "capacity": tz.capacity,
+            "fill": round(self.fill_fraction(), 4),
+            "free_ids": tz.free_ids,
+            "generation": tz.generation,
+            "evicted_total": tz.evicted_total,
+            "overflow_assigns_total": tz.overflow_assigns_total,
+            "rss_bytes": self.last_rss,
+            "rss_budget_mb": self.rss_budget_mb,
+            "sweeps": self.sweeps,
+        }

@@ -81,6 +81,19 @@ FLAG_THRESHOLD = "anomalyDetectorZThreshold"
 # edit that widens it is a visible contract change, not a drive-by.
 SHED_LANES = ("ok",)
 
+# Keyspace degradation ladder (runtime/keyspace.py drives the clock;
+# KEYSPACE_KNOBS is the registry). One rung per ANOMALY_KEYSPACE_HOLD_S
+# of SUSTAINED pressure, two-edge hysteresis exactly like the brownout
+# ladder — each rung degrades NEW-key admission harder while existing
+# keys' detection stays untouched:
+#   0 normal · 1 evict idle keys · 2 per-tenant new-key throttle ·
+#   3 overflow-collapse all new keys · 4 shed ingest (429 Retry-After).
+KEYSPACE_LEVEL_EVICT = 1
+KEYSPACE_LEVEL_THROTTLE = 2
+KEYSPACE_LEVEL_COLLAPSE = 3
+KEYSPACE_LEVEL_SHED = 4
+KEYSPACE_MAX_LEVEL = KEYSPACE_LEVEL_SHED
+
 
 def _pow2_ceil(n: int) -> int:
     """Smallest power of two ≥ n — the width ladder's rounding rule
@@ -126,6 +139,17 @@ class PipelineStats:
     # Times the queue crossed the high watermark (one event per
     # saturation episode, not per refused request).
     saturation_events: int = 0
+    # Keyspace ladder accounting (runtime/keyspace.py): NEW keys a
+    # tenant's token bucket deferred to overflow at the throttle rung,
+    # and new keys collapsed wholesale at the collapse rung — both
+    # keyed by tenant, exported as
+    # anomaly_keyspace_newkey_throttled_total{tenant=} /
+    # anomaly_keyspace_overflow_keys_total{tenant=}.
+    newkey_throttled_tenant: dict = field(default_factory=dict)
+    overflow_keys_tenant: dict = field(default_factory=dict)
+    # Times keyspace pressure crossed its high watermark (one event
+    # per pressure episode, mirroring saturation_events).
+    keyspace_pressure_events: int = 0
 
     def lag_p99_ms(self) -> float:
         if not self.lag_ms:
@@ -182,6 +206,12 @@ class DetectorPipeline:
         tenant_quota_rows_s: float = 0.0,
         provenance=None,
         explain_ring: int = 64,
+        keyspace_enable: bool = False,
+        keyspace_high_watermark: float = 0.85,
+        keyspace_low_watermark: float = 0.70,
+        keyspace_hold_s: float = 5.0,
+        keyspace_newkey_rate: float = 64.0,
+        keyspace_retry_after_s: float = 2.0,
     ):
         self.detector = detector
         # Time-travel span capture (runtime.history.HistoryWriter
@@ -367,6 +397,33 @@ class DetectorPipeline:
         self._tenant_of = tenant_of
         self.tenant_quota_rows_s = float(tenant_quota_rows_s)
         self._tenant_buckets: dict[str, tuple[float, float]] = {}
+        # Key lifecycle plane (runtime/keyspace.py; knob registry:
+        # utils.config.KEYSPACE_KNOBS). The pipeline owns the per-key
+        # last-seen clock (one vectorized scatter per admitted flush),
+        # the keyspace degradation ladder (same two-edge hysteresis as
+        # the brownout ladder, but clocked by the keyspace watchdog's
+        # tick, not the queue depth), and the NEW-key admission gate
+        # the tensorizer consults on a genuine intern miss. Eviction
+        # itself — folding idle rows into history and retiring ids —
+        # lives in keyspace.KeyspaceManager, which writes detector
+        # state only under _dispatch_lock.
+        self.keyspace_enable = bool(keyspace_enable)
+        self.keyspace_high_watermark = float(keyspace_high_watermark)
+        self.keyspace_low_watermark = float(keyspace_low_watermark)
+        self.keyspace_hold_s = float(keyspace_hold_s)
+        self.keyspace_newkey_rate = float(keyspace_newkey_rate)
+        self.keyspace_retry_after_s = float(keyspace_retry_after_s)
+        self._keyspace_level = 0
+        self._ks_saturated = False
+        self._ks_sat_since = 0.0
+        self._ks_unsat_since = time.monotonic()
+        self._ks_level_changed_at = 0.0
+        self._ks_newkey_buckets: dict[str, tuple[float, float]] = {}
+        self._last_seen = np.zeros(
+            detector.config.num_services, np.float64
+        )
+        if self.keyspace_enable:
+            self.tensorizer.new_key_gate = self.keyspace_newkey_gate
         self._exemplar_ring = int(exemplar_ring)
         self._hh_cand_max = int(hh_candidates)
         self._query_lock = threading.Lock()
@@ -404,6 +461,16 @@ class DetectorPipeline:
     def submit_columns(self, cols: SpanColumns) -> None:
         if not cols.rows:
             return
+        # Per-key liveness clock: a key is "seen" when rows ARRIVE for
+        # it, before any shed/brownout thins them — idleness means the
+        # world stopped sending, not that we dropped what it sent.
+        # Duplicate ids in one scatter are benign (same timestamp) and
+        # cross-thread races are too (both write "now"). Ids past the
+        # table (synthetic columns that bypassed the tensorizer) clip
+        # to the overflow slot, exactly like the device scatter does.
+        self._last_seen[
+            np.minimum(cols.svc, self._last_seen.shape[0] - 1)
+        ] = time.monotonic()
         if self.tenant_quota_rows_s > 0:
             cols = self._tenant_quota_sample(cols)
             if not cols.rows:
@@ -591,10 +658,107 @@ class DetectorPipeline:
         keeps 1/2^L of OK-lane rows)."""
         return self._brownout_level
 
+    def keyspace_update(
+        self, fill: float, rss_over: bool = False,
+        now: float | None = None,
+    ) -> int:
+        """Keyspace pressure hysteresis + degradation ladder.
+
+        Driven by the keyspace watchdog's tick (runtime/keyspace.py)
+        with the live-row fill fraction and the RSS-budget verdict.
+        Pressure flips at the high watermark (or any RSS breach) and
+        clears only at the low one; the ladder moves one rung per
+        ``keyspace_hold_s`` of SUSTAINED state in either direction —
+        identical discipline to :meth:`_admission_update`, so one fill
+        spike never staircases straight to the 429 rung. Returns the
+        post-update level.
+        """
+        now = time.monotonic() if now is None else now
+        with self._admission_lock:
+            if not self._ks_saturated:
+                if fill >= self.keyspace_high_watermark or rss_over:
+                    self._ks_saturated = True
+                    self._ks_sat_since = now
+                    self.stats.keyspace_pressure_events += 1
+            elif fill <= self.keyspace_low_watermark and not rss_over:
+                self._ks_saturated = False
+                self._ks_unsat_since = now
+            if self._ks_saturated:
+                if (
+                    self._keyspace_level < KEYSPACE_MAX_LEVEL
+                    and now - max(
+                        self._ks_sat_since, self._ks_level_changed_at
+                    ) >= self.keyspace_hold_s
+                ):
+                    self._keyspace_level += 1
+                    self._ks_level_changed_at = now
+            elif self._keyspace_level and (
+                now - max(
+                    self._ks_unsat_since, self._ks_level_changed_at
+                ) >= self.keyspace_hold_s
+            ):
+                self._keyspace_level -= 1
+                self._ks_level_changed_at = now
+            return self._keyspace_level
+
+    @property
+    def keyspace_level(self) -> int:
+        """Current keyspace ladder rung (0 = normal; see the
+        KEYSPACE_LEVEL_* constants)."""
+        return self._keyspace_level
+
+    def keyspace_newkey_gate(self, name: str) -> bool:
+        """NEW-key admission gate, consulted by the tensorizer under
+        its intern lock on a genuine miss (existing keys never reach
+        it). Below the throttle rung every new key gets a slot; at the
+        throttle rung each TENANT spends a token bucket refilled at
+        ``keyspace_newkey_rate`` new keys/s (a UUID-spraying tenant
+        exhausts its own bucket while a quiet tenant's first sighting
+        still interns); at the collapse rung and above every new key
+        folds to overflow. Refusals are counted per tenant — the key's
+        ROWS are still admitted, they just share the overflow bucket.
+        """
+        level = self._keyspace_level
+        if level < KEYSPACE_LEVEL_THROTTLE:
+            return True
+        tenant = (
+            self._tenant_of(name)
+            if self._tenant_of is not None else "default"
+        )
+        if level >= KEYSPACE_LEVEL_COLLAPSE:
+            with self._admission_lock:
+                d = self.stats.overflow_keys_tenant
+                d[tenant] = d.get(tenant, 0) + 1
+            return False
+        rate = self.keyspace_newkey_rate
+        if rate <= 0:
+            return True
+        now = time.monotonic()
+        with self._admission_lock:
+            tokens, t_last = self._ks_newkey_buckets.get(
+                tenant, (rate, now)
+            )
+            tokens = min(tokens + (now - t_last) * rate, rate)
+            if tokens >= 1.0:
+                self._ks_newkey_buckets[tenant] = (tokens - 1.0, now)
+                return True
+            self._ks_newkey_buckets[tenant] = (tokens, now)
+            d = self.stats.newkey_throttled_tenant
+            d[tenant] = d.get(tenant, 0) + 1
+        return False
+
     def admission_retry_after(self) -> float | None:
         """None while admitting; a Retry-After hint (seconds) while
-        saturated — the receivers' single admission-control question."""
-        return self.retry_after_s if self._saturated else None
+        saturated — the receivers' single admission-control question.
+        The keyspace ladder's shed rung answers here too, so ALL
+        ingest doors (Python OTLP, gRPC, native front door) return
+        429/RESOURCE_EXHAUSTED under a sustained cardinality bomb
+        without any door-side change."""
+        if self._saturated:
+            return self.retry_after_s
+        if self._keyspace_level >= KEYSPACE_LEVEL_SHED:
+            return self.keyspace_retry_after_s
+        return None
 
     def pending_rows(self) -> int:
         with self._pending_lock:

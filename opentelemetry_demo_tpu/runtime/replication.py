@@ -827,6 +827,12 @@ class ReplicationStandby:
         self.deltas_applied = 0
         self.snapshots_applied = 0
         self.frames_rejected = 0  # base mismatch (would double-count)
+        # Deltas refused for keyspace-generation drift (the eviction
+        # plane recycled intern ids between our base and the frame):
+        # refusal leaves applied_seq put, the stale ack triggers the
+        # primary's full resync, and the snapshot adopts the new
+        # generation wholesale — self-healing by the existing path.
+        self.frames_generation_drift = 0
         # Frames whose columnar payload failed verification (corrupt
         # link / bit rot): quarantined — never merged — and the ACK
         # re-asserts our last GOOD position, so the primary reships
@@ -1038,6 +1044,20 @@ class ReplicationStandby:
                 # (the primary re-bases or resyncs).
                 self.frames_rejected += 1
                 return
+            ours = int((self.meta or {}).get("generation") or 0)
+            theirs = int(
+                (frame["meta"] or {}).get("generation") or 0
+            )
+            if theirs != ours:
+                # Keyspace generation drift: the primary's evictor
+                # recycled intern ids since our mirror's base — a row-
+                # wise merge could attribute an old key's registers to
+                # the id's NEW owner. Refuse; the stale ack makes the
+                # primary ship a full snapshot, which replaces
+                # wholesale and adopts the new generation.
+                self.frames_generation_drift += 1
+                self.frames_rejected += 1
+                return
             hll_monotone = frame["meta"].get("hll_monotone", True)
             for key, inc in arrays.items():
                 if key in MAX_KEYS and hll_monotone:
@@ -1062,6 +1082,7 @@ class ReplicationStandby:
             "deltas_applied": self.deltas_applied,
             "snapshots_applied": self.snapshots_applied,
             "frames_rejected": self.frames_rejected,
+            "frames_generation_drift": self.frames_generation_drift,
             "frames_corrupt": self.frames_corrupt,
             "frames_version_skew": self.frames_version_skew,
             "fenced_sent": self.fenced_sent,

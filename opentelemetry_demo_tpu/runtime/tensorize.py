@@ -34,11 +34,19 @@ from __future__ import annotations
 import threading
 import zlib
 from dataclasses import dataclass
-from typing import Iterable, NamedTuple
+from typing import Callable, Iterable, NamedTuple
 
 import numpy as np
 
 from ..ops.hashing import split_hi_lo_np, splitmix64_np
+
+# Positional placeholder for an id slot the keyspace evictor freed and
+# nothing has reclaimed yet. It must round-trip through every surface
+# that carries the name table positionally (checkpoint meta,
+# replication meta, fleet merge masks) without ever colliding with a
+# real service name — OTLP service.name values are printable strings,
+# so a NUL-prefixed sentinel cannot be interned from the wire.
+EVICTED_SLOT = "\x00evicted"
 
 
 class SpanEvent(NamedTuple):
@@ -166,22 +174,36 @@ class InternArena:
     tests/test_ingest_pool.py.
     """
 
-    __slots__ = ("_tz", "_local")
+    __slots__ = ("_tz", "_local", "_gen")
 
     def __init__(self, tensorizer: "SpanTensorizer"):
         self._tz = tensorizer
         self._local: dict[str, int] = {}
+        self._gen = tensorizer.generation
 
     def lookup(self, names: list[str]) -> list[int]:
         """Resolve ``names`` (first-appearance document order) to ids."""
+        if self._gen != self._tz.generation:
+            # The evictor retired ids since this arena last synced —
+            # cached name→id pairs may now point at RECYCLED slots
+            # owned by different services. Drop the whole cache; one
+            # cold flush per worker per generation is the entire cost.
+            self._local = {}
+            self._gen = self._tz.generation
         local = self._local
         try:
             return [local[n] for n in names]  # pure-local hot path
         except KeyError:
             pass
         ids = self._tz.intern_many(names)
+        ov = self._tz.num_services - 1
         for n, sid in zip(names, ids):
-            local[n] = sid
+            # Never cache the overflow id: a key parked there by a
+            # full table or the keyspace new-key gate must re-consult
+            # the global table later, when pressure clears and a slot
+            # frees — a cached overflow hit would pin it forever.
+            if sid != ov:
+                local[n] = sid
         return ids
 
 
@@ -213,10 +235,54 @@ class SpanTensorizer:
         # writable table, assigns, and publishes a fresh snapshot.
         self._intern_lock = threading.Lock()
         self._svc_snapshot: dict[str, int] = {}
+        # Key lifecycle plane (runtime/keyspace.py). The table is
+        # BOUNDED: names map to at most num_services-1 real slots; a
+        # name that can't get one folds into the overflow bucket and
+        # is NOT memorized (an unbounded name dict is exactly the
+        # cardinality-bomb leak this plane exists to stop).
+        # ``_names_by_id`` is the id-ordered mirror of _svc_ids (None =
+        # never assigned, EVICTED_SLOT = freed, awaiting reuse);
+        # ``service_names`` reads it so positional order survives id
+        # recycling — dict insertion order stops being id order the
+        # moment one id is reused.
+        self._names_by_id: list[str | None] = []
+        self._free_ids: list[int] = []  # retired ids, ascending reuse
+        self._next_id = 0  # next never-used dense slot
+        # Generation epoch: bumped once per retirement sweep. Frames,
+        # checkpoints and fleet merges carry it so recycled ids are
+        # never merged across the retirement boundary (ShardMergeError
+        # drift-refusal contract); InternArena caches key on it.
+        self.generation = 0
+        # Optional admission gate consulted under the intern lock on a
+        # GENUINE miss only: return False to park the new key in the
+        # overflow bucket instead of granting a slot (the keyspace
+        # ladder's throttle/collapse rungs). Existing keys never pass
+        # through it.
+        self.new_key_gate: Callable[[str], bool] | None = None
+        self.evicted_total = 0  # ids retired over process lifetime
+        self.overflow_assigns_total = 0  # misses parked in overflow
 
     @property
     def service_names(self) -> list[str]:
-        return list(self._svc_ids)
+        """Positional name table: index i is the name owning id i
+        (EVICTED_SLOT marks freed slots). Bit-identical to the old
+        insertion-order list until the first eviction, after which
+        only this ordering is correct."""
+        out = list(self._names_by_id)
+        return [EVICTED_SLOT if n is None else n for n in out]
+
+    @property
+    def capacity(self) -> int:
+        """Real (non-overflow) id slots."""
+        return self.num_services - 1
+
+    @property
+    def live_keys(self) -> int:
+        return len(self._svc_ids)
+
+    @property
+    def free_ids(self) -> int:
+        return len(self._free_ids)
 
     def service_id(self, name: str) -> int:
         sid = self._svc_snapshot.get(name)  # lock-free: hit is immutable
@@ -228,17 +294,35 @@ class SpanTensorizer:
     def _assign_locked(self, name: str, publish: bool = True) -> int:
         """Assign (or find) ``name``'s id; caller holds the intern
         lock. The ONE assignment rule both the per-name path and the
-        batched path share — dense first-appearance ranks with the last
-        id reserved as the overflow bucket. ``publish=False`` defers
-        the snapshot publication to the caller (the batched path
-        publishes ONCE per batch instead of once per new name)."""
+        batched path share — recycled ids first (ascending), then
+        dense first-appearance ranks, with the last id reserved as the
+        overflow bucket. ``publish=False`` defers the snapshot
+        publication to the caller (the batched path publishes ONCE per
+        batch instead of once per new name)."""
         sid = self._svc_ids.get(name)
         if sid is None:
-            if len(self._svc_ids) < self.num_services - 1:
-                sid = len(self._svc_ids)
+            gate = self.new_key_gate
+            if gate is not None and not gate(name):
+                # Keyspace ladder refused the slot: overflow, and do
+                # NOT memorize the name — the key re-applies on its
+                # next sighting, when pressure may have cleared.
+                self.overflow_assigns_total += 1
+                return self.num_services - 1
+            if self._free_ids:
+                sid = self._free_ids.pop(0)
+            elif self._next_id < self.num_services - 1:
+                sid = self._next_id
+                self._next_id += 1
             else:
-                sid = self.num_services - 1  # overflow bucket
+                # Table saturated: overflow, unmemorized (bounded
+                # memory beats a lock-free re-hit for a key the
+                # sketches can't tell apart anyway).
+                self.overflow_assigns_total += 1
+                return self.num_services - 1
             self._svc_ids[name] = sid
+            while len(self._names_by_id) <= sid:
+                self._names_by_id.append(None)
+            self._names_by_id[sid] = name
             if publish:
                 # Publish a NEW snapshot object — readers holding the
                 # old one still see consistent (if stale) hits and
@@ -254,19 +338,77 @@ class SpanTensorizer:
         Misses are assigned in first-appearance order of ``names``, so
         a caller passing names in document order produces ids
         bit-identical to a serial ``service_id`` loop — the intern-id
-        bit-exactness contract (tests/test_ingest_pool.py).
+        bit-exactness contract (tests/test_ingest_pool.py). Names the
+        table refused (saturation or the new-key gate) resolve to the
+        overflow id without being memorized.
         """
         snap = self._svc_snapshot  # immutable: consistent for the batch
         if all(n in snap for n in names):
             return [snap[n] for n in names]
+        ov = self.num_services - 1
         with self._intern_lock:
+            before = len(self._svc_ids)
             for n in names:
                 if n not in self._svc_ids:
                     self._assign_locked(n, publish=False)
-            # ONE snapshot publication for the whole batch — k new
-            # names cost one O(N) copy, not k of them.
-            self._svc_snapshot = snap = dict(self._svc_ids)
-        return [snap[n] for n in names]
+            if len(self._svc_ids) != before:
+                # ONE snapshot publication for the whole batch — k new
+                # names cost one O(N) copy, not k of them. An all-
+                # overflow batch memorizes nothing and republishes
+                # nothing (a recurring overflow name must not cost a
+                # table copy per flush).
+                self._svc_snapshot = dict(self._svc_ids)
+            snap = self._svc_snapshot
+        return [snap.get(n, ov) for n in names]
+
+    def retire_services(self, names: list[str]) -> list[int]:
+        """Retire ``names`` from the live table: their ids join the
+        free list (ascending) for reuse and the generation epoch bumps
+        ONCE for the whole sweep. Returns the freed ids.
+
+        CONTRACT: the caller must hold the pipeline dispatch lock (the
+        eviction-lock staticcheck pass pins this) and must have folded
+        the retired rows out of detector state BEFORE calling — after
+        the snapshot republish below, a recycled id can be assigned to
+        a brand-new service on the very next flush, and any residue in
+        its sketch rows would mis-attribute history to the newcomer.
+        """
+        freed: list[int] = []
+        with self._intern_lock:
+            for name in names:
+                sid = self._svc_ids.pop(name, None)
+                if sid is None or sid >= self.num_services - 1:
+                    continue  # unknown, or the overflow bucket
+                self._names_by_id[sid] = EVICTED_SLOT
+                freed.append(sid)
+            if freed:
+                self._free_ids.extend(freed)
+                self._free_ids.sort()
+                self.evicted_total += len(freed)
+                self.generation += 1
+                self._svc_snapshot = dict(self._svc_ids)
+        return freed
+
+    def adopt_names(self, names: list[str]) -> None:
+        """Rebuild the table POSITIONALLY from a checkpoint/snapshot
+        name list (index = id), honoring EVICTED_SLOT tombstones as
+        free slots. A plain ``service_id`` replay can't restore a
+        post-eviction table — it would re-densify around the holes and
+        shift every id after the first tombstone.
+        """
+        with self._intern_lock:
+            self._svc_ids = {}
+            self._names_by_id = []
+            self._free_ids = []
+            for sid, name in enumerate(names[: self.num_services - 1]):
+                if name is None or name == EVICTED_SLOT:
+                    self._names_by_id.append(EVICTED_SLOT)
+                    self._free_ids.append(sid)
+                else:
+                    self._names_by_id.append(name)
+                    self._svc_ids[name] = sid
+            self._next_id = len(self._names_by_id)
+            self._svc_snapshot = dict(self._svc_ids)
 
     def tensorize(self, records: Iterable[SpanRecord]) -> list[TensorBatch]:
         """Pack records into one or more fixed-width batches."""

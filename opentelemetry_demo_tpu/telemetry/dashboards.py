@@ -362,6 +362,39 @@ def provisioned_dashboards() -> list[Dashboard]:
                       Query("rate", "anomaly_shed_rows_total",
                             matchers={"cause": "tenant-quota"},
                             by=("tenant",)), "rows/s"),
+                # Key lifecycle plane (runtime.keyspace): the
+                # detector's OWN memory story under a cardinality
+                # bomb — process RSS beside the intern-table fill and
+                # the degradation-ladder rung; eviction/throttle/
+                # overflow rates say what the ladder is doing about
+                # it, and a generation step-up marks each sweep that
+                # recycled intern ids.
+                Panel("Process RSS (memory budget)",
+                      Query("instant", "anomaly_process_rss_bytes"),
+                      "bytes"),
+                Panel("Intern-table fill fraction",
+                      Query("instant", "anomaly_keyspace_fill_ratio"),
+                      "fraction"),
+                Panel("Live keys vs capacity",
+                      Query("instant", "anomaly_keyspace_rows"),
+                      "keys"),
+                Panel("Keyspace ladder level",
+                      Query("instant", "anomaly_keyspace_level"),
+                      "level"),
+                Panel("Keys evicted (idle, folded to history)",
+                      Query("rate", "anomaly_keyspace_evicted_total"),
+                      "keys/s"),
+                Panel("Keyspace generation (eviction sweeps)",
+                      Query("instant", "anomaly_keyspace_generation"),
+                      "epoch"),
+                Panel("New keys throttled by tenant",
+                      Query("rate",
+                            "anomaly_keyspace_newkeys_throttled_total",
+                            by=("tenant",)), "keys/s"),
+                Panel("Overflow-bucket folds by tenant",
+                      Query("rate",
+                            "anomaly_keyspace_overflow_keys_total",
+                            by=("tenant",)), "keys/s"),
                 Panel("Recent warnings",
                       Query("logs", severity="WARN"), "docs"),
             ],

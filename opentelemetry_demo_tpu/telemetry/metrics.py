@@ -370,6 +370,24 @@ ANOMALY_EXPLANATIONS_EXPORTED = "anomaly_explanations_exported_total"
 ANOMALY_EXPLAIN_LATENCY = "anomaly_explain_latency_seconds"  # histogram
 ANOMALY_BUILD_INFO = "anomaly_build_info"  # {version=, frame_version=, jax=}
 
+# Key lifecycle plane (runtime.keyspace): the budget watchdog's RSS
+# sample (first-class — the soak bench's VmRSS read, promoted to a
+# scrapeable gauge), the intern-table occupancy trio the fill fraction
+# is computed from, the keyspace degradation-ladder level (0 normal ·
+# 1 evict idle · 2 throttle new keys · 3 collapse to overflow · 4 shed
+# ingest), the eviction/generation counters, and the per-tenant
+# admission outcomes under ladder pressure.
+ANOMALY_PROCESS_RSS = "anomaly_process_rss_bytes"
+ANOMALY_KEYSPACE_ROWS = "anomaly_keyspace_rows"  # live interned keys
+ANOMALY_KEYSPACE_CAPACITY = "anomaly_keyspace_capacity_rows"
+ANOMALY_KEYSPACE_FILL = "anomaly_keyspace_fill_ratio"
+ANOMALY_KEYSPACE_LEVEL = "anomaly_keyspace_level"
+ANOMALY_KEYSPACE_GENERATION = "anomaly_keyspace_generation"
+ANOMALY_KEYSPACE_EVICTED = "anomaly_keyspace_evicted_total"
+ANOMALY_KEYSPACE_FREE_IDS = "anomaly_keyspace_free_ids"
+ANOMALY_KEYSPACE_THROTTLED = "anomaly_keyspace_newkeys_throttled_total"  # {tenant=}
+ANOMALY_KEYSPACE_OVERFLOW = "anomaly_keyspace_overflow_keys_total"  # {tenant=}
+
 
 def export_metrics_report(
     registry: MetricRegistry,

@@ -286,6 +286,13 @@ QUERY_KNOBS: dict[str, tuple[str, object, str]] = {
         "cached copy is older than this, so every answer is at most "
         "this stale (plus replication lag on a read replica)",
     ),
+    "ANOMALY_QUERY_EVICTED_LOOKBACK_S": (
+        "float", 3600.0,
+        "how far back /query/* searches history for a service the "
+        "keyspace evictor retired from the live table; answers found "
+        "there are labeled source:\"evicted\" (0 disables the "
+        "evicted-key fallback)",
+    ),
 }
 
 
@@ -961,6 +968,70 @@ FRONTDOOR_KNOBS: dict[str, tuple[str, object, str]] = {
 }
 
 
+# Key lifecycle knobs (runtime/keyspace.py: the memory-budgeted
+# keyspace plane — idle-key eviction folding sketch rows into history
+# under the dispatch lock, intern-id recycling behind a generation
+# epoch, and the keyspace degradation ladder: evict → per-tenant
+# new-key throttle → overflow-collapse → 429). The watchdog gauges
+# (anomaly_process_rss_bytes + row/interner fill) export regardless of
+# the enable bit, so a cardinality bomb is visible even with the
+# ladder off. Values must stay literals (sanitycheck reads via
+# ast.literal_eval).
+KEYSPACE_KNOBS: dict[str, tuple[str, object, str]] = {
+    "ANOMALY_KEYSPACE_ENABLE": (
+        "int", 1,
+        "1 = keyspace lifecycle plane on (idle eviction + degradation "
+        "ladder under pressure); 0 = watchdog gauges only — the table "
+        "reverts to append-only-then-overflow",
+    ),
+    "ANOMALY_KEYSPACE_HIGH_WATERMARK": (
+        "float", 0.85,
+        "live-row fill fraction (interned keys / table capacity) above "
+        "which the keyspace ladder counts pressure; two-edge "
+        "hysteresis against the low watermark",
+    ),
+    "ANOMALY_KEYSPACE_LOW_WATERMARK": (
+        "float", 0.70,
+        "fill fraction the ladder must fall below before de-escalating "
+        "(the hysteresis lower edge; must be < high watermark)",
+    ),
+    "ANOMALY_KEYSPACE_IDLE_S": (
+        "float", 300.0,
+        "a key with no rows admitted for this long is eviction-"
+        "eligible under pressure; its sketch/head rows fold into a "
+        "history record before the id recycles",
+    ),
+    "ANOMALY_KEYSPACE_HOLD_S": (
+        "float", 5.0,
+        "seconds of SUSTAINED pressure (or relief) per ladder edge — "
+        "the same two-edge hysteresis hold the brownout ladder uses, "
+        "so one fill spike never staircases straight to 429",
+    ),
+    "ANOMALY_KEYSPACE_EVICT_BATCH": (
+        "int", 64,
+        "max idle keys folded out per evictor sweep; bounds how long "
+        "one sweep holds the dispatch lock",
+    ),
+    "ANOMALY_KEYSPACE_RSS_MB": (
+        "float", 0.0,
+        "process RSS budget in MB; above it the watchdog counts "
+        "pressure even when the intern table has room (0 = no RSS "
+        "budget — fill-fraction pressure only)",
+    ),
+    "ANOMALY_KEYSPACE_NEWKEY_RATE": (
+        "float", 64.0,
+        "per-tenant NEW-key admissions per second once the ladder "
+        "reaches the throttle rung; keys past the budget collapse to "
+        "the overflow bucket (counted per tenant)",
+    ),
+    "ANOMALY_KEYSPACE_RETRY_AFTER_S": (
+        "float", 2.0,
+        "Retry-After hint (seconds) the ingest doors return with 429 "
+        "once the keyspace ladder reaches its shed rung",
+    ),
+}
+
+
 # Registries whose knobs ride the DEPLOY surfaces: every knob in these
 # must be threaded through runtime/daemon.py, the compose overlay and
 # the k8s generator (scripts/staticcheck knob-discipline pass +
@@ -972,7 +1043,7 @@ DEPLOYED_KNOB_REGISTRIES: tuple[str, ...] = (
     "REPLICATION_KNOBS", "FRAME_KNOBS", "QUERY_KNOBS", "SPINE_KNOBS",
     "SELFTRACE_KNOBS", "HISTORY_KNOBS", "REMEDIATION_KNOBS",
     "FLEET_KNOBS", "AUTOSCALE_KNOBS", "SHADOW_KNOBS",
-    "PROVENANCE_KNOBS", "FRONTDOOR_KNOBS",
+    "PROVENANCE_KNOBS", "FRONTDOOR_KNOBS", "KEYSPACE_KNOBS",
 )
 
 
@@ -1100,6 +1171,12 @@ BENCH_KNOBS: dict[str, tuple[str, object, str]] = {
         "int", 1048576,
         "distinct (tenant x service) keys the cardinality soak must "
         "push through ingest->sketch->query",
+    ),
+    "BENCH_CHURN_WAVES": (
+        "int", 8,
+        "churn-soak waves (each wave: a fresh churn cohort past the "
+        "key budget, an eviction sweep, a live-cohort liveness + "
+        "evicted-query + generation-refusal probe; lifts churn_ok)",
     ),
 }
 
@@ -1685,6 +1762,39 @@ def frontdoor_config() -> dict[str, int | float | str]:
         raise ConfigError(
             "ANOMALY_FRONTDOOR_MAX_CONNS="
             f"{out['ANOMALY_FRONTDOOR_MAX_CONNS']} must be >= 1"
+        )
+    return out
+
+
+def keyspace_config() -> dict[str, int | float | str]:
+    """Resolve every KEYSPACE_KNOBS entry from the environment (same
+    contract as :func:`overload_config`); validates the watermark
+    ordering and the per-edge shapes — an inverted hysteresis band
+    would flap the ladder on every sweep, and must refuse to boot
+    instead."""
+    out = _resolve(KEYSPACE_KNOBS)
+    hi = float(out["ANOMALY_KEYSPACE_HIGH_WATERMARK"])
+    lo = float(out["ANOMALY_KEYSPACE_LOW_WATERMARK"])
+    if not (0.0 < lo < hi <= 1.0):
+        raise ConfigError(
+            "keyspace watermarks must satisfy 0 < "
+            f"ANOMALY_KEYSPACE_LOW_WATERMARK ({lo}) < "
+            f"ANOMALY_KEYSPACE_HIGH_WATERMARK ({hi}) <= 1"
+        )
+    if int(out["ANOMALY_KEYSPACE_EVICT_BATCH"]) < 1:
+        raise ConfigError(
+            "ANOMALY_KEYSPACE_EVICT_BATCH="
+            f"{out['ANOMALY_KEYSPACE_EVICT_BATCH']} must be >= 1"
+        )
+    if float(out["ANOMALY_KEYSPACE_HOLD_S"]) < 0:
+        raise ConfigError(
+            "ANOMALY_KEYSPACE_HOLD_S="
+            f"{out['ANOMALY_KEYSPACE_HOLD_S']} must be >= 0"
+        )
+    if float(out["ANOMALY_KEYSPACE_IDLE_S"]) < 0:
+        raise ConfigError(
+            "ANOMALY_KEYSPACE_IDLE_S="
+            f"{out['ANOMALY_KEYSPACE_IDLE_S']} must be >= 0"
         )
     return out
 

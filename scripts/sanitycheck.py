@@ -170,7 +170,7 @@ def main() -> int:
                         "HISTORY_KNOBS", "REMEDIATION_KNOBS",
                         "FLEET_KNOBS", "AUTOSCALE_KNOBS",
                         "SHADOW_KNOBS", "PROVENANCE_KNOBS",
-                        "FRONTDOOR_KNOBS",
+                        "FRONTDOOR_KNOBS", "KEYSPACE_KNOBS",
                     )
                     and node.value is not None
                 ):
@@ -181,6 +181,7 @@ def main() -> int:
         "SPINE_KNOBS", "SELFTRACE_KNOBS", "HISTORY_KNOBS",
         "REMEDIATION_KNOBS", "FLEET_KNOBS", "AUTOSCALE_KNOBS",
         "SHADOW_KNOBS", "PROVENANCE_KNOBS", "FRONTDOOR_KNOBS",
+        "KEYSPACE_KNOBS",
     ):
         knobs = registries.get(reg_name)
         check(bool(knobs), f"utils/config.py declares {reg_name}")
@@ -1008,6 +1009,129 @@ def main() -> int:
             "test_fleet_drift_refusal_large_tables",
         ):
             check(marker in fttext, f"front-door suite pins {marker}")
+
+    # §16 key lifecycle plane (r20): the bounded interner, the idle
+    # evictor, the degradation ladder, and the generation fence. The
+    # knob registry is consumer-threaded by the loop above; here we
+    # pin the semantics the knobs promise (two-edge hysteresis needs
+    # high > low; a 0-key evict batch would make the ladder's evict
+    # rung a no-op), the one concurrency invariant everything rests
+    # on (interner retirement happens inside the pipeline dispatch
+    # lock — an evictor that retires outside it races the pump's
+    # intern path), and the suite names.
+    ks_knobs = registries.get("KEYSPACE_KNOBS") or {}
+    ks_enable = ks_knobs.get("ANOMALY_KEYSPACE_ENABLE")
+    check(
+        ks_enable is not None and ks_enable[1] == 1,
+        "keyspace plane defaults ON (ANOMALY_KEYSPACE_ENABLE=1 — "
+        "bounded memory is the default posture, not an opt-in)",
+    )
+    ks_hi = ks_knobs.get("ANOMALY_KEYSPACE_HIGH_WATERMARK")
+    ks_lo = ks_knobs.get("ANOMALY_KEYSPACE_LOW_WATERMARK")
+    check(
+        ks_hi is not None and ks_lo is not None and ks_lo[1] < ks_hi[1] <= 1.0,
+        "keyspace watermarks form a hysteresis band "
+        "(LOW < HIGH <= 1.0 — equal edges would flap the ladder)",
+    )
+    ks_batch = ks_knobs.get("ANOMALY_KEYSPACE_EVICT_BATCH")
+    check(
+        ks_batch is not None and ks_batch[1] >= 1,
+        "keyspace evict batch >= 1 (a 0 batch silently disables the "
+        "evict rung)",
+    )
+    check(
+        "ANOMALY_QUERY_EVICTED_LOOKBACK_S"
+        in (registries.get("QUERY_KNOBS") or {}),
+        "QUERY_KNOBS carries ANOMALY_QUERY_EVICTED_LOOKBACK_S "
+        "(evicted-key answers need a bounded history search window)",
+    )
+    ks_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "keyspace.py"
+    )
+    check(os.path.exists(ks_py), "runtime/keyspace.py exists")
+    if os.path.exists(ks_py):
+        kstext = open(ks_py).read()
+        for marker in (
+            "class KeyspaceManager", "def evict_idle", "def tick",
+            "def process_rss_bytes",
+        ):
+            check(marker in kstext, f"runtime/keyspace.py declares {marker!r}")
+        # AST, not substring: every retire_services(...) call in the
+        # evictor must sit under a `with ... _dispatch_lock:` block.
+        # (scripts/staticcheck's eviction-lock pass enforces this
+        # repo-wide; this pin keeps the module itself honest even if
+        # the pass is ever skipped.)
+        unlocked = []
+        tree = ast.parse(kstext)
+
+        def _locked(node: ast.AST, guarded: bool) -> None:
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "retire_services"
+                and not guarded
+            ):
+                unlocked.append(node.lineno)
+            inside = guarded
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    src = ast.unparse(item.context_expr)
+                    if "_dispatch_lock" in src:
+                        inside = True
+            for child in ast.iter_child_nodes(node):
+                _locked(child, inside)
+
+        _locked(tree, False)
+        check(
+            not unlocked,
+            "keyspace.py retires interner ids only under the dispatch "
+            f"lock (unguarded retire_services at lines {unlocked or '—'})",
+        )
+    pl_py = os.path.join(
+        ROOT, "opentelemetry_demo_tpu", "runtime", "pipeline.py"
+    )
+    pltext = open(pl_py).read()
+    for marker in (
+        "KEYSPACE_LEVEL_EVICT", "KEYSPACE_LEVEL_THROTTLE",
+        "KEYSPACE_LEVEL_COLLAPSE", "KEYSPACE_LEVEL_SHED",
+        "def keyspace_update", "def keyspace_newkey_gate",
+        "def admission_retry_after",
+    ):
+        check(marker in pltext, f"runtime/pipeline.py declares {marker!r}")
+    check(
+        "keyspace:" in open(os.path.join(ROOT, "pyproject.toml")).read(),
+        "pyproject registers the keyspace marker",
+    )
+    check(
+        "measure_churn_soak"
+        in open(os.path.join(
+            ROOT, "opentelemetry_demo_tpu", "runtime", "frontdoorbench.py"
+        )).read(),
+        "frontdoorbench.py grows the churn-soak gate",
+    )
+    check(
+        "churn_ok" in open(os.path.join(ROOT, "bench.py")).read(),
+        "bench.py lifts the churn_ok verdict",
+    )
+    ks_tests = os.path.join(ROOT, "tests", "test_keyspace.py")
+    check(os.path.exists(ks_tests), "tests/test_keyspace.py exists")
+    if os.path.exists(ks_tests):
+        kttext = open(ks_tests).read()
+        for marker in (
+            "test_saturated_intern_many_dense_and_bit_stable",
+            "test_all_overflow_flush_roundtrips_the_frame_format",
+            "test_retire_recycles_ids_behind_a_generation_bump",
+            "test_two_edge_hysteresis_one_rung_per_hold",
+            "test_throttle_rung_isolates_tenants",
+            "test_shed_rung_answers_429_through_the_python_door",
+            "test_evict_folds_zeroes_and_retires_idle_keys",
+            "test_fleet_merge_refuses_generation_drift",
+            "test_replication_delta_refused_across_generations",
+            "test_checkpoint_roundtrips_generation_and_tombstones",
+            "test_evicted_key_answers_from_history",
+            "test_overflow_bucket_answers_are_labeled",
+        ):
+            check(marker in kttext, f"keyspace suite pins {marker}")
 
     # no imports from the read-only reference tree
     bad = []

@@ -289,6 +289,7 @@ def _load_passes() -> None:
     from .passes import (
         concurrency,
         donation,
+        eviction_lock,
         exception_status,
         frame_monopoly,
         knobs,
@@ -300,7 +301,7 @@ def _load_passes() -> None:
     for mod in (
         donation, knobs, metric_surface, trace_discipline,
         frame_monopoly, concurrency, exception_status,
-        provenance_vocabulary,
+        provenance_vocabulary, eviction_lock,
     ):
         PASSES[mod.PASS_ID] = (mod.run, mod.DESCRIPTION)
 

@@ -1,0 +1,64 @@
+"""eviction-lock: intern-id retirement happens under the dispatch lock.
+
+The keyspace evictor's safety contract (runtime/keyspace.py →
+``SpanTensorizer.retire_services``): the moment a retirement publishes
+its snapshot, a freed id is assignable to a brand-new service on the
+very next flush — and that flush scatters into whatever the old
+occupant's sketch/head rows still hold. The ONLY thing that makes the
+recycle safe is ordering: fold + zero the rows, then retire, all
+inside one ``with pipeline._dispatch_lock`` critical section so no
+dispatch can interleave between the zero and the republish.
+
+This pass pins the lock half of that contract lexically: every call
+to ``.retire_services(...)`` anywhere in the package must sit inside a
+``with`` statement whose context expression mentions the dispatch
+lock. (The fold-before-retire ordering is behavioral and lives in
+tests/test_keyspace.py; lexical lock scope is what an analyzer can
+prove and what a refactor is most likely to silently drop.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Repo, Violation
+
+PASS_ID = "eviction-lock"
+DESCRIPTION = (
+    "`.retire_services(...)` only inside `with ..._dispatch_lock` "
+    "(id recycling must not interleave with dispatch)"
+)
+
+LOCK_NEEDLE = "_dispatch_lock"
+RETIRE_METHOD = "retire_services"
+
+
+def run(repo: Repo) -> list[Violation]:
+    out: list[Violation] = []
+    scan = repo.iter_py(repo.package) if repo.package else []
+    for rel in sorted(set(scan)):
+        src = repo.source(rel)
+        if src is None or src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == RETIRE_METHOD
+            ):
+                continue
+            # The definition site's own intern-lock body never calls
+            # itself; any OTHER call — whatever the receiver is named
+            # (tz, self.tensorizer, pipeline.tensorizer) — needs the
+            # dispatch lock around it.
+            if src.inside_with_matching(node, LOCK_NEEDLE):
+                continue
+            out.append(Violation(
+                PASS_ID, rel, node.lineno,
+                f"`{src.segment(node.func)}(...)` outside "
+                f"`with ...{LOCK_NEEDLE}`: a freed id is assignable on "
+                "the next flush the instant the snapshot republishes — "
+                "fold + zero the rows and retire inside ONE dispatch-"
+                "lock critical section",
+            ))
+    return out

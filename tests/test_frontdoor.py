@@ -699,9 +699,16 @@ class TestInternScale:
         tz_small = SpanTensorizer(num_services=cap)
         small_ids = tz_small.intern_many(names)
         assert small_ids == [min(i, cap - 1) for i in range(n)]
-        # The TABLE still remembers every distinct name (the interner
-        # is exact; only the sketch axis saturates).
-        assert len(tz_small.service_names) == n
+        # The table stays BOUNDED at the key budget: overflow names
+        # are counted, never memorized (the key lifecycle plane's
+        # contract — the sketch axis saturating must not grow host
+        # memory either).
+        assert len(tz_small.service_names) == cap - 1
+        assert tz_small.overflow_assigns_total == n - (cap - 1)
+        # Re-intern: dense ids stable, overflow stable but re-counted
+        # (unmemorized keys re-apply on every sighting).
+        assert tz_small.intern_many(names) == small_ids
+        assert tz_small.overflow_assigns_total == 2 * (n - (cap - 1))
 
     def test_intern_known_batch_lock_free(self):
         """A batch of already-known names resolves from the published

@@ -1,0 +1,607 @@
+"""Key lifecycle plane: bounded-memory survival of cardinality bombs.
+
+The acceptance bars this suite proves (ISSUE 20):
+
+- **Bounded interner** (``TestBoundedInterner``): a saturated
+  ``intern_many`` keeps ids dense and bit-stable, parks every refused
+  key in the overflow bucket WITHOUT memorizing it, and an
+  all-overflow flush round-trips the frame format untouched; retired
+  ids recycle lowest-first behind a generation bump, ``adopt_names``
+  honors tombstones positionally, and the per-worker arena never
+  caches the overflow id and drops its cache on a generation change.
+- **Degradation ladder** (``TestLadder``): two-edge hysteresis — one
+  fill spike never staircases; sustained pressure climbs one rung per
+  hold; the throttle rung spends per-TENANT token buckets (a spraying
+  tenant starves only itself); the collapse rung folds every new key
+  to overflow with per-tenant counts; the shed rung answers 429 +
+  Retry-After through the Python OTLP door with no door-side change.
+- **Evictor** (``TestEvictor``): idle keys' rows fold into a history
+  record (bit-identical to the pre-eviction live rows), the live rows
+  zero, the ids retire — protected and recently-seen keys survive,
+  and the watchdog tick only engages the evictor at ladder pressure.
+- **Generation refusal** (``TestGenerationRefusal``): fleet merges,
+  replication deltas and history range merges all refuse to mix
+  frames across a generation bump (recycled ids must never
+  mis-attribute); checkpoints round-trip the generation and the
+  tombstoned name table.
+- **Evicted continuity** (``TestEvictedQuery``): a key the live table
+  no longer knows answers ``/query/*`` from history labeled
+  ``source:"evicted"``; a genuinely unknown key stays a 404; overflow
+  -bucket answers carry ``overflow: true``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from opentelemetry_demo_tpu.models import AnomalyDetector, DetectorConfig
+from opentelemetry_demo_tpu.runtime import checkpoint, frame
+from opentelemetry_demo_tpu.runtime.fleet import (
+    ShardMergeError,
+    merge_shard_arrays,
+)
+from opentelemetry_demo_tpu.runtime.history import (
+    HistoryReader,
+    HistoryStore,
+    HistoryWriter,
+)
+from opentelemetry_demo_tpu.runtime.keyspace import (
+    KeyspaceManager,
+    process_rss_bytes,
+)
+from opentelemetry_demo_tpu.runtime.otlp import OtlpHttpReceiver
+from opentelemetry_demo_tpu.runtime.pipeline import (
+    KEYSPACE_LEVEL_COLLAPSE,
+    KEYSPACE_LEVEL_EVICT,
+    KEYSPACE_LEVEL_SHED,
+    KEYSPACE_LEVEL_THROTTLE,
+    KEYSPACE_MAX_LEVEL,
+    DetectorPipeline,
+)
+from opentelemetry_demo_tpu.runtime.query import QueryEngine, QueryError
+from opentelemetry_demo_tpu.runtime.querybench import _snapshot_fn
+from opentelemetry_demo_tpu.runtime.replication import (
+    EpochFence,
+    ReplicationStandby,
+)
+from opentelemetry_demo_tpu.runtime.tensorize import (
+    EVICTED_SLOT,
+    InternArena,
+    SpanRecord,
+    SpanTensorizer,
+)
+
+pytestmark = pytest.mark.keyspace
+
+SMALL = dict(num_services=8, hll_p=8, cms_width=512)
+
+
+# --- plumbing ---------------------------------------------------------
+
+
+def _spans(names, n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        SpanRecord(
+            service=name,
+            duration_us=float(rng.normal(300.0, 10.0)),
+            trace_id=int(rng.integers(1, 2**63)),
+            attr="P-1",
+        )
+        for name in names
+        for _ in range(n)
+    ]
+
+
+def _pipe(**kw):
+    det = AnomalyDetector(DetectorConfig(**SMALL))
+    kw.setdefault("keyspace_enable", True)
+    pipe = DetectorPipeline(det, on_report=lambda *a: None, batch_size=64, **kw)
+    return det, pipe
+
+
+class _StubWriter:
+    """Captures record_eviction calls without a store behind it."""
+
+    def __init__(self):
+        self.calls = []
+
+    def record_eviction(self, record, rec_meta, now=None):
+        self.calls.append((record, rec_meta, now))
+
+
+# --- the bounded interner (satellite 3) -------------------------------
+
+
+class TestBoundedInterner:
+    def test_saturated_intern_many_dense_and_bit_stable(self):
+        tz = SpanTensorizer(num_services=8)
+        names = [f"key-{i:02d}" for i in range(20)]
+        ids = tz.intern_many(names)
+        # Dense first-appearance ranks up to capacity, overflow after.
+        assert ids[:7] == list(range(7))
+        assert ids[7:] == [7] * 13
+        assert tz.live_keys == tz.capacity == 7
+        assert tz.overflow_assigns_total == 13
+        # Bit-stable: the same batch re-interns to the same ids, and
+        # overflow misses are RE-counted because they were never
+        # memorized (the bounded-memory contract).
+        assert tz.intern_many(names) == ids
+        assert tz.overflow_assigns_total == 26
+        # Order independence for admitted keys.
+        assert tz.intern_many(list(reversed(names[:7]))) == list(
+            reversed(range(7))
+        )
+        # The per-name path agrees with the batched path.
+        assert tz.service_id(names[3]) == 3
+        assert tz.service_id("fresh-after-saturation") == 7
+        assert "fresh-after-saturation" not in tz._svc_snapshot
+
+    def test_all_overflow_flush_roundtrips_the_frame_format(self):
+        tz = SpanTensorizer(num_services=4)
+        tz.intern_many(["a", "b", "c"])  # saturate (capacity 3)
+        cols = tz.columns_from_records(
+            [
+                SpanRecord(
+                    service=f"bomb-{i:04d}",
+                    duration_us=1.0 + i,
+                    trace_id=i + 1,
+                    attr="k",
+                )
+                for i in range(16)
+            ]
+        )
+        assert (np.asarray(cols.svc) == 3).all()  # every row: overflow
+        arrays = {k: np.asarray(getattr(cols, k)) for k in cols._fields}
+        blob = frame.encode(arrays, meta={"generation": tz.generation})
+        fr = frame.decode(blob)
+        for k, a in arrays.items():
+            np.testing.assert_array_equal(np.asarray(fr.arrays[k]), a)
+        assert fr.meta["generation"] == tz.generation
+        # The bomb memorized NOTHING: table unchanged, counts on the
+        # overflow tally (the shed-metrics source).
+        assert tz.live_keys == 3
+        assert tz.overflow_assigns_total == 16
+
+    def test_retire_recycles_ids_behind_a_generation_bump(self):
+        tz = SpanTensorizer(num_services=8)
+        tz.intern_many(["a", "b", "c"])
+        assert tz.generation == 0
+        assert tz.retire_services(["b"]) == [1]
+        assert tz.generation == 1
+        assert tz.evicted_total == 1
+        assert tz.free_ids == 1
+        assert tz.service_names[1] == EVICTED_SLOT
+        assert "b" not in tz._svc_snapshot
+        # Unknown names are a no-op — no generation churn.
+        assert tz.retire_services(["never-interned"]) == []
+        assert tz.generation == 1
+        # Freed ids recycle lowest-first; a returning evictee is a NEW
+        # key (fresh slot, fresh baseline) and assignment never bumps.
+        assert tz.service_id("d") == 1
+        assert tz.service_id("b") == 3
+        assert tz.generation == 1
+
+    def test_adopt_names_honors_tombstones_positionally(self):
+        tz = SpanTensorizer(num_services=8)
+        tz.adopt_names(["a", EVICTED_SLOT, "c"])
+        assert tz._svc_snapshot == {"a": 0, "c": 2}
+        assert tz.free_ids == 1
+        # The hole fills FIRST — restoring a post-eviction table must
+        # not re-densify around the tombstone and shift ids.
+        assert tz.service_id("d") == 1
+        assert tz.service_names[:3] == ["a", "d", "c"]
+
+    def test_arena_never_caches_overflow_and_tracks_generation(self):
+        tz = SpanTensorizer(num_services=4)
+        arena = InternArena(tz)
+        assert arena.lookup(["a", "b", "c"]) == [0, 1, 2]
+        assert arena.lookup(["late"]) == [3]  # overflow: table full
+        tz.retire_services(["b"])
+        # A cached overflow hit would pin "late" in the bucket forever;
+        # the arena re-consults and wins the freed slot instead. Its
+        # pre-eviction cache died with the generation.
+        assert arena.lookup(["late"]) == [1]
+        assert arena.lookup(["a"]) == [0]
+
+
+# --- the degradation ladder -------------------------------------------
+
+
+class TestLadder:
+    def test_two_edge_hysteresis_one_rung_per_hold(self):
+        _, pipe = _pipe(
+            keyspace_hold_s=1.0,
+            keyspace_high_watermark=0.8,
+            keyspace_low_watermark=0.5,
+        )
+        t0 = time.monotonic() + 100.0
+        # A spike saturates but does NOT move the ladder (no hold yet).
+        assert pipe.keyspace_update(0.9, now=t0) == 0
+        assert pipe.stats.keyspace_pressure_events == 1
+        # Sustained pressure climbs exactly one rung per hold.
+        assert pipe.keyspace_update(0.9, now=t0 + 1.01) == 1
+        assert pipe.keyspace_update(0.9, now=t0 + 2.02) == 2
+        assert pipe.keyspace_update(0.9, now=t0 + 3.03) == 3
+        assert pipe.keyspace_update(0.9, now=t0 + 4.04) == 4
+        assert pipe.keyspace_update(0.9, now=t0 + 9.0) == KEYSPACE_MAX_LEVEL
+        # Inside the hysteresis band (low < fill < high): still
+        # saturated — the ladder does not flap on a partial recovery.
+        assert pipe.keyspace_update(0.6, now=t0 + 10.0) == 4
+        # Below the low watermark: descend one rung per sustained hold.
+        assert pipe.keyspace_update(0.4, now=t0 + 11.0) == 4
+        assert pipe.keyspace_update(0.4, now=t0 + 12.01) == 3
+        assert pipe.keyspace_update(0.4, now=t0 + 13.02) == 2
+        assert pipe.keyspace_update(0.4, now=t0 + 14.03) == 1
+        assert pipe.keyspace_update(0.4, now=t0 + 15.04) == 0
+        assert pipe.keyspace_level == 0
+
+    def test_rss_breach_saturates_at_any_fill(self):
+        _, pipe = _pipe(keyspace_hold_s=0.0)
+        t0 = time.monotonic() + 100.0
+        assert pipe.keyspace_update(0.01, rss_over=True, now=t0) == 1
+        assert pipe.keyspace_update(0.01, rss_over=True, now=t0 + 0.1) == 2
+        # RSS recovery clears pressure even though it never touched
+        # the fill watermarks.
+        pipe.keyspace_update(0.01, rss_over=False, now=t0 + 0.2)
+        assert pipe.keyspace_update(0.01, rss_over=False, now=t0 + 0.3) <= 1
+
+    def test_throttle_rung_isolates_tenants(self):
+        _, pipe = _pipe(
+            keyspace_hold_s=0.0,
+            keyspace_newkey_rate=1.0,
+            tenant_of=lambda n: n.split(".", 1)[0],
+        )
+        t0 = time.monotonic() + 100.0
+        pipe.keyspace_update(1.0, now=t0)
+        pipe.keyspace_update(1.0, now=t0 + 0.1)
+        assert pipe.keyspace_level == KEYSPACE_LEVEL_THROTTLE
+        # Tenant A spends its one token; its NEXT new key throttles.
+        assert pipe.keyspace_newkey_gate("tA.svc-1") is True
+        assert pipe.keyspace_newkey_gate("tA.svc-2") is False
+        # Tenant B's bucket is untouched by A's spray.
+        assert pipe.keyspace_newkey_gate("tB.svc-1") is True
+        assert pipe.stats.newkey_throttled_tenant == {"tA": 1}
+
+    def test_collapse_rung_folds_new_keys_to_overflow(self):
+        _, pipe = _pipe(
+            keyspace_hold_s=0.0,
+            tenant_of=lambda n: n.split(".", 1)[0],
+        )
+        tz = pipe.tensorizer
+        # The ctor wires the gate into the tensorizer's miss path
+        # (bound methods compare equal, never `is`).
+        assert tz.new_key_gate == pipe.keyspace_newkey_gate
+        t0 = time.monotonic() + 100.0
+        for k in range(KEYSPACE_LEVEL_COLLAPSE):
+            pipe.keyspace_update(1.0, now=t0 + 0.1 * k)
+        assert pipe.keyspace_level == KEYSPACE_LEVEL_COLLAPSE
+        before = tz.overflow_assigns_total
+        # A brand-new key folds to overflow, unmemorized, counted per
+        # tenant — the key's ROWS are still admitted.
+        assert tz.service_id("tC.fresh") == tz.num_services - 1
+        assert "tC.fresh" not in tz._svc_snapshot
+        assert tz.overflow_assigns_total == before + 1
+        assert pipe.stats.overflow_keys_tenant == {"tC": 1}
+        # Existing keys never reach the gate.
+        pipe.keyspace_update(0.0, now=t0 + 10.0)  # (clear for intern)
+        pipe.keyspace_update(0.0, now=t0 + 10.1)
+        pipe.keyspace_update(0.0, now=t0 + 10.2)
+        pipe.keyspace_update(0.0, now=t0 + 10.3)
+        assert pipe.keyspace_level == 0
+        sid = tz.service_id("tC.known")
+        for k in range(KEYSPACE_LEVEL_COLLAPSE):
+            pipe.keyspace_update(1.0, now=t0 + 20.0 + 0.1 * k)
+        assert tz.service_id("tC.known") == sid
+
+    def test_shed_rung_answers_429_through_the_python_door(self):
+        _, pipe = _pipe(keyspace_hold_s=0.0, keyspace_retry_after_s=2.0)
+        t0 = time.monotonic() + 100.0
+        assert pipe.admission_retry_after() is None
+        for k in range(KEYSPACE_LEVEL_SHED):
+            pipe.keyspace_update(1.0, now=t0 + 0.1 * k)
+        assert pipe.keyspace_level == KEYSPACE_LEVEL_SHED
+        # The ladder's shed rung surfaces through the SAME admission
+        # question every door already asks — no door-side change.
+        assert pipe.admission_retry_after() == 2.0
+        rx = OtlpHttpReceiver(
+            lambda r: None, host="127.0.0.1", port=0,
+            retry_after=pipe.admission_retry_after,
+        )
+        rx.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", rx.port, timeout=10)
+            conn.request(
+                "POST", "/v1/traces", body=b"\x00" * 8,
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 429, body
+            assert int(resp.getheader("Retry-After")) == 2
+            assert rx.rejects.get("saturated") == 1
+        finally:
+            rx.stop()
+
+
+# --- the evictor ------------------------------------------------------
+
+
+class TestEvictor:
+    def _loaded_pipe(self, names=("ghost", "zombie", "keeper")):
+        det, pipe = _pipe()
+        pipe.submit(_spans(names))
+        pipe.pump(1000.0)
+        pipe.pump(1000.25)
+        return det, pipe
+
+    def test_evict_folds_zeroes_and_retires_idle_keys(self):
+        det, pipe = self._loaded_pipe()
+        tz = pipe.tensorizer
+        sids = {n: tz._svc_snapshot[n] for n in ("ghost", "zombie", "keeper")}
+        before = {
+            k: np.array(v, copy=True)
+            for k, v in (
+                (k, np.asarray(v))
+                for k, v in det.state._asdict().items()
+            )
+        }
+        writer = _StubWriter()
+        mgr = KeyspaceManager(
+            pipe, idle_s=0.0, evict_batch=8,
+            protected=("keeper",), history_writer=writer,
+        )
+        evicted = mgr.evict_idle(now=time.monotonic() + 1.0)
+        assert sorted(evicted) == ["ghost", "zombie"]
+        assert mgr.evictions == 2 and mgr.sweeps == 1
+        # The fold record carries the PRE-eviction in-progress window
+        # bank bit-identically, stamped with the PRE-bump generation
+        # and the PRE-retirement name table.
+        (record, rec_meta, _now), = writer.calls
+        np.testing.assert_array_equal(
+            record["hll_bank"], before["hll_bank"][0, 0]
+        )
+        np.testing.assert_array_equal(
+            record["lat_mean"], before["lat_mean"]
+        )
+        # CMS/span totals ride as the add-identity: their cells are
+        # shared across services and already recorded by the rungs.
+        assert not np.asarray(record["cms_bank"]).any()
+        assert rec_meta["generation"] == 0
+        assert sorted(rec_meta["evicted"]) == ["ghost", "zombie"]
+        assert "ghost" in rec_meta["service_names"]
+        # Live rows zeroed for the evictees, untouched for the keeper.
+        after_hll = np.asarray(det.state.hll_bank)
+        after_lat = np.asarray(det.state.lat_mean)
+        for name in ("ghost", "zombie"):
+            assert not after_hll[:, :, sids[name], :].any()
+            assert not after_lat[sids[name]].any()
+        np.testing.assert_array_equal(
+            after_hll[:, :, sids["keeper"], :],
+            before["hll_bank"][:, :, sids["keeper"], :],
+        )
+        # Ids retired behind ONE generation bump; slots recycle.
+        assert tz.generation == 1
+        assert tz.free_ids == 2
+        assert tz.service_id("newcomer") == min(
+            sids["ghost"], sids["zombie"]
+        )
+
+    def test_protected_and_recent_keys_survive(self):
+        det, pipe = self._loaded_pipe()
+        mgr = KeyspaceManager(pipe, idle_s=3600.0, evict_batch=8)
+        # Everything was seen moments ago: nothing is idle.
+        assert mgr.evict_idle(now=time.monotonic()) == []
+        assert pipe.tensorizer.generation == 0
+
+    def test_tick_engages_evictor_only_at_ladder_pressure(self):
+        det, pipe = self._loaded_pipe()
+        pipe.keyspace_hold_s = 0.0
+        rss = {"v": 0}
+        mgr = KeyspaceManager(
+            pipe, idle_s=0.0, evict_batch=8, rss_budget_mb=1.0,
+            rss_fn=lambda: rss["v"],
+        )
+        t0 = time.monotonic() + 100.0
+        # No pressure: the ladder stays down, the evictor stays off.
+        calm = mgr.tick(now=t0)
+        assert calm["level"] == 0 and calm["evicted"] == []
+        assert pipe.tensorizer.generation == 0
+        # RSS breach: ladder engages and the sweep evicts every idle
+        # key (idle_s=0 makes them all eligible).
+        rss["v"] = 16 << 20
+        hot = mgr.tick(now=t0 + 1.0)
+        assert hot["level"] >= KEYSPACE_LEVEL_EVICT
+        assert len(hot["evicted"]) == 3
+        assert hot["rss_bytes"] == 16 << 20
+        stats = mgr.stats()
+        assert stats["generation"] == 1
+        assert stats["sweeps"] == 1
+        assert stats["rows"] == 0
+        # Recovery: the ladder steps back down one rung per tick.
+        rss["v"] = 0
+        levels = [mgr.tick(now=t0 + 2.0 + k)["level"] for k in range(6)]
+        assert levels[-1] == 0
+
+    def test_watchdog_thread_lifecycle(self):
+        _, pipe = _pipe()
+        mgr = KeyspaceManager(pipe, interval_s=0.05)
+        assert mgr.alive()  # never started: vacuously healthy
+        mgr.start()
+        assert mgr.alive()
+        mgr.close()
+        mgr.close()  # idempotent
+
+    def test_process_rss_bytes_reads_this_process(self):
+        rss = process_rss_bytes()
+        # Linux CI: a real positive sample; elsewhere the documented 0.
+        if os.path.exists("/proc/self/status"):
+            assert rss > 10 * 1024 * 1024
+        else:
+            assert rss == 0
+
+
+# --- generation refusal ----------------------------------------------
+
+
+class TestGenerationRefusal:
+    def _arrays(self, fill=1):
+        return {
+            "hll_bank": np.full((4, 8), fill, np.uint8),
+            "cms_bank": np.full((4, 8), fill, np.int64),
+        }
+
+    def test_fleet_merge_refuses_generation_drift(self):
+        a, b = self._arrays(1), self._arrays(2)
+        merged = merge_shard_arrays(
+            a, b, dst_generation=3, src_generation=3
+        )
+        assert (merged["hll_bank"] == 2).all()  # max-merge ran
+        with pytest.raises(ShardMergeError, match="generation drift"):
+            merge_shard_arrays(a, b, dst_generation=3, src_generation=4)
+        # None = a frame minted before the lifecycle plane: compatible.
+        merge_shard_arrays(a, b, dst_generation=3, src_generation=None)
+
+    def test_replication_delta_refused_across_generations(self):
+        standby = ReplicationStandby("127.0.0.1:1", EpochFence())
+        blob = frame.encode(self._arrays(1))
+        standby._apply_snapshot(
+            {"seq": 5, "meta": {"generation": 1}, "arrays": blob}
+        )
+        assert standby.applied_seq == 5
+        # A delta from the OTHER side of an eviction sweep: refused —
+        # the stale ack makes the primary ship a full snapshot.
+        standby._apply_delta({
+            "seq": 6, "base_seq": 5,
+            "meta": {"generation": 2},
+            "arrays": frame.encode(self._arrays(9)),
+        })
+        assert standby.frames_generation_drift == 1
+        assert standby.frames_rejected == 1
+        assert standby.applied_seq == 5
+        assert (standby.arrays["hll_bank"] == 1).all()  # never merged
+        # The SAME generation applies normally.
+        standby._apply_delta({
+            "seq": 6, "base_seq": 5,
+            "meta": {"generation": 1},
+            "arrays": frame.encode(self._arrays(9)),
+        })
+        assert standby.applied_seq == 6
+        assert (standby.arrays["hll_bank"] == 9).all()
+        assert standby.stats()["frames_generation_drift"] == 1
+
+    def test_checkpoint_roundtrips_generation_and_tombstones(self, tmp_path):
+        det = AnomalyDetector(DetectorConfig(**SMALL))
+        names = ["alpha", EVICTED_SLOT, "gamma"]
+        path = str(tmp_path / "snap")
+        checkpoint.save(
+            path, det, service_names=names, generation=3,
+            dispatch_lock=None,
+        )
+        _restored, meta = checkpoint.load(path)
+        assert meta["generation"] == 3
+        assert meta["service_names"] == names
+        # The restore path the daemon uses: adopt_names keeps the hole.
+        tz = SpanTensorizer(num_services=8)
+        tz.adopt_names(meta["service_names"])
+        assert tz._svc_snapshot == {"alpha": 0, "gamma": 2}
+        assert tz.service_id("delta") == 1
+
+    def test_history_range_merges_one_generation_only(self, tmp_path):
+        store = HistoryStore(str(tmp_path))
+        writer = HistoryWriter(
+            store, snapshot_fn=lambda: ({}, {}), rungs=(1.0, 60.0)
+        )
+        rec = {
+            "hll_bank": np.ones((4, 8), np.uint8),
+            "cms_bank": np.zeros((4, 8), np.int64),
+            "span_total": np.zeros((), np.float32),
+        }
+        base = {"seq": 1, "config": [], "query": {}}
+        writer.record_eviction(
+            rec, dict(base, service_names=["old"], generation=0),
+            now=1000.0,
+        )
+        writer.record_eviction(
+            rec, dict(base, service_names=["new"], generation=1),
+            now=1001.0,
+        )
+        assert writer.evictions_recorded == 2
+        reader = HistoryReader(store, rungs=(1.0, 60.0))
+        got = reader.range_state(995.0, 1005.0)
+        assert got is not None
+        _arrays, meta = got
+        # Newest generation wins; the drifted record is counted out,
+        # never mis-merged.
+        assert meta["generation"] == 1
+        assert meta["skipped_generation"] == 1
+        assert meta["service_names"] == ["new"]
+        # Pinning the OLD generation reads the other side.
+        _arrays, meta0 = reader.range_state(995.0, 1005.0, generation=0)
+        assert meta0["service_names"] == ["old"]
+
+
+# --- evicted-key query continuity (satellite 2) -----------------------
+
+
+class TestEvictedQuery:
+    def test_evicted_key_answers_from_history(self, tmp_path):
+        det, pipe = _pipe()
+        pipe.submit(_spans(("ghost", "keeper"), n=48))
+        pipe.pump(1000.0)
+        pipe.pump(1000.25)
+        store = HistoryStore(str(tmp_path))
+        writer = HistoryWriter(
+            store, snapshot_fn=lambda: ({}, {}), rungs=(1.0, 60.0)
+        )
+        mgr = KeyspaceManager(
+            pipe, idle_s=0.0, evict_batch=8,
+            protected=("keeper",), history_writer=writer,
+        )
+        assert mgr.evict_idle(now=time.monotonic() + 1.0) == ["ghost"]
+        engine = QueryEngine(
+            snapshot_fn=_snapshot_fn(det, pipe),
+            history=HistoryReader(store, rungs=(1.0, 60.0)),
+        )
+        # The live table no longer knows "ghost" — the answer stitches
+        # from the generation that did, labeled as such.
+        got = engine.cardinality("ghost")
+        assert got["meta"]["source"] == "evicted"
+        assert got["data"]["service"] == "ghost"
+        assert got["data"]["evicted"] is True
+        assert got["data"]["overflow"] is False
+        z = engine.zscore("ghost")
+        assert z["meta"]["source"] == "evicted"
+        t = engine.topk("ghost")
+        assert t["meta"]["source"] == "evicted"
+        # A name history never saw stays an honest 404.
+        with pytest.raises(QueryError) as err:
+            engine.cardinality("never-existed")
+        assert err.value.status == 404
+        # The surviving key still answers live.
+        live = engine.cardinality("keeper")
+        assert live["meta"]["source"] == "live"
+        assert "evicted" not in live["data"]
+
+    def test_overflow_bucket_answers_are_labeled(self):
+        det, pipe = _pipe()
+        pipe.submit(_spans(("solo",), n=48))
+        pipe.pump(1000.0)
+        engine = QueryEngine(snapshot_fn=_snapshot_fn(det, pipe))
+        ns = det.config.num_services
+        # The reserved last id aggregates every unadmitted key: served,
+        # but flagged so nobody mistakes the bucket for one service.
+        over = engine.cardinality(f"svc-{ns - 1}")
+        assert over["data"]["overflow"] is True
+        assert over["meta"]["source"] == "live"
+        dense = engine.cardinality("solo")
+        assert dense["data"]["overflow"] is False
+        zs = engine.zscore(f"svc-{ns - 1}")
+        assert zs["data"]["overflow"] is True

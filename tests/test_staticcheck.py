@@ -345,6 +345,30 @@ PROVENANCE_CLEAN = {
     """,
 }
 
+EVICTION_BAD = {
+    **BASE,
+    "pkg/runtime/sweep.py": """
+        def sweep(pipeline, names):
+            tz = pipeline.tensorizer
+            with pipeline._dispatch_lock:
+                fold_and_zero(pipeline, names)
+            # BUG: retirement escaped the critical section — a flush
+            # can intern into the freed slot before the zero lands.
+            return tz.retire_services(names)
+    """,
+}
+EVICTION_CLEAN = {
+    **BASE,
+    "pkg/runtime/sweep.py": """
+        def sweep(pipeline, names):
+            tz = pipeline.tensorizer
+            with pipeline._dispatch_lock:
+                fold_and_zero(pipeline, names)
+                freed = tz.retire_services(names)
+            return freed
+    """,
+}
+
 FIXTURES = [
     ("donation-race", DONATION_BAD, DONATION_CLEAN, 1),
     ("knob-discipline", KNOBS_BAD, KNOBS_CLEAN, 2),
@@ -354,6 +378,7 @@ FIXTURES = [
     ("concurrency", CONCURRENCY_BAD, CONCURRENCY_CLEAN, 2),
     ("exception-status", STATUS_BAD, STATUS_CLEAN, 4),
     ("provenance-vocabulary", PROVENANCE_BAD, PROVENANCE_CLEAN, 4),
+    ("eviction-lock", EVICTION_BAD, EVICTION_CLEAN, 1),
 ]
 
 
